@@ -1,0 +1,1 @@
+from . import bfp, bfp_golden  # noqa: F401

@@ -1,0 +1,108 @@
+"""JAX (XLA) implementation of the BFP codec.
+
+Vectorized, jit-safe, grad-transparent (via a straight-through custom_vjp
+wrapper).  Must agree bit-for-bit with `ops.bfp_golden` — enforced by
+tests/test_bfp.py.  The Pallas kernel variant lives in `ops.bfp_pallas`.
+
+Reference semantics: hw/bf16_to_bfp_core.sv / hw/bfp_to_bf16_core.sv as
+instantiated by hw/bfp_adapter.sv:134,150,678 (see bfp_golden docstring for
+the derivation).  TPU-first choices: int8 mantissa tensors feed the wire
+(and can feed int8 MXU paths later); scales are int8 exponents so a
+compressed payload is exactly ``n + n/block`` bytes.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.config import BFPConfig
+
+
+def _blocked(x: jax.Array, block: int) -> jax.Array:
+    assert x.shape[-1] % block == 0, (x.shape, block)
+    return x.reshape(*x.shape[:-1], x.shape[-1] // block, block)
+
+
+def biased_exponent(x: jax.Array) -> jax.Array:
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    return ((bits >> 23) & 0xFF).astype(jnp.int32)
+
+
+def _exp2_int(e: jax.Array) -> jax.Array:
+    """2.0**e for int e in [-126, 127], exactly, via exponent-field bitcast."""
+    bits = ((e + 127).astype(jnp.uint32)) << 23
+    return jax.lax.bitcast_convert_type(bits, jnp.float32)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "mantissa_bits", "rounding"))
+def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
+               rounding: str = "nearest") -> Tuple[jax.Array, jax.Array]:
+    """fp32/bf16 -> (int8 mantissas [...n], int8 scale exponents [...n/B])."""
+    x = x.astype(jnp.float32)
+    xb = _blocked(x, block_size)
+    emax = jnp.max(biased_exponent(xb), axis=-1)
+    scale_exp = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    q = xb * _exp2_int(-scale_exp)[..., None]
+    q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
+    lim = float(2 ** (mantissa_bits - 1) - 1)
+    mant = jnp.clip(q, -lim, lim).astype(jnp.int8).reshape(x.shape)
+    return mant, scale_exp.astype(jnp.int8)
+
+
+@functools.partial(jax.jit, static_argnames=("block_size", "dtype"))
+def bfp_decode(mant: jax.Array, scale_exp: jax.Array, block_size: int = 16,
+               dtype=jnp.float32) -> jax.Array:
+    mb = _blocked(mant, block_size)
+    scale = _exp2_int(scale_exp.astype(jnp.int32))
+    x = mb.astype(jnp.float32) * scale[..., None]
+    return x.reshape(mant.shape).astype(dtype)
+
+
+def bfp_roundtrip(x: jax.Array, cfg: BFPConfig) -> jax.Array:
+    """decode(encode(x)) — the quantization the wire applies."""
+    mant, se = bfp_encode(x, cfg.block_size, cfg.mantissa_bits, cfg.rounding)
+    return bfp_decode(mant, se, cfg.block_size, x.dtype)
+
+
+@jax.custom_vjp
+def bfp_ste(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8):
+    """Straight-through estimator: BFP quantization in fwd, identity grad.
+
+    Lets models train *through* a simulated compressed channel (the
+    reference ships lossy compression with zero accuracy evaluation —
+    readme.pdf §3.3; this is our handle for convergence tests)."""
+    mant, se = bfp_encode(x, block_size, mantissa_bits)
+    return bfp_decode(mant, se, block_size, x.dtype)
+
+
+def _ste_fwd(x, block_size=16, mantissa_bits=8):
+    return bfp_ste(x, block_size, mantissa_bits), None
+
+
+def _ste_bwd(_, g):
+    return (g, None, None)
+
+
+bfp_ste.defvjp(_ste_fwd, _ste_bwd)
+
+
+def pad_to_block(x: jax.Array, block_size: int) -> Tuple[jax.Array, int]:
+    """Flatten + zero-pad to a block multiple (the ring engine pads vectors
+    to slice multiples the same way — hw/all_reduce.sv:403-409,428-433)."""
+    flat = x.reshape(-1)
+    pad = (-flat.shape[0]) % block_size
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, pad
+
+
+def wire_bytes(n_elems: int, cfg: BFPConfig) -> int:
+    """Bytes on the wire: mantissas + one scale byte per block
+    (ref: BFP_SIZE = EXP_SIZE + NUM_FP*MANT_SIZE, hw/bfp_adapter.sv:76)."""
+    assert n_elems % cfg.block_size == 0
+    mant_bytes = (n_elems * cfg.mantissa_bits + 7) // 8
+    return mant_bytes + n_elems // cfg.block_size

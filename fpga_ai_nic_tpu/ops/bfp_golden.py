@@ -1,0 +1,109 @@
+"""Numpy golden model of the BFP (block-floating-point) codec.
+
+Bit-for-bit the specification that every other implementation in this repo
+(JAX `ops.bfp`, Pallas `ops.bfp_pallas`, native C++ `csrc/bfp_codec.cpp`)
+must match.  The reference has no such golden model — its RTL sim golden
+compare is documented to FAIL when BFP is enabled (readme.pdf §3.3); we fix
+that by making the codec itself the spec.
+
+Semantics (derived from the reference RTL, not translated from it):
+the encoder (hw/bf16_to_bfp_core.sv:30-132 as instantiated by
+hw/bfp_adapter.sv:134 with MANTISSA_SIZE=24, then truncated to MANT_SIZE=8
+at hw/bfp_adapter.sv:150) quantizes each block of ``block_size`` fp32 values
+against the block's maximum biased exponent ``emax``:
+
+    scale_exp = emax - 127 - (mantissa_bits - 2)      # int8 two's complement
+    q_i       = round_mode(x_i * 2**(-scale_exp))     # fits in [-127, 127]
+    x̂_i      = q_i * 2**(scale_exp)                  # decode
+
+For mantissa_bits=8 this is scale_exp = emax - 133: the block maximum lands
+in [64, 127], exactly the reference's layout (implicit-1 at bit 6, one bit
+of headroom so the two's-complement negation cannot overflow —
+hw/bf16_to_bfp_core.sv:109,125).  The decoder (hw/bfp_to_bf16_core.sv:30-125)
+renormalizes via leading-zero count; in value terms it is exactly
+``q * 2**scale_exp``, which is what we implement.
+
+Deviations from the RTL (deliberate, documented):
+- zero/denormal inputs decode to exactly 0 (the RTL feeds {1'b1, frac} even
+  for exp=0, so an all-tiny block would decode garbage — known-bug class,
+  see SURVEY.md §5 "known bugs"; we do not replicate it).
+- rounding="nearest" (ties-to-even) is offered in addition to the RTL's
+  truncation ("rtz"); nearest is the default because it halves the expected
+  quantization error at identical wire cost.
+- storage is (int8 mantissa, int8 scale_exp) rather than the RTL's biased
+  uint8 shared exponent; scale_exp = shared_biased - 133 is a relabeling,
+  wire size is identical (8 bits per block either way).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def _split_blocks(x: np.ndarray, block_size: int) -> np.ndarray:
+    if x.shape[-1] % block_size != 0:
+        raise ValueError(f"last dim {x.shape[-1]} not a multiple of block {block_size}")
+    return x.reshape(*x.shape[:-1], x.shape[-1] // block_size, block_size)
+
+
+def biased_exponent(x: np.ndarray) -> np.ndarray:
+    """IEEE-754 biased exponent field of fp32 values (0..255)."""
+    bits = np.asarray(x, np.float32).view(np.uint32)
+    return ((bits >> 23) & 0xFF).astype(np.int32)
+
+
+def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
+               rounding: str = "nearest"):
+    """Encode fp32/bf16 array -> (mantissas int8[..., n], scale_exp int8[..., n/B]).
+
+    Value of element i in block b is ``mant[i] * 2.0**scale_exp[b]``.
+    """
+    x = np.asarray(x, np.float32)
+    xb = _split_blocks(x, block_size)
+    emax = biased_exponent(xb).max(axis=-1)
+    scale_exp = emax - 127 - (mantissa_bits - 2)
+    # int8-storable and ldexp-safe; blocks of subnormals quantize to 0.
+    scale_exp = np.clip(scale_exp, -126, 127).astype(np.int32)
+    inv_scale = np.ldexp(np.float32(1.0), -scale_exp).astype(np.float32)
+    q = xb * inv_scale[..., None]
+    if rounding == "nearest":
+        q = np.rint(q)
+    elif rounding == "rtz":
+        q = np.trunc(q)
+    else:
+        raise ValueError(rounding)
+    lim = float(2 ** (mantissa_bits - 1) - 1)
+    q = np.clip(q, -lim, lim)
+    mant = q.astype(np.int8).reshape(x.shape)
+    return mant, scale_exp.astype(np.int8)
+
+
+def bfp_decode(mant: np.ndarray, scale_exp: np.ndarray, block_size: int = 16,
+               dtype=np.float32) -> np.ndarray:
+    """Decode (int8 mantissas, int8 per-block scale exponents) -> float array."""
+    mb = _split_blocks(np.asarray(mant, np.int8), block_size)
+    x = mb.astype(np.float32) * np.ldexp(
+        np.float32(1.0), scale_exp.astype(np.int32))[..., None]
+    return x.reshape(mant.shape).astype(dtype)
+
+
+def max_abs_error_bound(x: np.ndarray, block_size: int = 16,
+                        mantissa_bits: int = 8) -> np.ndarray:
+    """Per-element worst-case |x - decode(encode(x))| bound.
+
+    One half ULP of the block grid for nearest, one ULP for rtz; callers
+    asserting the bound should pick the mode's factor.  Returns the grid
+    spacing 2**scale_exp per element (the "rtz" bound; halve for nearest).
+    """
+    xb = _split_blocks(np.asarray(x, np.float32), block_size)
+    emax = biased_exponent(xb).max(axis=-1)
+    scale_exp = np.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    grid = np.ldexp(np.float32(1.0), scale_exp)
+    return np.broadcast_to(grid[..., None], xb.shape).reshape(x.shape)
+
+
+def wire_bits(n_elems: int, block_size: int = 16, mantissa_bits: int = 8) -> int:
+    """Bits on the wire for n_elems values (ref frame: 136b per 16 fp32,
+    hw/bfp_adapter.sv:76 BFP_SIZE = EXP_SIZE + NUM_FP*MANT_SIZE)."""
+    assert n_elems % block_size == 0
+    return (n_elems // block_size) * (8 + block_size * mantissa_bits)

@@ -1,0 +1,17 @@
+from .config import (
+    BFPConfig,
+    CollectiveConfig,
+    MeshConfig,
+    MLPConfig,
+    OptimizerConfig,
+    TrainConfig,
+)
+
+__all__ = [
+    "BFPConfig",
+    "CollectiveConfig",
+    "MeshConfig",
+    "MLPConfig",
+    "OptimizerConfig",
+    "TrainConfig",
+]

@@ -1,0 +1,189 @@
+"""Unified configuration system.
+
+The reference scatters configuration across compile-time SystemVerilog macros
+(`BFP_EN`, hw/all_reduce.sv:12-13), SV parameters (BUF_SIZE=512, NUM_FP=16,
+MANT_SIZE=8; hw/all_reduce.sv:101-103,746), CLI positional args
+(sw/mlp_mpi_example_f32.cpp:269-296), env vars (sw/run.sh:12-15) and side
+files (hostlist / ikl_config, sw/README:1-3).  Here everything is a typed
+dataclass with a single CLI entry point (``from_flags``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from dataclasses import dataclass, field
+from typing import Any, Optional, Sequence, Tuple
+
+
+@dataclass(frozen=True)
+class BFPConfig:
+    """Block-floating-point wire format.
+
+    Mirrors the reference codec's parameterization (NUM, EXPONENT_SIZE,
+    MANTISSA_SIZE, NX_MODE — hw/bf16_to_bfp_core.sv:30-34) with TPU-friendly
+    storage: per-block int8 mantissas plus one int8 power-of-two scale
+    exponent, value = mantissa * 2**scale_exp.  With block_size=16 and 8-bit
+    mantissas this is bit-rate-identical to the reference's 136b-per-512b
+    frame (hw/bfp_adapter.sv:63-77): 3.76x over f32, 1.88x over bf16.
+
+    rounding:
+      - "nearest": round-to-nearest-even (default; better accuracy than HW)
+      - "rtz":     truncate toward zero, mirroring the RTL barrel-shifter
+                   truncation (hw/bf16_to_bfp_core.sv:108-125) for parity
+                   tests against the golden model.
+    """
+
+    block_size: int = 16          # NUM_FP (hw/all_reduce.sv:746)
+    mantissa_bits: int = 8        # MANT_SIZE (hw/all_reduce.sv:746)
+    rounding: str = "nearest"     # "nearest" | "rtz"
+
+    def __post_init__(self):
+        assert self.block_size >= 2 and self.block_size & (self.block_size - 1) == 0
+        assert 2 <= self.mantissa_bits <= 8
+        assert self.rounding in ("nearest", "rtz")
+
+    @property
+    def compression_ratio_vs_f32(self) -> float:
+        raw = 32 * self.block_size
+        packed = self.mantissa_bits * self.block_size + 8
+        return raw / packed
+
+
+@dataclass(frozen=True)
+class CollectiveConfig:
+    """All-reduce engine configuration.
+
+    slice_elems generalizes the reference's fixed 32 KiB ring slice
+    (BUF_SIZE=512 cache lines, hw/all_reduce.sv:101-103); max_inflight
+    mirrors the 8-deep collective queue with round-robin done IDs
+    (hw/all_reduce.sv:1228,1373; readme.pdf §2.1).
+
+    impl:
+      - "xla":  lax.psum_scatter / all_gather — XLA schedules and overlaps.
+      - "ring": explicit ppermute ring (the st_eth_t analogue); required for
+                on-the-wire BFP compression.
+    """
+
+    impl: str = "xla"             # "xla" | "ring"
+    compression: Optional[BFPConfig] = None
+    slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
+    max_inflight: int = 8
+
+    def __post_init__(self):
+        assert self.impl in ("xla", "ring")
+        if self.compression is not None and self.impl != "ring":
+            raise ValueError("BFP compression requires impl='ring' "
+                             "(XLA collectives cannot compress on the wire)")
+
+
+@dataclass(frozen=True)
+class OptimizerConfig:
+    """Fused optimizer. The reference hard-codes SGD lr=0.1 in RTL
+    (a = 0xBDCCCCCD = -0.1, hw/weight_update.sv:439-446); we make it a flag
+    and add momentum/adamw for the larger model configs."""
+
+    kind: str = "sgd"             # "sgd" | "momentum" | "adamw"
+    learning_rate: float = 0.1
+    momentum: float = 0.9
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    weight_decay: float = 0.0
+
+    def __post_init__(self):
+        assert self.kind in ("sgd", "momentum", "adamw")
+
+
+@dataclass(frozen=True)
+class MeshConfig:
+    """Logical device mesh. The reference supports only a 1-D ring of FPGAs
+    (data parallelism, sw/setup_route.sh); we generalize to the full
+    dp x fsdp x tp x sp x ep product over ICI."""
+
+    dp: int = 1                   # data parallel (the reference's only axis)
+    fsdp: int = 1                 # ZeRO / fully-sharded data parallel
+    tp: int = 1                   # tensor parallel
+    sp: int = 1                   # sequence/context parallel (ring attention)
+    ep: int = 1                   # expert parallel
+
+    @property
+    def nproc(self) -> int:
+        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+
+    def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
+        return (("dp", self.dp), ("fsdp", self.fsdp), ("tp", self.tp),
+                ("sp", self.sp), ("ep", self.ep))
+
+
+@dataclass(frozen=True)
+class MLPConfig:
+    """The reference benchmark model: N fully-connected layers of equal width
+    trained with softmax cross-entropy (sw/mlp_mpi_example_f32.cpp:284-296,
+    canonical 10x2048x2048 f32, sw/run.sh:16)."""
+
+    layer_sizes: Tuple[int, ...] = (2048,) * 11   # 10 layers of 2048x2048
+    num_classes: Optional[int] = None             # defaults to last width
+    dtype: str = "float32"
+    fuse_bias: bool = True
+
+    @property
+    def n_layers(self) -> int:
+        return len(self.layer_sizes) - 1
+
+
+@dataclass(frozen=True)
+class TrainConfig:
+    """Training-loop configuration (ref driver CLI: iters MB fuse_type type
+    bn bk bc C1..CN, sw/mlp_mpi_example_f32.cpp:269-296)."""
+
+    iters: int = 20               # canonical run: 20 (sw/run.sh:16)
+    global_batch: int = 5376      # canonical run: MB 5376 (sw/run.sh:16)
+    mesh: MeshConfig = field(default_factory=MeshConfig)
+    collective: CollectiveConfig = field(default_factory=CollectiveConfig)
+    optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
+    zero1: bool = True            # sharded optimizer state + fused gather
+    seed: int = 0
+
+    @property
+    def per_device_batch(self) -> int:
+        n = self.mesh.nproc
+        assert self.global_batch % n == 0, (self.global_batch, n)
+        return self.global_batch // n
+
+
+def _coerce(T: Any, v: str) -> Any:
+    if T is bool:
+        return v.lower() in ("1", "true", "yes", "on")
+    if T in (int, float, str):
+        return T(v)
+    raise TypeError(f"cannot coerce flag value {v!r} to {T}")
+
+
+def from_flags(cls, argv: Sequence[str]):
+    """Build a (possibly nested) config dataclass from --dotted.key=value
+    flags, e.g. ``from_flags(TrainConfig, ["--mesh.dp=4", "--iters=100"])``."""
+    cfg = cls()
+    for arg in argv:
+        if not arg.startswith("--"):
+            raise ValueError(f"flags must look like --key=value, got {arg!r}")
+        key, _, val = arg[2:].partition("=")
+        path = key.split(".")
+        cfg = _replace_path(cfg, path, val)
+    return cfg
+
+
+def _replace_path(cfg, path, val):
+    name, rest = path[0], path[1:]
+    fields = {f.name: f for f in dataclasses.fields(cfg)}
+    if name not in fields:
+        raise ValueError(f"unknown config field {name!r} on {type(cfg).__name__}")
+    cur = getattr(cfg, name)
+    if rest:
+        new = _replace_path(cur, rest, val)
+    elif dataclasses.is_dataclass(cur):
+        raise ValueError(f"{name} is a nested config; use --{name}.<field>=...")
+    else:
+        ftype = fields[name].type
+        new = _coerce(type(cur) if cur is not None else str, val) \
+            if not isinstance(ftype, str) or cur is not None else val
+    return dataclasses.replace(cfg, **{name: new})
