@@ -1,0 +1,74 @@
+"""The reference benchmark model: an N-layer fully-connected MLP trained
+with softmax cross-entropy (sw/mlp_mpi_example_f32.cpp:492-541 sets up
+libxsmm fc fwd/bwd + smax fwd/bwd kernels; canonical config is 10 layers of
+2048x2048 f32, sw/run.sh:16).
+
+TPU-first: we do not reimplement libxsmm's blocked GEMM (bn/bk/bc CLI knobs,
+sw/mlp_mpi_example_f32.cpp:284-296) — tiling onto the MXU is XLA's job; the
+model is plain jnp matmuls with a configurable compute dtype (bf16 keeps
+the MXU fed at full rate; f32 matches the reference numerics).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.config import MLPConfig
+
+Params = Dict[str, List[jax.Array]]
+
+
+def init(key: jax.Array, cfg: MLPConfig) -> Params:
+    sizes = cfg.layer_sizes
+    dtype = jnp.dtype(cfg.dtype)
+    ws, bs = [], []
+    for i in range(cfg.n_layers):
+        key, sub = jax.random.split(key)
+        fan_in = sizes[i]
+        w = jax.random.normal(sub, (sizes[i], sizes[i + 1]), jnp.float32)
+        ws.append((w * jnp.sqrt(2.0 / fan_in)).astype(dtype))
+        bs.append(jnp.zeros((sizes[i + 1],), dtype))
+    return {"w": ws, "b": bs}
+
+
+def apply(params: Params, x: jax.Array, cfg: MLPConfig) -> jax.Array:
+    """Forward pass -> logits. ReLU between layers, none after the last
+    (the reference fuses ReLU masks into its fc kernels; the last layer
+    feeds softmax, sw/mlp_mpi_example_f32.cpp:707-728)."""
+    dtype = jnp.dtype(cfg.dtype)
+    h = x.astype(dtype)
+    n = len(params["w"])
+    for i, (w, b) in enumerate(zip(params["w"], params["b"])):
+        h = h @ w
+        if cfg.fuse_bias:
+            h = h + b
+        if i < n - 1:
+            h = jax.nn.relu(h)
+    return h
+
+
+def softmax_xent(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean softmax cross-entropy (ref: libxsmm_dnn_smax_fwd/bwd_exec_f32,
+    sw/mlp_mpi_example_f32.cpp:718-728). labels: int class ids [B]."""
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logz, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def loss_fn(params: Params, batch, cfg: MLPConfig) -> jax.Array:
+    x, y = batch
+    return softmax_xent(apply(params, x, cfg), y)
+
+
+def flops_per_sample(cfg: MLPConfig) -> float:
+    """Reference FLOP accounting: 6*C_i*C_{i+1} per middle layer
+    (fwd 2 + bwd 2 + upd 2), 4* for layer 0 (no input-grad GEMM)
+    (sw/mlp_mpi_example_f32.cpp:794-798)."""
+    sizes = cfg.layer_sizes
+    total = 4.0 * sizes[0] * sizes[1]
+    for i in range(1, cfg.n_layers):
+        total += 6.0 * sizes[i] * sizes[i + 1]
+    return total

@@ -1,0 +1,164 @@
+"""The fused all-reduce + weight-update engine — the reference's defining
+capability, rebuilt TPU-first.
+
+Reference semantics (SURVEY.md §3.2): gradients stream through a ring
+reduce-scatter; the *reduced* gradient shard feeds a fused SGD unit holding
+the canonical weights (hw/weight_update.sv); the all-gather phase then
+distributes **updated weights**, not gradients (hw/all_reduce.sv:996-1086).
+That is exactly ZeRO-1: sharded optimizer + master weights, gather of the
+updated parameters.  On TPU we express it as
+
+    g_own   = reduce_scatter(flat_grads)        # XLA psum_scatter or BFP ring
+    w_own'  = opt(w_own, g_own / n)             # owned f32 master shard
+    params' = all_gather(cast(w_own'))          # replicated working copy
+
+inside ``shard_map``; XLA overlaps the collectives with surrounding compute
+the way the FPGA overlapped its ring with the host's backward GEMMs
+(sw/mlp_mpi_example_f32.cpp:735-787).
+
+Pytrees are flattened into one contiguous f32 vector (padded to a
+lcm(n, bfp_block) multiple) before the collective, mirroring the reference's
+treatment of the model as one long gradient stream sliced into 32 KiB
+blocks (hw/all_reduce.sv:101-103,246-253).
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from . import ring as ring_ops
+from .. import optim
+from ..utils.config import CollectiveConfig, OptimizerConfig
+
+
+class FlatMeta(NamedTuple):
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    sizes: Tuple[int, ...]
+    padded_len: int
+
+
+def _pad_multiple(coll: CollectiveConfig, n: int) -> int:
+    if coll.compression is not None:
+        # per-device chunk (padded_len / n) must be a whole number of blocks
+        return n * coll.compression.block_size
+    return n
+
+
+def flat_meta(tree, coll: CollectiveConfig, n: int) -> FlatMeta:
+    """Static flattening metadata from a pytree of arrays (or shape structs)
+    without touching device memory."""
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes = tuple(l.shape for l in leaves)
+    dtypes = tuple(l.dtype for l in leaves)
+    sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
+    total = sum(sizes)
+    m = _pad_multiple(coll, n)
+    padded = total + ((-total) % m)
+    return FlatMeta(treedef, shapes, dtypes, sizes, padded)
+
+
+def flatten_tree(tree, coll: CollectiveConfig, n: int) -> Tuple[jax.Array, FlatMeta]:
+    """Concatenate a pytree into one flat f32 vector, zero-padded so the
+    per-device chunk is a whole number of BFP blocks."""
+    meta = flat_meta(tree, coll, n)
+    leaves = jax.tree_util.tree_leaves(tree)
+    flat = jnp.concatenate([l.astype(jnp.float32).reshape(-1) for l in leaves])
+    pad = meta.padded_len - flat.shape[0]
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    return flat, meta
+
+
+def unflatten_tree(flat: jax.Array, meta: FlatMeta):
+    leaves, off = [], 0
+    for shape, dtype, size in zip(meta.shapes, meta.dtypes, meta.sizes):
+        leaves.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
+def shard_slice(flat: jax.Array, axis_name: str) -> jax.Array:
+    """This device's chunk of a replicated flat vector (natural order)."""
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    c = flat.shape[0] // n
+    return lax.dynamic_slice_in_dim(flat, idx * c, c)
+
+
+def reduce_scatter(flat_g: jax.Array, axis_name: str,
+                   coll: CollectiveConfig) -> jax.Array:
+    if coll.impl == "xla":
+        return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                tiled=True)
+    return ring_ops.ring_reduce_scatter(flat_g, axis_name,
+                                        compression=coll.compression)
+
+
+def all_gather_flat(owned: jax.Array, axis_name: str,
+                    coll: CollectiveConfig) -> jax.Array:
+    if coll.impl == "xla":
+        return lax.all_gather(owned, axis_name, tiled=True)
+    return ring_ops.ring_all_gather(owned, axis_name,
+                                    compression=coll.compression)
+
+
+def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):
+    """Plain (unfused) mean all-reduce of a gradient pytree — for training
+    loops that keep a separate optimizer.  Uses psum or the BFP ring."""
+    n = lax.axis_size(axis_name)
+    if coll.impl == "xla":
+        return jax.tree_util.tree_map(
+            lambda g: lax.psum(g, axis_name) / n, tree)
+    flat, meta = flatten_tree(tree, coll, n)
+    red = ring_ops.ring_all_reduce(flat, axis_name,
+                                   compression=coll.compression)
+    return unflatten_tree(red / n, meta)
+
+
+def fused_allreduce_update(
+    grads_tree,
+    w_own: jax.Array,
+    opt_state: optim.OptState,
+    meta: FlatMeta,
+    axis_name: str,
+    coll: CollectiveConfig,
+    opt_cfg: OptimizerConfig,
+    *,
+    reduce_mean: bool = True,
+    step=None,
+):
+    """One fused collective step inside shard_map.
+
+    grads_tree: local gradient pytree (per-device, un-reduced).
+    w_own:      this device's f32 master shard [padded_len // n].
+    Returns (new_params_tree, new_w_own, new_opt_state).
+    """
+    n = lax.axis_size(axis_name)
+    flat_g, _ = flatten_tree(grads_tree, coll, n)
+    assert flat_g.shape[0] == meta.padded_len, (flat_g.shape, meta.padded_len)
+    g_own = reduce_scatter(flat_g, axis_name, coll)
+    if reduce_mean:
+        g_own = g_own / n
+    w_new, opt_state = optim.apply(opt_cfg, w_own, g_own, opt_state, step)
+    flat_w = all_gather_flat(w_new, axis_name, coll)
+    return unflatten_tree(flat_w, meta), w_new, opt_state
+
+
+def init_master_shard(params_tree, axis_name: str, coll: CollectiveConfig,
+                      opt_cfg: OptimizerConfig):
+    """Build (w_own, opt_state, meta) from a replicated params pytree.
+    Run inside shard_map once at startup — the analogue of the reference's
+    first-iteration weight download into FPGA-local DDR (flags=1 path,
+    hw/weight_update.sv MEM_INIT, sw/mlp_mpi_example_f32.cpp:700)."""
+    n = lax.axis_size(axis_name)
+    flat_w, meta = flatten_tree(params_tree, coll, n)
+    w_own = shard_slice(flat_w, axis_name)
+    opt_state = optim.init_state(opt_cfg, w_own.shape[0])
+    return w_own, opt_state, meta

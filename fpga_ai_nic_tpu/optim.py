@@ -1,0 +1,69 @@
+"""Fused optimizers operating on flat parameter shards.
+
+The reference fuses exactly one optimizer into the collective: SGD with a
+hard-wired lr = 0.1 (FFMA constant a = 0xBDCCCCCD = -0.1,
+hw/weight_update.sv:439-452; the lrate CSR plumbing is commented out,
+hw/all_reduce.sv:616,638-642).  We keep the same fusion point — the update
+runs on the *owned shard* between reduce-scatter and all-gather — but make
+the optimizer pluggable (sgd / momentum / adamw) and the hyperparameters
+configuration, and keep master weights + state in f32 regardless of the
+compute dtype (ZeRO-1 style, per BASELINE.json config 5).
+
+State layout: a dict of flat f32 arrays with the same length as the owned
+shard, so the whole thing shards trivially over the dp axis.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .utils.config import OptimizerConfig
+
+OptState = Dict[str, jax.Array]
+
+
+def init_state(cfg: OptimizerConfig, shard_len: int) -> OptState:
+    z = lambda: jnp.zeros((shard_len,), jnp.float32)
+    if cfg.kind == "sgd":
+        return {}
+    if cfg.kind == "momentum":
+        return {"m": z()}
+    if cfg.kind == "adamw":
+        # the step count lives in TrainState.step (replicated), not here,
+        # so every state leaf is a flat shard and shards uniformly
+        return {"m": z(), "v": z()}
+    raise ValueError(cfg.kind)
+
+
+def apply(cfg: OptimizerConfig, w: jax.Array, g: jax.Array,
+          state: OptState, step=None) -> Tuple[jax.Array, OptState]:
+    """w_new = step(w, g); w, g are flat f32 shards (ref semantics:
+    w_new = -lr*g + w, hw/weight_update.sv:441-452)."""
+    w = w.astype(jnp.float32)
+    g = g.astype(jnp.float32)
+    lr = jnp.float32(cfg.learning_rate)
+    if cfg.kind == "sgd":
+        if cfg.weight_decay:
+            g = g + jnp.float32(cfg.weight_decay) * w
+        return w - lr * g, state
+    if cfg.kind == "momentum":
+        if cfg.weight_decay:
+            g = g + jnp.float32(cfg.weight_decay) * w
+        m = jnp.float32(cfg.momentum) * state["m"] + g
+        return w - lr * m, {"m": m}
+    if cfg.kind == "adamw":
+        assert step is not None, "adamw needs the (replicated) step count"
+        t = (step + 1).astype(jnp.float32)
+        b1, b2 = jnp.float32(cfg.b1), jnp.float32(cfg.b2)
+        m = b1 * state["m"] + (1 - b1) * g
+        v = b2 * state["v"] + (1 - b2) * g * g
+        mhat = m / (1 - b1 ** t)
+        vhat = v / (1 - b2 ** t)
+        upd = mhat / (jnp.sqrt(vhat) + jnp.float32(cfg.eps))
+        if cfg.weight_decay:
+            upd = upd + jnp.float32(cfg.weight_decay) * w
+        return w - lr * upd, {"m": m, "v": v}
+    raise ValueError(cfg.kind)

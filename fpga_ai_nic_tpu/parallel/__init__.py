@@ -1,0 +1,4 @@
+from .mesh import make_mesh
+from .train import DPTrainer, TrainState
+
+__all__ = ["make_mesh", "DPTrainer", "TrainState"]

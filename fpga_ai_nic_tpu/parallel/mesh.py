@@ -1,0 +1,40 @@
+"""Device mesh construction.
+
+The reference's topology layer is a static unidirectional ring of FPGAs
+configured by shell script (sw/setup_route.sh:12-40, node n -> (n+1)%N).
+On TPU the topology is the ICI fabric; we only choose the logical mesh.
+Axes: dp (data), fsdp (ZeRO), tp (tensor), sp (sequence/ring-attention),
+ep (expert) — the reference has only dp (SURVEY.md §2), the rest are the
+north-star generalizations from BASELINE.json.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+from ..utils.config import MeshConfig
+
+AXES = ("dp", "fsdp", "tp", "sp", "ep")
+
+
+def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
+    devices = list(devices if devices is not None else jax.devices())
+    n = cfg.nproc
+    if n > len(devices):
+        raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
+    sizes = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.ep]
+    arr = np.array(devices[:n]).reshape(sizes)
+    return Mesh(arr, AXES)
+
+
+def single_axis_mesh(axis: str = "dp", n: Optional[int] = None,
+                     devices: Optional[Sequence] = None) -> Mesh:
+    """1-D mesh over all (or n) devices — the reference's shape."""
+    devices = list(devices if devices is not None else jax.devices())
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(np.array(devices), (axis,))

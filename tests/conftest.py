@@ -5,16 +5,48 @@ testbench simulating a 3-FPGA ring (readme.pdf §3.2, hw/README:1).  We make
 multi-device testing first-class instead: every test runs on an 8-device
 virtual CPU mesh so ring collectives, shardings and the full train step are
 exercised without hardware.
+
+This container's sitecustomize eagerly registers the single-chip TPU (axon)
+backend before any user code runs, so mutating JAX_PLATFORMS here is too
+late — if we detect the wrong platform we re-exec pytest once with the CPU
+mesh environment.
 """
 
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8").strip()
+_ENV = {
+    "JAX_PLATFORMS": "cpu",
+    "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
+                  " --xla_force_host_platform_device_count=8").strip(),
+    "PALLAS_AXON_POOL_IPS": "",      # disable eager TPU-tunnel registration
+    "_FPGA_AI_NIC_TPU_REEXEC": "1",
+}
+
+
+def _needs_reexec() -> bool:
+    if os.environ.get("_FPGA_AI_NIC_TPU_REEXEC"):
+        return False
+    try:
+        import jax
+
+        return jax.default_backend() != "cpu" or jax.device_count() < 8
+    except Exception:
+        # a broken eagerly-registered TPU backend is exactly what the
+        # re-exec environment escapes
+        return True
+
+
+def pytest_configure(config):
+    if _needs_reexec():
+        # pytest captures at the fd level; release fds 1/2 before exec so the
+        # replacement process writes to the real terminal.
+        capman = config.pluginmanager.getplugin("capturemanager")
+        if capman is not None:
+            capman.stop_global_capturing()
+        env = dict(os.environ, **_ENV)
+        os.execvpe(sys.executable,
+                   [sys.executable, "-m", "pytest"] + sys.argv[1:], env)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

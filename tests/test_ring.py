@@ -1,0 +1,112 @@
+"""Ring collective vs golden model — the multi-instance golden compare the
+reference documents but doesn't ship (readme.pdf §3.2-3.3)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fpga_ai_nic_tpu.ops import ring, ring_golden
+from fpga_ai_nic_tpu.utils.config import BFPConfig
+
+N = 8
+L = N * 64  # per-device vector length
+
+
+def _mesh():
+    return Mesh(jax.devices()[:N], ("dp",))
+
+
+def _run_sharded(fn, shards, out_spec=P("dp")):
+    return jax.shard_map(fn, mesh=_mesh(), in_specs=P("dp", None),
+                         out_specs=out_spec)(jnp.asarray(shards))
+
+
+@pytest.fixture
+def shards(rng):
+    return (rng.standard_normal((N, L)) * 3).astype(np.float32)
+
+
+def test_reduce_scatter_uncompressed(shards):
+    got = _run_sharded(
+        lambda x: ring.ring_reduce_scatter(x[0], "dp"), shards)
+    want = ring_golden.ring_reduce_scatter(shards)
+    np.testing.assert_array_equal(np.asarray(got).reshape(N, L // N), want)
+    # and vs the plain sum (fp32 add order may differ from np.sum)
+    np.testing.assert_allclose(np.asarray(got), shards.sum(0), rtol=1e-5)
+
+
+def test_reduce_scatter_matches_psum_scatter(shards):
+    from jax import lax
+    got_ring = _run_sharded(lambda x: ring.ring_reduce_scatter(x[0], "dp"),
+                            shards)
+    got_xla = _run_sharded(
+        lambda x: lax.psum_scatter(x[0], "dp", scatter_dimension=0, tiled=True),
+        shards)
+    np.testing.assert_allclose(np.asarray(got_ring), np.asarray(got_xla),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_all_gather(shards):
+    owned = shards[:, : L // N]
+    got = jax.shard_map(
+        lambda x: ring.ring_all_gather(x[0], "dp"),
+        mesh=_mesh(), in_specs=P("dp", None), out_specs=P("dp"),
+    )(jnp.asarray(owned))
+    want = ring_golden.ring_all_gather(owned)
+    # each device reassembles the same full vector
+    np.testing.assert_array_equal(np.asarray(got).reshape(N, -1)[0], want[0])
+    assert (want == want[0]).all()
+
+
+def test_all_reduce_uncompressed(shards):
+    got = _run_sharded(lambda x: ring.ring_all_reduce(x[0], "dp")[None],
+                       shards, out_spec=P("dp", None))
+    want = ring_golden.ring_all_reduce(shards)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+@pytest.mark.parametrize("rounding", ["nearest", "rtz"])
+def test_bfp_ring_matches_golden_bitexact(shards, rounding):
+    """Per-hop compression, including error accumulation, is part of the
+    spec: JAX ring must equal the numpy golden bit for bit."""
+    cfg = BFPConfig(rounding=rounding)
+    got = _run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg)[None],
+        shards, out_spec=P("dp", None))
+    want = ring_golden.ring_all_reduce(shards, cfg)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
+def test_bfp_ring_error_bounded(shards):
+    """Compressed all-reduce error stays within the analytic bound:
+    each of n-1 reduce hops adds <= half a grid step of the running
+    partial's scale; the gather hop one more."""
+    cfg = BFPConfig()
+    got = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg)[None],
+        shards, out_spec=P("dp", None)))[0]
+    exact = shards.sum(0)
+    scale = np.abs(exact).max()
+    err = np.abs(got - exact).max()
+    # 2^-6 relative grid, N hops of accumulation, generous constant
+    assert err <= scale * (2.0 ** -6) * N, (err, scale)
+
+
+def test_bfp_ring_replicas_identical(shards):
+    cfg = BFPConfig()
+    full = np.asarray(jax.shard_map(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg)[None],
+        mesh=_mesh(), in_specs=P("dp", None), out_specs=P("dp", None),
+    )(jnp.asarray(shards)))
+    assert (full == full[0]).all()
+
+
+def test_wire_bytes_accounting():
+    cfg = BFPConfig()
+    raw = ring.wire_bytes_per_device(4096, 8, None)
+    comp = ring.wire_bytes_per_device(4096, 8, cfg)
+    assert raw == 2 * 7 * 512 * 4
+    assert abs(raw / comp - 512 / 136) < 1e-9

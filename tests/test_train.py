@@ -1,0 +1,119 @@
+"""End-to-end data-parallel training with the fused collective —
+the rebuild of the reference's MLP driver semantics
+(sw/mlp_mpi_example_f32.cpp:682-827), verified against an unfused
+reference implementation and for convergence under BFP compression."""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fpga_ai_nic_tpu.models import mlp
+from fpga_ai_nic_tpu.parallel import DPTrainer, make_mesh
+from fpga_ai_nic_tpu.utils.config import (
+    BFPConfig, CollectiveConfig, MeshConfig, MLPConfig, OptimizerConfig,
+    TrainConfig)
+
+MCFG = MLPConfig(layer_sizes=(32, 64, 64, 10), dtype="float32")
+
+
+def _cfg(**kw):
+    base = dict(
+        iters=4, global_batch=64, mesh=MeshConfig(dp=8),
+        collective=CollectiveConfig(), optimizer=OptimizerConfig())
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(rng, n=64):
+    x = rng.standard_normal((n, 32)).astype(np.float32)
+    w_true = rng.standard_normal((32, 10)).astype(np.float32)
+    y = (x @ w_true).argmax(-1).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _loss_fn(params, batch):
+    return mlp.loss_fn(params, batch, MCFG)
+
+
+def _make(cfg, rng):
+    mesh = make_mesh(cfg.mesh)
+    tr = DPTrainer(_loss_fn, mesh, cfg)
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    state = tr.init_state(params)
+    batch = tr.shard_batch(_data(rng))
+    return tr, state, batch
+
+
+def _reference_sgd_step(params, batch, lr):
+    """Unfused reference: full-batch gradient + plain SGD on full params."""
+    grads = jax.grad(_loss_fn)(params, batch)
+    return jax.tree_util.tree_map(
+        lambda w, g: (w.astype(jnp.float32) - lr * g.astype(jnp.float32)
+                      ).astype(w.dtype), params, grads)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring"])
+def test_fused_step_matches_unfused_reference(rng, impl):
+    cfg = _cfg(collective=CollectiveConfig(impl=impl))
+    tr, state, batch = _make(cfg, rng)
+    state2, loss = tr.step(state, batch)
+    want = _reference_sgd_step(
+        mlp.init(jax.random.PRNGKey(0), MCFG), batch,
+        cfg.optimizer.learning_rate)
+    for got_w, want_w in zip(state2.params["w"], want["w"]):
+        np.testing.assert_allclose(np.asarray(got_w), np.asarray(want_w),
+                                   rtol=2e-5, atol=2e-6)
+    assert np.isfinite(float(loss))
+
+
+@pytest.mark.parametrize("kind", ["momentum", "adamw"])
+def test_optimizers_run_and_descend(rng, kind):
+    cfg = _cfg(optimizer=OptimizerConfig(kind=kind, learning_rate=1e-2))
+    tr, state, batch = _make(cfg, rng)
+    losses = []
+    for _ in range(8):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+
+
+def test_bfp_compressed_training_converges(rng):
+    cfg = _cfg(collective=CollectiveConfig(impl="ring",
+                                           compression=BFPConfig()))
+    tr, state, batch = _make(cfg, rng)
+    losses = []
+    for _ in range(10):
+        state, loss = tr.step(state, batch)
+        losses.append(float(loss))
+    assert losses[-1] < 0.7 * losses[0], losses
+
+
+def test_ring_impl_close_to_xla_impl():
+    s_by_impl = {}
+    for impl in ("xla", "ring"):
+        cfg = _cfg(collective=CollectiveConfig(impl=impl))
+        tr, state, batch = _make(cfg, np.random.default_rng(0))
+        for _ in range(3):
+            state, _ = tr.step(state, batch)
+        s_by_impl[impl] = state
+    for a, b in zip(s_by_impl["xla"].params["w"], s_by_impl["ring"].params["w"]):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_master_shard_is_true_zero1(rng):
+    """Optimizer state + master weights live sharded: each device holds
+    1/n of the flat parameter vector."""
+    cfg = _cfg(optimizer=OptimizerConfig(kind="adamw"))
+    tr, state, batch = _make(cfg, rng)
+    total = sum(int(np.prod(w.shape)) for w in jax.tree_util.tree_leaves(state.params))
+    pad_len = tr._meta.padded_len
+    assert state.w_own.shape[0] == pad_len  # global view of sharded array
+    shard_shapes = {s.data.shape for s in state.w_own.addressable_shards}
+    assert shard_shapes == {(pad_len // 8,)}
+    for leaf in jax.tree_util.tree_leaves(state.opt_state):
+        assert {s.data.shape for s in leaf.addressable_shards} == {(pad_len // 8,)}
