@@ -1,0 +1,73 @@
+// Native host-side BFP codec — bit-for-bit identical to the Python golden
+// model (fpga_ai_nic_tpu/ops/bfp_golden.py), which is the repo's codec spec
+// (derived from hw/bf16_to_bfp_core.sv / hw/bfp_to_bf16_core.sv as
+// instantiated by hw/bfp_adapter.sv; see the golden model's docstring).
+//
+// Role: the host-runtime equivalent of the reference's C++ layer — used for
+// checkpoint (de)compression off the hot path and as an independent parity
+// check against the numpy/JAX/Pallas implementations in tests.
+//
+// Build: make -C fpga_ai_nic_tpu/csrc   (produces libbfp_codec.so)
+// ABI: plain C, loaded via ctypes (fpga_ai_nic_tpu/runtime/native.py).
+
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+
+namespace {
+
+inline int32_t biased_exp(float v) {
+  uint32_t bits;
+  std::memcpy(&bits, &v, sizeof(bits));
+  return static_cast<int32_t>((bits >> 23) & 0xFF);
+}
+
+inline int32_t clampi(int32_t v, int32_t lo, int32_t hi) {
+  return v < lo ? lo : (v > hi ? hi : v);
+}
+
+}  // namespace
+
+extern "C" {
+
+// rounding: 0 = nearest-even (rintf), 1 = truncate toward zero (rtz).
+// n must be a multiple of block. mant: n int8; scale: n/block int8.
+void bfp_encode_f32(const float* x, int64_t n, int32_t block,
+                    int32_t mant_bits, int32_t rounding, int8_t* mant,
+                    int8_t* scale) {
+  const float lim = static_cast<float>((1 << (mant_bits - 1)) - 1);
+  const int64_t nblocks = n / block;
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < nblocks; ++b) {
+    const float* xb = x + b * block;
+    int32_t emax = 0;
+    for (int32_t i = 0; i < block; ++i) {
+      int32_t e = biased_exp(xb[i]);
+      if (e > emax) emax = e;
+    }
+    int32_t scale_exp = clampi(emax - 127 - (mant_bits - 2), -126, 127);
+    const float inv_scale = std::ldexp(1.0f, -scale_exp);
+    for (int32_t i = 0; i < block; ++i) {
+      float q = xb[i] * inv_scale;
+      q = rounding == 0 ? std::rint(q) : std::trunc(q);
+      if (q > lim) q = lim;
+      if (q < -lim) q = -lim;
+      mant[b * block + i] = static_cast<int8_t>(q);
+    }
+    scale[b] = static_cast<int8_t>(scale_exp);
+  }
+}
+
+void bfp_decode_f32(const int8_t* mant, const int8_t* scale, int64_t n,
+                    int32_t block, float* out) {
+  const int64_t nblocks = n / block;
+#pragma omp parallel for schedule(static)
+  for (int64_t b = 0; b < nblocks; ++b) {
+    const float s = std::ldexp(1.0f, static_cast<int32_t>(scale[b]));
+    for (int32_t i = 0; i < block; ++i) {
+      out[b * block + i] = static_cast<float>(mant[b * block + i]) * s;
+    }
+  }
+}
+
+}  // extern "C"

@@ -40,6 +40,43 @@ from __future__ import annotations
 import numpy as np
 
 
+LANES = 128  # TPU vector-register lane count (the "sublane" layout's stride)
+
+
+def _to_blocks(x: np.ndarray, block_size: int, layout: str) -> np.ndarray:
+    """Partition into [n_blocks, block_size].
+
+    layout="flat16":  consecutive elements form a block — the reference's
+      grouping (one 512-bit beat of 16 fp32, hw/bfp_adapter.sv:129-131).
+    layout="sublane": elements stride LANES apart form a block — the TPU
+      hardware word: in a (block_size, 128) vector tile each *lane column*
+      is one block, so the block max is a sublane reduction on the VPU.
+      Used by the Pallas kernel (ops/bfp_pallas.py); same rate, same error
+      bounds, different partition.  Scale order: block (tile b, lane l) is
+      at index b*LANES + l.
+    """
+    if layout == "flat16":
+        return _split_blocks(x, block_size)
+    if layout == "sublane":
+        if x.ndim != 1 or x.shape[0] % (block_size * LANES) != 0:
+            raise ValueError(
+                f"sublane layout needs a flat vector divisible by "
+                f"{block_size * LANES}, got {x.shape}")
+        return x.reshape(-1, block_size, LANES).transpose(0, 2, 1).reshape(
+            -1, block_size)
+    raise ValueError(layout)
+
+
+def _from_blocks(blocks: np.ndarray, shape, block_size: int,
+                 layout: str) -> np.ndarray:
+    """Inverse of _to_blocks: back to the original element order/shape.
+    flat16 keeps leading batch dims ([..., nb, bs]); sublane is flat-only."""
+    if layout == "flat16":
+        return blocks.reshape(shape)
+    return blocks.reshape(-1, LANES, block_size).transpose(0, 2, 1).reshape(
+        shape)
+
+
 def _split_blocks(x: np.ndarray, block_size: int) -> np.ndarray:
     if x.shape[-1] % block_size != 0:
         raise ValueError(f"last dim {x.shape[-1]} not a multiple of block {block_size}")
@@ -53,13 +90,14 @@ def biased_exponent(x: np.ndarray) -> np.ndarray:
 
 
 def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
-               rounding: str = "nearest"):
-    """Encode fp32/bf16 array -> (mantissas int8[..., n], scale_exp int8[..., n/B]).
-
-    Value of element i in block b is ``mant[i] * 2.0**scale_exp[b]``.
+               rounding: str = "nearest", layout: str = "flat16"):
+    """Encode fp32/bf16 array -> (mantissas int8 [x.shape], scale_exp int8
+    [n/B]).  Value of element i in block b is ``mant[i] * 2.0**scale_exp[b]``.
+    Mantissas keep the input element order for every layout; only the
+    block *membership* (and hence the scale array order) depends on layout.
     """
     x = np.asarray(x, np.float32)
-    xb = _split_blocks(x, block_size)
+    xb = _to_blocks(x, block_size, layout)
     emax = biased_exponent(xb).max(axis=-1)
     scale_exp = emax - 127 - (mantissa_bits - 2)
     # int8-storable and ldexp-safe; blocks of subnormals quantize to 0.
@@ -74,17 +112,19 @@ def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
         raise ValueError(rounding)
     lim = float(2 ** (mantissa_bits - 1) - 1)
     q = np.clip(q, -lim, lim)
-    mant = q.astype(np.int8).reshape(x.shape)
+    mant = _from_blocks(q.astype(np.int8), x.shape, block_size, layout)
     return mant, scale_exp.astype(np.int8)
 
 
 def bfp_decode(mant: np.ndarray, scale_exp: np.ndarray, block_size: int = 16,
-               dtype=np.float32) -> np.ndarray:
+               dtype=np.float32, layout: str = "flat16") -> np.ndarray:
     """Decode (int8 mantissas, int8 per-block scale exponents) -> float array."""
-    mb = _split_blocks(np.asarray(mant, np.int8), block_size)
-    x = mb.astype(np.float32) * np.ldexp(
-        np.float32(1.0), scale_exp.astype(np.int32))[..., None]
-    return x.reshape(mant.shape).astype(dtype)
+    mb = _to_blocks(np.asarray(mant, np.int8), block_size, layout)
+    scale = scale_exp.astype(np.int32)
+    if layout == "sublane":
+        scale = scale.reshape(-1)
+    x = mb.astype(np.float32) * np.ldexp(np.float32(1.0), scale)[..., None]
+    return _from_blocks(x, mant.shape, block_size, layout).astype(dtype)
 
 
 def max_abs_error_bound(x: np.ndarray, block_size: int = 16,

@@ -1,0 +1,128 @@
+"""Pallas TPU kernels for the BFP codec.
+
+The reference implements the codec as a fully-pipelined RTL datapath:
+exponent max-tree (hw/max_u.sv), per-lane barrel shift
+(hw/barrel_shifter.sv), two's-complement pack (hw/bf16_to_bfp_core.sv:109),
+and an LZC-based renormalizing decoder (hw/bfp_to_bf16_core.sv).  On TPU the
+same dataflow maps onto the VPU: the kernel views the flat vector as
+(tiles, block_size, 128) so each *lane column* of a (block_size, 128) tile
+is one BFP block — the block max is a sublane reduction, and shift/round
+becomes a scale-multiply (the "sublane" layout of ops.bfp_golden, which is
+the bit-level spec these kernels must match; see tests/test_bfp_pallas.py).
+
+Fusing encode (exponent extract -> block max -> scale -> round -> int8) into
+one VMEM pass matters because the codec sits on the collective's critical
+path: at HBM-bandwidth ~1 byte/flop there is no headroom for the 4+
+materialized intermediates the XLA version produces.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128
+_DEF_TILES = 64  # (64, 16, 128) f32 tiles = 512 KiB per grid step in VMEM
+
+
+def _is_tpu() -> bool:
+    return jax.devices()[0].platform in ("tpu", "axon")
+
+
+def _encode_kernel(x_ref, mant_ref, scale_ref, *, mantissa_bits, rounding):
+    x = x_ref[:]                                   # (T, B, 128) f32
+    bits = pltpu.bitcast(x, jnp.uint32)
+    e = jnp.right_shift(bits, 23).astype(jnp.int32) & 0xFF
+    emax = jnp.max(e, axis=1, keepdims=True)       # (T, 1, 128)
+    scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    inv = pltpu.bitcast(((127 - scale_e) << 23).astype(jnp.uint32),
+                        jnp.float32)               # 2.0**-scale_e, exact
+    q = x * inv
+    q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
+    lim = float(2 ** (mantissa_bits - 1) - 1)
+    mant_ref[:] = jnp.clip(q, -lim, lim).astype(jnp.int8)
+    scale_ref[:] = scale_e[:, 0, :].astype(jnp.int8)
+
+
+def _decode_kernel(mant_ref, scale_ref, out_ref):
+    m = mant_ref[:].astype(jnp.float32)            # (T, B, 128)
+    se = scale_ref[:].astype(jnp.int32)[:, None, :]
+    scale = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
+    out_ref[:] = m * scale
+
+
+def _grid(n_tiles: int, block_size: int, tiles_per_step: int):
+    t = min(tiles_per_step, n_tiles)
+    while n_tiles % t:
+        t -= 1
+    return t, n_tiles // t
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "block_size", "mantissa_bits", "rounding", "interpret", "tiles_per_step"))
+def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
+               rounding: str = "nearest", interpret: Optional[bool] = None,
+               tiles_per_step: int = _DEF_TILES
+               ) -> Tuple[jax.Array, jax.Array]:
+    """Flat f32/bf16 [N] (N % (block*128) == 0) -> (int8 [N], int8 [N/block])
+    in the "sublane" layout (bit-identical to
+    ``bfp_golden.bfp_encode(..., layout="sublane")``)."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    n = x.shape[0]
+    assert n % (block_size * LANES) == 0, (n, block_size * LANES)
+    x3 = x.astype(jnp.float32).reshape(-1, block_size, LANES)
+    t, steps = _grid(x3.shape[0], block_size, tiles_per_step)
+    kern = functools.partial(_encode_kernel, mantissa_bits=mantissa_bits,
+                             rounding=rounding)
+    mant, scale = pl.pallas_call(
+        kern,
+        grid=(steps,),
+        in_specs=[pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM)],
+        out_specs=[
+            pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct(x3.shape, jnp.int8),
+            jax.ShapeDtypeStruct((x3.shape[0], LANES), jnp.int8),
+        ],
+        interpret=interpret,
+    )(x3)
+    return mant.reshape(n), scale.reshape(n // block_size)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "block_size", "dtype", "interpret", "tiles_per_step"))
+def bfp_decode(mant: jax.Array, scale: jax.Array, block_size: int = 16,
+               dtype=jnp.float32, interpret: Optional[bool] = None,
+               tiles_per_step: int = _DEF_TILES) -> jax.Array:
+    if interpret is None:
+        interpret = not _is_tpu()
+    n = mant.shape[0]
+    m3 = mant.reshape(-1, block_size, LANES)
+    s2 = scale.reshape(-1, LANES)
+    t, steps = _grid(m3.shape[0], block_size, tiles_per_step)
+    out = pl.pallas_call(
+        _decode_kernel,
+        grid=(steps,),
+        in_specs=[
+            pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((t, LANES), lambda i: (i, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct(m3.shape, jnp.float32),
+        interpret=interpret,
+    )(m3, s2)
+    return out.reshape(n).astype(dtype)

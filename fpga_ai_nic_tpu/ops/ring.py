@@ -149,5 +149,5 @@ def wire_bytes_per_device(L: int, n: int,
     elems = 2 * (n - 1) * (L // n)
     if compression is None:
         return elems * dtype_bytes
-    per_block = compression.mantissa_bits * compression.block_size + 8
-    return (elems // compression.block_size) * per_block // 8
+    from .bfp import wire_bytes
+    return wire_bytes(elems, compression)

@@ -115,6 +115,37 @@ class DPTrainer:
     def step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         return self.step_fn(state, batch)
 
+    # -- restore ------------------------------------------------------------
+
+    def params_from_master(self, w_own: jax.Array):
+        """Rebuild the replicated working params from the sharded f32 master
+        vector — the checkpoint-restore analogue of the fused step's gather
+        phase.  Needed because checkpoints persist only the master shards."""
+        meta = self._meta
+        assert meta is not None, "call init_state first (defines the layout)"
+        coll, ax = self.cfg.collective, self.ax
+
+        def _gather(w):
+            flat = fused_update.all_gather_flat(w, ax, coll)
+            return fused_update.unflatten_tree(flat, meta)
+
+        return jax.jit(jax.shard_map(
+            _gather, mesh=self.mesh, in_specs=P(self.ax), out_specs=P(),
+            check_vma=False))(w_own)
+
+    def restore_state(self, restored: dict) -> TrainState:
+        """TrainState from a Checkpointer.restore() payload."""
+        w_own = jax.device_put(
+            jnp.asarray(restored["w_own"]),
+            NamedSharding(self.mesh, P(self.ax)))
+        opt_state = {
+            k: jax.device_put(jnp.asarray(v),
+                              NamedSharding(self.mesh, P(self.ax)))
+            for k, v in restored["opt_state"].items()}
+        return TrainState(
+            params=self.params_from_master(w_own), w_own=w_own,
+            opt_state=opt_state, step=jnp.asarray(restored["step"]))
+
     # -- data ---------------------------------------------------------------
 
     def shard_batch(self, batch):

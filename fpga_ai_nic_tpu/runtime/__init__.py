@@ -1,0 +1,4 @@
+from .queue import CollectiveQueue, Ticket
+from . import native  # noqa: F401
+
+__all__ = ["CollectiveQueue", "Ticket", "native"]

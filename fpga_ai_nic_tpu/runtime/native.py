@@ -1,0 +1,85 @@
+"""ctypes loader for the native host codec (csrc/bfp_codec.cpp).
+
+The reference's host runtime is C++ (sw/mlp_mpi_example_f32.cpp + OPAE
+wrapper); our host-native piece is the BFP codec used for checkpoint
+compression and as an independent parity implementation.  Loading degrades
+gracefully: ``lib()`` returns None when the .so is absent and cannot be
+built, and callers fall back to the numpy golden model.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
+_SO = os.path.join(_DIR, "libbfp_codec.so")
+_lib = None
+_tried = False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """Load (building on first use if needed) the native codec library."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        l = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    l.bfp_encode_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int32,
+        ctypes.c_int32, ctypes.c_int32, ctypes.POINTER(ctypes.c_int8),
+        ctypes.POINTER(ctypes.c_int8)]
+    l.bfp_decode_f32.argtypes = [
+        ctypes.POINTER(ctypes.c_int8), ctypes.POINTER(ctypes.c_int8),
+        ctypes.c_int64, ctypes.c_int32, ctypes.POINTER(ctypes.c_float)]
+    _lib = l
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
+               rounding: str = "nearest") -> Tuple[np.ndarray, np.ndarray]:
+    l = lib()
+    assert l is not None, "native codec unavailable (csrc build failed)"
+    x = np.ascontiguousarray(x, np.float32)
+    n = x.size
+    assert n % block_size == 0
+    mant = np.empty(n, np.int8)
+    scale = np.empty(n // block_size, np.int8)
+    l.bfp_encode_f32(
+        x.ctypes.data_as(ctypes.POINTER(ctypes.c_float)), n, block_size,
+        mantissa_bits, 0 if rounding == "nearest" else 1,
+        mant.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        scale.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)))
+    return mant.reshape(x.shape), scale
+
+
+def bfp_decode(mant: np.ndarray, scale: np.ndarray,
+               block_size: int = 16) -> np.ndarray:
+    l = lib()
+    assert l is not None, "native codec unavailable (csrc build failed)"
+    mant = np.ascontiguousarray(mant, np.int8)
+    scale = np.ascontiguousarray(scale, np.int8)
+    out = np.empty(mant.size, np.float32)
+    l.bfp_decode_f32(
+        mant.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        scale.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
+        mant.size, block_size,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)))
+    return out.reshape(mant.shape)

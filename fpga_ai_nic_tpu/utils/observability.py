@@ -1,0 +1,80 @@
+"""Observability: per-collective latency, stall attribution, wire counters.
+
+The reference exports hardware counters over CSRs — per-collective active
+cycles (`lpbk_latency`, hw/all_reduce.sv:92, read back at
+sw/mlp_mpi_example_f32.cpp:100-106), stall attribution by cause
+(`stall_host_in/out`, `stall_eth_in/out`, hw/all_reduce.sv:94-97), request
+counters and BFP flit counters (hw/bfp_adapter.sv:705-729), plus a
+DETAILED_PROFILE wall-clock bucket breakdown in the driver
+(sw/mlp_mpi_example_f32.cpp:236-244,702-750).
+
+On TPU the runtime hides queues, so stall attribution comes from the
+issue/wait timeline (SURVEY.md §5): time blocked inside ``wait`` is
+network-bound ("stall_collective"), time between a ticket's issue and its
+wait call is overlapped compute ("overlap"), and wire bytes come from the
+collective config, not sniffing.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+from collections import defaultdict
+from contextlib import contextmanager
+from dataclasses import dataclass, field
+from typing import Dict, List
+
+
+@dataclass
+class CollectiveStats:
+    issued: int = 0
+    completed: int = 0
+    wire_bytes: int = 0
+    raw_bytes: int = 0
+    latency_s: List[float] = field(default_factory=list)   # issue -> ready
+    stall_s: float = 0.0      # blocked inside wait()  ("network-bound")
+    overlap_s: float = 0.0    # issue->wait gap        ("compute overlapped")
+
+    def as_dict(self) -> Dict:
+        lat = self.latency_s
+        return {
+            "issued": self.issued,
+            "completed": self.completed,
+            "wire_bytes": self.wire_bytes,
+            "raw_bytes": self.raw_bytes,
+            "compression_ratio": (self.raw_bytes / self.wire_bytes
+                                  if self.wire_bytes else 1.0),
+            "mean_latency_ms": (sum(lat) / len(lat) * 1e3) if lat else 0.0,
+            "max_latency_ms": max(lat) * 1e3 if lat else 0.0,
+            "stall_s": self.stall_s,
+            "overlap_s": self.overlap_s,
+        }
+
+
+class Profiler:
+    """Named wall-clock buckets (DETAILED_PROFILE equivalent) + collective
+    stats. One instance per trainer/queue; cheap enough to leave on."""
+
+    def __init__(self):
+        self.buckets: Dict[str, float] = defaultdict(float)
+        self.counts: Dict[str, int] = defaultdict(int)
+        self.collectives = CollectiveStats()
+
+    @contextmanager
+    def bucket(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.buckets[name] += time.perf_counter() - t0
+            self.counts[name] += 1
+
+    def report(self) -> Dict:
+        return {
+            "buckets_s": dict(self.buckets),
+            "counts": dict(self.counts),
+            "collectives": self.collectives.as_dict(),
+        }
+
+    def json_line(self) -> str:
+        return json.dumps(self.report())

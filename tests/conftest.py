@@ -15,10 +15,13 @@ mesh environment.
 import os
 import sys
 
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
 _ENV = {
     "JAX_PLATFORMS": "cpu",
-    "XLA_FLAGS": (os.environ.get("XLA_FLAGS", "") +
-                  " --xla_force_host_platform_device_count=8").strip(),
+    "XLA_FLAGS": _flags,
     "PALLAS_AXON_POOL_IPS": "",      # disable eager TPU-tunnel registration
     "_FPGA_AI_NIC_TPU_REEXEC": "1",
 }

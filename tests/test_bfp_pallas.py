@@ -1,0 +1,58 @@
+"""Pallas codec vs the sublane-layout golden model (bit-exact), plus the
+layout-equivalence property (same error bounds as flat16)."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from fpga_ai_nic_tpu.ops import bfp_golden, bfp_pallas
+
+N = 16 * 128 * 10  # ten (16,128) tiles
+
+
+@pytest.mark.parametrize("rounding", ["nearest", "rtz"])
+def test_pallas_encode_matches_sublane_golden(rng, rounding):
+    x = (rng.standard_normal(N) * 4).astype(np.float32)
+    x[::13] = 0.0
+    gm, gs = bfp_golden.bfp_encode(x, 16, 8, rounding, layout="sublane")
+    pm, ps = bfp_pallas.bfp_encode(jnp.asarray(x), rounding=rounding)
+    np.testing.assert_array_equal(gm, np.asarray(pm))
+    np.testing.assert_array_equal(gs, np.asarray(ps))
+
+
+def test_pallas_decode_matches_sublane_golden(rng):
+    x = (rng.standard_normal(N) * 4).astype(np.float32)
+    gm, gs = bfp_golden.bfp_encode(x, 16, 8, layout="sublane")
+    want = bfp_golden.bfp_decode(gm, gs, 16, layout="sublane")
+    got = bfp_pallas.bfp_decode(jnp.asarray(gm), jnp.asarray(gs))
+    np.testing.assert_array_equal(want, np.asarray(got))
+
+
+def test_pallas_roundtrip_error_bound(rng):
+    x = (rng.standard_normal(N) * 100).astype(np.float32)
+    m, s = bfp_pallas.bfp_encode(jnp.asarray(x))
+    xhat = np.asarray(bfp_pallas.bfp_decode(m, s))
+    # per-block half-grid bound, blocks in sublane order
+    xb = x.reshape(-1, 16, 128)
+    emax = bfp_golden.biased_exponent(xb).max(axis=1)
+    grid = np.ldexp(np.float32(1.0), np.clip(emax - 133, -126, 127))
+    err = np.abs((x - xhat).reshape(-1, 16, 128))
+    # half grid for interior lanes + up to one grid where the max lane
+    # clips at 127 (q in (127.5, 128) rounds to 128 then clips)
+    assert np.all(err <= 1.0 * grid[:, None, :] + 1e-45)
+
+
+def test_sublane_layout_same_rate_as_flat16(rng):
+    x = (rng.standard_normal(N)).astype(np.float32)
+    m1, s1 = bfp_golden.bfp_encode(x, layout="flat16")
+    m2, s2 = bfp_golden.bfp_encode(x, layout="sublane")
+    assert m1.size == m2.size and s1.size == s2.size
+
+
+def test_4bit_mantissa(rng):
+    x = (rng.standard_normal(N)).astype(np.float32)
+    m, s = bfp_pallas.bfp_encode(jnp.asarray(x), mantissa_bits=4)
+    gm, gs = bfp_golden.bfp_encode(x, 16, 4, layout="sublane")
+    np.testing.assert_array_equal(gm, np.asarray(m))
+    np.testing.assert_array_equal(gs, np.asarray(s))

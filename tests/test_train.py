@@ -112,6 +112,7 @@ def test_master_shard_is_true_zero1(rng):
     tr, state, batch = _make(cfg, rng)
     total = sum(int(np.prod(w.shape)) for w in jax.tree_util.tree_leaves(state.params))
     pad_len = tr._meta.padded_len
+    assert pad_len >= total and pad_len % 8 == 0
     assert state.w_own.shape[0] == pad_len  # global view of sharded array
     shard_shapes = {s.data.shape for s in state.w_own.addressable_shards}
     assert shard_shapes == {(pad_len // 8,)}
