@@ -45,7 +45,8 @@ void bfp_encode_f32(const float* x, int64_t n, int32_t block,
       int32_t e = biased_exp(xb[i]);
       if (e > emax) emax = e;
     }
-    int32_t scale_exp = clampi(emax - 127 - (mant_bits - 2), -126, 127);
+    // [-126, 126]: both 2^s and 2^-s stay normal fp32 (see bfp_golden.py)
+    int32_t scale_exp = clampi(emax - 127 - (mant_bits - 2), -126, 126);
     const float inv_scale = std::ldexp(1.0f, -scale_exp);
     for (int32_t i = 0; i < block; ++i) {
       float q = xb[i] * inv_scale;

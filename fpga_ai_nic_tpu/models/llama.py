@@ -1,0 +1,236 @@
+"""Llama-3-family decoder, written for explicit mesh parallelism.
+
+BASELINE.json config 5 ("Llama-3 8B ZeRO-1 ... BFP optimizer-state
+compression") is the north-star; the reference itself has no transformer —
+this model exists to exercise the framework's parallel axes at scale:
+
+- tp: attention heads and FFN hidden are column/row sharded; row-parallel
+  projections end in one ``lax.psum`` over the tp axis (Megatron-style,
+  expressed directly in the model because shard_map makes collectives
+  first-class, the way the reference made its ring explicit in RTL).
+- sp: the sequence axis is sharded; attention runs `ops.ring_attention`
+  (K/V blocks rotating the ring) and RoPE positions are offset per shard.
+- dp/ZeRO-1: handled outside by the trainer (`parallel.sharded`).
+
+Functional pytree params, like models.mlp.  GQA, RMSNorm, SwiGLU, RoPE.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from ..ops.ring_attention import full_attention, ring_attention
+
+
+@dataclass(frozen=True)
+class LlamaConfig:
+    vocab: int = 128256
+    dim: int = 4096
+    n_layers: int = 32
+    n_heads: int = 32
+    n_kv_heads: int = 8
+    ffn_dim: int = 14336
+    rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def llama3_8b() -> "LlamaConfig":
+        return LlamaConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 256, dim: int = 64, n_layers: int = 2,
+             n_heads: int = 4, n_kv_heads: int = 2, ffn_dim: int = 128,
+             dtype: str = "float32") -> "LlamaConfig":
+        return LlamaConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                           n_heads=n_heads, n_kv_heads=n_kv_heads,
+                           ffn_dim=ffn_dim, dtype=dtype)
+
+
+def init(key: jax.Array, cfg: LlamaConfig) -> Dict:
+    """Global (unsharded) parameter pytree; shard with param_specs."""
+    dt = jnp.dtype(cfg.dtype)
+    D, Hd = cfg.dim, cfg.head_dim
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * jnp.sqrt(1.0 / fan_in)).astype(dt)
+
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 7))
+    params = {
+        "tok_emb": dense(next(keys), D, (cfg.vocab, D)),
+        "final_norm": jnp.ones((D,), dt),
+        "lm_head": dense(next(keys), D, (D, cfg.vocab)),
+        "layers": [],
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "attn_norm": jnp.ones((D,), dt),
+            "wq": dense(next(keys), D, (D, cfg.n_heads * Hd)),
+            "wk": dense(next(keys), D, (D, cfg.n_kv_heads * Hd)),
+            "wv": dense(next(keys), D, (D, cfg.n_kv_heads * Hd)),
+            "wo": dense(next(keys), cfg.n_heads * Hd, (cfg.n_heads * Hd, D)),
+            "mlp_norm": jnp.ones((D,), dt),
+            "w1": dense(next(keys), D, (D, cfg.ffn_dim)),
+            "w3": dense(next(keys), D, (D, cfg.ffn_dim)),
+            "w2": dense(next(keys), cfg.ffn_dim, (cfg.ffn_dim, D)),
+        })
+    return params
+
+
+def param_specs(cfg: LlamaConfig, tp_axis: str = "tp") -> Dict:
+    """PartitionSpecs: Megatron column/row sharding over the tp axis."""
+    col, row, rep = P(None, tp_axis), P(tp_axis, None), P()
+    layer = {"attn_norm": rep, "wq": col, "wk": col, "wv": col, "wo": row,
+             "mlp_norm": rep, "w1": col, "w3": col, "w2": row}
+    return {"tok_emb": rep, "final_norm": rep, "lm_head": col,
+            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+
+
+def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    scale = jax.lax.rsqrt(jnp.mean(xf * xf, axis=-1, keepdims=True) + eps)
+    return (xf * scale).astype(x.dtype) * w
+
+
+def _rope(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+    """x: [B, H, S, dh]; pos: [S] global token positions (rotate-half)."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]     # [S, half]
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def _psum_if(x: jax.Array, axis: Optional[str]) -> jax.Array:
+    return lax.psum(x, axis) if axis is not None else x
+
+
+def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
+          tp_axis: Optional[str] = None,
+          sp_axis: Optional[str] = None,
+          gather_logits: bool = True) -> jax.Array:
+    """tokens [B, S_local] -> logits [B, S_local, vocab] (vocab/tp when
+    gather_logits=False under tp).
+
+    Call inside shard_map with params pre-sharded per ``param_specs`` when
+    tp_axis is set; sequence shards must be contiguous when sp_axis is set.
+    """
+    B, S = tokens.shape
+    Hd = cfg.head_dim
+    n_heads = cfg.n_heads
+    n_kv = cfg.n_kv_heads
+    if tp_axis is not None:
+        tp = lax.axis_size(tp_axis)
+        if n_heads % tp or n_kv % tp:
+            raise ValueError(
+                f"tp={tp} must divide n_heads={n_heads} and "
+                f"n_kv_heads={n_kv} (kv-head replication not implemented)")
+        n_heads //= tp
+        n_kv //= tp
+    sp_off = (lax.axis_index(sp_axis) * S) if sp_axis is not None else 0
+    pos = sp_off + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+
+    x = params["tok_emb"][tokens]                       # [B, S, D]
+    for lyr in params["layers"]:
+        h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
+        q = (h @ lyr["wq"]).reshape(B, S, n_heads, Hd).transpose(0, 2, 1, 3)
+        k = (h @ lyr["wk"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+        v = (h @ lyr["wv"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+        q = _rope(q, pos, cfg.rope_theta)
+        k = _rope(k, pos, cfg.rope_theta)
+        if n_kv != n_heads:                             # GQA: expand kv heads
+            rep = n_heads // n_kv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
+        if sp_axis is not None:
+            att = ring_attention(q, k, v, sp_axis, causal=True)
+        else:
+            att = full_attention(q, k, v, causal=True)
+        att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)
+        x = x + _psum_if(att @ lyr["wo"], tp_axis)
+
+        h = _rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
+        gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
+        ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
+        x = x + _psum_if(ff, tp_axis)
+
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]                      # [B, S, V/tp]
+    if tp_axis is not None and gather_logits:
+        logits = lax.all_gather(logits, tp_axis, axis=2, tiled=True)
+    return logits
+
+
+def _vocab_parallel_nll(logits: jax.Array, labels: jax.Array,
+                        tp_axis: str) -> jax.Array:
+    """Per-token NLL from vocab-sharded logits [B, S, V/tp] without
+    gathering — Megatron-style distributed softmax cross-entropy.
+
+    Every reduction over the vocab runs through psum/pmax, so the result is
+    tp-invariant: each rank holds ONE copy of the loss and vma-typed
+    autodiff counts each rank's logit shard exactly once.  (Computing the
+    loss redundantly from all-gathered logits double-counts every gradient
+    by a factor of tp — the all_gather transpose sums the identical
+    per-rank cotangents.)
+    """
+    lf = logits.astype(jnp.float32)
+    Vl = lf.shape[-1]
+    off = lax.axis_index(tp_axis) * Vl
+    # stability shift only — it cancels in the softmax gradient, and pmax
+    # has no differentiation rule anyway
+    m = lax.pmax(lax.stop_gradient(jnp.max(lf, axis=-1)), tp_axis)  # [B, S]
+    z = lax.psum(jnp.sum(jnp.exp(lf - m[..., None]), axis=-1), tp_axis)
+    local = labels - off
+    in_range = (local >= 0) & (local < Vl)
+    safe = jnp.clip(local, 0, Vl - 1)
+    tgt = jnp.take_along_axis(lf, safe[..., None], axis=-1)[..., 0]
+    tgt = lax.psum(jnp.where(in_range, tgt, 0.0), tp_axis)      # [B, S]
+    return jnp.log(z) + m - tgt
+
+
+def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
+            tp_axis: Optional[str] = None,
+            sp_axis: Optional[str] = None) -> jax.Array:
+    """Next-token cross-entropy.  batch = (tokens, labels), both [B, S_local]
+    — labels are the globally-shifted targets (shift crosses sequence-shard
+    boundaries, so the data pipeline provides them; -100 entries are
+    ignored)."""
+    tokens, labels = batch
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    if tp_axis is not None:
+        logits = apply(params, tokens, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                       gather_logits=False)
+        nll = _vocab_parallel_nll(logits, safe, tp_axis)
+    else:
+        logits = apply(params, tokens, cfg, sp_axis=sp_axis)
+        logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        nll = -jnp.take_along_axis(logz, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    count = jnp.maximum(jnp.sum(valid), 1)
+    loss = jnp.sum(nll) / count
+    if sp_axis is not None:
+        # token-weighted global mean over sequence shards
+        loss = lax.psum(loss * count, sp_axis) / lax.psum(count, sp_axis)
+    return loss
+
+
+def num_params(cfg: LlamaConfig) -> int:
+    D, Hd = cfg.dim, cfg.head_dim
+    per_layer = (2 * D + D * cfg.n_heads * Hd + 2 * D * cfg.n_kv_heads * Hd
+                 + cfg.n_heads * Hd * D + 3 * D * cfg.ffn_dim)
+    return cfg.vocab * D * 2 + D + cfg.n_layers * per_layer

@@ -45,7 +45,7 @@ def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
     x = x.astype(jnp.float32)
     xb = _blocked(x, block_size)
     emax = jnp.max(biased_exponent(xb), axis=-1)
-    scale_exp = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    scale_exp = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
     q = xb * _exp2_int(-scale_exp)[..., None]
     q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
     lim = float(2 ** (mantissa_bits - 1) - 1)

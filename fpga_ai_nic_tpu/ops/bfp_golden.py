@@ -100,8 +100,11 @@ def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
     xb = _to_blocks(x, block_size, layout)
     emax = biased_exponent(xb).max(axis=-1)
     scale_exp = emax - 127 - (mantissa_bits - 2)
-    # int8-storable and ldexp-safe; blocks of subnormals quantize to 0.
-    scale_exp = np.clip(scale_exp, -126, 127).astype(np.int32)
+    # [-126, 126]: int8-storable, exactly representable as a NORMAL fp32 on
+    # both encode (2^-s) and decode (2^s) sides — +-127 would need a
+    # subnormal reciprocal, which exponent-bitcast implementations (Pallas,
+    # C++) cannot form.  Blocks of subnormals quantize to 0.
+    scale_exp = np.clip(scale_exp, -126, 126).astype(np.int32)
     inv_scale = np.ldexp(np.float32(1.0), -scale_exp).astype(np.float32)
     q = xb * inv_scale[..., None]
     if rounding == "nearest":
@@ -137,7 +140,7 @@ def max_abs_error_bound(x: np.ndarray, block_size: int = 16,
     """
     xb = _split_blocks(np.asarray(x, np.float32), block_size)
     emax = biased_exponent(xb).max(axis=-1)
-    scale_exp = np.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    scale_exp = np.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
     grid = np.ldexp(np.float32(1.0), scale_exp)
     return np.broadcast_to(grid[..., None], xb.shape).reshape(x.shape)
 

@@ -39,7 +39,7 @@ def _encode_kernel(x_ref, mant_ref, scale_ref, *, mantissa_bits, rounding):
     bits = pltpu.bitcast(x, jnp.uint32)
     e = jnp.right_shift(bits, 23).astype(jnp.int32) & 0xFF
     emax = jnp.max(e, axis=1, keepdims=True)       # (T, 1, 128)
-    scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 127)
+    scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
     inv = pltpu.bitcast(((127 - scale_e) << 23).astype(jnp.uint32),
                         jnp.float32)               # 2.0**-scale_e, exact
     q = x * inv

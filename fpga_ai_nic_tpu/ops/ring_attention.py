@@ -1,0 +1,106 @@
+"""Ring attention: exact attention over sequence shards with K/V blocks
+rotating a unidirectional device ring.
+
+The reference has no attention (MLP only — SURVEY.md §5 "long-context:
+not present"), but its defining dataflow — stream a neighbor's block in,
+combine locally, forward it on (hw/all_reduce.sv st_eth_t REDUCE/FORWARD
+states) — is exactly the ring-attention schedule: each hop, the local query
+block attends to the visiting K/V block with a numerically-stable online
+softmax (flash-attention accumulation), while the K/V payload moves to the
+next neighbor over ``lax.ppermute``.  XLA overlaps the permute with the
+local attention compute the way the FPGA overlapped wire and adders.
+
+Causal masking uses global token positions, so the result is bit-equivalent
+to full attention on the unsharded sequence (up to fp reassociation).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e30)   # "minus infinity" that survives exp() safely
+
+
+def _block_attend(q, k, v, q_pos, k_pos, m, l, o, sm_scale, causal):
+    """One online-softmax accumulation step against a visiting K/V block.
+
+    q: [B,H,Sq,dh]; k,v: [B,H,Sk,dh]; positions: [Sq]/[Sk];
+    m,l: [B,H,Sq,1] running max / normalizer; o: [B,H,Sq,dh] running output.
+    """
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * sm_scale
+    if causal:
+        mask = k_pos[None, :] > q_pos[:, None]           # [Sq, Sk]
+        s = jnp.where(mask[None, None], _NEG, s)
+    m_blk = jnp.max(s, axis=-1, keepdims=True)           # [B,H,Sq,1]
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)                           # rescale old state
+    p = jnp.exp(s - m_new)                               # [B,H,Sq,Sk]
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                   v.astype(jnp.float32),
+                                   preferred_element_type=jnp.float32)
+    return m_new, l_new, o_new
+
+
+def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
+                   *, causal: bool = True,
+                   sm_scale: Optional[float] = None) -> jax.Array:
+    """Sequence-parallel exact attention inside ``shard_map``.
+
+    q, k, v: [B, H, S_local, dh] — the local sequence shard; shards are
+    contiguous: device i holds global positions [i*S_local, (i+1)*S_local).
+    Returns [B, H, S_local, dh] in q's dtype.
+    """
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, S, dh = q.shape
+    if sm_scale is None:
+        sm_scale = dh ** -0.5
+    qf = q.astype(jnp.float32)
+    q_pos = idx * S + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+
+    # hop 0: attend the local block first (a causal token always sees
+    # itself, so the row max is finite and the carry enters the ring loop
+    # already device-varying — no variance-cast ops needed)
+    m0 = jnp.full((B, H, S, 1), _NEG, jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
+    o0 = jnp.zeros((B, H, S, dh), jnp.float32)
+    m, l, o = _block_attend(qf, k.astype(jnp.float32), v, q_pos, q_pos,
+                            m0, l0, o0, sm_scale, causal)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop(s_i, carry):
+        m, l, o, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (idx - s_i) % n                 # whose K/V we hold this hop
+        k_pos = src * S + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+        m, l, o = _block_attend(qf, kc.astype(jnp.float32),
+                                vc, q_pos, k_pos, m, l, o, sm_scale, causal)
+        return m, l, o, kc, vc
+
+    m, l, o, _, _ = lax.fori_loop(1, n, hop, (m, l, o, k, v), unroll=True)
+    # rows with no visible keys (can't happen causally: a token sees itself)
+    l = jnp.where(l == 0, 1.0, l)
+    return (o / l).astype(q.dtype)
+
+
+def full_attention(q, k, v, *, causal=True, sm_scale=None):
+    """Unsharded reference implementation (the golden model for tests)."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    S = q.shape[2]
+    if causal:
+        pos = lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+        s = jnp.where((pos[None, :] > pos[:, None])[None, None], _NEG, s)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)
+                      ).astype(q.dtype)

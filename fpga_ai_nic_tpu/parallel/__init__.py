@@ -1,4 +1,6 @@
 from .mesh import make_mesh
+from .sharded import ShardedState, ShardedTrainer
 from .train import DPTrainer, TrainState
 
-__all__ = ["make_mesh", "DPTrainer", "TrainState"]
+__all__ = ["make_mesh", "DPTrainer", "TrainState",
+           "ShardedTrainer", "ShardedState"]

@@ -1,0 +1,167 @@
+"""Multi-axis sharded trainer: dp x tp x sp with ZeRO-1 over dp.
+
+Generalizes `parallel.train.DPTrainer` (the reference's shape: pure DP,
+SURVEY.md §2) to the full mesh the BASELINE configs demand:
+
+- tp: params arrive tp-sharded per the model's ``param_specs``; the model
+  itself closes its row-parallel sums with ``psum(tp)``.
+- sp: batch sequence axis sharded; gradients are partial per sequence shard
+  and are summed over sp before the weight update.
+- dp: batch axis sharded; the fused ZeRO-1 collective (reduce-scatter ->
+  optimizer on owned f32 master shard -> all-gather of updated weights)
+  runs over dp, per tp shard.
+
+Master/optimizer state layout: one flat f32 vector per tp shard, sharded
+over dp — a global 1-D array of length tp * padded_len with spec
+P(("tp", "dp")).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .. import optim
+from ..ops import fused_update
+from ..utils.config import TrainConfig
+
+
+class ShardedState(NamedTuple):
+    params: Any            # tp-sharded working weights (model dtype)
+    w_own: jax.Array       # [tp * padded_len] f32, spec P(("tp","dp"))
+    opt_state: Any
+    step: jax.Array
+
+
+def _axis_factor(spec_entry, mesh: Mesh) -> int:
+    if spec_entry is None:
+        return 1
+    names = spec_entry if isinstance(spec_entry, tuple) else (spec_entry,)
+    f = 1
+    for nm in names:
+        f *= mesh.shape[nm]
+    return f
+
+
+def local_shape_tree(tree, specs, mesh: Mesh):
+    """ShapeDtypeStructs of the per-device shards given PartitionSpecs."""
+    def one(leaf, spec):
+        shape = list(leaf.shape)
+        for d, entry in enumerate(spec):
+            shape[d] //= _axis_factor(entry, mesh)
+        return jax.ShapeDtypeStruct(tuple(shape), leaf.dtype)
+
+    return jax.tree_util.tree_map(one, tree, specs,
+                                  is_leaf=lambda x: isinstance(x, P))
+
+
+class ShardedTrainer:
+    """loss_fn(params_local, batch_local) -> scalar, already closed over the
+    model's tp/sp axis names.  batch leaves are [global_batch, global_seq]
+    and shard as P(dp, sp)."""
+
+    def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
+                 param_specs, *, dp_axis: str = "dp", tp_axis: str = "tp",
+                 sp_axis: str = "sp"):
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.cfg = cfg
+        self.param_specs = param_specs
+        self.dp, self.tp, self.sp = dp_axis, tp_axis, sp_axis
+        self.n_dp = mesh.shape[dp_axis]
+        self._meta = None
+
+    # -- init ---------------------------------------------------------------
+
+    def shard_params(self, params):
+        return jax.tree_util.tree_map(
+            lambda x, s: jax.device_put(x, NamedSharding(self.mesh, s)),
+            params, self.param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+
+    def init_state(self, params) -> ShardedState:
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        params = self.shard_params(params)
+        local = local_shape_tree(params, self.param_specs, self.mesh)
+        self._meta = fused_update.flat_meta(local, coll, self.n_dp)
+        self.__dict__.pop("step_fn", None)
+        meta, dp = self._meta, self.dp
+
+        def _init(p):
+            w_own, opt_state, _ = fused_update.init_master_shard(
+                p, dp, coll, opt_cfg)
+            return w_own, opt_state
+
+        w_own, opt_state = jax.jit(jax.shard_map(
+            _init, mesh=self.mesh, in_specs=(self.param_specs,),
+            out_specs=P((self.tp, self.dp)), check_vma=False))(params)
+        return ShardedState(params=params, w_own=w_own, opt_state=opt_state,
+                            step=jnp.zeros((), jnp.int32))
+
+    # -- step ---------------------------------------------------------------
+
+    @functools.cached_property
+    def step_fn(self):
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        meta = self._meta
+        assert meta is not None, "call init_state first"
+        dp, tp, sp = self.dp, self.tp, self.sp
+        n_sp = self.mesh.shape[sp]
+
+        # Phase 1 runs with check_vma=True: differentiating THROUGH
+        # collectives (tp psum, sp loss reduction, ring-attention ppermute)
+        # is only sound with variance tracking on — with it, the transposes
+        # of auto-inserted pvary ops ARE the tp/sp gradient reductions.
+        # (check_vma=False silently corrupts those gradients.)
+        def shard_update(params, w_own, opt_state, step, batch):
+            # dp goes varying BEFORE grad so the dp reduction stays manual
+            # (reduce-scatter, fusible, compressible); sp and tp stay as-is
+            # so vma-typed autodiff inserts exactly the right psums for
+            # sequence shards and tp-replicated params.
+            params_v = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, dp, to="varying"), params)
+            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            flat_g, _ = fused_update.flatten_tree(grads, coll, self.n_dp)
+            g_own = fused_update.reduce_scatter(flat_g, dp, coll) / self.n_dp
+            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
+                                            opt_state, step)
+            loss = lax.pmean(loss, dp)
+            loss = lax.pmean(loss, tp)     # numerically identity; clears vma
+            if n_sp == 1:
+                loss = lax.pmean(loss, sp)  # loss_fn psums sp when n_sp > 1
+            return w_new, opt_state2, loss
+
+        # Phase 2 (no autodiff): gather updated weights back to the
+        # tp-sharded replicated working copy.
+        def shard_gather(w_new):
+            flat_w = fused_update.all_gather_flat(w_new, dp, coll)
+            return fused_update.unflatten_tree(flat_w, meta)
+
+        def _step(state: ShardedState, batch):
+            w_own, opt_state, loss = jax.shard_map(
+                shard_update, mesh=self.mesh,
+                in_specs=(self.param_specs, P((tp, dp)), P((tp, dp)), P(),
+                          P(dp, sp)),
+                out_specs=(P((tp, dp)), P((tp, dp)), P()),
+            )(state.params, state.w_own, state.opt_state, state.step, batch)
+            new_params = jax.shard_map(
+                shard_gather, mesh=self.mesh, in_specs=P((tp, dp)),
+                out_specs=self.param_specs, check_vma=False)(w_own)
+            return ShardedState(new_params, w_own, opt_state,
+                                state.step + 1), loss
+
+        return jax.jit(_step, donate_argnums=(0,))
+
+    def step(self, state: ShardedState, batch) -> Tuple[ShardedState, jax.Array]:
+        return self.step_fn(state, batch)
+
+    def shard_batch(self, batch):
+        spec = P(self.dp, self.sp)
+        return jax.tree_util.tree_map(
+            lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch)

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from .. import optim
 from ..ops import fused_update
 from ..utils.config import TrainConfig
 
@@ -93,21 +94,40 @@ class DPTrainer:
         assert meta is not None, "call init_state first"
         ax = self.ax
 
-        def _step(state: TrainState, batch):
-            def shard_step(params, w_own, opt_state, step, batch):
-                loss, grads = jax.value_and_grad(self.loss_fn)(params, batch)
-                new_params, w_own, opt_state = fused_update.fused_allreduce_update(
-                    grads, w_own, opt_state, meta, ax, coll, opt_cfg,
-                    step=step)
-                loss = lax.pmean(loss, ax)
-                return new_params, w_own, opt_state, loss
+        # Phase 1 (check_vma=True): gradients + reduce-scatter + optimizer.
+        # Variance tracking must stay ON anywhere jax.grad runs inside
+        # shard_map — with check_vma=False the transposes of collectives
+        # inside the loss are silently wrong.
+        def shard_update(params, w_own, opt_state, step, batch):
+            # Cast params dp-varying BEFORE grad: otherwise vma-typed
+            # autodiff auto-inserts a full psum over dp for every gradient
+            # (params are dp-invariant), which both double-counts once we
+            # reduce-scatter and forfeits the fused-ring/BFP wire path.
+            params_v = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, ax, to="varying"), params)
+            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
+            g_own = fused_update.reduce_scatter(flat_g, ax, coll) / self.n
+            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
+                                            opt_state, step)
+            return w_new, opt_state2, lax.pmean(loss, ax)
 
-            new_params, w_own, opt_state, loss = jax.shard_map(
-                shard_step, mesh=self.mesh,
+        # Phase 2 (no autodiff): all-gather updated weights -> replicated
+        # working params (the reference's host write-back of w_new,
+        # hw/all_reduce.sv:1286-1311).
+        def shard_gather(w_new):
+            flat_w = fused_update.all_gather_flat(w_new, ax, coll)
+            return fused_update.unflatten_tree(flat_w, meta)
+
+        def _step(state: TrainState, batch):
+            w_own, opt_state, loss = jax.shard_map(
+                shard_update, mesh=self.mesh,
                 in_specs=(P(), P(ax), P(ax), P(), P(ax)),
-                out_specs=(P(), P(ax), P(ax), P()),
-                check_vma=False,
+                out_specs=(P(ax), P(ax), P()),
             )(state.params, state.w_own, state.opt_state, state.step, batch)
+            new_params = jax.shard_map(
+                shard_gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
+                check_vma=False)(w_own)
             return TrainState(new_params, w_own, opt_state, state.step + 1), loss
 
         return jax.jit(_step, donate_argnums=(0,))

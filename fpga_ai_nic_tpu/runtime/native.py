@@ -58,8 +58,11 @@ def bfp_encode(x: np.ndarray, block_size: int = 16, mantissa_bits: int = 8,
     l = lib()
     assert l is not None, "native codec unavailable (csrc build failed)"
     x = np.ascontiguousarray(x, np.float32)
+    if x.shape[-1] % block_size != 0:
+        # same blocking rule as the golden model: blocks never span rows
+        raise ValueError(
+            f"last dim {x.shape[-1]} not a multiple of block {block_size}")
     n = x.size
-    assert n % block_size == 0
     mant = np.empty(n, np.int8)
     scale = np.empty(n // block_size, np.int8)
     l.bfp_encode_f32(

@@ -88,7 +88,7 @@ class CollectiveQueue:
             pass
         st = self.profiler.collectives
         st.completed += 1
-        st.latency_s.append(now - ticket.issued_at)
+        st.record_latency(now - ticket.issued_at)
         st.stall_s += now - t0                    # network-bound time
         st.overlap_s += t0 - ticket.issued_at     # compute overlapped
         return ticket.result

@@ -13,6 +13,7 @@ Two layers:
 from __future__ import annotations
 
 import os
+import re
 from typing import Any, Dict, Optional
 
 import jax
@@ -97,6 +98,11 @@ class Checkpointer:
         return tree
 
     def latest_step(self) -> Optional[int]:
-        steps = [int(d.split("_")[1]) for d in os.listdir(self.directory)
-                 if d.startswith("step_")]
+        # ignore orbax atomic-write temp dirs (step_N.orbax-checkpoint-tmp-*)
+        # left behind by an interrupted save — this is the crash-recovery path
+        steps = []
+        for d in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)", d)
+            if m:
+                steps.append(int(m.group(1)))
         return max(steps) if steps else None

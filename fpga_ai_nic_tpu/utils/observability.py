@@ -31,12 +31,18 @@ class CollectiveStats:
     completed: int = 0
     wire_bytes: int = 0
     raw_bytes: int = 0
-    latency_s: List[float] = field(default_factory=list)   # issue -> ready
+    # running latency aggregates (O(1) memory — safe for million-step runs)
+    latency_sum_s: float = 0.0
+    latency_max_s: float = 0.0
     stall_s: float = 0.0      # blocked inside wait()  ("network-bound")
     overlap_s: float = 0.0    # issue->wait gap        ("compute overlapped")
 
+    def record_latency(self, seconds: float) -> None:
+        self.latency_sum_s += seconds
+        self.latency_max_s = max(self.latency_max_s, seconds)
+
     def as_dict(self) -> Dict:
-        lat = self.latency_s
+        n = self.completed
         return {
             "issued": self.issued,
             "completed": self.completed,
@@ -44,8 +50,8 @@ class CollectiveStats:
             "raw_bytes": self.raw_bytes,
             "compression_ratio": (self.raw_bytes / self.wire_bytes
                                   if self.wire_bytes else 1.0),
-            "mean_latency_ms": (sum(lat) / len(lat) * 1e3) if lat else 0.0,
-            "max_latency_ms": max(lat) * 1e3 if lat else 0.0,
+            "mean_latency_ms": (self.latency_sum_s / n * 1e3) if n else 0.0,
+            "max_latency_ms": self.latency_max_s * 1e3,
             "stall_s": self.stall_s,
             "overlap_s": self.overlap_s,
         }
