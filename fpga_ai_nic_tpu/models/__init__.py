@@ -1,1 +1,3 @@
 from . import mlp  # noqa: F401
+from . import llama  # noqa: F401
+from . import resnet  # noqa: F401

@@ -204,11 +204,22 @@ def _vocab_parallel_nll(logits: jax.Array, labels: jax.Array,
 
 def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
             tp_axis: Optional[str] = None,
-            sp_axis: Optional[str] = None) -> jax.Array:
+            sp_axis: Optional[str] = None,
+            dp_axis: Optional[str] = None) -> jax.Array:
     """Next-token cross-entropy.  batch = (tokens, labels), both [B, S_local]
     — labels are the globally-shifted targets (shift crosses sequence-shard
     boundaries, so the data pipeline provides them; -100 entries are
-    ignored)."""
+    ignored).
+
+    Pass dp_axis when training under a dp-sharded trainer with masked
+    labels: the trainers average gradients uniformly over dp
+    (reduce_scatter/n), which mis-weights tokens when shards hold unequal
+    valid-token counts.  With dp_axis set, the loss *value* is the exact
+    global token-weighted mean and the *gradient* carries an n_dp factor
+    that cancels the trainer's /n_dp — so the effective update is the true
+    global-mean gradient.  (With uniformly valid labels the two coincide
+    and dp_axis may be omitted.)
+    """
     tokens, labels = batch
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
@@ -221,11 +232,21 @@ def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
         logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
         nll = -jnp.take_along_axis(logz, safe[..., None], axis=-1)[..., 0]
     nll = jnp.where(valid, nll, 0.0)
-    count = jnp.maximum(jnp.sum(valid), 1)
-    loss = jnp.sum(nll) / count
-    if sp_axis is not None:
-        # token-weighted global mean over sequence shards
-        loss = lax.psum(loss * count, sp_axis) / lax.psum(count, sp_axis)
+    local_sum = jnp.sum(nll)
+    count = jnp.sum(valid)
+    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+    if not axes:
+        return local_sum / jnp.maximum(count, 1)
+    total = lax.psum(local_sum, axes)             # token-weighted global sum
+    denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
+    loss = total / denom
+    if dp_axis is not None:
+        # value: global mean (dp/sp-invariant).  gradient: scaled by n_dp so
+        # the trainer's uniform mean over dp (reduce_scatter / n_dp) yields
+        # the exact global token-weighted gradient.
+        n_dp = lax.axis_size(dp_axis)
+        loss = lax.stop_gradient(loss) + (
+            n_dp * (total - lax.stop_gradient(total)) / denom)
     return loss
 
 

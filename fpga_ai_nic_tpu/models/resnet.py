@@ -1,0 +1,232 @@
+"""ResNet-50 for data-parallel training with the fused collective —
+BASELINE.json config 3 ("ResNet-50 DP with fused SGD").
+
+The reference has no conv nets (MLP only, sw/mlp_mpi_example_f32.cpp); this
+model exists to exercise the framework's DP + fused scatter-update-gather
+path on a conv workload, per the north-star configs.
+
+TPU-first choices:
+- NHWC layout + HWIO filters — the layouts XLA lowers to MXU convolutions
+  without transposes.
+- Batch norm is *sync-BN over the dp axis* in train mode (lax.pmean of
+  batch moments inside shard_map): with per-device batches split N ways
+  (the reference's MB = global_MB / n_procs, sw/mlp_mpi_example_f32.cpp:301)
+  this reproduces single-device numerics exactly.
+- Running statistics are not threaded through the gradient step (they are
+  non-gradient state; the fused ZeRO-1 update streams one flat *gradient*
+  vector, SURVEY.md §3.2).  Eval stats come from `compute_stats`, an EMA
+  calibration pass — the standard functional-JAX split.
+
+Functional pytree params, like models.mlp / models.llama.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@dataclass(frozen=True)
+class ResNetConfig:
+    stage_sizes: Tuple[int, ...] = (3, 4, 6, 3)   # ResNet-50
+    width: int = 64                               # stem / stage-0 bottleneck
+    num_classes: int = 1000
+    dtype: str = "bfloat16"
+    bn_eps: float = 1e-5
+    bn_momentum: float = 0.9
+
+    @staticmethod
+    def resnet50() -> "ResNetConfig":
+        return ResNetConfig()
+
+    @staticmethod
+    def tiny(stage_sizes=(1, 1), width=8, num_classes=10,
+             dtype="float32") -> "ResNetConfig":
+        return ResNetConfig(stage_sizes=tuple(stage_sizes), width=width,
+                            num_classes=num_classes, dtype=dtype)
+
+
+# -- init --------------------------------------------------------------------
+
+def _conv_init(key, kh, kw, cin, cout, dtype):
+    fan_in = kh * kw * cin
+    w = jax.random.normal(key, (kh, kw, cin, cout), jnp.float32)
+    return (w * jnp.sqrt(2.0 / fan_in)).astype(dtype)
+
+
+def _bn_init(c, dtype):
+    return {"scale": jnp.ones((c,), dtype), "bias": jnp.zeros((c,), dtype)}
+
+
+def _block_widths(cfg: ResNetConfig, stage: int) -> Tuple[int, int]:
+    """(bottleneck width, output width) of a stage."""
+    w = cfg.width * (2 ** stage)
+    return w, 4 * w
+
+
+def init(key: jax.Array, cfg: ResNetConfig) -> Dict:
+    dt = jnp.dtype(cfg.dtype)
+    keys = iter(jax.random.split(key, 4 + 16 * sum(cfg.stage_sizes)))
+    params = {
+        "stem": {"conv": _conv_init(next(keys), 7, 7, 3, cfg.width, dt),
+                 "bn": _bn_init(cfg.width, dt)},
+        "stages": [],
+    }
+    cin = cfg.width
+    for s, n_blocks in enumerate(cfg.stage_sizes):
+        mid, cout = _block_widths(cfg, s)
+        blocks = []
+        for b in range(n_blocks):
+            blk = {
+                "conv1": _conv_init(next(keys), 1, 1, cin, mid, dt),
+                "bn1": _bn_init(mid, dt),
+                "conv2": _conv_init(next(keys), 3, 3, mid, mid, dt),
+                "bn2": _bn_init(mid, dt),
+                "conv3": _conv_init(next(keys), 1, 1, mid, cout, dt),
+                "bn3": _bn_init(cout, dt),
+            }
+            if b == 0:
+                blk["proj"] = _conv_init(next(keys), 1, 1, cin, cout, dt)
+                blk["proj_bn"] = _bn_init(cout, dt)
+            blocks.append(blk)
+            cin = cout
+        params["stages"].append(blocks)
+    params["fc"] = {
+        "w": (jax.random.normal(next(keys), (cin, cfg.num_classes),
+                                jnp.float32)
+              * jnp.sqrt(1.0 / cin)).astype(dt),
+        "b": jnp.zeros((cfg.num_classes,), dt),
+    }
+    return params
+
+
+# -- batch norm --------------------------------------------------------------
+
+def _bn(x, bn, cfg: ResNetConfig, bn_axis: Optional[str],
+        stats: Optional[Dict]):
+    """Train mode (stats=None): moments over (N, H, W), pmean'd over bn_axis
+    (sync-BN == single-device numerics under dp batch split).  Eval mode:
+    use the provided running stats."""
+    xf = x.astype(jnp.float32)
+    if stats is None:
+        mean = jnp.mean(xf, axis=(0, 1, 2))
+        m2 = jnp.mean(jnp.square(xf), axis=(0, 1, 2))
+        if bn_axis is not None:
+            mean = lax.pmean(mean, bn_axis)
+            m2 = lax.pmean(m2, bn_axis)
+        var = m2 - jnp.square(mean)
+    else:
+        mean, var = stats["mean"], stats["var"]
+    inv = lax.rsqrt(var + cfg.bn_eps)
+    out = (xf - mean) * inv
+    return (out.astype(x.dtype) * bn["scale"] + bn["bias"])
+
+
+def _conv(x, w, stride=1):
+    return lax.conv_general_dilated(
+        x, w, window_strides=(stride, stride), padding="SAME",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"))
+
+
+# -- forward -----------------------------------------------------------------
+
+def _forward(params: Dict, x: jax.Array, cfg: ResNetConfig, bn_fn):
+    """The single source of truth for the network topology.  ``bn_fn(h, bn)``
+    is called once per BN layer, in a fixed visit order (stem, then per block
+    bn1..bn3 [+ proj_bn on block 0 of each stage]) — init_stats and
+    compute_stats rely on that order."""
+    dt = jnp.dtype(cfg.dtype)
+    h = _conv(x.astype(dt), params["stem"]["conv"], stride=2)
+    h = jax.nn.relu(bn_fn(h, params["stem"]["bn"]))
+    h = lax.reduce_window(h, -jnp.inf, lax.max, (1, 3, 3, 1), (1, 2, 2, 1),
+                          "SAME")
+    for s, blocks in enumerate(params["stages"]):
+        for b, blk in enumerate(blocks):
+            stride = 2 if (s > 0 and b == 0) else 1
+            r = _conv(h, blk["conv1"])
+            r = jax.nn.relu(bn_fn(r, blk["bn1"]))
+            r = _conv(r, blk["conv2"], stride=stride)
+            r = jax.nn.relu(bn_fn(r, blk["bn2"]))
+            r = _conv(r, blk["conv3"])
+            r = bn_fn(r, blk["bn3"])
+            if "proj" in blk:
+                h = _conv(h, blk["proj"], stride=stride)
+                h = bn_fn(h, blk["proj_bn"])
+            h = jax.nn.relu(h + r)
+    h = jnp.mean(h.astype(jnp.float32), axis=(1, 2))      # global avg pool
+    return h.astype(dt) @ params["fc"]["w"] + params["fc"]["b"]
+
+
+def apply(params: Dict, x: jax.Array, cfg: ResNetConfig, *,
+          bn_axis: Optional[str] = None,
+          stats: Optional[Dict] = None) -> jax.Array:
+    """x: [B, H, W, 3] -> logits [B, num_classes].
+
+    Train mode: stats=None (batch statistics; pass bn_axis="dp" inside
+    shard_map for sync-BN).  Eval: pass the stats pytree from compute_stats.
+    """
+    st = iter(stats["bn"]) if stats is not None else None
+    bn_fn = (lambda h, bn: _bn(h, bn, cfg, bn_axis,
+                               next(st) if st is not None else None))
+    return _forward(params, x, cfg, bn_fn)
+
+
+def loss_fn(params: Dict, batch, cfg: ResNetConfig, *,
+            bn_axis: Optional[str] = None) -> jax.Array:
+    """Softmax cross-entropy; batch = (images [B,H,W,3], labels [B])."""
+    x, y = batch
+    logits = apply(params, x, cfg, bn_axis=bn_axis)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logz, y[:, None], axis=-1)[:, 0])
+
+
+# -- eval statistics ---------------------------------------------------------
+
+def init_stats(cfg: ResNetConfig) -> Dict:
+    """Zero-initialized running-stats pytree, ordered exactly as the shared
+    forward visits BN layers (derived by abstractly tracing _forward, so it
+    can never desync from the topology)."""
+    chans = []
+
+    def bn_probe(h, bn):
+        chans.append(h.shape[-1])
+        return h
+
+    params = jax.eval_shape(lambda: init(jax.random.PRNGKey(0), cfg))
+    x = jax.ShapeDtypeStruct((1, 32, 32, 3), jnp.float32)
+    jax.eval_shape(lambda p, xb: _forward(p, xb, cfg, bn_probe), params, x)
+    return {"bn": [{"mean": jnp.zeros((c,), jnp.float32),
+                    "var": jnp.ones((c,), jnp.float32)} for c in chans]}
+
+
+def compute_stats(params: Dict, x: jax.Array, cfg: ResNetConfig,
+                  stats: Dict) -> Dict:
+    """One EMA calibration step of the running statistics on a batch.
+    Runs the shared forward in train mode while capturing each BN's
+    moments (same visit order as apply, by construction)."""
+    captured = []
+
+    def bn_cap(h, bn):
+        hf = h.astype(jnp.float32)
+        mean = jnp.mean(hf, axis=(0, 1, 2))
+        m2 = jnp.mean(jnp.square(hf), axis=(0, 1, 2))
+        st = {"mean": mean, "var": m2 - jnp.square(mean)}
+        captured.append(st)
+        return _bn(h, bn, cfg, None, st)     # one BN implementation only
+
+    _forward(params, x, cfg, bn_cap)
+
+    m = cfg.bn_momentum
+    new_bn = [{"mean": m * old["mean"] + (1 - m) * cap["mean"],
+               "var": m * old["var"] + (1 - m) * cap["var"]}
+              for old, cap in zip(stats["bn"], captured)]
+    return {"bn": new_bn}
+
+
+def num_params(cfg: ResNetConfig) -> int:
+    return sum(int(x.size) for x in jax.tree_util.tree_leaves(
+        jax.eval_shape(lambda: init(jax.random.PRNGKey(0), cfg))))

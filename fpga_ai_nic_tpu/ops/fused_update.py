@@ -122,35 +122,6 @@ def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):
     return unflatten_tree(red / n, meta)
 
 
-def fused_allreduce_update(
-    grads_tree,
-    w_own: jax.Array,
-    opt_state: optim.OptState,
-    meta: FlatMeta,
-    axis_name: str,
-    coll: CollectiveConfig,
-    opt_cfg: OptimizerConfig,
-    *,
-    reduce_mean: bool = True,
-    step=None,
-):
-    """One fused collective step inside shard_map.
-
-    grads_tree: local gradient pytree (per-device, un-reduced).
-    w_own:      this device's f32 master shard [padded_len // n].
-    Returns (new_params_tree, new_w_own, new_opt_state).
-    """
-    n = lax.axis_size(axis_name)
-    flat_g, _ = flatten_tree(grads_tree, coll, n)
-    assert flat_g.shape[0] == meta.padded_len, (flat_g.shape, meta.padded_len)
-    g_own = reduce_scatter(flat_g, axis_name, coll)
-    if reduce_mean:
-        g_own = g_own / n
-    w_new, opt_state = optim.apply(opt_cfg, w_own, g_own, opt_state, step)
-    flat_w = all_gather_flat(w_new, axis_name, coll)
-    return unflatten_tree(flat_w, meta), w_new, opt_state
-
-
 def init_master_shard(params_tree, axis_name: str, coll: CollectiveConfig,
                       opt_cfg: OptimizerConfig):
     """Build (w_own, opt_state, meta) from a replicated params pytree.

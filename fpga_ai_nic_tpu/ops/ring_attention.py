@@ -80,8 +80,21 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         vc = lax.ppermute(vc, axis_name, perm)
         src = (idx - s_i) % n                 # whose K/V we hold this hop
         k_pos = src * S + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
-        m, l, o = _block_attend(qf, kc.astype(jnp.float32),
-                                vc, q_pos, k_pos, m, l, o, sm_scale, causal)
+
+        def attend(mlo):
+            return _block_attend(qf, kc.astype(jnp.float32), vc, q_pos,
+                                 k_pos, *mlo, sm_scale, causal)
+
+        if causal:
+            # blocks entirely in the future (src > idx: every key position
+            # exceeds every local query position) are fully masked — skip
+            # their attention compute, keep only the ring hop itself.  This
+            # halves the attention FLOPs at large n, the same dead-beat
+            # elision the reference's FSM gets by construction (it never
+            # reduces slices it hasn't reached, hw/all_reduce.sv:923-987).
+            m, l, o = lax.cond(src > idx, lambda mlo: mlo, attend, (m, l, o))
+        else:
+            m, l, o = attend((m, l, o))
         return m, l, o, kc, vc
 
     m, l, o, _, _ = lax.fori_loop(1, n, hop, (m, l, o, k, v), unroll=True)

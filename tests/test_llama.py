@@ -43,6 +43,48 @@ def test_ignored_labels(rng):
     assert np.isfinite(float(loss))
 
 
+def test_masked_dp_training_matches_unsharded(rng):
+    """With -100-masked labels concentrated unevenly across dp shards, the
+    dp-sharded step must still produce the unsharded global token-weighted
+    update (llama.loss_fn dp_axis gradient-scale correction)."""
+    toks, labels = _batch(rng)
+    # mask out most of the sequence on the first half of the batch only:
+    # dp shards end up with very different valid-token counts
+    lab = np.asarray(labels).copy()
+    lab[: B // 2, : (3 * S) // 4] = -100
+    labels = jnp.asarray(lab)
+
+    params0 = llama.init(jax.random.PRNGKey(0), CFG)
+
+    def ref_step(params):
+        g = jax.grad(lambda p: llama.loss_fn(p, (toks, labels), CFG))(params)
+        return jax.tree_util.tree_map(
+            lambda w, gg: (w.astype(jnp.float32)
+                           - 0.1 * gg.astype(jnp.float32)).astype(w.dtype),
+            params, g)
+
+    want = ref_step(params0)
+
+    mesh = make_mesh(MeshConfig(dp=4))
+    cfg = TrainConfig(iters=1, global_batch=B, mesh=MeshConfig(dp=4),
+                      collective=CollectiveConfig(impl="xla"),
+                      optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1))
+    from fpga_ai_nic_tpu.parallel import DPTrainer
+
+    tr = DPTrainer(lambda p, b: llama.loss_fn(p, b, CFG, dp_axis="dp"),
+                   mesh, cfg)
+    state = tr.init_state(llama.init(jax.random.PRNGKey(0), CFG))
+    state, loss = tr.step(state, tr.shard_batch((toks, labels)))
+
+    ref_loss = float(llama.loss_fn(params0, (toks, labels), CFG))
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-5)
+    for lw, lg in zip(jax.tree_util.tree_leaves(want),
+                      jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(lg, np.float32),
+                                   np.asarray(lw, np.float32),
+                                   rtol=5e-4, atol=5e-5)
+
+
 def test_num_params_matches_init():
     params = llama.init(jax.random.PRNGKey(0), CFG)
     got = sum(int(np.prod(l.shape)) for l in jax.tree_util.tree_leaves(params))
