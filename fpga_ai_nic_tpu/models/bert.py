@@ -1,0 +1,176 @@
+"""BERT-family bidirectional encoder with a masked-LM head.
+
+BASELINE.json config 4 ("BERT-base DP bucketed ring all-reduce") is the
+target: the model itself is plain data-parallel (no internal collectives);
+its role is to exercise the bucketed gradient all-reduce path
+(`ops.bucketed` + `parallel.ddp.DDPTrainer`) on a transformer whose layer
+structure produces the many medium-sized gradient tensors that bucketing
+exists for — the reference's per-layer all-reduce issue
+(sw/mlp_mpi_example_f32.cpp:753-756) at transformer scale.
+
+Architecture: post-LN encoder, learned positions, GELU FFN, tied MLM
+decoder (logits through tok_emb^T), padding masked via ``pad_id``.
+Functional pytree params like models.mlp / models.llama.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = jnp.float32(-1e30)
+
+
+@dataclass(frozen=True)
+class BertConfig:
+    vocab: int = 30522
+    dim: int = 768
+    n_layers: int = 12
+    n_heads: int = 12
+    ffn_dim: int = 3072
+    max_pos: int = 512
+    pad_id: int = 0
+    norm_eps: float = 1e-12
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.n_heads
+
+    @staticmethod
+    def bert_base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(vocab: int = 256, dim: int = 64, n_layers: int = 2,
+             n_heads: int = 4, ffn_dim: int = 128, max_pos: int = 64,
+             dtype: str = "float32") -> "BertConfig":
+        return BertConfig(vocab=vocab, dim=dim, n_layers=n_layers,
+                          n_heads=n_heads, ffn_dim=ffn_dim, max_pos=max_pos,
+                          dtype=dtype)
+
+
+def init(key: jax.Array, cfg: BertConfig) -> Dict:
+    dt = jnp.dtype(cfg.dtype)
+    D = cfg.dim
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * jnp.sqrt(1.0 / fan_in)).astype(dt)
+
+    def ln():
+        return {"g": jnp.ones((D,), dt), "b": jnp.zeros((D,), dt)}
+
+    keys = iter(jax.random.split(key, 4 + cfg.n_layers * 6))
+    params = {
+        "tok_emb": dense(next(keys), D, (cfg.vocab, D)),
+        "pos_emb": dense(next(keys), D, (cfg.max_pos, D)),
+        "emb_norm": ln(),
+        "layers": [],
+        "mlm_dense": dense(next(keys), D, (D, D)),
+        "mlm_norm": ln(),
+        "mlm_bias": jnp.zeros((cfg.vocab,), dt),
+    }
+    for _ in range(cfg.n_layers):
+        params["layers"].append({
+            "wq": dense(next(keys), D, (D, D)),
+            "wk": dense(next(keys), D, (D, D)),
+            "wv": dense(next(keys), D, (D, D)),
+            "wo": dense(next(keys), D, (D, D)),
+            "attn_norm": ln(),
+            "w1": dense(next(keys), D, (D, cfg.ffn_dim)),
+            "w2": dense(next(keys), cfg.ffn_dim, (cfg.ffn_dim, D)),
+            "ffn_norm": ln(),
+        })
+    return params
+
+
+def _layernorm(x: jax.Array, p: Dict, eps: float) -> jax.Array:
+    xf = x.astype(jnp.float32)
+    mu = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.mean((xf - mu) ** 2, axis=-1, keepdims=True)
+    return ((xf - mu) * lax.rsqrt(var + eps)).astype(x.dtype) * p["g"] + p["b"]
+
+
+def apply(params: Dict, tokens: jax.Array, cfg: BertConfig,
+          attention_mask: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] -> MLM logits [B, S, vocab].
+
+    attention_mask: [B, S] bool/int, 1 = attend; derived from
+    ``tokens != pad_id`` when omitted.
+    """
+    B, S = tokens.shape
+    if S > cfg.max_pos:
+        # JAX's clamping gather would silently repeat pos_emb[max_pos-1]
+        raise ValueError(f"sequence length {S} exceeds max_pos={cfg.max_pos}")
+    H, Hd = cfg.n_heads, cfg.head_dim
+    if attention_mask is None:
+        attention_mask = tokens != cfg.pad_id
+    key_bias = jnp.where(attention_mask[:, None, None, :].astype(bool),
+                         jnp.float32(0), _NEG)           # [B, 1, 1, S]
+
+    pos = lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+    x = params["tok_emb"][tokens] + params["pos_emb"][pos]
+    x = _layernorm(x, params["emb_norm"], cfg.norm_eps)
+
+    scale = Hd ** -0.5
+    for lyr in params["layers"]:
+        q = (x @ lyr["wq"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        k = (x @ lyr["wk"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        v = (x @ lyr["wv"]).reshape(B, S, H, Hd).transpose(0, 2, 1, 3)
+        s = jnp.einsum("bhqd,bhkd->bhqk", q, k,
+                       preferred_element_type=jnp.float32) * scale
+        p = jax.nn.softmax(s + key_bias, axis=-1)
+        att = jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(B, S, -1)
+        x = _layernorm(x + att @ lyr["wo"], lyr["attn_norm"], cfg.norm_eps)
+
+        h = jax.nn.gelu((x @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
+        x = _layernorm(x + h @ lyr["w2"], lyr["ffn_norm"], cfg.norm_eps)
+
+    h = jax.nn.gelu((x @ params["mlm_dense"]).astype(jnp.float32)
+                    ).astype(x.dtype)
+    h = _layernorm(h, params["mlm_norm"], cfg.norm_eps)
+    return h @ params["tok_emb"].T + params["mlm_bias"]   # tied decoder
+
+
+def loss_fn(params: Dict, batch, cfg: BertConfig, *,
+            dp_axis: Optional[str] = None) -> jax.Array:
+    """Masked-LM cross-entropy.  batch = (tokens, labels), labels [B, S]
+    with -100 on unmasked positions (standard MLM convention).
+
+    dp_axis: as in models.llama.loss_fn — under a dp trainer that averages
+    gradients uniformly (mean over dp), masked-token counts differ per
+    shard; with dp_axis set the loss value is the exact global
+    token-weighted mean and the gradient carries the n_dp factor that
+    cancels the trainer's /n_dp.
+    """
+    tokens, labels = batch
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logits = apply(params, tokens, cfg)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logz, safe[..., None], axis=-1)[..., 0]
+    nll = jnp.where(valid, nll, 0.0)
+    local_sum = jnp.sum(nll)
+    count = jnp.sum(valid)
+    if dp_axis is None:
+        return local_sum / jnp.maximum(count, 1)
+    total = lax.psum(local_sum, dp_axis)
+    denom = jnp.maximum(lax.psum(count, dp_axis), 1).astype(jnp.float32)
+    loss = total / denom
+    n_dp = lax.axis_size(dp_axis)
+    return lax.stop_gradient(loss) + (
+        n_dp * (total - lax.stop_gradient(total)) / denom)
+
+
+def num_params(cfg: BertConfig) -> int:
+    D = cfg.dim
+    per_layer = 4 * D * D + 2 * D * cfg.ffn_dim + 4 * D
+    head = D * D + 2 * D + cfg.vocab
+    return (cfg.vocab * D + cfg.max_pos * D + 2 * D
+            + cfg.n_layers * per_layer + head)

@@ -1,1 +1,1 @@
-from . import bfp, bfp_golden, fused_update, ring, ring_golden  # noqa: F401
+from . import bfp, bfp_golden, bucketed, fused_update, ring, ring_golden  # noqa: F401

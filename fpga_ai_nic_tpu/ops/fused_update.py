@@ -44,9 +44,10 @@ class FlatMeta(NamedTuple):
     padded_len: int
 
 
-def _pad_multiple(coll: CollectiveConfig, n: int) -> int:
+def pad_multiple(coll: CollectiveConfig, n: int) -> int:
+    """Padding multiple for flat vectors fed to the n-way collective: the
+    per-device chunk (len / n) must be a whole number of BFP blocks."""
     if coll.compression is not None:
-        # per-device chunk (padded_len / n) must be a whole number of blocks
         return n * coll.compression.block_size
     return n
 
@@ -59,7 +60,7 @@ def flat_meta(tree, coll: CollectiveConfig, n: int) -> FlatMeta:
     dtypes = tuple(l.dtype for l in leaves)
     sizes = tuple(int(np.prod(s)) if s else 1 for s in shapes)
     total = sum(sizes)
-    m = _pad_multiple(coll, n)
+    m = pad_multiple(coll, n)
     padded = total + ((-total) % m)
     return FlatMeta(treedef, shapes, dtypes, sizes, padded)
 

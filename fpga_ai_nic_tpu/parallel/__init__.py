@@ -1,6 +1,8 @@
+from .ddp import DDPState, DDPTrainer
 from .mesh import make_mesh
 from .sharded import ShardedState, ShardedTrainer
 from .train import DPTrainer, TrainState
 
 __all__ = ["make_mesh", "DPTrainer", "TrainState",
-           "ShardedTrainer", "ShardedState"]
+           "ShardedTrainer", "ShardedState",
+           "DDPTrainer", "DDPState"]

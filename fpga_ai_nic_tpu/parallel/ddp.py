@@ -1,0 +1,131 @@
+"""DDP trainer: bucketed gradient all-reduce + replicated optimizer.
+
+BASELINE.json config 4 ("BERT-base DP bucketed ring all-reduce") is this
+shape: plain data parallelism where *gradients* are all-reduced (bucketed,
+in backward order — `ops.bucketed`) and every device runs the full optimizer
+on a replicated f32 master copy.  It is the un-fused counterpart of
+`parallel.train.DPTrainer` (which reduce-scatters and gathers updated
+weights, ZeRO-1); the reference's own dataflow is the fused one, but its
+host API — one all-reduce per layer's gradient buffer, optimizer elsewhere
+(sw/mlp_mpi_example_f32.cpp:753-756 with the host optimizer calls intact
+rather than commented out) — is exactly this trainer.
+
+Master weights / optimizer state: one flat replicated f32 vector, updated
+from the bucketed gradient means; working params are re-materialized in the
+model dtype each step (same cast discipline as the fused path).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import mesh as mesh_lib
+from .. import optim
+from ..ops import bucketed, fused_update
+from ..utils.config import CollectiveConfig, TrainConfig
+
+
+class DDPState(NamedTuple):
+    params: Any            # replicated working weights (model dtype)
+    w_master: jax.Array    # replicated flat f32 master vector
+    opt_state: Any         # replicated flat optimizer state
+    step: jax.Array
+
+
+def _unbucketed_meta(coll: CollectiveConfig):
+    """Flat-vector layout for the master copy: no per-device chunking, so
+    pad multiple is 1 (a CollectiveConfig with compression=None, n=1)."""
+    return CollectiveConfig(impl="xla", bucket_elems=coll.bucket_elems)
+
+
+class DDPTrainer:
+    """loss_fn(params, batch) -> scalar; batch leaves shard over dp."""
+
+    def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
+                 axis_name: str = "dp"):
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.cfg = cfg
+        self.ax = axis_name
+        self.n = mesh.shape[axis_name]
+        self._meta = None
+        self._plan = None
+
+    # -- init ---------------------------------------------------------------
+
+    def init_state(self, params) -> DDPState:
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        self._meta = fused_update.flat_meta(params, _unbucketed_meta(coll), 1)
+        self._plan = bucketed.plan_buckets(params, coll, self.n)
+        self.__dict__.pop("step_fn", None)
+
+        def _init(p):
+            flat, _ = fused_update.flatten_tree(p, _unbucketed_meta(coll), 1)
+            return flat, optim.init_state(opt_cfg, flat.shape[0])
+
+        w_master, opt_state = jax.jit(_init)(params)
+        return DDPState(params=params, w_master=w_master,
+                        opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    # -- step ---------------------------------------------------------------
+
+    @functools.cached_property
+    def step_fn(self):
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        meta, plan = self._meta, self._plan
+        assert meta is not None, "call init_state first"
+        ax = self.ax
+
+        # Phase 1 (check_vma=True): grads + bucketed all-reduce.  The ring
+        # collective's result is replicated in value but vma-typed varying
+        # (there is no varying->invariant cast), so the mean gradient is
+        # handed to phase 2 through a P(ax) output — physically each
+        # device's own copy, no extra bytes moved.
+        def shard_grads(params, batch):
+            # dp-varying before grad: keeps the dp reduction manual (the
+            # bucketed collective below), not an autodiff-inserted psum.
+            params_v = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, ax, to="varying"), params)
+            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            # flat f32 end to end: the dp-mean gradient must NOT round
+            # through the model dtype on its way to the f32 master update
+            flat_g = bucketed.all_reduce_bucketed_flat(grads, ax, coll, plan)
+            if coll.impl == "xla":        # psum output is invariant-typed
+                flat_g = lax.pcast(flat_g, ax, to="varying")
+            return flat_g, lax.pmean(loss, ax)
+
+        # Phase 2 (no autodiff): replicated optimizer on the flat master.
+        def shard_update(flat_g, w_master, opt_state, step):
+            w_new, opt_state2 = optim.apply(opt_cfg, w_master, flat_g,
+                                            opt_state, step)
+            params2 = fused_update.unflatten_tree(w_new, meta)
+            return params2, w_new, opt_state2
+
+        def _step(state: DDPState, batch):
+            flat_g, loss = jax.shard_map(
+                shard_grads, mesh=self.mesh, in_specs=(P(), P(ax)),
+                out_specs=(P(ax), P()),
+            )(state.params, batch)
+            params, w_master, opt_state = jax.shard_map(
+                shard_update, mesh=self.mesh,
+                in_specs=(P(ax), P(), P(), P()),
+                out_specs=(P(), P(), P()), check_vma=False,
+            )(flat_g, state.w_master, state.opt_state, state.step)
+            return DDPState(params, w_master, opt_state,
+                            state.step + 1), loss
+
+        return jax.jit(_step, donate_argnums=(0,))
+
+    def step(self, state: DDPState, batch) -> Tuple[DDPState, jax.Array]:
+        return self.step_fn(state, batch)
+
+    # -- data ---------------------------------------------------------------
+
+    def shard_batch(self, batch):
+        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))

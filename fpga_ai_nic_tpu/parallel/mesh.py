@@ -14,7 +14,7 @@ from typing import Optional, Sequence
 
 import jax
 import numpy as np
-from jax.sharding import Mesh
+from jax.sharding import Mesh, NamedSharding
 
 from ..utils.config import MeshConfig
 
@@ -29,6 +29,14 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     sizes = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.ep]
     arr = np.array(devices[:n]).reshape(sizes)
     return Mesh(arr, AXES)
+
+
+def shard_host_batch(batch, mesh: Mesh, spec) -> object:
+    """Place a host batch pytree onto the mesh with one PartitionSpec for
+    every leaf (the MPI_Scatter analogue, sw/mlp_mpi_example_f32.cpp:
+    452-460).  Shared by all trainers."""
+    ns = NamedSharding(mesh, spec)
+    return jax.tree_util.tree_map(lambda x: jax.device_put(x, ns), batch)
 
 
 def single_axis_mesh(axis: str = "dp", n: Optional[int] = None,

@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import mesh as mesh_lib
 from .. import optim
 from ..ops import fused_update
 from ..utils.config import TrainConfig
@@ -162,6 +163,5 @@ class ShardedTrainer:
         return self.step_fn(state, batch)
 
     def shard_batch(self, batch):
-        spec = P(self.dp, self.sp)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch)
+        return mesh_lib.shard_host_batch(batch, self.mesh,
+                                         P(self.dp, self.sp))

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import mesh as mesh_lib
 from .. import optim
 from ..ops import fused_update
 from ..utils.config import TrainConfig
@@ -170,6 +171,4 @@ class DPTrainer:
 
     def shard_batch(self, batch):
         """Place a host batch with sharding over dp (MPI_Scatter analogue)."""
-        spec = P(self.ax)
-        return jax.tree_util.tree_map(
-            lambda x: jax.device_put(x, NamedSharding(self.mesh, spec)), batch)
+        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))

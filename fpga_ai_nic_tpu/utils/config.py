@@ -68,6 +68,11 @@ class CollectiveConfig:
     compression: Optional[BFPConfig] = None
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
     max_inflight: int = 8
+    # bucketed (DDP-style) all-reduce: min elements per bucket.  The
+    # reference's granularity is one bucket per layer (one all_reduce()
+    # call per bwd layer, sw/mlp_mpi_example_f32.cpp:753); 4M f32 = 16 MiB
+    # amortizes per-collective latency while keeping backward overlap.
+    bucket_elems: int = 4 * 1024 * 1024
 
     def __post_init__(self):
         assert self.impl in ("xla", "ring")

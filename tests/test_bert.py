@@ -1,0 +1,217 @@
+"""BERT + bucketed DDP all-reduce (BASELINE.json config 4).
+
+Verifies: bucket planning (reverse-leaf issue order, BFP padding), bucketed
+all-reduce == per-leaf psum mean, the DDP trainer against a single-device
+reference SGD step, masked-token loss weighting under dp, and convergence
+with the BFP-compressed bucketed ring.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from fpga_ai_nic_tpu.models import bert
+from fpga_ai_nic_tpu.ops import bucketed
+from fpga_ai_nic_tpu.parallel import DDPTrainer, make_mesh
+from fpga_ai_nic_tpu.utils.config import (
+    BFPConfig, CollectiveConfig, MeshConfig, OptimizerConfig, TrainConfig)
+
+MCFG = bert.BertConfig.tiny()
+
+
+def _cfg(**kw):
+    base = dict(
+        iters=4, global_batch=16, mesh=MeshConfig(dp=8),
+        collective=CollectiveConfig(bucket_elems=4096),
+        optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1))
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _data(rng, n=16, S=32, mask_frac=0.15):
+    """MLM batch: 15% of non-pad positions masked, labels -100 elsewhere."""
+    toks = rng.integers(1, MCFG.vocab, (n, S)).astype(np.int32)
+    toks[:, S - 4:] = MCFG.pad_id                    # padded tail
+    labels = np.full((n, S), -100, np.int32)
+    m = (rng.random((n, S)) < mask_frac) & (toks != MCFG.pad_id)
+    m[:, 0] = True                                   # >=1 target per row
+    labels[m] = toks[m]
+    toks[m] = 3                                      # [MASK]-style id
+    return jnp.asarray(toks), jnp.asarray(labels)
+
+
+# -- bucket planning ---------------------------------------------------------
+
+def test_plan_buckets_covers_all_leaves_in_reverse_order():
+    params = bert.init(jax.random.PRNGKey(0), MCFG)
+    coll = CollectiveConfig(bucket_elems=5000)
+    plan = bucketed.plan_buckets(params, coll, 8)
+    seen = [i for b in plan.buckets for i in b.leaf_ids]
+    n_leaves = len(jax.tree_util.tree_leaves(params))
+    assert sorted(seen) == list(range(n_leaves))
+    # issue order is reverse flatten order (backward availability)
+    assert seen == list(reversed(range(n_leaves)))
+    sizes = [int(np.prod(s)) if s else 1 for s in plan.shapes]
+    for b in plan.buckets[:-1]:
+        assert sum(b.sizes) >= coll.bucket_elems or len(b.leaf_ids) == 1
+    for b in plan.buckets:
+        assert b.padded_len % 8 == 0
+        assert b.padded_len >= sum(sizes[i] for i in b.leaf_ids)
+
+
+def test_plan_buckets_pads_for_bfp_blocks():
+    params = bert.init(jax.random.PRNGKey(0), MCFG)
+    coll = CollectiveConfig(impl="ring", compression=BFPConfig(),
+                            bucket_elems=5000)
+    plan = bucketed.plan_buckets(params, coll, 8)
+    for b in plan.buckets:
+        assert b.padded_len % (8 * 16) == 0
+
+
+# -- bucketed all-reduce -----------------------------------------------------
+
+@pytest.mark.parametrize("impl", ["xla", "ring"])
+def test_bucketed_all_reduce_is_mean(rng, impl):
+    mesh = make_mesh(MeshConfig(dp=8))
+    coll = CollectiveConfig(impl=impl, bucket_elems=500)
+    trees = [
+        {"a": jnp.asarray(rng.standard_normal((8, 40, 7)), jnp.float32),
+         "b": [jnp.asarray(rng.standard_normal((8, 333)), jnp.float32),
+               jnp.asarray(rng.standard_normal((8, 2, 3)), jnp.float32)]}]
+    tree = trees[0]
+
+    def run(t):
+        out = bucketed.all_reduce_bucketed(t, "dp", coll)
+        if impl == "xla":
+            out = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, "dp", to="varying"), out)
+        return out
+
+    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P("dp"),),
+                                out_specs=P("dp")))(tree)
+    want = jax.tree_util.tree_map(lambda x: np.broadcast_to(
+        np.mean(np.asarray(x), axis=0, keepdims=True), x.shape), tree)
+    jax.tree_util.tree_map(
+        lambda g, w: np.testing.assert_allclose(np.asarray(g), w, atol=1e-6),
+        got, want)
+
+
+def test_bucketed_flat_keeps_f32_for_bf16_leaves(rng):
+    """The flat variant must not round the dp-mean through the leaf dtype
+    (bf16 models keep f32 masters for exactly this reason)."""
+    mesh = make_mesh(MeshConfig(dp=8))
+    coll = CollectiveConfig(bucket_elems=64)
+    tree = {"w": jnp.asarray(rng.standard_normal((8, 100)), jnp.bfloat16),
+            "b": jnp.asarray(rng.standard_normal((8, 33)), jnp.bfloat16)}
+
+    def run(t):
+        flat = bucketed.all_reduce_bucketed_flat(t, "dp", coll)
+        return lax.pcast(flat, "dp", to="varying")
+
+    got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(P("dp"),),
+                                out_specs=P("dp")))(tree)
+    got = np.asarray(got).reshape(8, -1)[0]
+    assert got.dtype == np.float32
+    want = np.concatenate([
+        np.mean(np.asarray(tree["b"], np.float32), axis=0).reshape(-1),
+        np.mean(np.asarray(tree["w"], np.float32), axis=0).reshape(-1)])
+    # forward leaf order: dict flattens alphabetically -> b then w
+    np.testing.assert_allclose(got, want, atol=1e-6)
+    # and it is strictly more precise than the bf16-rounded tree path
+    rounded = want.astype(jnp.bfloat16).astype(np.float32)
+    assert np.any(got != rounded)
+
+
+# -- DDP trainer -------------------------------------------------------------
+
+def _loss(params, batch):
+    return bert.loss_fn(params, batch, MCFG, dp_axis="dp")
+
+
+def _reference_step(params, batch, lr):
+    """Single-device global-mean MLM gradient + SGD."""
+    g = jax.grad(lambda p, b: bert.loss_fn(p, b, MCFG))(params, batch)
+    return jax.tree_util.tree_map(
+        lambda w, gg: (w.astype(jnp.float32) - lr * gg.astype(jnp.float32)
+                       ).astype(w.dtype), params, g)
+
+
+@pytest.mark.parametrize("impl", ["xla", "ring"])
+def test_ddp_matches_single_device_reference(rng, impl):
+    cfg = _cfg(collective=CollectiveConfig(impl=impl, bucket_elems=4096))
+    tr = DDPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+    params = bert.init(jax.random.PRNGKey(0), MCFG)
+    state = tr.init_state(params)
+    batch_host = _data(rng)
+    # reference first: the trainer's donated step invalidates `params`
+    want = _reference_step(params, batch_host, cfg.optimizer.learning_rate)
+    ref_loss = float(bert.loss_fn(params, batch_host, MCFG))
+    state, loss = tr.step(state, tr.shard_batch(batch_host))
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-4, atol=2e-5), state.params, want)
+    np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-4)
+
+
+def test_ddp_bfp_ring_converges(rng):
+    cfg = _cfg(
+        iters=8,
+        collective=CollectiveConfig(impl="ring", compression=BFPConfig(),
+                                    bucket_elems=4096),
+        optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
+    tr = DDPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(bert.init(jax.random.PRNGKey(0), MCFG))
+    batch = tr.shard_batch(_data(rng))
+    first = None
+    for _ in range(cfg.iters):
+        state, loss = tr.step(state, batch)
+        first = float(loss) if first is None else first
+    assert np.isfinite(float(loss))
+    assert float(loss) < first, (float(loss), first)
+
+
+def test_ddp_replicas_stay_identical(rng):
+    """Master copy must remain bit-identical across devices after steps
+    (the reference's invariant: every node's DDR holds the same weights)."""
+    cfg = _cfg(collective=CollectiveConfig(impl="ring", bucket_elems=2048))
+    tr = DDPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(bert.init(jax.random.PRNGKey(0), MCFG))
+    for _ in range(2):
+        state, _ = tr.step(state, tr.shard_batch(_data(rng)))
+    shards = [np.asarray(s.data) for s in
+              state.w_master.addressable_shards]
+    for s in shards[1:]:
+        np.testing.assert_array_equal(shards[0], s)
+
+
+# -- model sanity ------------------------------------------------------------
+
+def test_bert_forward_shapes_and_padding_mask(rng):
+    params = bert.init(jax.random.PRNGKey(1), MCFG)
+    toks, _ = _data(rng, n=4)
+    logits = bert.apply(params, toks, MCFG)
+    assert logits.shape == (4, 32, MCFG.vocab)
+    assert np.all(np.isfinite(np.asarray(logits, np.float32)))
+    # padding keys must not influence non-pad positions: perturb pad tokens
+    toks2 = np.asarray(toks).copy()
+    pads = toks2 == MCFG.pad_id
+    toks2[pads] = 7
+    mask = jnp.asarray(~pads)
+    l1 = bert.apply(params, toks, MCFG)
+    l2 = bert.apply(params, jnp.asarray(toks2), MCFG, attention_mask=mask)
+    np.testing.assert_allclose(np.asarray(l1[~pads]), np.asarray(l2[~pads]),
+                               atol=1e-5)
+
+
+def test_num_params_matches_init():
+    params = bert.init(jax.random.PRNGKey(0), MCFG)
+    total = sum(int(np.prod(l.shape))
+                for l in jax.tree_util.tree_leaves(params))
+    assert total == bert.num_params(MCFG)
