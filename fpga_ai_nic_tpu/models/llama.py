@@ -88,8 +88,9 @@ def init(key: jax.Array, cfg: LlamaConfig) -> Dict:
     return params
 
 
-def param_specs(cfg: LlamaConfig, tp_axis: str = "tp") -> Dict:
-    """PartitionSpecs: Megatron column/row sharding over the tp axis."""
+def param_specs(cfg: LlamaConfig, tp_axis: Optional[str] = "tp") -> Dict:
+    """PartitionSpecs: Megatron column/row sharding over the tp axis
+    (tp_axis=None replicates — for meshes without a tp axis)."""
     col, row, rep = P(None, tp_axis), P(tp_axis, None), P()
     layer = {"attn_norm": rep, "wq": col, "wk": col, "wv": col, "wo": row,
              "mlp_norm": rep, "w1": col, "w3": col, "w2": row}
@@ -119,6 +120,54 @@ def _psum_if(x: jax.Array, axis: Optional[str]) -> jax.Array:
     return lax.psum(x, axis) if axis is not None else x
 
 
+def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
+           n_heads: int, n_kv: int, tp_axis: Optional[str],
+           sp_axis: Optional[str]) -> jax.Array:
+    """One decoder layer (pre-norm attention + SwiGLU FFN) on local shards.
+    n_heads/n_kv are the per-tp-shard head counts."""
+    B, S = x.shape[:2]
+    Hd = cfg.head_dim
+    h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
+    q = (h @ lyr["wq"]).reshape(B, S, n_heads, Hd).transpose(0, 2, 1, 3)
+    k = (h @ lyr["wk"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+    v = (h @ lyr["wv"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+    q = _rope(q, pos, cfg.rope_theta)
+    k = _rope(k, pos, cfg.rope_theta)
+    if n_kv != n_heads:                             # GQA: expand kv heads
+        rep = n_heads // n_kv
+        k = jnp.repeat(k, rep, axis=1)
+        v = jnp.repeat(v, rep, axis=1)
+    if sp_axis is not None:
+        att = ring_attention(q, k, v, sp_axis, causal=True)
+    else:
+        att = full_attention(q, k, v, causal=True)
+    att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)
+    x = x + _psum_if(att @ lyr["wo"], tp_axis)
+
+    h = _rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
+    gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
+    ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
+    return x + _psum_if(ff, tp_axis)
+
+
+def _shard_counts(cfg: LlamaConfig, tp_axis: Optional[str]):
+    n_heads, n_kv = cfg.n_heads, cfg.n_kv_heads
+    if tp_axis is not None:
+        tp = lax.axis_size(tp_axis)
+        if n_heads % tp or n_kv % tp:
+            raise ValueError(
+                f"tp={tp} must divide n_heads={n_heads} and "
+                f"n_kv_heads={n_kv} (kv-head replication not implemented)")
+        n_heads //= tp
+        n_kv //= tp
+    return n_heads, n_kv
+
+
+def _positions(S: int, sp_axis: Optional[str]) -> jax.Array:
+    sp_off = (lax.axis_index(sp_axis) * S) if sp_axis is not None else 0
+    return sp_off + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+
+
 def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
           tp_axis: Optional[str] = None,
           sp_axis: Optional[str] = None,
@@ -130,43 +179,12 @@ def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
     tp_axis is set; sequence shards must be contiguous when sp_axis is set.
     """
     B, S = tokens.shape
-    Hd = cfg.head_dim
-    n_heads = cfg.n_heads
-    n_kv = cfg.n_kv_heads
-    if tp_axis is not None:
-        tp = lax.axis_size(tp_axis)
-        if n_heads % tp or n_kv % tp:
-            raise ValueError(
-                f"tp={tp} must divide n_heads={n_heads} and "
-                f"n_kv_heads={n_kv} (kv-head replication not implemented)")
-        n_heads //= tp
-        n_kv //= tp
-    sp_off = (lax.axis_index(sp_axis) * S) if sp_axis is not None else 0
-    pos = sp_off + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+    n_heads, n_kv = _shard_counts(cfg, tp_axis)
+    pos = _positions(S, sp_axis)
 
     x = params["tok_emb"][tokens]                       # [B, S, D]
     for lyr in params["layers"]:
-        h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
-        q = (h @ lyr["wq"]).reshape(B, S, n_heads, Hd).transpose(0, 2, 1, 3)
-        k = (h @ lyr["wk"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
-        v = (h @ lyr["wv"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
-        q = _rope(q, pos, cfg.rope_theta)
-        k = _rope(k, pos, cfg.rope_theta)
-        if n_kv != n_heads:                             # GQA: expand kv heads
-            rep = n_heads // n_kv
-            k = jnp.repeat(k, rep, axis=1)
-            v = jnp.repeat(v, rep, axis=1)
-        if sp_axis is not None:
-            att = ring_attention(q, k, v, sp_axis, causal=True)
-        else:
-            att = full_attention(q, k, v, causal=True)
-        att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)
-        x = x + _psum_if(att @ lyr["wo"], tp_axis)
-
-        h = _rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
-        gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
-        ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
-        x = x + _psum_if(ff, tp_axis)
+        x = _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)
 
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]                      # [B, S, V/tp]
@@ -202,6 +220,35 @@ def _vocab_parallel_nll(logits: jax.Array, labels: jax.Array,
     return jnp.log(z) + m - tgt
 
 
+def _token_nll(logits: jax.Array, safe_labels: jax.Array,
+               tp_axis: Optional[str]) -> jax.Array:
+    """Per-token NLL [B, S]; logits vocab-sharded when tp_axis is set."""
+    if tp_axis is not None:
+        return _vocab_parallel_nll(logits, safe_labels, tp_axis)
+    logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    return -jnp.take_along_axis(logz, safe_labels[..., None], axis=-1)[..., 0]
+
+
+def _weighted_loss(local_sum: jax.Array, count: jax.Array,
+                   sp_axis: Optional[str],
+                   dp_axis: Optional[str]) -> jax.Array:
+    """Token-weighted global mean over sequence/data shards.  With dp_axis,
+    the gradient carries an n_dp factor that cancels the trainer's uniform
+    /n_dp average so the effective update is the true global-mean gradient
+    (see loss_fn docstring)."""
+    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+    if not axes:
+        return local_sum / jnp.maximum(count, 1)
+    total = lax.psum(local_sum, axes)
+    denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
+    loss = total / denom
+    if dp_axis is not None:
+        n_dp = lax.axis_size(dp_axis)
+        loss = lax.stop_gradient(loss) + (
+            n_dp * (total - lax.stop_gradient(total)) / denom)
+    return loss
+
+
 def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
             tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None,
@@ -223,31 +270,88 @@ def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
     tokens, labels = batch
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
-    if tp_axis is not None:
-        logits = apply(params, tokens, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                       gather_logits=False)
-        nll = _vocab_parallel_nll(logits, safe, tp_axis)
-    else:
-        logits = apply(params, tokens, cfg, sp_axis=sp_axis)
-        logz = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-        nll = -jnp.take_along_axis(logz, safe[..., None], axis=-1)[..., 0]
-    nll = jnp.where(valid, nll, 0.0)
-    local_sum = jnp.sum(nll)
-    count = jnp.sum(valid)
-    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
-    if not axes:
-        return local_sum / jnp.maximum(count, 1)
-    total = lax.psum(local_sum, axes)             # token-weighted global sum
-    denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
-    loss = total / denom
-    if dp_axis is not None:
-        # value: global mean (dp/sp-invariant).  gradient: scaled by n_dp so
-        # the trainer's uniform mean over dp (reduce_scatter / n_dp) yields
-        # the exact global token-weighted gradient.
-        n_dp = lax.axis_size(dp_axis)
-        loss = lax.stop_gradient(loss) + (
-            n_dp * (total - lax.stop_gradient(total)) / denom)
-    return loss
+    logits = apply(params, tokens, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
+                   gather_logits=False)
+    nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
+    return _weighted_loss(jnp.sum(nll), jnp.sum(valid), sp_axis, dp_axis)
+
+
+# -- pipeline-parallel path ---------------------------------------------------
+
+
+def stack_params(params: Dict) -> Dict:
+    """List-of-layers pytree -> stacked [n_layers, ...] leaves, shardable
+    over a pp mesh axis (parallel.pipeline layout contract)."""
+    from ..parallel import pipeline as pl
+    out = dict(params)
+    out["layers"] = pl.stack_layers(params["layers"])
+    return out
+
+
+def stacked_param_specs(cfg: LlamaConfig, pp_axis: str = "pp",
+                        tp_axis: Optional[str] = "tp") -> Dict:
+    """PartitionSpecs for stack_params output: the layer stack's leading axis
+    shards over pp; within a layer, Megatron col/row over tp; embedding and
+    head replicated over pp (they run on every stage, only stage 0 / the
+    last stage contribute gradients)."""
+    def pp_spec(spec: P) -> P:
+        return P(pp_axis, *spec)
+
+    base = param_specs(cfg, tp_axis)
+    return {"tok_emb": base["tok_emb"], "final_norm": base["final_norm"],
+            "lm_head": base["lm_head"],
+            "layers": {k: pp_spec(v) for k, v in base["layers"][0].items()}}
+
+
+def apply_pp(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
+             pp_axis: str, num_microbatches: int,
+             tp_axis: Optional[str] = None,
+             sp_axis: Optional[str] = None,
+             remat: bool = False) -> jax.Array:
+    """Pipelined forward; call inside shard_map with stack_params params
+    sharded per ``stacked_param_specs``.  Returns logits valid on the LAST
+    pp stage only (loss_fn handles the mask; see parallel.pipeline)."""
+    from ..parallel import pipeline as pl
+
+    S = tokens.shape[1]
+    n_heads, n_kv = _shard_counts(cfg, tp_axis)
+    pos = _positions(S, sp_axis)
+
+    def block(lyr, x):
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)
+
+    def stage_fn(stacked, x):
+        return pl.scan_layers(block, stacked, x, remat=remat)
+
+    x = params["tok_emb"][tokens]                       # [B, S, D]
+    x = pl.pipeline_apply(stage_fn, params["layers"], x,
+                          num_microbatches, pp_axis)
+    x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    return x @ params["lm_head"]                        # [B, S, V/tp]
+
+
+def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
+               pp_axis: str, num_microbatches: int,
+               tp_axis: Optional[str] = None,
+               sp_axis: Optional[str] = None,
+               dp_axis: Optional[str] = None,
+               remat: bool = False) -> jax.Array:
+    """Next-token cross-entropy through the pipeline.  Every pp stage
+    computes the head on its own (mostly garbage) activations — unavoidable
+    under SPMD — so the token NLL sum is psum-masked from the last stage
+    before the global token-weighted reduction; gradients flow only through
+    real activations.  dp_axis as in loss_fn (masked-label weighting)."""
+    from ..parallel import pipeline as pl
+
+    tokens, labels = batch
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+    logits = apply_pp(params, tokens, cfg, pp_axis=pp_axis,
+                      num_microbatches=num_microbatches, tp_axis=tp_axis,
+                      sp_axis=sp_axis, remat=remat)
+    nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
+    local_sum = pl.from_last_stage(jnp.sum(nll), pp_axis)
+    return _weighted_loss(local_sum, jnp.sum(valid), sp_axis, dp_axis)
 
 
 def num_params(cfg: LlamaConfig) -> int:

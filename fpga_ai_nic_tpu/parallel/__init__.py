@@ -1,3 +1,4 @@
+from . import pipeline
 from .ddp import DDPState, DDPTrainer
 from .mesh import make_mesh
 from .sharded import ShardedState, ShardedTrainer
@@ -5,4 +6,4 @@ from .train import DPTrainer, TrainState
 
 __all__ = ["make_mesh", "DPTrainer", "TrainState",
            "ShardedTrainer", "ShardedState",
-           "DDPTrainer", "DDPState"]
+           "DDPTrainer", "DDPState", "pipeline"]

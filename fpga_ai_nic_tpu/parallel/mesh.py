@@ -4,8 +4,8 @@ The reference's topology layer is a static unidirectional ring of FPGAs
 configured by shell script (sw/setup_route.sh:12-40, node n -> (n+1)%N).
 On TPU the topology is the ICI fabric; we only choose the logical mesh.
 Axes: dp (data), fsdp (ZeRO), tp (tensor), sp (sequence/ring-attention),
-ep (expert) — the reference has only dp (SURVEY.md §2), the rest are the
-north-star generalizations from BASELINE.json.
+pp (pipeline), ep (expert) — the reference has only dp (SURVEY.md §2), the
+rest are the north-star generalizations from BASELINE.json.
 """
 
 from __future__ import annotations
@@ -18,7 +18,7 @@ from jax.sharding import Mesh, NamedSharding
 
 from ..utils.config import MeshConfig
 
-AXES = ("dp", "fsdp", "tp", "sp", "ep")
+AXES = ("dp", "fsdp", "tp", "sp", "pp", "ep")
 
 
 def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
@@ -26,7 +26,7 @@ def make_mesh(cfg: MeshConfig, devices: Optional[Sequence] = None) -> Mesh:
     n = cfg.nproc
     if n > len(devices):
         raise ValueError(f"mesh needs {n} devices, have {len(devices)}")
-    sizes = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.ep]
+    sizes = [cfg.dp, cfg.fsdp, cfg.tp, cfg.sp, cfg.pp, cfg.ep]
     arr = np.array(devices[:n]).reshape(sizes)
     return Mesh(arr, AXES)
 

@@ -1,0 +1,132 @@
+"""Pipeline parallelism (pp): GPipe microbatch schedule over a mesh axis.
+
+The reference has no pipeline axis (its only strategy is DP over a ring of
+FPGAs, SURVEY.md §2 "Parallelism strategies"), but its defining mechanism —
+a static ring whose stages each own a slice of state and forward partial
+results to the next hop (hw/all_reduce.sv st_eth_t, SEND_LOCAL/REDUCE/
+FORWARD) — is exactly what a TPU pipeline stage does with activations.  We
+reuse that shape: each device owns a contiguous slice of the layer stack,
+processes one microbatch per tick, and `lax.ppermute`s its activation to the
+next stage, keeping the ring full (1 bubble of pp-1 ticks per batch, the
+GPipe schedule).
+
+Everything is a single `lax.scan` inside `shard_map`, so XLA sees static
+control flow; autodiff through ppermute gives the reverse-ring backward
+schedule for free.
+
+Layout contract:
+- stage params: any pytree whose leaves are stacked [n_local_layers, ...]
+  slices of the global [n_layers, ...] stack, sharded P(pp_axis, ...).
+- activations: replicated over pp on entry; microbatching is temporal
+  (B is split into num_microbatches chunks), so batch specs never mention pp.
+- output: valid on the LAST stage; use `from_last_stage` (scalar-cheap psum
+  mask) to make it pp-invariant.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _pcast_to(x: jax.Array, vma) -> jax.Array:
+    """Widen x's varying-manual-axes set to `vma` (scan carries must enter
+    with the vma type their loop body produces)."""
+    missing = tuple(sorted(set(vma) - set(jax.typeof(x).vma)))
+    return lax.pcast(x, missing, to="varying") if missing else x
+
+
+def _tree_vma(*trees) -> set:
+    vma = set()
+    for t in trees:
+        for leaf in jax.tree_util.tree_leaves(t):
+            vma |= set(jax.typeof(leaf).vma)
+    return vma
+
+
+def stack_layers(layers: List[Any]):
+    """[{w: [..]}, ...] -> {w: [L, ..]}: stack a homogeneous list-of-pytrees
+    along a new leading layer axis (shardable over pp)."""
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *layers)
+
+
+def unstack_layers(stacked) -> List[Any]:
+    leaves = jax.tree_util.tree_leaves(stacked)
+    n = leaves[0].shape[0]
+    return [jax.tree_util.tree_map(lambda x: x[i], stacked)
+            for i in range(n)]
+
+
+def scan_layers(block_fn: Callable, stacked_params, x, *,
+                remat: bool = False):
+    """Apply block_fn(layer_params, x) -> x over a stacked [L, ...] slice."""
+    fn = jax.checkpoint(block_fn) if remat else block_fn
+
+    def body(h, lyr):
+        return fn(lyr, h), None
+
+    # carry must enter varying over every axis the block output varies over
+    # (block_fn is assumed vma-monotone, e.g. residual-style)
+    out, _ = lax.scan(body, _pcast_to(x, _tree_vma(x, stacked_params)),
+                      stacked_params)
+    return out
+
+
+def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
+                   num_microbatches: int, pp_axis: str) -> jax.Array:
+    """Run x through the full pipeline; call inside shard_map.
+
+    stage_fn(stage_params, mb) -> mb applies this device's layer slice to one
+    microbatch.  x: [B, ...] replicated over pp, B % num_microbatches == 0.
+    Returns [B, ...] — valid ONLY on the last stage (mask with
+    `from_last_stage`).
+
+    Schedule (per tick t of num_microbatches + pp - 1):
+      stage 0 injects microbatch t; every stage applies its slice; the
+      result rotates one hop down the ring (ppermute), exactly the
+      reference's SEND_LOCAL -> REDUCE -> FORWARD slice rotation
+      (hw/all_reduce.sv:891-1086) with layers in place of partial sums.
+    Ticks where a stage holds no real microbatch compute on ring garbage;
+    those results land in output slots that a later tick overwrites.
+    """
+    n = lax.axis_size(pp_axis)
+    stage = lax.axis_index(pp_axis)
+    B = x.shape[0]
+    assert B % num_microbatches == 0, (B, num_microbatches)
+    mb = B // num_microbatches
+    x_mb = x.reshape((num_microbatches, mb) + x.shape[1:])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    # scan carries enter with the vma type the tick body produces: varying
+    # over pp (stage index / ppermute) plus everything x or the params carry
+    vma = _tree_vma(x, stage_params) | {pp_axis}
+    state = _pcast_to(jnp.zeros_like(x_mb[0]), vma)
+    outputs = _pcast_to(jnp.zeros_like(x_mb), vma)
+
+    def tick(carry, t):
+        state, outputs = carry
+        inject = lax.dynamic_index_in_dim(x_mb, t % num_microbatches, 0,
+                                          keepdims=False)
+        cur = jnp.where(stage == 0, inject, state)
+        out = stage_fn(stage_params, cur)
+        # Last stage finished microbatch t-(n-1); earlier ticks write garbage
+        # at wrapped indices that tick t+num_microbatches overwrites.
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, out, (t - (n - 1)) % num_microbatches, 0)
+        state = lax.ppermute(out, pp_axis, perm)
+        return (state, outputs), None
+
+    ticks = jnp.arange(num_microbatches + n - 1)
+    (_, outputs), _ = lax.scan(tick, (state, outputs), ticks)
+    return outputs.reshape(x.shape)
+
+
+def from_last_stage(val: jax.Array, pp_axis: str) -> jax.Array:
+    """psum-broadcast a value that is only valid on the last pp stage.
+    Cheap for scalars (per-microbatch losses); use sparingly on big tensors."""
+    n = lax.axis_size(pp_axis)
+    is_last = (lax.axis_index(pp_axis) == n - 1).astype(val.dtype)
+    return lax.psum(val * is_last, pp_axis)

@@ -69,12 +69,17 @@ class ShardedTrainer:
 
     def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
                  param_specs, *, dp_axis: str = "dp", tp_axis: str = "tp",
-                 sp_axis: str = "sp"):
+                 sp_axis: str = "sp", pp_axis: Optional[str] = None):
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.cfg = cfg
         self.param_specs = param_specs
         self.dp, self.tp, self.sp = dp_axis, tp_axis, sp_axis
+        self.pp = pp_axis
+        # flat-master sharding: one distinct f32 shard per (tp[, pp]) model
+        # shard, split over dp for ZeRO-1
+        self._waxes = ((tp_axis,) + ((pp_axis,) if pp_axis else ())
+                       + (dp_axis,))
         self.n_dp = mesh.shape[dp_axis]
         self._meta = None
 
@@ -101,7 +106,7 @@ class ShardedTrainer:
 
         w_own, opt_state = jax.jit(jax.shard_map(
             _init, mesh=self.mesh, in_specs=(self.param_specs,),
-            out_specs=P((self.tp, self.dp)), check_vma=False))(params)
+            out_specs=P(self._waxes), check_vma=False))(params)
         return ShardedState(params=params, w_own=w_own, opt_state=opt_state,
                             step=jnp.zeros((), jnp.int32))
 
@@ -112,8 +117,9 @@ class ShardedTrainer:
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
         meta = self._meta
         assert meta is not None, "call init_state first"
-        dp, tp, sp = self.dp, self.tp, self.sp
+        dp, tp, sp, pp = self.dp, self.tp, self.sp, self.pp
         n_sp = self.mesh.shape[sp]
+        w_spec = P(self._waxes)
 
         # Phase 1 runs with check_vma=True: differentiating THROUGH
         # collectives (tp psum, sp loss reduction, ring-attention ppermute)
@@ -136,6 +142,8 @@ class ShardedTrainer:
             loss = lax.pmean(loss, tp)     # numerically identity; clears vma
             if n_sp == 1:
                 loss = lax.pmean(loss, sp)  # loss_fn psums sp when n_sp > 1
+            if pp is not None:
+                loss = lax.pmean(loss, pp)  # identity: loss_fn psums pp
             return w_new, opt_state2, loss
 
         # Phase 2 (no autodiff): gather updated weights back to the
@@ -147,12 +155,12 @@ class ShardedTrainer:
         def _step(state: ShardedState, batch):
             w_own, opt_state, loss = jax.shard_map(
                 shard_update, mesh=self.mesh,
-                in_specs=(self.param_specs, P((tp, dp)), P((tp, dp)), P(),
+                in_specs=(self.param_specs, w_spec, w_spec, P(),
                           P(dp, sp)),
-                out_specs=(P((tp, dp)), P((tp, dp)), P()),
+                out_specs=(w_spec, w_spec, P()),
             )(state.params, state.w_own, state.opt_state, state.step, batch)
             new_params = jax.shard_map(
-                shard_gather, mesh=self.mesh, in_specs=P((tp, dp)),
+                shard_gather, mesh=self.mesh, in_specs=w_spec,
                 out_specs=self.param_specs, check_vma=False)(w_own)
             return ShardedState(new_params, w_own, opt_state,
                                 state.step + 1), loss

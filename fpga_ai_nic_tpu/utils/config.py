@@ -109,15 +109,16 @@ class MeshConfig:
     fsdp: int = 1                 # ZeRO / fully-sharded data parallel
     tp: int = 1                   # tensor parallel
     sp: int = 1                   # sequence/context parallel (ring attention)
-    ep: int = 1                   # expert parallel
+    pp: int = 1                   # pipeline parallel (GPipe microbatch ring)
+    ep: int = 1                   # expert parallel (MoE all-to-all)
 
     @property
     def nproc(self) -> int:
-        return self.dp * self.fsdp * self.tp * self.sp * self.ep
+        return self.dp * self.fsdp * self.tp * self.sp * self.pp * self.ep
 
     def axis_sizes(self) -> Tuple[Tuple[str, int], ...]:
         return (("dp", self.dp), ("fsdp", self.fsdp), ("tp", self.tp),
-                ("sp", self.sp), ("ep", self.ep))
+                ("sp", self.sp), ("pp", self.pp), ("ep", self.ep))
 
 
 @dataclass(frozen=True)
