@@ -18,13 +18,14 @@ Functional pytree params, like models.mlp.  GQA, RMSNorm, SwiGLU, RoPE.
 from __future__ import annotations
 
 from dataclasses import dataclass
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from ..ops import moe as moe_ops
 from ..ops.ring_attention import full_attention, ring_attention
 
 
@@ -39,10 +40,26 @@ class LlamaConfig:
     rope_theta: float = 500000.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # MoE: when moe_experts > 0, every FFN becomes a top-k routed expert
+    # layer (ops.moe); dense SwiGLU otherwise.  Not composable with the
+    # pipelined path yet (apply_pp raises).
+    moe_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_weight: float = 0.01
 
     @property
     def head_dim(self) -> int:
         return self.dim // self.n_heads
+
+    @property
+    def moe(self) -> Optional["moe_ops.MoEConfig"]:
+        if self.moe_experts == 0:
+            return None
+        return moe_ops.MoEConfig(
+            num_experts=self.moe_experts, top_k=self.moe_top_k,
+            capacity_factor=self.moe_capacity_factor,
+            aux_weight=self.moe_aux_weight)
 
     @staticmethod
     def llama3_8b() -> "LlamaConfig":
@@ -74,28 +91,43 @@ def init(key: jax.Array, cfg: LlamaConfig) -> Dict:
         "layers": [],
     }
     for _ in range(cfg.n_layers):
-        params["layers"].append({
+        lyr = {
             "attn_norm": jnp.ones((D,), dt),
             "wq": dense(next(keys), D, (D, cfg.n_heads * Hd)),
             "wk": dense(next(keys), D, (D, cfg.n_kv_heads * Hd)),
             "wv": dense(next(keys), D, (D, cfg.n_kv_heads * Hd)),
             "wo": dense(next(keys), cfg.n_heads * Hd, (cfg.n_heads * Hd, D)),
             "mlp_norm": jnp.ones((D,), dt),
-            "w1": dense(next(keys), D, (D, cfg.ffn_dim)),
-            "w3": dense(next(keys), D, (D, cfg.ffn_dim)),
-            "w2": dense(next(keys), cfg.ffn_dim, (cfg.ffn_dim, D)),
-        })
+        }
+        if cfg.moe is not None:
+            lyr["moe"] = moe_ops.init_ffn(next(keys), D, cfg.ffn_dim,
+                                          cfg.moe, dtype=dt)
+        else:
+            lyr.update({
+                "w1": dense(next(keys), D, (D, cfg.ffn_dim)),
+                "w3": dense(next(keys), D, (D, cfg.ffn_dim)),
+                "w2": dense(next(keys), cfg.ffn_dim, (cfg.ffn_dim, D)),
+            })
+        params["layers"].append(lyr)
     return params
 
 
-def param_specs(cfg: LlamaConfig, tp_axis: Optional[str] = "tp") -> Dict:
+def param_specs(cfg: LlamaConfig, tp_axis: Optional[str] = "tp",
+                ep_axis: Optional[str] = None) -> Dict:
     """PartitionSpecs: Megatron column/row sharding over the tp axis
-    (tp_axis=None replicates — for meshes without a tp axis)."""
+    (tp_axis=None replicates — for meshes without a tp axis); MoE expert
+    weights shard over ep_axis."""
     col, row, rep = P(None, tp_axis), P(tp_axis, None), P()
     layer = {"attn_norm": rep, "wq": col, "wk": col, "wv": col, "wo": row,
-             "mlp_norm": rep, "w1": col, "w3": col, "w2": row}
+             "mlp_norm": rep}
+    if cfg.moe is not None:
+        layer["moe"] = moe_ops.param_specs(cfg.moe, ep_axis)
+    else:
+        layer.update({"w1": col, "w3": col, "w2": row})
     return {"tok_emb": rep, "final_norm": rep, "lm_head": col,
-            "layers": [dict(layer) for _ in range(cfg.n_layers)]}
+            "layers": [{k: dict(v) if isinstance(v, dict) else v
+                        for k, v in layer.items()}
+                       for _ in range(cfg.n_layers)]}
 
 
 def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
@@ -122,9 +154,11 @@ def _psum_if(x: jax.Array, axis: Optional[str]) -> jax.Array:
 
 def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
            n_heads: int, n_kv: int, tp_axis: Optional[str],
-           sp_axis: Optional[str]) -> jax.Array:
-    """One decoder layer (pre-norm attention + SwiGLU FFN) on local shards.
-    n_heads/n_kv are the per-tp-shard head counts."""
+           sp_axis: Optional[str], ep_axis: Optional[str] = None,
+           batch_axes=()) -> "tuple[jax.Array, jax.Array]":
+    """One decoder layer (pre-norm attention + SwiGLU or MoE FFN) on local
+    shards; n_heads/n_kv are the per-tp-shard head counts.  Returns
+    (x, aux) — aux is the MoE load-balance loss (0 for dense layers)."""
     B, S = x.shape[:2]
     Hd = cfg.head_dim
     h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
@@ -145,9 +179,14 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
     x = x + _psum_if(att @ lyr["wo"], tp_axis)
 
     h = _rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
-    gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
-    ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
-    return x + _psum_if(ff, tp_axis)
+    if "moe" in lyr:
+        ff, aux = moe_ops.moe_ffn(lyr["moe"], h, cfg.moe, ep_axis=ep_axis,
+                                  batch_axes=batch_axes)
+    else:
+        gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)).astype(x.dtype)
+        ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
+        aux = jnp.float32(0.0)
+    return x + _psum_if(ff, tp_axis), aux
 
 
 def _shard_counts(cfg: LlamaConfig, tp_axis: Optional[str]):
@@ -171,26 +210,38 @@ def _positions(S: int, sp_axis: Optional[str]) -> jax.Array:
 def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
           tp_axis: Optional[str] = None,
           sp_axis: Optional[str] = None,
-          gather_logits: bool = True) -> jax.Array:
+          ep_axis: Optional[str] = None,
+          batch_axes=(),
+          gather_logits: bool = True,
+          with_aux: bool = False) -> jax.Array:
     """tokens [B, S_local] -> logits [B, S_local, vocab] (vocab/tp when
-    gather_logits=False under tp).
+    gather_logits=False under tp); (logits, moe_aux) when with_aux.
 
     Call inside shard_map with params pre-sharded per ``param_specs`` when
-    tp_axis is set; sequence shards must be contiguous when sp_axis is set.
+    tp_axis is set; sequence shards must be contiguous when sp_axis is set;
+    batch_axes lists every token-sharding axis for MoE aux statistics.
     """
     B, S = tokens.shape
+    if cfg.moe is not None and tp_axis is not None:
+        raise NotImplementedError(
+            "MoE + tensor parallelism is not supported: experts replicate "
+            "over tp, so the row-parallel psum would multiply the FFN "
+            "residual by n_tp (shard experts over ep instead)")
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
     pos = _positions(S, sp_axis)
 
     x = params["tok_emb"][tokens]                       # [B, S, D]
+    aux = jnp.float32(0.0)
     for lyr in params["layers"]:
-        x = _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)
+        x, a = _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
+                      ep_axis, batch_axes)
+        aux = aux + a
 
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
     logits = x @ params["lm_head"]                      # [B, S, V/tp]
     if tp_axis is not None and gather_logits:
         logits = lax.all_gather(logits, tp_axis, axis=2, tiled=True)
-    return logits
+    return (logits, aux) if with_aux else logits
 
 
 def _vocab_parallel_nll(logits: jax.Array, labels: jax.Array,
@@ -229,30 +280,35 @@ def _token_nll(logits: jax.Array, safe_labels: jax.Array,
     return -jnp.take_along_axis(logz, safe_labels[..., None], axis=-1)[..., 0]
 
 
+def _grad_scale(x: jax.Array, n: int) -> jax.Array:
+    """Value-preserving gradient scale by n (cancels a trainer's uniform
+    /n_dp gradient average)."""
+    return lax.stop_gradient(x) + n * (x - lax.stop_gradient(x))
+
+
 def _weighted_loss(local_sum: jax.Array, count: jax.Array,
-                   sp_axis: Optional[str],
+                   batch_axes: Tuple[Optional[str], ...],
                    dp_axis: Optional[str]) -> jax.Array:
-    """Token-weighted global mean over sequence/data shards.  With dp_axis,
-    the gradient carries an n_dp factor that cancels the trainer's uniform
-    /n_dp average so the effective update is the true global-mean gradient
-    (see loss_fn docstring)."""
-    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+    """Token-weighted global mean over the token-sharding axes (sp/dp/ep).
+    With dp_axis, the gradient carries an n_dp factor that cancels the
+    trainer's uniform /n_dp average so the effective update is the true
+    global-mean gradient (see loss_fn docstring)."""
+    axes = tuple(a for a in batch_axes if a is not None)
     if not axes:
         return local_sum / jnp.maximum(count, 1)
     total = lax.psum(local_sum, axes)
     denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
     loss = total / denom
     if dp_axis is not None:
-        n_dp = lax.axis_size(dp_axis)
-        loss = lax.stop_gradient(loss) + (
-            n_dp * (total - lax.stop_gradient(total)) / denom)
+        loss = _grad_scale(loss, lax.axis_size(dp_axis))
     return loss
 
 
 def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
             tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None,
-            dp_axis: Optional[str] = None) -> jax.Array:
+            dp_axis: Optional[str] = None,
+            ep_axis: Optional[str] = None) -> jax.Array:
     """Next-token cross-entropy.  batch = (tokens, labels), both [B, S_local]
     — labels are the globally-shifted targets (shift crosses sequence-shard
     boundaries, so the data pipeline provides them; -100 entries are
@@ -270,10 +326,17 @@ def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
     tokens, labels = batch
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
-    logits = apply(params, tokens, cfg, tp_axis=tp_axis, sp_axis=sp_axis,
-                   gather_logits=False)
+    batch_axes = (sp_axis, dp_axis, ep_axis)
+    logits, aux = apply(params, tokens, cfg, tp_axis=tp_axis,
+                        sp_axis=sp_axis, ep_axis=ep_axis,
+                        batch_axes=tuple(a for a in batch_axes
+                                         if a is not None),
+                        gather_logits=False, with_aux=True)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
-    return _weighted_loss(jnp.sum(nll), jnp.sum(valid), sp_axis, dp_axis)
+    loss = _weighted_loss(jnp.sum(nll), jnp.sum(valid), batch_axes, dp_axis)
+    if dp_axis is not None:     # same /n_dp cancellation as the ce term
+        aux = _grad_scale(aux, lax.axis_size(dp_axis))
+    return loss + aux
 
 
 # -- pipeline-parallel path ---------------------------------------------------
@@ -313,12 +376,15 @@ def apply_pp(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
     pp stage only (loss_fn handles the mask; see parallel.pipeline)."""
     from ..parallel import pipeline as pl
 
+    if cfg.moe is not None:
+        raise NotImplementedError("MoE layers are not supported on the "
+                                  "pipelined path yet (aux-loss carry)")
     S = tokens.shape[1]
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
     pos = _positions(S, sp_axis)
 
     def block(lyr, x):
-        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)[0]
 
     def stage_fn(stacked, x):
         return pl.scan_layers(block, stacked, x, remat=remat)
@@ -351,11 +417,16 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
                       sp_axis=sp_axis, remat=remat)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
     local_sum = pl.from_last_stage(jnp.sum(nll), pp_axis)
-    return _weighted_loss(local_sum, jnp.sum(valid), sp_axis, dp_axis)
+    return _weighted_loss(local_sum, jnp.sum(valid), (sp_axis, dp_axis),
+                          dp_axis)
 
 
 def num_params(cfg: LlamaConfig) -> int:
     D, Hd = cfg.dim, cfg.head_dim
+    if cfg.moe is not None:
+        ffn = D * cfg.moe_experts + 3 * cfg.moe_experts * D * cfg.ffn_dim
+    else:
+        ffn = 3 * D * cfg.ffn_dim
     per_layer = (2 * D + D * cfg.n_heads * Hd + 2 * D * cfg.n_kv_heads * Hd
-                 + cfg.n_heads * Hd * D + 3 * D * cfg.ffn_dim)
+                 + cfg.n_heads * Hd * D + ffn)
     return cfg.vocab * D * 2 + D + cfg.n_layers * per_layer

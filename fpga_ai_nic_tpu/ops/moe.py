@@ -1,0 +1,147 @@
+"""Mixture-of-experts FFN with expert parallelism (ep) over all-to-all.
+
+The reference has no MoE (SURVEY.md §2 "Absent: ... EP, MoE"); this is the
+north-star generalization of its core move — shard state across a ring and
+move *data* to the state's owner instead of replicating state — applied to
+FFN experts: expert weights shard over the ep mesh axis, and tokens travel
+to their expert's owner via `lax.all_to_all` (ICI), the TPU analogue of the
+reference streaming gradient slices to the slice's reducing node
+(hw/all_reduce.sv slice rotation).
+
+Design (GShard/Switch-style, static shapes for XLA):
+- top-k routing with renormalized gates;
+- fixed per-expert capacity C = ceil(T*k/E * capacity_factor); overflow
+  tokens are dropped deterministically in token-major priority order (their
+  residual path still carries them);
+- dispatch/combine via scatter-add / gather, not [T,E,C] one-hot einsums —
+  O(T*k*D) memory;
+- load-balance aux loss computed over the *global* batch (psum over the
+  batch axes) so sharded and unsharded training see the same regularizer.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Dict, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+@dataclass(frozen=True)
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 2.0   # C = ceil(T*k/E * cf) per rank
+    aux_weight: float = 0.01       # load-balance loss weight
+
+    def __post_init__(self):
+        assert 1 <= self.top_k <= self.num_experts
+
+    def capacity(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens * self.top_k / self.num_experts
+                                * self.capacity_factor))
+
+
+def init_ffn(key: jax.Array, dim: int, ffn_dim: int, cfg: MoEConfig,
+             dtype=jnp.float32) -> Dict:
+    """Router + E SwiGLU experts.  wr stays f32 (routing logits are
+    precision-sensitive); expert weights use the model dtype."""
+    kr, k1, k3, k2 = jax.random.split(key, 4)
+    E, D, F = cfg.num_experts, dim, ffn_dim
+
+    def dense(k, fan_in, shape):
+        return (jax.random.normal(k, shape, jnp.float32)
+                * jnp.sqrt(1.0 / fan_in)).astype(dtype)
+
+    return {"wr": jax.random.normal(kr, (D, E), jnp.float32)
+                  * jnp.sqrt(1.0 / D),
+            "w1": dense(k1, D, (E, D, F)),
+            "w3": dense(k3, D, (E, D, F)),
+            "w2": dense(k2, F, (E, F, D))}
+
+
+def param_specs(cfg: MoEConfig, ep_axis: Optional[str] = None) -> Dict:
+    """Experts shard over ep on their leading axis; the router replicates."""
+    e = P(ep_axis, None, None)
+    return {"wr": P(), "w1": e, "w3": e, "w2": e}
+
+
+def _expert_ffn(params: Dict, h: jax.Array) -> jax.Array:
+    """h: [E_local, C', D] -> [E_local, C', D], SwiGLU per expert."""
+    g = jnp.einsum("ecd,edf->ecf", h, params["w1"])
+    u = jnp.einsum("ecd,edf->ecf", h, params["w3"])
+    g = jax.nn.silu(g.astype(jnp.float32)).astype(h.dtype)
+    return jnp.einsum("ecf,efd->ecd", g * u, params["w2"])
+
+
+def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig, *,
+            ep_axis: Optional[str] = None,
+            batch_axes: Sequence[str] = ()) -> Tuple[jax.Array, jax.Array]:
+    """x: [B, S, D] local tokens -> (y [B, S, D], aux scalar).
+
+    With ep_axis set (inside shard_map), expert leaves are the local
+    [E/ep, ...] shards and tokens are exchanged with two all_to_alls
+    (dispatch + return).  batch_axes: every mesh axis that shards tokens
+    (dp/sp/ep) — used only for the global aux statistics.
+    """
+    B, S, D = x.shape
+    T = B * S
+    E, k = cfg.num_experts, cfg.top_k
+    C = cfg.capacity(T)
+    xf = x.reshape(T, D)
+
+    logits = (xf.astype(jnp.float32) @ params["wr"])          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, eidx = lax.top_k(probs, k)                         # [T, k]
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+    # deterministic token-major priority: earlier tokens win capacity slots
+    # (the reference drops nothing but orders everything by stream position;
+    # same discipline here)
+    e_flat = eidx.reshape(-1)                                 # [T*k]
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [T*k, E]
+    prio = jnp.cumsum(onehot, axis=0) - onehot
+    pos = jnp.sum(prio * onehot, axis=-1)                     # [T*k]
+    keep = (pos < C)
+    slot = jnp.where(keep, pos, 0)
+
+    toks = jnp.repeat(xf, k, axis=0)                          # [T*k, D]
+    buf = jnp.zeros((E, C, D), x.dtype).at[e_flat, slot].add(
+        toks * keep[:, None].astype(x.dtype))
+
+    if ep_axis is not None:
+        ep = lax.axis_size(ep_axis)
+        assert E % ep == 0, (E, ep)
+        El = E // ep
+        buf = buf.reshape(ep, El, C, D)
+        buf = lax.all_to_all(buf, ep_axis, split_axis=0, concat_axis=0)
+        h = buf.transpose(1, 0, 2, 3).reshape(El, ep * C, D)
+        out = _expert_ffn(params, h)
+        out = out.reshape(El, ep, C, D).transpose(1, 0, 2, 3)
+        out = lax.all_to_all(out, ep_axis, split_axis=0, concat_axis=0)
+        ybuf = out.reshape(E, C, D)
+    else:
+        ybuf = _expert_ffn(params, buf)
+
+    w = (gates.reshape(-1) * keep.astype(jnp.float32)).astype(x.dtype)
+    ytok = ybuf[e_flat, slot] * w[:, None]                    # [T*k, D]
+    y = ytok.reshape(T, k, D).sum(axis=1).reshape(B, S, D)
+
+    # load-balance aux (GShard): E * sum_i f_i * p_i over the GLOBAL batch.
+    # f from hard assignments (zero grad), p from mean router probs.
+    counts = jnp.sum(onehot, axis=0).astype(jnp.float32)      # [E]
+    psum_p = jnp.sum(probs, axis=0)                           # [E]
+    n_tok = jnp.float32(T)
+    if batch_axes:
+        axes = tuple(batch_axes)
+        counts = lax.psum(counts, axes)
+        psum_p = lax.psum(psum_p, axes)
+        n_tok = lax.psum(n_tok, axes)
+    f = counts / (n_tok * k)
+    p = psum_p / n_tok
+    aux = cfg.aux_weight * E * jnp.dot(f, p)
+    return y, aux

@@ -69,17 +69,21 @@ class ShardedTrainer:
 
     def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
                  param_specs, *, dp_axis: str = "dp", tp_axis: str = "tp",
-                 sp_axis: str = "sp", pp_axis: Optional[str] = None):
+                 sp_axis: str = "sp", pp_axis: Optional[str] = None,
+                 ep_axis: Optional[str] = None):
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.cfg = cfg
         self.param_specs = param_specs
         self.dp, self.tp, self.sp = dp_axis, tp_axis, sp_axis
-        self.pp = pp_axis
-        # flat-master sharding: one distinct f32 shard per (tp[, pp]) model
-        # shard, split over dp for ZeRO-1
+        self.pp, self.ep = pp_axis, ep_axis
+        # flat-master sharding: one distinct f32 shard per (tp[, pp, ep])
+        # model shard, split over dp for ZeRO-1
         self._waxes = ((tp_axis,) + ((pp_axis,) if pp_axis else ())
-                       + (dp_axis,))
+                       + ((ep_axis,) if ep_axis else ()) + (dp_axis,))
+        # token/batch sharding: ep splits the batch alongside dp (experts
+        # exchange tokens within the ep group via all_to_all)
+        self._bspec = P((dp_axis, ep_axis) if ep_axis else dp_axis, sp_axis)
         self.n_dp = mesh.shape[dp_axis]
         self._meta = None
 
@@ -117,9 +121,10 @@ class ShardedTrainer:
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
         meta = self._meta
         assert meta is not None, "call init_state first"
-        dp, tp, sp, pp = self.dp, self.tp, self.sp, self.pp
+        dp, tp, sp, pp, ep = self.dp, self.tp, self.sp, self.pp, self.ep
         n_sp = self.mesh.shape[sp]
         w_spec = P(self._waxes)
+        b_spec = self._bspec
 
         # Phase 1 runs with check_vma=True: differentiating THROUGH
         # collectives (tp psum, sp loss reduction, ring-attention ppermute)
@@ -144,6 +149,8 @@ class ShardedTrainer:
                 loss = lax.pmean(loss, sp)  # loss_fn psums sp when n_sp > 1
             if pp is not None:
                 loss = lax.pmean(loss, pp)  # identity: loss_fn psums pp
+            if ep is not None:
+                loss = lax.pmean(loss, ep)  # identity: loss_fn psums ep
             return w_new, opt_state2, loss
 
         # Phase 2 (no autodiff): gather updated weights back to the
@@ -156,7 +163,7 @@ class ShardedTrainer:
             w_own, opt_state, loss = jax.shard_map(
                 shard_update, mesh=self.mesh,
                 in_specs=(self.param_specs, w_spec, w_spec, P(),
-                          P(dp, sp)),
+                          b_spec),
                 out_specs=(w_spec, w_spec, P()),
             )(state.params, state.w_own, state.opt_state, state.step, batch)
             new_params = jax.shard_map(
@@ -171,5 +178,4 @@ class ShardedTrainer:
         return self.step_fn(state, batch)
 
     def shard_batch(self, batch):
-        return mesh_lib.shard_host_batch(batch, self.mesh,
-                                         P(self.dp, self.sp))
+        return mesh_lib.shard_host_batch(batch, self.mesh, self._bspec)
