@@ -1,1 +1,2 @@
-from . import bfp, bfp_golden, bucketed, fused_update, ring, ring_golden  # noqa: F401
+from . import (bfp, bfp_golden, bfp_pallas, bucketed, fused_update, moe,
+               ring, ring_attention, ring_golden)  # noqa: F401

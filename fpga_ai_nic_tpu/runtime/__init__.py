@@ -1,4 +1,6 @@
-from .queue import CollectiveQueue, Ticket
 from . import native  # noqa: F401
+from .queue import CollectiveQueue, Ticket
+from .watchdog import DeviceHangError, Heartbeat, Watchdog, run_with_recovery
 
-__all__ = ["CollectiveQueue", "Ticket", "native"]
+__all__ = ["CollectiveQueue", "Ticket", "native", "Watchdog", "Heartbeat",
+           "DeviceHangError", "run_with_recovery"]

@@ -162,6 +162,8 @@ def _coerce(T: Any, v: str) -> Any:
         return v.lower() in ("1", "true", "yes", "on")
     if T in (int, float, str):
         return T(v)
+    if T is tuple:     # comma-separated ints, e.g. --model.layer_sizes=64,64
+        return tuple(int(p) for p in v.split(",") if p)
     raise TypeError(f"cannot coerce flag value {v!r} to {T}")
 
 

@@ -1,0 +1,96 @@
+"""Failure detection: watchdog timeouts, heartbeat staleness, and
+retry-from-known-good-state recovery."""
+
+import time
+
+import pytest
+
+from fpga_ai_nic_tpu.runtime.watchdog import (
+    DeviceHangError, Heartbeat, Watchdog, run_with_recovery)
+
+
+def test_watchdog_passes_results_through():
+    wd = Watchdog(timeout_s=5.0)
+    assert wd.run(lambda a, b: a + b, 2, 3) == 5
+
+
+def test_watchdog_detects_hang_and_recovers_worker():
+    wd = Watchdog(timeout_s=0.1)
+    with pytest.raises(DeviceHangError):
+        wd.run(time.sleep, 2.0)
+    # the wedged (daemon) worker must not block subsequent healthy calls
+    assert wd.run(lambda: "ok") == "ok"
+
+
+def test_watchdog_propagates_exceptions():
+    wd = Watchdog(timeout_s=5.0)
+    with pytest.raises(ValueError, match="boom"):
+        wd.run(lambda: (_ for _ in ()).throw(ValueError("boom")))
+
+
+def test_heartbeat_staleness():
+    hb = Heartbeat(stall_after_s=0.05)
+    hb.beat()
+    assert not hb.stalled()
+    time.sleep(0.1)
+    assert hb.stalled()
+    with pytest.raises(DeviceHangError):
+        hb.assert_alive()
+    hb.beat()
+    hb.assert_alive()
+    assert hb.beats == 2
+
+
+def test_run_with_recovery_retries_transient_failure():
+    calls = {"n": 0}
+
+    def flaky_step(state, batch):
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise RuntimeError("transient")
+        return state + batch, float(batch)
+
+    failures = []
+    out, loss = run_with_recovery(flaky_step, 10, 5, max_retries=3,
+                                  backoff_s=0.01,
+                                  on_failure=failures.append)
+    assert (out, loss) == (15, 5.0)
+    assert calls["n"] == 3 and len(failures) == 2
+
+
+def test_run_with_recovery_restores_state():
+    seen = []
+
+    def step(state, batch):
+        seen.append(state)
+        if len(seen) < 2:
+            raise RuntimeError("bad state")
+        return state, 0.0
+
+    out, _ = run_with_recovery(step, "live", None, max_retries=2,
+                               backoff_s=0.01, restore_fn=lambda: "ckpt")
+    assert seen == ["live", "ckpt"] and out == "ckpt"
+
+
+def test_run_with_recovery_exhausts_and_raises():
+    def always_fail(state, batch):
+        raise RuntimeError("permanent")
+
+    with pytest.raises(RuntimeError, match="permanent"):
+        run_with_recovery(always_fail, None, None, max_retries=1,
+                          backoff_s=0.01)
+
+
+def test_recovery_composes_with_watchdog():
+    calls = {"n": 0}
+
+    def sometimes_hangs(state, batch):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            time.sleep(1.0)
+        return state, 1.0
+
+    out, loss = run_with_recovery(sometimes_hangs, 7, None, max_retries=1,
+                                  backoff_s=0.01,
+                                  watchdog=Watchdog(timeout_s=0.1))
+    assert (out, loss) == (7, 1.0) and calls["n"] == 2
