@@ -26,9 +26,11 @@ import time
 import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_NODE = 14_000.0
+METRIC = "mlp_train_samples_per_sec_per_chip"
+TIMEOUT_S = 480.0      # compile (~40s) + 23 steps + sync, with slack
 
 
-def main():
+def _run():
     import jax
     import jax.numpy as jnp
 
@@ -82,12 +84,30 @@ def main():
 
     samples_per_sec = cfg.iters * cfg.global_batch / dt
     per_chip = samples_per_sec / n_dev
-    print(json.dumps({
-        "metric": "mlp_train_samples_per_sec_per_chip",
+    return {
+        "metric": METRIC,
         "value": round(per_chip, 1),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_NODE, 3),
-    }))
+    }
+
+
+def main():
+    # A wedged device/tunnel must yield a diagnosable JSON line, not an
+    # infinite hang (the reference's failure mode, hw/README:3); the
+    # watchdog's worker is a daemon thread so the process can still exit.
+    from fpga_ai_nic_tpu.runtime.watchdog import Watchdog
+
+    try:
+        result = Watchdog(timeout_s=TIMEOUT_S).run(_run)
+    except Exception as e:  # noqa: BLE001 — the one JSON line must happen
+        result = {"metric": METRIC, "value": 0.0, "unit": "samples/s/chip",
+                  "vs_baseline": 0.0,
+                  "error": f"{type(e).__name__}: {str(e)[:200]}"}
+    print(json.dumps(result), flush=True)
+    if "error" in result:   # callers checking the exit code must see failure
+        import sys
+        sys.exit(1)
 
 
 if __name__ == "__main__":

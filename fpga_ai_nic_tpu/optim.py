@@ -38,13 +38,39 @@ def init_state(cfg: OptimizerConfig, shard_len: int) -> OptState:
     raise ValueError(cfg.kind)
 
 
+def learning_rate_at(cfg: OptimizerConfig, step) -> jax.Array:
+    """Scheduled lr at a (traced) step count: linear warmup then constant /
+    cosine / linear decay to min_lr_ratio * lr.  The reference's lr is a
+    synthesis-time FFMA constant (hw/weight_update.sv:439-446) — schedules
+    are impossible there; here they are one traced expression."""
+    base = jnp.float32(cfg.learning_rate)
+    if cfg.schedule == "constant" and cfg.warmup_steps == 0:
+        return base
+    t = jnp.asarray(step, jnp.float32)
+    warm = (jnp.minimum(1.0, (t + 1.0) / cfg.warmup_steps)
+            if cfg.warmup_steps > 0 else jnp.float32(1.0))
+    if cfg.schedule == "constant":
+        return base * warm
+    horizon = max(cfg.decay_steps - cfg.warmup_steps, 1)
+    frac = jnp.clip((t - cfg.warmup_steps) / horizon, 0.0, 1.0)
+    decay = (0.5 * (1.0 + jnp.cos(jnp.pi * frac))
+             if cfg.schedule == "cosine" else 1.0 - frac)
+    floor = jnp.float32(cfg.min_lr_ratio)
+    return base * warm * (floor + (1.0 - floor) * decay)
+
+
 def apply(cfg: OptimizerConfig, w: jax.Array, g: jax.Array,
           state: OptState, step=None) -> Tuple[jax.Array, OptState]:
     """w_new = step(w, g); w, g are flat f32 shards (ref semantics:
     w_new = -lr*g + w, hw/weight_update.sv:441-452)."""
     w = w.astype(jnp.float32)
     g = g.astype(jnp.float32)
-    lr = jnp.float32(cfg.learning_rate)
+    if step is None:
+        assert cfg.schedule == "constant" and cfg.warmup_steps == 0, (
+            "lr schedules need the step count")
+        lr = jnp.float32(cfg.learning_rate)
+    else:
+        lr = learning_rate_at(cfg, step)
     if cfg.kind == "sgd":
         if cfg.weight_decay:
             g = g + jnp.float32(cfg.weight_decay) * w

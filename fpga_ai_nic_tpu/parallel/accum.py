@@ -1,0 +1,67 @@
+"""Gradient accumulation: split the per-device batch into sequential
+microbatches inside one jitted step.
+
+The reference's knob for this trade-off is per-node minibatch size alone
+(global MB / n_procs, sw/mlp_mpi_example_f32.cpp:301); accumulation lets a
+fixed device memory train an arbitrarily large global batch — the fused
+collective still runs ONCE per step on the averaged gradient, preserving
+the reduce-scatter -> update -> gather structure (and its wire compression)
+unchanged.
+
+Accumulation runs in f32 regardless of the compute dtype (bf16 partial sums
+lose ~8 bits over long accumulations).  The scan carry is seeded with the
+first microbatch's real outputs so its vma type matches the loop body under
+shard_map variance tracking.
+
+Weighting: microbatches are averaged uniformly, so with -100-masked labels
+token weighting is exact within a microbatch but uniform across microbatch
+boundaries (the standard accumulation semantics).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def accumulated_value_and_grad(loss_fn: Callable, accum_steps: int):
+    """value_and_grad(loss_fn) that averages over accum_steps sequential
+    microbatches.  Batch leaves split on their leading axis, which must be
+    divisible by accum_steps."""
+    if accum_steps == 1:
+        return jax.value_and_grad(loss_fn)
+
+    def fn(params, batch):
+        def split(x):
+            assert x.shape[0] % accum_steps == 0, (x.shape, accum_steps)
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(split, batch)
+        first = jax.tree_util.tree_map(lambda x: x[0], micro)
+        rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+
+        def one(mb):
+            return jax.value_and_grad(loss_fn)(params, mb)
+
+        loss0, g0 = one(first)
+        carry = (loss0.astype(jnp.float32),
+                 jax.tree_util.tree_map(
+                     lambda g: g.astype(jnp.float32), g0))
+
+        def body(c, mb):
+            loss, grads = one(mb)
+            acc_l, acc_g = c
+            return (acc_l + loss.astype(jnp.float32),
+                    jax.tree_util.tree_map(
+                        lambda a, g: a + g.astype(jnp.float32),
+                        acc_g, grads)), None
+
+        (loss, grads), _ = lax.scan(body, carry, rest)
+        inv = jnp.float32(1.0 / accum_steps)
+        return loss * inv, jax.tree_util.tree_map(lambda g: g * inv, grads)
+
+    return fn

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
+from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..ops import bucketed, fused_update
@@ -92,7 +93,8 @@ class DDPTrainer:
             # bucketed collective below), not an autodiff-inserted psum.
             params_v = jax.tree_util.tree_map(
                 lambda x: lax.pcast(x, ax, to="varying"), params)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            loss, grads = accum.accumulated_value_and_grad(
+                self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             # flat f32 end to end: the dp-mean gradient must NOT round
             # through the model dtype on its way to the f32 master update
             flat_g = bucketed.all_reduce_bucketed_flat(grads, ax, coll, plan)

@@ -27,6 +27,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..ops import fused_update
@@ -138,7 +139,8 @@ class ShardedTrainer:
             # sequence shards and tp-replicated params.
             params_v = jax.tree_util.tree_map(
                 lambda x: lax.pcast(x, dp, to="varying"), params)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            loss, grads = accum.accumulated_value_and_grad(
+                self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n_dp)
             g_own = fused_update.reduce_scatter(flat_g, dp, coll) / self.n_dp
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,

@@ -33,6 +33,7 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..ops import fused_update
@@ -106,7 +107,8 @@ class DPTrainer:
             # reduce-scatter and forfeits the fused-ring/BFP wire path.
             params_v = jax.tree_util.tree_map(
                 lambda x: lax.pcast(x, ax, to="varying"), params)
-            loss, grads = jax.value_and_grad(self.loss_fn)(params_v, batch)
+            loss, grads = accum.accumulated_value_and_grad(
+                self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
             g_own = fused_update.reduce_scatter(flat_g, ax, coll) / self.n
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,

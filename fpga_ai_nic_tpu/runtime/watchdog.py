@@ -30,6 +30,8 @@ import time
 from dataclasses import dataclass
 from typing import Any, Callable, Optional, Tuple
 
+import jax
+
 
 class DeviceHangError(RuntimeError):
     """A device-touching call exceeded its watchdog timeout."""
@@ -121,13 +123,29 @@ def run_with_recovery(step_fn: Callable[[Any, Any], Tuple[Any, Any]],
 
     On failure (including DeviceHangError from the watchdog), restores
     state via restore_fn (e.g. a checkpoint load; defaults to reusing the
-    pre-step state, which is valid because steps are functional) and
-    retries with exponential backoff.  Raises the last error after
-    max_retries.
+    pre-step state, valid for non-donating steps because they are
+    functional) and retries with exponential backoff.  Raises the last
+    error after max_retries.
+
+    Donation caveat: the framework's trainers jit their step with
+    ``donate_argnums=(0,)``, so a dispatched-then-failed attempt may have
+    consumed the input state's buffers — retrying with the same pytree
+    would crash on deleted arrays.  Pass restore_fn (checkpoint restore)
+    for those; the retry loop checks and raises a clear error otherwise.
     """
+
+    def _deleted(tree) -> bool:
+        return any(getattr(l, "is_deleted", lambda: False)()
+                   for l in jax.tree_util.tree_leaves(tree))
+
     err: Optional[Exception] = None
     for attempt in range(max_retries + 1):
         src = state if restore_fn is None or attempt == 0 else restore_fn()
+        if attempt > 0 and restore_fn is None and _deleted(src):
+            raise RuntimeError(
+                "cannot retry: the failed step donated the state buffers "
+                "(trainer steps use donate_argnums); pass restore_fn="
+                "<checkpoint restore> to run_with_recovery") from err
         try:
             if watchdog is not None:
                 return watchdog.run(step_fn, src, batch)

@@ -94,9 +94,19 @@ class OptimizerConfig:
     b2: float = 0.999
     eps: float = 1e-8
     weight_decay: float = 0.0
+    # learning-rate schedule (the reference cannot schedule at all — its lr
+    # is an RTL constant; see optim.learning_rate_at)
+    schedule: str = "constant"    # "constant" | "cosine" | "linear"
+    warmup_steps: int = 0
+    decay_steps: int = 0          # horizon for cosine/linear (incl. warmup)
+    min_lr_ratio: float = 0.0     # floor as a fraction of learning_rate
 
     def __post_init__(self):
         assert self.kind in ("sgd", "momentum", "adamw")
+        assert self.schedule in ("constant", "cosine", "linear")
+        if self.schedule != "constant":
+            assert self.decay_steps > self.warmup_steps >= 0, (
+                "cosine/linear schedules need decay_steps > warmup_steps")
 
 
 @dataclass(frozen=True)
@@ -144,6 +154,7 @@ class TrainConfig:
 
     iters: int = 20               # canonical run: 20 (sw/run.sh:16)
     global_batch: int = 5376      # canonical run: MB 5376 (sw/run.sh:16)
+    accum_steps: int = 1          # gradient accumulation microbatches
     mesh: MeshConfig = field(default_factory=MeshConfig)
     collective: CollectiveConfig = field(default_factory=CollectiveConfig)
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
@@ -157,7 +168,10 @@ class TrainConfig:
         return self.global_batch // n
 
 
-def _coerce(T: Any, v: str) -> Any:
+def coerce_value(T: Any, v: str) -> Any:
+    """Parse a flag string as type T (bool truthy words, int/float/str,
+    comma-separated int tuples).  Shared by from_flags and the example
+    drivers' --model.* overlays."""
     if T is bool:
         return v.lower() in ("1", "true", "yes", "on")
     if T in (int, float, str):
@@ -165,6 +179,9 @@ def _coerce(T: Any, v: str) -> Any:
     if T is tuple:     # comma-separated ints, e.g. --model.layer_sizes=64,64
         return tuple(int(p) for p in v.split(",") if p)
     raise TypeError(f"cannot coerce flag value {v!r} to {T}")
+
+
+_coerce = coerce_value
 
 
 def from_flags(cls, argv: Sequence[str]):
@@ -190,8 +207,14 @@ def _replace_path(cfg, path, val):
         new = _replace_path(cur, rest, val)
     elif dataclasses.is_dataclass(cur):
         raise ValueError(f"{name} is a nested config; use --{name}.<field>=...")
+    elif cur is not None:
+        new = coerce_value(type(cur), val)
     else:
-        ftype = fields[name].type
-        new = _coerce(type(cur) if cur is not None else str, val) \
-            if not isinstance(ftype, str) or cur is not None else val
+        # Optional field with a None default: the live value carries no
+        # type, so parse literally (ints/floats) and fall back to string.
+        import ast
+        try:
+            new = ast.literal_eval(val)
+        except (ValueError, SyntaxError):
+            new = val
     return dataclasses.replace(cfg, **{name: new})

@@ -1,0 +1,121 @@
+"""Gradient accumulation equivalence and learning-rate schedules."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fpga_ai_nic_tpu import optim
+from fpga_ai_nic_tpu.models import llama, mlp
+from fpga_ai_nic_tpu.parallel import DPTrainer, ShardedTrainer, make_mesh
+from fpga_ai_nic_tpu.utils.config import (
+    CollectiveConfig, MeshConfig, MLPConfig, OptimizerConfig, TrainConfig)
+
+
+def test_lr_schedule_warmup_and_cosine():
+    cfg = OptimizerConfig(kind="sgd", learning_rate=1.0, schedule="cosine",
+                          warmup_steps=10, decay_steps=110, min_lr_ratio=0.1)
+    lr = lambda t: float(optim.learning_rate_at(cfg, jnp.int32(t)))
+    np.testing.assert_allclose(lr(0), 0.1, rtol=1e-6)        # ramp start
+    np.testing.assert_allclose(lr(9), 1.0, rtol=1e-6)        # ramp end
+    np.testing.assert_allclose(lr(10), 1.0, rtol=1e-3)       # decay start
+    mid = lr(60)                                             # halfway
+    np.testing.assert_allclose(mid, 0.1 + 0.9 * 0.5, rtol=1e-2)
+    np.testing.assert_allclose(lr(110), 0.1, rtol=1e-6)      # floor
+    np.testing.assert_allclose(lr(1000), 0.1, rtol=1e-6)     # clamped
+
+
+def test_lr_schedule_linear_and_constant_warmup():
+    lin = OptimizerConfig(kind="sgd", learning_rate=2.0, schedule="linear",
+                          warmup_steps=0, decay_steps=100)
+    np.testing.assert_allclose(
+        float(optim.learning_rate_at(lin, jnp.int32(50))), 1.0, rtol=1e-6)
+    const = OptimizerConfig(kind="sgd", learning_rate=2.0, warmup_steps=4)
+    np.testing.assert_allclose(
+        float(optim.learning_rate_at(const, jnp.int32(1))), 1.0, rtol=1e-6)
+    np.testing.assert_allclose(
+        float(optim.learning_rate_at(const, jnp.int32(100))), 2.0, rtol=1e-6)
+
+
+def test_schedule_invalid_config():
+    with pytest.raises(AssertionError):
+        OptimizerConfig(schedule="cosine", warmup_steps=5, decay_steps=5)
+
+
+def test_from_flags_optional_and_tuple_fields():
+    from fpga_ai_nic_tpu.utils.config import from_flags
+    cfg = from_flags(MLPConfig, ["--num_classes=10",
+                                 "--layer_sizes=32,64,16"])
+    assert cfg.num_classes == 10                    # Optional[int] coerced
+    assert cfg.layer_sizes == (32, 64, 16)
+
+
+MCFG = MLPConfig(layer_sizes=(32, 64, 64, 16), dtype="float32")
+
+
+def _mlp_state_after(accum, iters=3, rng_seed=0):
+    rng = np.random.default_rng(rng_seed)
+    B = 32
+    cfg = TrainConfig(iters=iters, global_batch=B, accum_steps=accum,
+                      mesh=MeshConfig(dp=2),
+                      collective=CollectiveConfig(impl="xla"),
+                      optimizer=OptimizerConfig(kind="momentum",
+                                                learning_rate=0.05))
+    tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, MCFG),
+                   make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(mlp.init(jax.random.PRNGKey(0), MCFG))
+    x = jnp.asarray(rng.standard_normal((B, 32)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 16, B), jnp.int32)
+    batch = tr.shard_batch((x, y))
+    for _ in range(iters):
+        state, loss = tr.step(state, batch)
+    return state, float(loss)
+
+
+def test_accumulation_matches_single_shot():
+    """accum_steps=4 must reproduce the accum_steps=1 update: same global
+    batch, same gradient average, bit-comparable in f32."""
+    s1, l1 = _mlp_state_after(1)
+    s4, l4 = _mlp_state_after(4)
+    np.testing.assert_allclose(l4, l1, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s4.params),
+                    jax.tree_util.tree_leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-6)
+
+
+def test_accumulation_sharded_llama():
+    """Accumulation composes with the multi-axis trainer (dp x tp)."""
+    cfg_m = llama.LlamaConfig.tiny()
+    B, S = 8, 16
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg_m.vocab, (B, S + 1)).astype(np.int32)
+    batch = (jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:]))
+
+    def run(accum):
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2, 1),
+                    ("dp", "tp", "sp"))
+        cfg = TrainConfig(iters=2, global_batch=B, accum_steps=accum,
+                          mesh=MeshConfig(dp=2, tp=2),
+                          collective=CollectiveConfig(impl="xla"),
+                          optimizer=OptimizerConfig(kind="sgd",
+                                                    learning_rate=0.1))
+        tr = ShardedTrainer(
+            lambda p, b: llama.loss_fn(p, b, cfg_m, tp_axis="tp"),
+            mesh, cfg, llama.param_specs(cfg_m))
+        state = tr.init_state(llama.init(jax.random.PRNGKey(0), cfg_m))
+        sb = tr.shard_batch(batch)
+        for _ in range(2):
+            state, loss = tr.step(state, sb)
+        return state, float(loss)
+
+    s1, l1 = run(1)
+    s2, l2 = run(2)
+    np.testing.assert_allclose(l2, l1, rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(s2.params),
+                    jax.tree_util.tree_leaves(s1.params)):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=5e-4, atol=5e-5)

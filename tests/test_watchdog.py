@@ -81,6 +81,29 @@ def test_run_with_recovery_exhausts_and_raises():
                           backoff_s=0.01)
 
 
+def test_recovery_refuses_donated_state_without_restore_fn():
+    """A failed jitted step with donate_argnums consumes its input buffers;
+    retrying with the same pytree must raise a clear error, not crash on
+    deleted arrays."""
+    import jax
+    import jax.numpy as jnp
+
+    step = jax.jit(lambda s, b: (s + b, jnp.sum(b)), donate_argnums=(0,))
+    state = jnp.ones((4,))
+    step(state, jnp.ones((4,)))          # donates `state`
+
+    calls = {"n": 0}
+
+    def failing_step(s, b):
+        calls["n"] += 1
+        raise RuntimeError("transient")
+
+    with pytest.raises(RuntimeError, match="donated the state buffers"):
+        run_with_recovery(failing_step, state, jnp.ones((4,)),
+                          max_retries=2, backoff_s=0.01)
+    assert calls["n"] == 1               # no blind retry on dead buffers
+
+
 def test_recovery_composes_with_watchdog():
     calls = {"n": 0}
 
