@@ -3,17 +3,30 @@
 
 Runs the reference's canonical model — a 10-layer 2048x2048 MLP with softmax
 cross-entropy (sw/run.sh:16: 20 iters, global MB 5376, 3 nodes) — as a full
-fused training step (fwd + bwd + fused reduce-scatter/SGD/all-gather) on the
-chips available, and reports per-chip throughput.
+fused training step (fwd + bwd + fused reduce-scatter/SGD/all-gather) and
+reports per-chip throughput.
+
+Structure (the round-1 lesson): the parent process imports NO jax — on this
+container the TPU (axon) plugin registers at import and a wedged tunnel can
+hang `import jax` itself.  The parent runs a ladder of child attempts
+
+    1. tpu      — ambient platform, canonical config
+    2. tpu_small— ambient platform, reduced config      (degraded=true)
+    3. cpu      — forced JAX_PLATFORMS=cpu, reduced     (degraded=true)
+
+each in a subprocess under an *activity watchdog*: the child prints a
+progress line per phase (import / devices / init / compile / warmup / timed
+/ sync) and the parent kills it when either the total budget expires or no
+line arrives for the silence limit — so a hang is always localized to a
+phase and the ladder falls through to a config that still measures a real
+number.
 
 vs_baseline: ratio against the reference system's estimated per-node
 throughput.  The reference repo publishes no absolute numbers (BASELINE.md);
 we model its canonical node — Xeon Platinum 8280, 28 cores, AVX-512, libxsmm
-f32 GEMMs at ~80% of a ~4.3 TFLOP/s peak (2 FMA ports x 16 f32 x 2 ops x
-~2.4 GHz AVX-512 all-core) with the all-reduce fully overlapped (its design
-goal) — over the reference FLOP accounting of 243.3 MFLOP/sample
-(sw/mlp_mpi_example_f32.cpp:794-798): ~3.4e12 / 243.3e6 ~= 14,000
-samples/s/node.
+f32 GEMMs at ~80% of a ~4.3 TFLOP/s peak — over the reference FLOP
+accounting of 243.3 MFLOP/sample (sw/mlp_mpi_example_f32.cpp:794-798):
+~3.4e12 / 243.3e6 ~= 14,000 samples/s/node.
 
 TPU-first choice: compute dtype bf16 (MXU native rate; the reference used
 f32 because its CPUs had no reduced-precision GEMM path); master weights and
@@ -21,17 +34,58 @@ the fused optimizer stay f32.
 """
 
 import json
+import os
+import sys
 import time
-
-import numpy as np
 
 BASELINE_SAMPLES_PER_SEC_PER_NODE = 14_000.0
 METRIC = "mlp_train_samples_per_sec_per_chip"
-TIMEOUT_S = 480.0      # compile (~40s) + 23 steps + sync, with slack
+
+# Attempt ladder: (name, env overrides, config knobs, budget_s, silence_s).
+# Budgets sum to ~430s so the whole ladder fits a driver-side timeout of
+# ~8 minutes even when every TPU attempt hangs to its limit.
+ATTEMPTS = [
+    {"name": "tpu", "cpu": False, "layers": 10, "batch": 4096, "iters": 20,
+     "budget_s": 240.0, "silence_s": 150.0, "degraded": False},
+    {"name": "tpu_small", "cpu": False, "layers": 3, "batch": 512, "iters": 10,
+     "budget_s": 110.0, "silence_s": 75.0, "degraded": True},
+    {"name": "cpu", "cpu": True, "layers": 3, "batch": 512, "iters": 3,
+     "budget_s": 80.0, "silence_s": 60.0, "degraded": True},
+]
 
 
-def _run():
+def _log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+# ---------------------------------------------------------------------------
+# child: one measured attempt
+# ---------------------------------------------------------------------------
+
+def child_main(layers: int, batch: int, iters: int) -> None:
+    t0 = time.time()
+
+    def phase(name):
+        _log(f"phase={name} t={time.time() - t0:.1f}s")
+
+    phase("import")
     import jax
+
+    # persistent compile cache: repeat runs (and the degraded retry) skip
+    # XLA compilation entirely
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        _log(f"compile cache unavailable: {e}")
+
+    phase("devices")
+    n_dev = jax.device_count()
+    platform = jax.default_backend()
+    _log(f"platform={platform} n_dev={n_dev}")
+
     import jax.numpy as jnp
 
     from fpga_ai_nic_tpu.models import mlp
@@ -39,76 +93,176 @@ def _run():
     from fpga_ai_nic_tpu.utils.config import (
         CollectiveConfig, MeshConfig, MLPConfig, OptimizerConfig, TrainConfig)
 
-    n_dev = jax.device_count()
-    mcfg = MLPConfig(layer_sizes=(2048,) * 11, dtype="bfloat16")
-    per_chip_batch = 4096
+    phase("init")
+    mcfg = MLPConfig(layer_sizes=(2048,) * (layers + 1), dtype="bfloat16")
     cfg = TrainConfig(
-        iters=20,
-        global_batch=per_chip_batch * n_dev,
+        iters=iters,
+        global_batch=batch * n_dev,
         mesh=MeshConfig(dp=n_dev),
         collective=CollectiveConfig(impl="xla"),
         optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1),
     )
-
     mesh = make_mesh(cfg.mesh)
     tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg), mesh, cfg)
     params = mlp.init(jax.random.PRNGKey(0), mcfg)
     state = tr.init_state(params)
 
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((cfg.global_batch, 2048)),
-                    jnp.bfloat16)
-    y = jnp.asarray(rng.integers(0, 2048, cfg.global_batch), jnp.int32)
-    batch = tr.shard_batch((x, y))
+    phase("data")
+    # generate the batch on-device: a host->device transfer of the 16 MiB
+    # input through the tunnel is exactly the kind of single giant DMA that
+    # wedges; fold-in keyed per-attempt so XLA cannot cache across runs
+    @jax.jit
+    def make_batch(key):
+        kx, ky = jax.random.split(key)
+        x = jax.random.normal(kx, (cfg.global_batch, 2048), jnp.bfloat16)
+        y = jax.random.randint(ky, (cfg.global_batch,), 0, 2048, jnp.int32)
+        return x, y
 
-    # Sync by fetching an on-device scalar reduction: on the tunneled TPU
-    # platform block_until_ready can return before execution finishes, and
-    # fetching an element of a large array pulls the whole buffer; a jitted
-    # scalar sum is the only honest barrier.
+    batch_dev = tr.shard_batch(make_batch(jax.random.PRNGKey(1)))
+
+    # Honest barrier: on the tunneled TPU platform block_until_ready can
+    # return before execution finishes, and fetching one element of a large
+    # array pulls the whole buffer; a jitted scalar reduction is the only
+    # honest sync.
     _sum = jax.jit(lambda t: jax.tree_util.tree_reduce(
         lambda a, l: a + jnp.sum(l.astype(jnp.float32)), t, jnp.float32(0)))
 
     def sync(tree):
         return float(_sum(tree))
 
-    # warmup + compile
-    for _ in range(3):
-        state, loss = tr.step(state, batch)
+    phase("compile")
+    state, loss = tr.step(state, batch_dev)   # first step compiles
     sync(state.params)
 
-    t0 = time.perf_counter()
-    for _ in range(cfg.iters):
-        state, loss = tr.step(state, batch)
+    phase("warmup")
+    for _ in range(2):
+        state, loss = tr.step(state, batch_dev)
     sync(state.params)
-    dt = time.perf_counter() - t0
+
+    phase("timed")
+    t_loop = time.perf_counter()
+    for i in range(cfg.iters):
+        state, loss = tr.step(state, batch_dev)
+        if (i + 1) % 5 == 0:
+            _log(f"iter {i + 1}/{cfg.iters}")
+    phase("sync")
+    sync(state.params)
+    dt = time.perf_counter() - t_loop
 
     samples_per_sec = cfg.iters * cfg.global_batch / dt
     per_chip = samples_per_sec / n_dev
-    return {
+    phase(f"done dt={dt:.3f}s")
+    print(json.dumps({
         "metric": METRIC,
         "value": round(per_chip, 1),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_NODE, 3),
-    }
+        "platform": platform,
+        "n_devices": n_dev,
+        "loss": float(loss),
+    }), flush=True)
 
 
-def main():
-    # A wedged device/tunnel must yield a diagnosable JSON line, not an
-    # infinite hang (the reference's failure mode, hw/README:3); the
-    # watchdog's worker is a daemon thread so the process can still exit.
-    from fpga_ai_nic_tpu.runtime.watchdog import Watchdog
+# ---------------------------------------------------------------------------
+# parent: attempt ladder with activity watchdog
+# ---------------------------------------------------------------------------
 
+def _run_attempt(att: dict) -> dict:
+    """Run one child attempt; returns its parsed JSON or raises RuntimeError
+    with the last progress lines (the forensic record)."""
+    import subprocess
+    import threading
+
+    env = dict(os.environ)
+    if att["cpu"]:
+        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    here = os.path.abspath(__file__)
+    cmd = [sys.executable, "-u", here, "--child", str(att["layers"]),
+           str(att["batch"]), str(att["iters"])]
+    _log(f"attempt={att['name']} budget={att['budget_s']:.0f}s "
+         f"silence={att['silence_s']:.0f}s cmd={' '.join(cmd[2:])}")
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env, cwd=os.path.dirname(here),
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    last_line_at = [time.time()]
+    deadline = t0 + att["budget_s"]
+    kill_reason = [None]
+
+    def _watch():
+        while proc.poll() is None:
+            now = time.time()
+            if now > deadline:
+                kill_reason[0] = f"total budget {att['budget_s']:.0f}s"
+            elif now - last_line_at[0] > att["silence_s"]:
+                kill_reason[0] = (
+                    f"silent for {now - last_line_at[0]:.0f}s "
+                    f"(limit {att['silence_s']:.0f}s)")
+            if kill_reason[0]:
+                proc.kill()
+                return
+            time.sleep(1.0)
+
+    watcher = threading.Thread(target=_watch, daemon=True)
+    watcher.start()
+    lines, result = [], None
     try:
-        result = Watchdog(timeout_s=TIMEOUT_S).run(_run)
-    except Exception as e:  # noqa: BLE001 — the one JSON line must happen
-        result = {"metric": METRIC, "value": 0.0, "unit": "samples/s/chip",
-                  "vs_baseline": 0.0,
-                  "error": f"{type(e).__name__}: {str(e)[:200]}"}
-    print(json.dumps(result), flush=True)
-    if "error" in result:   # callers checking the exit code must see failure
-        import sys
-        sys.exit(1)
+        for line in proc.stdout:
+            last_line_at[0] = time.time()
+            lines.append(line)
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        rc = proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+    if result is not None:
+        # A measurement that printed before an unclean exit is still a real
+        # measurement — runtime teardown through a wedged tunnel is exactly
+        # where a post-result hang/kill happens; keep the number, flag it.
+        if rc != 0:
+            result["unclean_exit"] = kill_reason[0] or f"rc={rc}"
+        return result
+    why = kill_reason[0] or f"rc={rc}"
+    raise RuntimeError(
+        f"attempt {att['name']} failed ({why}); last output: "
+        + " | ".join(l.strip() for l in lines[-4:]))
+
+
+def main() -> None:
+    errors = []
+    for att in ATTEMPTS:
+        try:
+            result = _run_attempt(att)
+        except RuntimeError as e:
+            _log(str(e))
+            errors.append(f"{att['name']}: {e}")
+            continue
+        if att["degraded"]:
+            result["degraded"] = True
+            result["degraded_config"] = (
+                f"{att['layers']}x2048 batch={att['batch']}")
+        if errors:
+            result["failed_attempts"] = errors
+        print(json.dumps(result), flush=True)
+        return
+    # every rung failed — one diagnosable JSON line, nonzero exit
+    print(json.dumps({
+        "metric": METRIC, "value": 0.0, "unit": "samples/s/chip",
+        "vs_baseline": 0.0,
+        "error": "; ".join(errors)[:800],
+    }), flush=True)
+    sys.exit(1)
 
 
 if __name__ == "__main__":
-    main()
+    if len(sys.argv) >= 5 and sys.argv[1] == "--child":
+        child_main(int(sys.argv[2]), int(sys.argv[3]), int(sys.argv[4]))
+    else:
+        main()

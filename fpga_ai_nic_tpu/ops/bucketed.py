@@ -99,7 +99,9 @@ def _reduce_bucket(leaves: Sequence[jax.Array], b: Bucket, axis_name: str,
         red = lax.psum(flat, axis_name)
     else:
         red = ring_ops.ring_all_reduce(flat, axis_name,
-                                       compression=coll.compression)
+                                       compression=coll.compression,
+                                       slice_elems=coll.slice_elems,
+                                       unroll=coll.unroll_hops)
     return red / n
 
 

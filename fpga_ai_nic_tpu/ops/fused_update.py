@@ -99,7 +99,9 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
         return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
                                 tiled=True)
     return ring_ops.ring_reduce_scatter(flat_g, axis_name,
-                                        compression=coll.compression)
+                                        compression=coll.compression,
+                                        slice_elems=coll.slice_elems,
+                                        unroll=coll.unroll_hops)
 
 
 def all_gather_flat(owned: jax.Array, axis_name: str,
@@ -107,7 +109,8 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
     if coll.impl == "xla":
         return lax.all_gather(owned, axis_name, tiled=True)
     return ring_ops.ring_all_gather(owned, axis_name,
-                                    compression=coll.compression)
+                                    compression=coll.compression,
+                                    unroll=coll.unroll_hops)
 
 
 def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):
@@ -119,7 +122,9 @@ def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):
             lambda g: lax.psum(g, axis_name) / n, tree)
     flat, meta = flatten_tree(tree, coll, n)
     red = ring_ops.ring_all_reduce(flat, axis_name,
-                                   compression=coll.compression)
+                                   compression=coll.compression,
+                                   slice_elems=coll.slice_elems,
+                                   unroll=coll.unroll_hops)
     return unflatten_tree(red / n, meta)
 
 

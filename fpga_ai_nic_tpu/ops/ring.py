@@ -16,6 +16,17 @@ the reference's rotated slice order (hw/all_reduce.sv:361), which existed
 only to keep its host-write FSM streaming; on TPU natural order keeps
 ZeRO-1 shard <-> device mapping stable across collective impls.
 
+Slicing (the reference's BUF_SIZE=512-CL / 32 KiB streaming granularity,
+hw/all_reduce.sv:101-103,330): a compressed hop whose chunk exceeds
+``slice_elems`` is streamed slice-by-slice, double-buffered so slice k+1's
+encode runs while slice k's ppermute is on the wire — the TPU analogue of
+the bfp_adapter sitting *inside* the ring stream (hw/bfp_adapter.sv).
+Because BFP blocks are independent and ``slice_elems`` is a block multiple,
+sliced and whole-chunk hops are bit-identical; slicing changes the
+schedule, never the numerics.  Uncompressed hops always send the whole
+chunk in one ppermute: with no codec work to overlap, slicing would only
+serialize the DMA that XLA already streams.
+
 All functions must run inside ``jax.shard_map`` with `axis_name` a mesh
 axis; per-device inputs must vary over that axis (JAX >= 0.8 VMA rules).
 Bit-exactness vs `ops.ring_golden` (same add order, same per-hop
@@ -30,7 +41,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .bfp import bfp_decode, bfp_encode
+from . import bfp as _bfp_xla
+from . import bfp_pallas as _bfp_pl
 from ..utils.config import BFPConfig
 
 
@@ -40,21 +52,73 @@ def _next_neighbor_perm(n: int):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+def _use_pallas(cfg: BFPConfig, n_elems: int) -> bool:
+    return cfg.codec == "pallas" or (
+        cfg.codec == "auto" and _bfp_pl._is_tpu()
+        and n_elems % (cfg.block_size * _bfp_pl.LANES) == 0)
+
+
+def _codec(cfg: BFPConfig, n_elems: int):
+    """(encode, decode) pair for a flat [n_elems] payload.
+
+    codec="auto" picks the fused Pallas kernels on TPU when the payload
+    tiles onto (block, 128)-lane registers, else the XLA ops; the default
+    "xla" keeps golden bit-exactness on every platform (see BFPConfig)."""
+    mod = _bfp_pl if _use_pallas(cfg, n_elems) else _bfp_xla
+
+    def enc(x):
+        return mod.bfp_encode(x, cfg.block_size, cfg.mantissa_bits,
+                              cfg.rounding)
+
+    def dec(mant, se, dtype):
+        return mod.bfp_decode(mant, se, cfg.block_size, dtype)
+
+    return enc, dec
+
+
 def _send(payload: jax.Array, axis_name: str, n: int,
-          cfg: Optional[BFPConfig]) -> jax.Array:
+          cfg: Optional[BFPConfig],
+          slice_elems: Optional[int] = None) -> jax.Array:
     """One ring hop, optionally BFP-compressed on the wire."""
     perm = _next_neighbor_perm(n)
     if cfg is None:
         return lax.ppermute(payload, axis_name, perm)
-    mant, se = bfp_encode(payload, cfg.block_size, cfg.mantissa_bits,
-                          cfg.rounding)
-    mant = lax.ppermute(mant, axis_name, perm)
-    se = lax.ppermute(se, axis_name, perm)
-    return bfp_decode(mant, se, cfg.block_size, payload.dtype)
+    C = payload.shape[0]
+    if (slice_elems is None or C <= slice_elems or C % slice_elems
+            or slice_elems % cfg.block_size
+            # sliced and whole-chunk paths must resolve to the SAME codec,
+            # or slicing would change the block partition (and the bits)
+            or _use_pallas(cfg, slice_elems) != _use_pallas(cfg, C)):
+        enc, dec = _codec(cfg, C)
+        mant, se = enc(payload)
+        mant = lax.ppermute(mant, axis_name, perm)
+        se = lax.ppermute(se, axis_name, perm)
+        return dec(mant, se, payload.dtype)
+
+    # Sliced, double-buffered stream: while slice k's compressed payload is
+    # on the wire, encode slice k+1 (they are independent, so XLA's
+    # latency-hiding scheduler overlaps codec compute with the permute DMA).
+    # The final iteration's look-ahead encode (slice 0 again) is dead work
+    # worth 1/S of one codec pass — the price of a uniform scan body.
+    S = C // slice_elems
+    slices = payload.reshape(S, slice_elems)
+    enc, dec = _codec(cfg, slice_elems)
+
+    def step(carry, k):
+        mant_k, se_k = carry
+        mant_r = lax.ppermute(mant_k, axis_name, perm)
+        se_r = lax.ppermute(se_k, axis_name, perm)
+        nxt = enc(slices[(k + 1) % S])
+        return nxt, dec(mant_r, se_r, payload.dtype)
+
+    _, received = lax.scan(step, enc(slices[0]), jnp.arange(S))
+    return received.reshape(C)
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
-                        compression: Optional[BFPConfig] = None) -> jax.Array:
+                        compression: Optional[BFPConfig] = None,
+                        slice_elems: Optional[int] = None,
+                        unroll: bool = False) -> jax.Array:
     """Sliced ring reduce-scatter of a flat per-device vector.
 
     x: [L] with L % n == 0 (pad upstream; the reference pads to slice
@@ -75,15 +139,16 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
 
     def hop(s, ch):
         send = jnp.take(ch, ((idx - s - 1) % n)[None], axis=0)[0]
-        recv = _send(send, axis_name, n, compression)
+        recv = _send(send, axis_name, n, compression, slice_elems)
         return ch.at[(idx - s - 2) % n].add(recv)
 
-    chunks = lax.fori_loop(0, n - 1, hop, chunks, unroll=True)
+    chunks = lax.fori_loop(0, n - 1, hop, chunks, unroll=unroll)
     return jnp.take(chunks, idx[None], axis=0)[0]
 
 
 def ring_all_gather(owned: jax.Array, axis_name: str, *,
-                    compression: Optional[BFPConfig] = None) -> jax.Array:
+                    compression: Optional[BFPConfig] = None,
+                    unroll: bool = False) -> jax.Array:
     """Ring all-gather: device i contributes chunk i, returns [n * C].
 
     This is the phase that distributes *updated weights* in the fused
@@ -91,6 +156,8 @@ def ring_all_gather(owned: jax.Array, axis_name: str, *,
     996-1086).  Under compression the chunk is quantized once at first
     send and the compressed payload is forwarded verbatim thereafter
     (BFP roundtrip is idempotent), so every replica sees identical bytes.
+    No per-hop slicing here: the payload is encoded exactly once, so there
+    is no codec work to overlap with the forwarding permutes.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -98,10 +165,9 @@ def ring_all_gather(owned: jax.Array, axis_name: str, *,
         # still quantize: replicas must see wire-identical bytes at any n,
         # and the golden model quantizes the owned chunk unconditionally
         if compression is not None:
-            mant, se = bfp_encode(owned, compression.block_size,
-                                  compression.mantissa_bits,
-                                  compression.rounding)
-            return bfp_decode(mant, se, compression.block_size, owned.dtype)
+            enc, dec = _codec(compression, owned.shape[0])
+            mant, se = enc(owned)
+            return dec(mant, se, owned.dtype)
         return owned
     C = owned.shape[0]
     out = jnp.zeros((n, C), owned.dtype).at[idx].set(owned)
@@ -112,32 +178,35 @@ def ring_all_gather(owned: jax.Array, axis_name: str, *,
             pay = lax.ppermute(pay, axis_name, _next_neighbor_perm(n))
             return out_.at[(idx - s - 1) % n].set(pay), pay
 
-        out, _ = lax.fori_loop(0, n - 1, hop, (out, owned), unroll=True)
+        out, _ = lax.fori_loop(0, n - 1, hop, (out, owned), unroll=unroll)
     else:
-        cfg = compression
-        mant, se = bfp_encode(owned, cfg.block_size, cfg.mantissa_bits,
-                              cfg.rounding)
+        enc, dec = _codec(compression, C)
+        mant, se = enc(owned)
         # the local replica stores the same quantized bytes it sends,
         # keeping replicas identical across devices
-        out = out.at[idx].set(bfp_decode(mant, se, cfg.block_size, owned.dtype))
+        out = out.at[idx].set(dec(mant, se, owned.dtype))
 
         def hop(s, carry):
             out_, m, e = carry
             perm = _next_neighbor_perm(n)
             m = lax.ppermute(m, axis_name, perm)
             e = lax.ppermute(e, axis_name, perm)
-            dec = bfp_decode(m, e, cfg.block_size, owned.dtype)
-            return out_.at[(idx - s - 1) % n].set(dec), m, e
+            return out_.at[(idx - s - 1) % n].set(dec(m, e, owned.dtype)), m, e
 
-        out, _, _ = lax.fori_loop(0, n - 1, hop, (out, mant, se), unroll=True)
+        out, _, _ = lax.fori_loop(0, n - 1, hop, (out, mant, se),
+                                  unroll=unroll)
     return out.reshape(n * C)
 
 
 def ring_all_reduce(x: jax.Array, axis_name: str, *,
-                    compression: Optional[BFPConfig] = None) -> jax.Array:
+                    compression: Optional[BFPConfig] = None,
+                    slice_elems: Optional[int] = None,
+                    unroll: bool = False) -> jax.Array:
     """Full all-reduce (sum) = reduce-scatter + all-gather."""
-    owned = ring_reduce_scatter(x, axis_name, compression=compression)
-    return ring_all_gather(owned, axis_name, compression=compression)
+    owned = ring_reduce_scatter(x, axis_name, compression=compression,
+                                slice_elems=slice_elems, unroll=unroll)
+    return ring_all_gather(owned, axis_name, compression=compression,
+                           unroll=unroll)
 
 
 def wire_bytes_per_device(L: int, n: int,

@@ -36,11 +36,26 @@ class BFPConfig:
     block_size: int = 16          # NUM_FP (hw/all_reduce.sv:746)
     mantissa_bits: int = 8        # MANT_SIZE (hw/all_reduce.sv:746)
     rounding: str = "nearest"     # "nearest" | "rtz"
+    # codec backend for the ring's per-hop encode/decode:
+    #   "xla":    ops.bfp (block = consecutive elements, the reference's
+    #             flat16 grouping) — the default: bit-exact vs
+    #             ops.ring_golden on every platform.
+    #   "pallas": ops.bfp_pallas (block = lane column, elements LANES
+    #             apart) — the fused-kernel fast path for TPU.
+    #   "auto":   pallas on TPU when the payload tiles onto (block, 128)
+    #             lanes, xla elsewhere.
+    # Every codec is bit-exact vs ops.bfp_golden under its own layout, but
+    # the *block partition* differs between xla and pallas, so cross-codec
+    # results differ by quantization grouping (same wire bytes, same error
+    # bound).  "xla" stays the default so golden-compare guarantees hold
+    # unchanged on TPU; opt into "auto"/"pallas" for wire-path speed.
+    codec: str = "xla"
 
     def __post_init__(self):
         assert self.block_size >= 2 and self.block_size & (self.block_size - 1) == 0
         assert 2 <= self.mantissa_bits <= 8
         assert self.rounding in ("nearest", "rtz")
+        assert self.codec in ("auto", "xla", "pallas")
 
     @property
     def compression_ratio_vs_f32(self) -> float:
@@ -67,6 +82,10 @@ class CollectiveConfig:
     impl: str = "xla"             # "xla" | "ring"
     compression: Optional[BFPConfig] = None
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
+    # unroll the n-1 ring-hop loop at trace time: marginally better codegen
+    # for tiny rings, O(n) compile-time blowup for real ones — rolled
+    # lax.fori_loop is the default (hop count is data-independent either way)
+    unroll_hops: bool = False
     max_inflight: int = 8
     # bucketed (DDP-style) all-reduce: min elements per bucket.  The
     # reference's granularity is one bucket per layer (one all_reduce()

@@ -13,11 +13,14 @@ mesh environment.
 """
 
 import os
+import re
 import sys
 
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+# replace (not merely append) any inherited device-count flag: the suite is
+# written against exactly 8 virtual devices
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+_flags = (_flags.strip() + " --xla_force_host_platform_device_count=8").strip()
 
 _ENV = {
     "JAX_PLATFORMS": "cpu",
@@ -30,14 +33,13 @@ _ENV = {
 def _needs_reexec() -> bool:
     if os.environ.get("_FPGA_AI_NIC_TPU_REEXEC"):
         return False
-    try:
-        import jax
-
-        return jax.default_backend() != "cpu" or jax.device_count() < 8
-    except Exception:
-        # a broken eagerly-registered TPU backend is exactly what the
-        # re-exec environment escapes
-        return True
+    # Decide from env vars ALONE.  Importing jax here would initialize the
+    # eagerly-registered TPU backend, whose import/first query can hang
+    # outright when the tunnel is wedged — the deciding process must never
+    # touch jax (same rule as __graft_entry__.dryrun_multichip).
+    return (os.environ.get("JAX_PLATFORMS") != "cpu"
+            or not re.search(r"--xla_force_host_platform_device_count=8\b",
+                             os.environ.get("XLA_FLAGS", "")))
 
 
 def pytest_configure(config):

@@ -104,6 +104,49 @@ def test_bfp_ring_replicas_identical(shards):
     assert (full == full[0]).all()
 
 
+def test_sliced_hops_bitexact_vs_unsliced(shards):
+    """Slicing a compressed hop (BUF_SIZE streaming, hw/all_reduce.sv:330)
+    must change the schedule only: BFP blocks are independent, so sliced
+    and whole-chunk hops produce identical bits — and both match golden."""
+    cfg = BFPConfig()
+    # chunk C = L // N = 64; slice into 4 x 16-elem slices
+    sliced = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg,
+                                       slice_elems=16)[None],
+        shards, out_spec=P("dp", None)))
+    whole = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg)[None],
+        shards, out_spec=P("dp", None)))
+    np.testing.assert_array_equal(sliced, whole)
+    want = ring_golden.ring_all_reduce(shards, cfg)
+    np.testing.assert_array_equal(sliced, want)
+
+
+def test_unrolled_hops_bitexact_vs_rolled(shards):
+    """unroll only changes trace-time loop structure, never values."""
+    cfg = BFPConfig()
+    rolled = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg)[None],
+        shards, out_spec=P("dp", None)))
+    unrolled = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg,
+                                       unroll=True)[None],
+        shards, out_spec=P("dp", None)))
+    np.testing.assert_array_equal(rolled, unrolled)
+
+
+def test_sliced_hop_indivisible_falls_back(shards):
+    """slice_elems that doesn't divide the chunk (or the block size) falls
+    back to whole-chunk hops rather than mis-slicing."""
+    cfg = BFPConfig()
+    got = np.asarray(_run_sharded(
+        lambda x: ring.ring_all_reduce(x[0], "dp", compression=cfg,
+                                       slice_elems=48)[None],  # 64 % 48 != 0
+        shards, out_spec=P("dp", None)))
+    want = ring_golden.ring_all_reduce(shards, cfg)
+    np.testing.assert_array_equal(got, want)
+
+
 def test_wire_bytes_accounting():
     cfg = BFPConfig()
     raw = ring.wire_bytes_per_device(4096, 8, None)
