@@ -38,6 +38,8 @@ import os
 import sys
 import time
 
+from bench_common import cpu_env, enable_compile_cache, log as _log, run_attempt
+
 BASELINE_SAMPLES_PER_SEC_PER_NODE = 14_000.0
 METRIC = "mlp_train_samples_per_sec_per_chip"
 
@@ -52,10 +54,6 @@ ATTEMPTS = [
     {"name": "cpu", "cpu": True, "layers": 3, "batch": 512, "iters": 3,
      "budget_s": 80.0, "silence_s": 60.0, "degraded": True},
 ]
-
-
-def _log(msg: str) -> None:
-    print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
 # ---------------------------------------------------------------------------
@@ -73,13 +71,7 @@ def child_main(layers: int, batch: int, iters: int) -> None:
 
     # persistent compile cache: repeat runs (and the degraded retry) skip
     # XLA compilation entirely
-    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
-                             ".jax_cache")
-    try:
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception as e:  # noqa: BLE001 — cache is best-effort
-        _log(f"compile cache unavailable: {e}")
+    enable_compile_cache(jax)
 
     phase("devices")
     n_dev = jax.device_count()
@@ -168,71 +160,13 @@ def child_main(layers: int, batch: int, iters: int) -> None:
 # ---------------------------------------------------------------------------
 
 def _run_attempt(att: dict) -> dict:
-    """Run one child attempt; returns its parsed JSON or raises RuntimeError
-    with the last progress lines (the forensic record)."""
-    import subprocess
-    import threading
-
-    env = dict(os.environ)
-    if att["cpu"]:
-        env.update(JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="")
+    env = cpu_env(1) if att["cpu"] else dict(os.environ)
     here = os.path.abspath(__file__)
     cmd = [sys.executable, "-u", here, "--child", str(att["layers"]),
            str(att["batch"]), str(att["iters"])]
-    _log(f"attempt={att['name']} budget={att['budget_s']:.0f}s "
-         f"silence={att['silence_s']:.0f}s cmd={' '.join(cmd[2:])}")
-    t0 = time.time()
-    proc = subprocess.Popen(cmd, env=env, cwd=os.path.dirname(here),
-                            stdout=subprocess.PIPE,
-                            stderr=subprocess.STDOUT, text=True, bufsize=1)
-    last_line_at = [time.time()]
-    deadline = t0 + att["budget_s"]
-    kill_reason = [None]
-
-    def _watch():
-        while proc.poll() is None:
-            now = time.time()
-            if now > deadline:
-                kill_reason[0] = f"total budget {att['budget_s']:.0f}s"
-            elif now - last_line_at[0] > att["silence_s"]:
-                kill_reason[0] = (
-                    f"silent for {now - last_line_at[0]:.0f}s "
-                    f"(limit {att['silence_s']:.0f}s)")
-            if kill_reason[0]:
-                proc.kill()
-                return
-            time.sleep(1.0)
-
-    watcher = threading.Thread(target=_watch, daemon=True)
-    watcher.start()
-    lines, result = [], None
-    try:
-        for line in proc.stdout:
-            last_line_at[0] = time.time()
-            lines.append(line)
-            sys.stderr.write(line)
-            sys.stderr.flush()
-            if line.startswith("{"):
-                try:
-                    result = json.loads(line)
-                except json.JSONDecodeError:
-                    pass
-        rc = proc.wait()
-    finally:
-        if proc.poll() is None:
-            proc.kill()
-        proc.wait()
-    if result is not None:
-        # A measurement that printed before an unclean exit is still a real
-        # measurement — runtime teardown through a wedged tunnel is exactly
-        # where a post-result hang/kill happens; keep the number, flag it.
-        if rc != 0:
-            result["unclean_exit"] = kill_reason[0] or f"rc={rc}"
-        return result
-    why = kill_reason[0] or f"rc={rc}"
-    raise RuntimeError(
-        f"attempt {att['name']} failed ({why}); last output: "
-        + " | ".join(l.strip() for l in lines[-4:]))
+    return run_attempt(att["name"], cmd, env=env,
+                       budget_s=att["budget_s"], silence_s=att["silence_s"],
+                       cwd=os.path.dirname(here))
 
 
 def main() -> None:
@@ -240,7 +174,7 @@ def main() -> None:
     for att in ATTEMPTS:
         try:
             result = _run_attempt(att)
-        except RuntimeError as e:
+        except Exception as e:  # noqa: BLE001 — the one JSON line must happen
             _log(str(e))
             errors.append(f"{att['name']}: {e}")
             continue

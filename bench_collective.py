@@ -1,0 +1,239 @@
+#!/usr/bin/env python
+"""All-reduce bandwidth benchmark — the first-named BASELINE metric:
+"all-reduce GB/s over ICI (bf16 vs BFP-compressed)".
+
+Measures three implementations over a sweep of flat-vector sizes:
+
+  - psum_bf16:  XLA's native all-reduce on bf16 (the TPU incumbent)
+  - ring_f32:   the explicit ppermute ring, uncompressed f32
+  - ring_bfp:   the same ring with per-hop BFP compression
+                (8-bit mantissa, shared exponent per 16 — 3.76x fewer wire
+                bytes than f32, 1.88x than bf16; hw/bfp_adapter.sv:30,63-77)
+
+plus standalone codec throughput (encode/decode GB/s), which bounds the
+compressed ring's critical path on a single chip.
+
+Bandwidth accounting follows the standard ring model: an n-device
+all-reduce of B bytes moves 2*(n-1)/n * B per device over the wire, so
+  busbw = 2*(n-1)/n * B / t      (the "effective" wire bandwidth)
+  algbw = B / t                  (application-visible)
+The reference's comparable envelope: 80 Gbps link model (readme.pdf §3.2),
+3.76x wire ratio under BFP.
+
+Single-chip runs (the current TPU surface) measure codec throughput and
+report the *projected* BFP ring advantage = wire-ratio / codec-overhead;
+multi-device meshes (virtual CPU mesh here, real multi-chip ICI when
+available) measure the rings directly.
+
+Same parent/child ladder as bench.py: the parent never imports jax; a
+wedged TPU falls through to the forced-CPU mesh with full forensics.
+"""
+
+import json
+import os
+import sys
+import time
+
+from bench_common import cpu_env, enable_compile_cache, log, run_attempt
+
+ATTEMPTS = [
+    {"name": "tpu", "cpu": False, "budget_s": 240.0, "silence_s": 120.0},
+    {"name": "cpu_mesh", "cpu": True, "budget_s": 360.0, "silence_s": 150.0},
+]
+
+SWEEP_MB = (16, 64, 256)          # flat f32 vector sizes to sweep
+CODEC_MB = 64                     # standalone codec payload
+TIMED_ITERS = 3
+
+
+# ---------------------------------------------------------------------------
+# child
+# ---------------------------------------------------------------------------
+
+def _timeit(fn, sync, iters=TIMED_ITERS):
+    """Median-free simple timing: warmup (compile) + timed loop + honest
+    sync (jitted scalar reduction fetch — see bench.py docstring)."""
+    out = fn()
+    sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn()
+    sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def child_main() -> None:
+    t0 = time.time()
+
+    def phase(name):
+        log(f"phase={name} t={time.time() - t0:.1f}s")
+
+    phase("import")
+    import jax
+    enable_compile_cache(jax)
+    phase("devices")
+    n_dev = jax.device_count()
+    platform = jax.default_backend()
+    log(f"platform={platform} n_dev={n_dev}")
+
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fpga_ai_nic_tpu.ops import ring as ring_ops
+    from fpga_ai_nic_tpu.utils.config import BFPConfig
+
+    cfg = BFPConfig()   # 16-elem blocks, 8-bit mantissa — the wire format
+    # On TPU use the fused Pallas codec (the wire-path kernel); off TPU the
+    # XLA codec (pallas interpret mode would measure the emulator).
+    on_tpu = platform in ("tpu", "axon")
+    codec_cfg = BFPConfig(codec="auto" if on_tpu else "xla")
+
+    _scalar = jax.jit(lambda t: sum(
+        jnp.sum(l.astype(jnp.float32))
+        for l in jax.tree_util.tree_leaves(t)))
+
+    def sync(tree):
+        return float(_scalar(tree))
+
+    report = {
+        "metric": "allreduce_busbw_gbps",
+        "unit": "GB/s",
+        "platform": platform,
+        "n_devices": n_dev,
+        "wire_compression_vs_f32": round(cfg.compression_ratio_vs_f32, 3),
+        "wire_compression_vs_bf16": round(cfg.compression_ratio_vs_f32 / 2, 3),
+    }
+
+    # -- standalone codec throughput (always; single-chip meaningful) -------
+    phase(f"codec throughput ({CODEC_MB} MiB)")
+    n_elems = CODEC_MB * (1 << 20) // 4
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_elems,), jnp.float32)
+
+    @jax.jit
+    def enc_dec_chain(x):
+        # K chained roundtrips inside ONE dispatch so per-call overhead
+        # (~0.3ms through the tunnel) amortizes; carry feeds forward so
+        # nothing is dead-code-eliminated.
+        def body(i, v):
+            m, s = ring_ops._codec(codec_cfg, n_elems)[0](v)
+            return ring_ops._codec(codec_cfg, n_elems)[1](m, s, v.dtype)
+        return lax.fori_loop(0, 4, body, x)
+
+    dt = _timeit(lambda: enc_dec_chain(x), sync) / 4   # per roundtrip
+    gb = n_elems * 4 / 1e9
+    report["codec_roundtrip_gbps"] = round(gb / dt, 2)
+    log(f"codec roundtrip {report['codec_roundtrip_gbps']} GB/s")
+
+    # -- ring sweep (needs a multi-device axis) -----------------------------
+    if n_dev >= 2:
+        mesh = Mesh(jax.devices(), ("dp",))
+        sweep = []
+        for mb in SWEEP_MB:
+            phase(f"sweep {mb} MiB")
+            L = mb * (1 << 20) // 4
+            L -= L % (n_dev * cfg.block_size)
+            xs = jax.device_put(
+                jax.random.normal(jax.random.PRNGKey(1), (L,), jnp.float32),
+                jax.sharding.NamedSharding(mesh, P()))
+            xb = xs.astype(jnp.bfloat16)
+            bytes_f32, bytes_bf16 = L * 4, L * 2
+            bus = 2 * (n_dev - 1) / n_dev
+
+            def shmap(fn):
+                return jax.jit(jax.shard_map(
+                    fn, mesh=mesh, in_specs=P(), out_specs=P(),
+                    check_vma=False))
+
+            psum_bf16 = shmap(lambda v: lax.psum(
+                lax.pcast(v, "dp", to="varying"), "dp"))
+            ring_f32 = shmap(lambda v: ring_ops.ring_all_reduce(
+                lax.pcast(v, "dp", to="varying"), "dp"))
+            ring_bfp = shmap(lambda v: ring_ops.ring_all_reduce(
+                lax.pcast(v, "dp", to="varying"), "dp",
+                compression=codec_cfg, slice_elems=8192))
+
+            row = {"size_mb": mb}
+            for label, fn, nbytes in (
+                    ("psum_bf16", lambda: psum_bf16(xb), bytes_bf16),
+                    ("ring_f32", lambda: ring_f32(xs), bytes_f32),
+                    ("ring_bfp", lambda: ring_bfp(xs), bytes_f32)):
+                dt = _timeit(fn, sync)
+                row[f"{label}_gbps"] = round(bus * nbytes / dt / 1e9, 3)
+                log(f"{mb} MiB {label}: {row[f'{label}_gbps']} GB/s "
+                    f"(t={dt * 1e3:.1f} ms)")
+            row["bfp_speedup_vs_ring_f32"] = round(
+                row["ring_bfp_gbps"] / row["ring_f32_gbps"], 3)
+            sweep.append(row)
+        report["sweep"] = sweep
+        best = max(sweep, key=lambda r: r["ring_bfp_gbps"])
+        report["value"] = best["ring_bfp_gbps"]
+        report["best_psum_bf16_gbps"] = max(
+            r["psum_bf16_gbps"] for r in sweep)
+    else:
+        # single chip: no wire to measure; report the projection — the BFP
+        # ring beats a bf16 psum by up to the wire ratio (1.88x) provided
+        # the codec sustains the link rate, which codec_roundtrip_gbps
+        # bounds from below (it includes both encode and decode passes).
+        phase("single device: projecting ring advantage from codec rate")
+        report["value"] = report["codec_roundtrip_gbps"]
+        report["projected_max_speedup_vs_bf16_psum"] = round(
+            cfg.compression_ratio_vs_f32 / 2, 3)
+        report["note"] = (
+            "single-device run: value is codec roundtrip GB/s (the wire-"
+            "path compute bound); ring busbw sweep needs >= 2 devices — "
+            "see mesh_sweep for the virtual-mesh measurement")
+
+    phase("done")
+    print(json.dumps(report), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# parent
+# ---------------------------------------------------------------------------
+
+def main() -> None:
+    """Run every rung and MERGE: a healthy single-chip TPU contributes the
+    codec throughput, but the ring sweep still needs a multi-device mesh —
+    so the cpu_mesh rung always runs unless the TPU rung already produced a
+    sweep (i.e. multi-chip ICI was available)."""
+    errors, results = [], {}
+    for att in ATTEMPTS:
+        if results and any("sweep" in r for r in results.values()):
+            break       # a multi-device sweep exists; nothing left to add
+        env = cpu_env(8) if att["cpu"] else dict(os.environ)
+        here = os.path.abspath(__file__)
+        try:
+            results[att["name"]] = run_attempt(
+                att["name"], [sys.executable, "-u", here, "--child"],
+                env=env, budget_s=att["budget_s"],
+                silence_s=att["silence_s"], cwd=os.path.dirname(here))
+        except Exception as e:  # noqa: BLE001 — one JSON line must happen
+            log(str(e))
+            errors.append(f"{att['name']}: {e}")
+    if not results:
+        print(json.dumps({
+            "metric": "allreduce_busbw_gbps", "value": 0.0, "unit": "GB/s",
+            "error": "; ".join(errors)[:800]}), flush=True)
+        sys.exit(1)
+    # primary = the TPU result when present, else the mesh result; attach
+    # the other rung's sweep/codec numbers so nothing measured is dropped
+    primary = results.get("tpu") or results["cpu_mesh"]
+    other = results.get("cpu_mesh") if primary is not results.get("cpu_mesh") \
+        else None
+    if other is not None:
+        if "sweep" not in primary and "sweep" in other:
+            primary["mesh_sweep"] = other["sweep"]
+            primary["mesh_sweep_platform"] = other["platform"]
+        primary.setdefault("cpu_codec_roundtrip_gbps",
+                           other.get("codec_roundtrip_gbps"))
+    if errors:
+        primary["failed_attempts"] = errors
+    print(json.dumps(primary), flush=True)
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 2 and sys.argv[1] == "--child":
+        child_main()
+    else:
+        main()

@@ -1,0 +1,102 @@
+"""Shared machinery for the benchmark drivers (bench.py, bench_collective.py).
+
+The parent process imports NO jax — on this container the TPU (axon) plugin
+registers at `import jax` and a wedged tunnel hangs the import itself — and
+supervises child attempts under an *activity watchdog*: children print
+`[bench] phase=...` progress lines; the parent kills a child when the total
+budget expires or no line arrives within the silence limit, so a hang is
+always localized to a phase (the diagnosability the reference's infinite
+`wait()` spin lacked, sw/mlp_mpi_example_f32.cpp:157-180, hw/README:3).
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def log(msg: str) -> None:
+    print(f"[bench] {msg}", file=sys.stderr, flush=True)
+
+
+def run_attempt(name: str, cmd, *, env=None, budget_s: float,
+                silence_s: float, cwd=None) -> dict:
+    """Run one child attempt; returns its parsed result JSON (the last line
+    starting with '{') or raises RuntimeError carrying the forensic tail.
+
+    A result that printed before an unclean exit is kept and annotated —
+    runtime teardown through a wedged tunnel is exactly where a post-result
+    hang happens."""
+    import subprocess
+    import threading
+
+    log(f"attempt={name} budget={budget_s:.0f}s silence={silence_s:.0f}s")
+    t0 = time.time()
+    proc = subprocess.Popen(cmd, env=env or dict(os.environ), cwd=cwd,
+                            stdout=subprocess.PIPE,
+                            stderr=subprocess.STDOUT, text=True, bufsize=1)
+    last_line_at = [time.time()]
+    deadline = t0 + budget_s
+    kill_reason = [None]
+
+    def _watch():
+        while proc.poll() is None:
+            now = time.time()
+            if now > deadline:
+                kill_reason[0] = f"total budget {budget_s:.0f}s"
+            elif now - last_line_at[0] > silence_s:
+                kill_reason[0] = (f"silent for {now - last_line_at[0]:.0f}s "
+                                  f"(limit {silence_s:.0f}s)")
+            if kill_reason[0]:
+                proc.kill()
+                return
+            time.sleep(1.0)
+
+    threading.Thread(target=_watch, daemon=True).start()
+    lines, result = [], None
+    try:
+        for line in proc.stdout:
+            last_line_at[0] = time.time()
+            lines.append(line)
+            sys.stderr.write(line)
+            sys.stderr.flush()
+            if line.startswith("{"):
+                try:
+                    result = json.loads(line)
+                except json.JSONDecodeError:
+                    pass
+        rc = proc.wait()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+        proc.wait()
+    if result is not None:
+        if rc != 0:
+            result["unclean_exit"] = kill_reason[0] or f"rc={rc}"
+        return result
+    why = kill_reason[0] or f"rc={rc}"
+    raise RuntimeError(
+        f"attempt {name} failed ({why}); last output: "
+        + " | ".join(l.strip() for l in lines[-4:]))
+
+
+def cpu_env(n_devices: int = 8) -> dict:
+    """Env overrides forcing an n-device virtual CPU mesh (and disabling the
+    eager TPU-tunnel registration)."""
+    import re
+    flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                   os.environ.get("XLA_FLAGS", ""))
+    flags = (flags.strip() +
+             f" --xla_force_host_platform_device_count={n_devices}").strip()
+    return dict(os.environ, JAX_PLATFORMS="cpu", PALLAS_AXON_POOL_IPS="",
+                XLA_FLAGS=flags)
+
+
+def enable_compile_cache(jax) -> None:
+    cache_dir = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                             ".jax_cache")
+    try:
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception as e:  # noqa: BLE001 — cache is best-effort
+        log(f"compile cache unavailable: {e}")

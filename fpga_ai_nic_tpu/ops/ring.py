@@ -88,7 +88,12 @@ def _send(payload: jax.Array, axis_name: str, n: int,
             or slice_elems % cfg.block_size
             # sliced and whole-chunk paths must resolve to the SAME codec,
             # or slicing would change the block partition (and the bits)
-            or _use_pallas(cfg, slice_elems) != _use_pallas(cfg, C)):
+            or _use_pallas(cfg, slice_elems) != _use_pallas(cfg, C)
+            # a pallas-bound slice must actually tile onto (block, 128)
+            # lanes; fall back to the whole-chunk hop instead of tripping
+            # the kernel's tiling assert (forced codec="pallas" case)
+            or (_use_pallas(cfg, slice_elems)
+                and slice_elems % (cfg.block_size * _bfp_pl.LANES))):
         enc, dec = _codec(cfg, C)
         mant, se = enc(payload)
         mant = lax.ppermute(mant, axis_name, perm)

@@ -47,14 +47,54 @@ def _block_attend(q, k, v, q_pos, k_pos, m, l, o, sm_scale, causal):
     return m_new, l_new, o_new
 
 
+def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
+                  k_block: Optional[int]):
+    """Online-softmax accumulation against one visiting K/V chunk, scanning
+    it in k-blocks so at most [B,H,Sq,k_block] scores materialize — the
+    flash-attention blocking that keeps peak memory O(S*k_block) instead of
+    O(S^2).  k_block=None (or >= S) processes the chunk whole.
+
+    The streamed-block structure is the same move the reference makes in
+    hardware: it never buffers a whole vector, it streams 32 KiB slices
+    through fixed-size working sets (hw/all_reduce.sv:101-103)."""
+    S = k.shape[2]
+    if k_block is not None and S % k_block:
+        # keep the memory bound for any S: largest divisor of S <= k_block
+        # (smaller blocks cost iterations, never memory)
+        k_block = next(d for d in range(min(k_block, S), 0, -1) if S % d == 0)
+    if k_block is None or k_block >= S:
+        k_pos = k_pos0 + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+        return _block_attend(qf, k.astype(jnp.float32), v, q_pos, k_pos,
+                             m, l, o, sm_scale, causal)
+
+    def step(carry, j):
+        m, l, o = carry
+        ks = lax.dynamic_slice_in_dim(k, j * k_block, k_block, axis=2)
+        vs = lax.dynamic_slice_in_dim(v, j * k_block, k_block, axis=2)
+        kp = (k_pos0 + j * k_block
+              + lax.broadcasted_iota(jnp.int32, (k_block, 1), 0)[:, 0])
+        m, l, o = _block_attend(qf, ks.astype(jnp.float32), vs, q_pos, kp,
+                                m, l, o, sm_scale, causal)
+        return (m, l, o), None
+
+    (m, l, o), _ = lax.scan(step, (m, l, o), jnp.arange(S // k_block))
+    return m, l, o
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    *, causal: bool = True,
-                   sm_scale: Optional[float] = None) -> jax.Array:
+                   sm_scale: Optional[float] = None,
+                   k_block: Optional[int] = 512) -> jax.Array:
     """Sequence-parallel exact attention inside ``shard_map``.
 
     q, k, v: [B, H, S_local, dh] — the local sequence shard; shards are
     contiguous: device i holds global positions [i*S_local, (i+1)*S_local).
     Returns [B, H, S_local, dh] in q's dtype.
+
+    k_block: flash-style blocking of each visiting K/V chunk (see
+    `_attend_chunk`); the default keeps peak score memory at
+    [B, H, S_local, 512] regardless of sequence length.  None disables
+    blocking (the whole-chunk reference schedule).
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -67,11 +107,15 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     # hop 0: attend the local block first (a causal token always sees
     # itself, so the row max is finite and the carry enters the ring loop
     # already device-varying — no variance-cast ops needed)
-    m0 = jnp.full((B, H, S, 1), _NEG, jnp.float32)
-    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
-    o0 = jnp.zeros((B, H, S, dh), jnp.float32)
-    m, l, o = _block_attend(qf, k.astype(jnp.float32), v, q_pos, q_pos,
-                            m0, l0, o0, sm_scale, causal)
+    # accumulators start device-varying: the k-block scan in _attend_chunk
+    # carries them, and a scan carry's variance type must match its output
+    # (which is varying as soon as it touches q/k)
+    m0, l0, o0 = (lax.pcast(z, axis_name, to="varying") for z in (
+        jnp.full((B, H, S, 1), _NEG, jnp.float32),
+        jnp.zeros((B, H, S, 1), jnp.float32),
+        jnp.zeros((B, H, S, dh), jnp.float32)))
+    m, l, o = _attend_chunk(qf, k, v, q_pos, idx * S, m0, l0, o0,
+                            sm_scale, causal, k_block)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     def hop(s_i, carry):
@@ -79,11 +123,10 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         kc = lax.ppermute(kc, axis_name, perm)
         vc = lax.ppermute(vc, axis_name, perm)
         src = (idx - s_i) % n                 # whose K/V we hold this hop
-        k_pos = src * S + lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
 
         def attend(mlo):
-            return _block_attend(qf, kc.astype(jnp.float32), vc, q_pos,
-                                 k_pos, *mlo, sm_scale, causal)
+            return _attend_chunk(qf, kc, vc, q_pos, src * S, *mlo,
+                                 sm_scale, causal, k_block)
 
         if causal:
             # blocks entirely in the future (src > idx: every key position
