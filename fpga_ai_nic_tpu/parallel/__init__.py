@@ -1,5 +1,6 @@
 from . import pipeline
 from .ddp import DDPState, DDPTrainer
+from .fsdp import FSDPState, FSDPTrainer
 from .mesh import make_mesh
 from .sharded import ShardedState, ShardedTrainer
 from .train import DPTrainer, TrainState

@@ -65,3 +65,34 @@ def accumulated_value_and_grad(loss_fn: Callable, accum_steps: int):
         return loss * inv, jax.tree_util.tree_map(lambda g: g * inv, grads)
 
     return fn
+
+
+def accumulated_loss(loss_fn: Callable, accum_steps: int):
+    """Mean loss over accum_steps sequential microbatches, differentiable as
+    a whole — for trainers (parallel.fsdp) that take gradients of an outer
+    function wrapping the loss, where the grad accumulation falls out of
+    autodiff through the scan instead of the explicit carry above."""
+    if accum_steps == 1:
+        return loss_fn
+
+    def fn(params, batch):
+        def split(x):
+            assert x.shape[0] % accum_steps == 0, (x.shape, accum_steps)
+            return x.reshape((accum_steps, x.shape[0] // accum_steps)
+                             + x.shape[1:])
+
+        micro = jax.tree_util.tree_map(split, batch)
+        # seed the carry from microbatch 0 (not a fresh 0.0): under
+        # shard_map a scan carry's variance type must match its output,
+        # and the loss of a device-varying batch is varying
+        first = jax.tree_util.tree_map(lambda x: x[0], micro)
+        rest = jax.tree_util.tree_map(lambda x: x[1:], micro)
+
+        def body(acc, mb):
+            return acc + loss_fn(params, mb).astype(jnp.float32), None
+
+        total, _ = lax.scan(body, loss_fn(params, first).astype(jnp.float32),
+                            rest)
+        return total / accum_steps
+
+    return fn

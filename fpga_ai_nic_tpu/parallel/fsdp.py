@@ -1,0 +1,172 @@
+"""Fully-sharded data parallelism (ZeRO-3) over the ``fsdp`` mesh axis.
+
+The reference's fused engine is ZeRO-1: optimizer state + master weights
+sharded, working weights replicated by the all-gather of updated weights
+(hw/all_reduce.sv FORWARD_OUTPUT; `parallel.train.DPTrainer`).  ZeRO-3
+drops the replicated working copy too: each device persistently holds ONLY
+its flat f32 master shard [L/n] and optimizer shard — full parameters exist
+transiently inside the step, materialized by an all-gather-on-use.
+
+TPU-first shape of the step (one jitted ``shard_map`` over fsdp):
+
+    flat    = all_gather(w_own)            # transient full vector
+    params  = unflatten(flat)              # model dtype views
+    loss    = loss_fn(params, batch_shard)
+    g_own   = grad wrt w_own               # == psum_scatter(dL/dflat):
+                                           #    the TRANSPOSE of all_gather
+                                           #    IS the reduce-scatter, so
+                                           #    ZeRO-3's gradient collective
+                                           #    falls out of autodiff
+    w_own'  = opt(w_own, g_own / n)        # f32 master update, same as ZeRO-1
+
+No gather of updated weights happens: the next step's all-gather reads the
+new shards.  Peak memory = master shard + one transient full copy during
+fwd/bwd (XLA donates/reuses the gather buffer), vs ZeRO-1's persistent
+replicated params + transient copies.
+
+The gather runs in f32 (master precision): gathering in model dtype would
+round the master before the forward AND make the transposed reduce-scatter
+accumulate in bf16; the 2x wire cost vs a bf16 gather is the price of
+exactness, and per-layer/bf16 gathering composes later via param_specs.
+
+Parity contract (tests/test_fsdp.py): identical losses to DPTrainer on the
+same model/batch/optimizer, since both compute mean-reduced gradients into
+an f32 master — only the collective schedule differs.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from . import accum
+from . import mesh as mesh_lib
+from .. import optim
+from ..ops import fused_update
+from ..utils.config import TrainConfig
+
+
+class FSDPState(NamedTuple):
+    w_own: jax.Array       # this device's f32 master shard [L/n]
+    opt_state: Any         # sharded optimizer state
+    step: jax.Array
+
+
+class FSDPTrainer:
+    """loss_fn(params, batch) -> scalar over a 1-D ``fsdp`` mesh axis.
+
+    Batch leaves shard over fsdp (ZeRO-3 is still data parallelism); params
+    never exist replicated outside the step.
+    """
+
+    def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
+                 axis_name: str = "fsdp"):
+        if cfg.collective.impl != "xla":
+            # The on-use gather sits INSIDE autodiff (its transpose is the
+            # gradient reduce-scatter); the explicit ring is built from a
+            # rolled fori_loop (no reverse-mode rule) and the BFP codec's
+            # int8 casts have no gradient. The ring/BFP wire path belongs to
+            # the ZeRO-1 trainers, whose collectives run outside autodiff.
+            raise ValueError(
+                "FSDPTrainer requires collective.impl='xla'; the ring/BFP "
+                "path applies to the ZeRO-1 trainers (parallel.train/"
+                "parallel.sharded) where the collective is not "
+                "differentiated through")
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.cfg = cfg
+        self.ax = axis_name
+        self.n = mesh.shape[axis_name]
+        self._meta = None
+
+    # -- init ---------------------------------------------------------------
+
+    def init_state(self, params) -> FSDPState:
+        """Shard replicated init params into the persistent master shards
+        (the only copy that survives the call — the ZeRO-3 memory claim)."""
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        self._meta = fused_update.flat_meta(params, coll, self.n)
+        self.__dict__.pop("step_fn", None)
+
+        def _init(p):
+            w_own, opt_state, _ = fused_update.init_master_shard(
+                p, self.ax, coll, opt_cfg)
+            return w_own, opt_state
+
+        w_own, opt_state = jax.jit(jax.shard_map(
+            _init, mesh=self.mesh, in_specs=P(),
+            out_specs=P(self.ax), check_vma=False))(params)
+        return FSDPState(w_own=w_own, opt_state=opt_state,
+                         step=jnp.zeros((), jnp.int32))
+
+    # -- step ---------------------------------------------------------------
+
+    @functools.cached_property
+    def step_fn(self):
+        coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
+        meta = self._meta
+        assert meta is not None, "call init_state first"
+        ax, n = self.ax, self.n
+
+        def shard_step(w_own, opt_state, step, batch):
+            def shard_loss(w_own):
+                # all-gather-on-use; its autodiff transpose is the
+                # reduce-scatter that lands gradients on the owning shard
+                flat = fused_update.all_gather_flat(w_own, ax, coll)
+                params = fused_update.unflatten_tree(flat, meta)
+                return accum.accumulated_loss(
+                    self.loss_fn, self.cfg.accum_steps)(params, batch)
+
+            loss, g_own = jax.value_and_grad(shard_loss)(w_own)
+            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own / n,
+                                            opt_state, step)
+            return w_new, opt_state2, lax.pmean(loss, ax)
+
+        def _step(state: FSDPState, batch):
+            w_own, opt_state, loss = jax.shard_map(
+                shard_step, mesh=self.mesh,
+                in_specs=(P(ax), P(ax), P(), P(ax)),
+                out_specs=(P(ax), P(ax), P()),
+            )(state.w_own, state.opt_state, state.step, batch)
+            return FSDPState(w_own, opt_state, state.step + 1), loss
+
+        return jax.jit(_step, donate_argnums=(0,))
+
+    def step(self, state: FSDPState, batch) -> Tuple[FSDPState, jax.Array]:
+        return self.step_fn(state, batch)
+
+    # -- materialization (eval / checkpoint restore) ------------------------
+
+    def gathered_params(self, state: FSDPState):
+        """Replicated params pytree from the master shards — for eval or
+        export only; training never materializes this persistently."""
+        meta, coll, ax = self._meta, self.cfg.collective, self.ax
+        assert meta is not None, "call init_state first"
+
+        def _gather(w):
+            return fused_update.unflatten_tree(
+                fused_update.all_gather_flat(w, ax, coll), meta)
+
+        return jax.jit(jax.shard_map(
+            _gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
+            check_vma=False))(state.w_own)
+
+    def restore_state(self, restored: dict) -> FSDPState:
+        """FSDPState from a Checkpointer.restore() payload (same layout the
+        ZeRO-1 trainers persist: flat master + opt shards)."""
+        sh = NamedSharding(self.mesh, P(self.ax))
+        return FSDPState(
+            w_own=jax.device_put(jnp.asarray(restored["w_own"]), sh),
+            opt_state={k: jax.device_put(jnp.asarray(v), sh)
+                       for k, v in restored["opt_state"].items()},
+            step=jnp.asarray(restored["step"]))
+
+    # -- data ---------------------------------------------------------------
+
+    def shard_batch(self, batch):
+        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))

@@ -1,0 +1,111 @@
+"""ZeRO-3 (fsdp) trainer: parity vs the ZeRO-1 DPTrainer and the memory
+contract (no persistent replicated params)."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+
+from fpga_ai_nic_tpu.models import mlp
+from fpga_ai_nic_tpu.parallel import DPTrainer, FSDPTrainer
+from fpga_ai_nic_tpu.utils.config import (
+    CollectiveConfig, MeshConfig, MLPConfig, OptimizerConfig, TrainConfig)
+
+N = 8
+MCFG = MLPConfig(layer_sizes=(64, 128, 128, 32), dtype="float32")
+
+
+def _cfg(**kw):
+    kw.setdefault("collective", CollectiveConfig(impl="xla"))
+    return TrainConfig(
+        iters=1, global_batch=64,
+        optimizer=OptimizerConfig(kind="momentum", learning_rate=1e-2), **kw)
+
+
+def _batch(rng):
+    x = jnp.asarray(rng.standard_normal((64, 64)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 32, 64), jnp.int32)
+    return x, y
+
+
+def _loss(p, b):
+    return mlp.loss_fn(p, b, MCFG)
+
+
+def test_fsdp_matches_dp_trainer(rng):
+    """Same model, batch, optimizer: ZeRO-3 and ZeRO-1 must produce the
+    same loss trajectory (only the collective schedule differs)."""
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    batch_host = _batch(rng)
+
+    fsdp_mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
+                     ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    tr_f = FSDPTrainer(_loss, fsdp_mesh, _cfg(mesh=MeshConfig(fsdp=N)))
+    st_f = tr_f.init_state(params)
+
+    dp_mesh = Mesh(jax.devices()[:N], ("dp",))
+    tr_d = DPTrainer(_loss, dp_mesh, _cfg(mesh=MeshConfig(dp=N)))
+    st_d = tr_d.init_state(params)
+
+    losses_f, losses_d = [], []
+    for _ in range(4):
+        st_f, lf = tr_f.step(st_f, tr_f.shard_batch(batch_host))
+        st_d, ld = tr_d.step(st_d, tr_d.shard_batch(batch_host))
+        losses_f.append(float(lf))
+        losses_d.append(float(ld))
+    np.testing.assert_allclose(losses_f, losses_d, rtol=1e-5)
+    assert losses_f[-1] < losses_f[0]
+    # master shards end equal too (same updates, same layout)
+    np.testing.assert_allclose(np.asarray(st_f.w_own), np.asarray(st_d.w_own),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_fsdp_state_is_sharded_only(rng):
+    """The persistent state is O(L/n) per device: no leaf of FSDPState may
+    be replicated (the ZeRO-3 memory claim)."""
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
+                ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    tr = FSDPTrainer(_loss, mesh, _cfg(mesh=MeshConfig(fsdp=N)))
+    st = tr.init_state(params)
+    total = int(np.sum([np.prod(l.shape)
+                        for l in jax.tree_util.tree_leaves(params)]))
+    # per-device shard bytes ~ total/n (f32), never total
+    for leaf in (st.w_own, *st.opt_state.values()):
+        shard = leaf.addressable_shards[0].data
+        assert shard.size <= total // N + N * 16, (leaf.shape, shard.shape)
+    # and gathered_params reconstructs the replicated tree exactly
+    got = tr.gathered_params(st)
+    chex_tree = jax.tree_util.tree_map(lambda a, b: np.allclose(a, b, atol=0),
+                                       got, tr.gathered_params(st))
+    assert all(jax.tree_util.tree_leaves(chex_tree))
+
+
+def test_fsdp_rejects_ring_impl():
+    mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
+                ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    with pytest.raises(ValueError, match="impl='xla'"):
+        FSDPTrainer(_loss, mesh,
+                    _cfg(mesh=MeshConfig(fsdp=N),
+                         collective=CollectiveConfig(impl="ring")))
+
+
+def test_fsdp_grad_accumulation(rng):
+    """accum_steps > 1 averages microbatches identically to one big batch
+    (f32 model: tolerances are tight)."""
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    batch_host = _batch(rng)
+    mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
+                ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    tr1 = FSDPTrainer(_loss, mesh, _cfg(mesh=MeshConfig(fsdp=N)))
+    tr2 = FSDPTrainer(_loss, mesh, _cfg(mesh=MeshConfig(fsdp=N),
+                                        accum_steps=2))
+    st1 = tr1.init_state(params)
+    st2 = tr2.init_state(params)
+    st1, l1 = tr1.step(st1, tr1.shard_batch(batch_host))
+    st2, l2 = tr2.step(st2, tr2.shard_batch(batch_host))
+    np.testing.assert_allclose(float(l1), float(l2), rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(st1.w_own), np.asarray(st2.w_own),
+                               rtol=1e-5, atol=1e-6)
