@@ -17,6 +17,17 @@ Examples:
 Flags split by prefix: --model.* -> MLPConfig, everything else ->
 TrainConfig; --bfp=1 turns on the BFP wire codec (implies the explicit
 ring collective).
+
+--queue=fused|explicit selects the execution schedule: "fused" (default)
+is the one-program ZeRO-1 trainer XLA overlaps on its own; "explicit"
+reproduces the reference's host-side issue/wait loop (one collective
+dispatch per gradient bucket through the bounded CollectiveQueue,
+sw/mlp_mpi_example_f32.cpp:735-787) and reports live stall/overlap/
+wire-byte attribution in the output JSON's profile.collectives.
+
+--trace-dir=PATH captures a JAX profiler trace of the timed loop (XProf
+viewable) — the overlap evidence SURVEY.md §5 says must come from trace
+analysis on TPU rather than hardware counters.
 """
 
 import json
@@ -34,7 +45,8 @@ def main(argv):
     import jax.numpy as jnp
 
     from fpga_ai_nic_tpu.models import mlp
-    from fpga_ai_nic_tpu.parallel import DPTrainer, make_mesh
+    from fpga_ai_nic_tpu.parallel import (DPTrainer, QueuedDDPTrainer,
+                                          make_mesh)
     from fpga_ai_nic_tpu.runtime.watchdog import Watchdog
     from fpga_ai_nic_tpu.utils.config import (
         BFPConfig, MLPConfig, TrainConfig, from_flags)
@@ -47,8 +59,20 @@ def main(argv):
     if bfp_flags and not bfp and any(
             v not in ("0", "false", "no", "off") for v in bfp_flags):
         raise ValueError(f"unrecognized --bfp value: {bfp_flags}")
+    queue_mode = "fused"
+    trace_dir = None
+    for a in argv:
+        if a.startswith("--queue="):
+            queue_mode = a.partition("=")[2]
+            if queue_mode not in ("fused", "explicit"):
+                raise ValueError(f"--queue must be fused|explicit, "
+                                 f"got {queue_mode!r}")
+        elif a.startswith("--trace-dir="):
+            trace_dir = a.partition("=")[2]
     rest = [a for a in argv
-            if not a.startswith("--model.") and not a.startswith("--bfp=")]
+            if not a.startswith("--model.") and not a.startswith("--bfp=")
+            and not a.startswith("--queue=")
+            and not a.startswith("--trace-dir=")]
     mcfg = from_flags(MLPConfig,
                       [a.replace("--model.", "--") for a in model_flags])
     cfg = from_flags(TrainConfig, rest)
@@ -64,7 +88,11 @@ def main(argv):
     # sync) that wedges raises DeviceHangError instead of spinning forever
     # like the reference's wait() poll (sw/mlp_mpi_example_f32.cpp:157-180)
     wd = Watchdog(timeout_s=600.0)
-    tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg), mesh, cfg)
+    loss_fn = lambda p, b: mlp.loss_fn(p, b, mcfg)  # noqa: E731
+    if queue_mode == "explicit":
+        tr = QueuedDDPTrainer(loss_fn, mesh, cfg, profiler=prof)
+    else:
+        tr = DPTrainer(loss_fn, mesh, cfg)
 
     with prof.bucket("init"):
         state = tr.init_state(mlp.init(jax.random.PRNGKey(cfg.seed), mcfg))
@@ -81,8 +109,20 @@ def main(argv):
         state, loss = wd.run(tr.step, state, batch)
         loss = wd.run(float, loss)
 
+    import contextlib
+    trace_cm = (jax.profiler.trace(trace_dir) if trace_dir
+                else contextlib.nullcontext())
+    # the warmup step is compile-dominated; reset the per-step buckets and
+    # collective stats so the report attributes the *timed* loop only (the
+    # queue reads profiler.collectives per call, so it sees the fresh stats;
+    # the init/warmup buckets keep their compile wall-time)
+    from fpga_ai_nic_tpu.utils.observability import CollectiveStats
+    prof.collectives = CollectiveStats()
+    for k in ("grads", "issue", "update"):
+        prof.buckets.pop(k, None)
+        prof.counts.pop(k, None)
     t0 = time.perf_counter()
-    with prof.bucket("train"):
+    with trace_cm, prof.bucket("train"):
         for _ in range(cfg.iters):
             state, loss = wd.run(tr.step, state, batch)
         loss = wd.run(float, loss)         # materializes the chain

@@ -134,6 +134,29 @@ def all_reduce_bucketed(grads, axis_name: str, coll: CollectiveConfig,
     return jax.tree_util.tree_unflatten(plan.treedef, out)
 
 
+def bucket_locals(grads, plan: BucketPlan) -> List[jax.Array]:
+    """Per-bucket flat f32 local gradients, in issue (reverse-leaf) order —
+    the pre-collective payloads the host-side queue (`runtime.queue`)
+    dispatches one collective per (the reference's per-layer grad buffers,
+    sw/mlp_mpi_example_f32.cpp:753-756)."""
+    leaves = jax.tree_util.tree_leaves(grads)
+    return [_flatten_bucket(leaves, b) for b in plan.buckets]
+
+
+def assemble_flat(bucket_vecs: Sequence[jax.Array],
+                  plan: BucketPlan) -> jax.Array:
+    """Inverse of `bucket_locals` into the canonical flat layout: reduced
+    bucket vectors -> one flat f32 vector in forward leaf order, padding
+    dropped (the layout `fused_update.flatten_tree` gives the master)."""
+    segs: List = [None] * len(plan.shapes)
+    for b, red in zip(plan.buckets, bucket_vecs):
+        off = 0
+        for i, size in zip(b.leaf_ids, b.sizes):
+            segs[i] = red[off:off + size]
+            off += size
+    return jnp.concatenate(segs)
+
+
 def all_reduce_bucketed_flat(grads, axis_name: str, coll: CollectiveConfig,
                              plan: BucketPlan = None) -> jax.Array:
     """Bucketed mean all-reduce assembled directly into the canonical flat
@@ -149,14 +172,9 @@ def all_reduce_bucketed_flat(grads, axis_name: str, coll: CollectiveConfig,
     if plan is None:
         plan = plan_buckets(grads, coll, n)
     leaves = jax.tree_util.tree_leaves(grads)
-    segs: List = [None] * len(leaves)
-    for b in plan.buckets:
-        red = _reduce_bucket(leaves, b, axis_name, n, coll)
-        off = 0
-        for i, size in zip(b.leaf_ids, b.sizes):
-            segs[i] = red[off:off + size]
-            off += size
-    return jnp.concatenate(segs)
+    return assemble_flat(
+        [_reduce_bucket(leaves, b, axis_name, n, coll)
+         for b in plan.buckets], plan)
 
 
 def bucket_wire_bytes(plan: BucketPlan, n: int,

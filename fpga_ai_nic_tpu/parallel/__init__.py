@@ -2,9 +2,11 @@ from . import pipeline
 from .ddp import DDPState, DDPTrainer
 from .fsdp import FSDPState, FSDPTrainer
 from .mesh import make_mesh
+from .queued import QueuedDDPTrainer
 from .sharded import ShardedState, ShardedTrainer
 from .train import DPTrainer, TrainState
 
 __all__ = ["make_mesh", "DPTrainer", "TrainState",
            "ShardedTrainer", "ShardedState",
-           "DDPTrainer", "DDPState", "pipeline"]
+           "DDPTrainer", "DDPState", "QueuedDDPTrainer",
+           "FSDPTrainer", "FSDPState", "pipeline"]

@@ -1,0 +1,150 @@
+"""Queued DDP trainer — the reference's host-side issue/wait loop, live.
+
+`parallel.ddp.DDPTrainer` fuses grads + bucketed collectives + optimizer
+into one jitted program and lets XLA's latency-hiding scheduler overlap
+them — the right default on TPU.  This trainer instead reproduces the
+reference's *host-driven* structure (sw/mlp_mpi_example_f32.cpp:735-787):
+backward produces per-bucket gradient buffers, thread 0 issues one async
+all-reduce per buffer through a bounded window (<= 8 in flight,
+hw/all_reduce.sv:1228,1373), waits land one step behind, and the optimizer
+consumes reduced buffers as they complete.
+
+Here each phase is its own jitted program and every bucket's collective is
+a separate dispatch through `runtime.queue.CollectiveQueue`:
+
+    grads_fn   : shard_map'd fwd+bwd -> per-bucket local f32 vectors
+    reduce[b]  : shard_map'd mean all-reduce of one bucket (psum or the
+                 BFP ring per CollectiveConfig) — issued via queue.issue()
+    update_fn  : flat f32 master optimizer + working-param rematerialize
+
+Because JAX dispatch is async, issue() returns while the device still runs
+backward; the issue->wait gap measured by the queue is genuine overlap and
+the time blocked in wait() is genuine network-bound stall — the profiler
+counters the reference reads over CSRs (lpbk_latency / stall_host,
+sw/mlp_mpi_example_f32.cpp:100-112) come out of a real training run, not a
+unit test.  The fused trainer remains the throughput king; this one exists
+for observability and for parity with the reference's programming model.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable, Optional, Tuple
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from . import accum
+from .ddp import DDPState, DDPTrainer
+from .. import optim
+from ..ops import bucketed, fused_update, ring as ring_ops
+from ..runtime.queue import CollectiveQueue
+from ..utils.config import TrainConfig
+from ..utils.observability import Profiler
+
+
+class QueuedDDPTrainer(DDPTrainer):
+    """loss_fn(params, batch) -> scalar; batch leaves shard over dp.
+
+    Same state/numerics as DDPTrainer (identical bucket plan, add order and
+    per-hop quantization), different execution: 2 + n_buckets dispatches per
+    step through a CollectiveQueue instead of one fused program.
+    """
+
+    def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
+                 axis_name: str = "dp", profiler: Optional[Profiler] = None):
+        super().__init__(loss_fn, mesh, cfg, axis_name)
+        self.profiler = profiler or Profiler()
+        self.queue = CollectiveQueue(lambda fn, g: fn(g), cfg.collective,
+                                     self.profiler)
+
+    # -- init ---------------------------------------------------------------
+
+    def init_state(self, params) -> DDPState:
+        state = super().init_state(params)   # sets _meta/_plan, clears caches
+        self.__dict__.pop("grads_fn", None)
+        self.__dict__.pop("reduce_fn", None)
+        self.__dict__.pop("update_fn", None)
+        return state
+
+    # -- jitted phases ------------------------------------------------------
+
+    @functools.cached_property
+    def grads_fn(self):
+        plan, ax = self._plan, self.ax
+        assert plan is not None, "call init_state first"
+
+        def shard_grads(params, batch):
+            params_v = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, ax, to="varying"), params)
+            loss, grads = accum.accumulated_value_and_grad(
+                self.loss_fn, self.cfg.accum_steps)(params_v, batch)
+            return tuple(bucketed.bucket_locals(grads, plan)), \
+                lax.pmean(loss, ax)
+
+        nb = len(plan.buckets)
+        return jax.jit(jax.shard_map(
+            shard_grads, mesh=self.mesh, in_specs=(P(), P(ax)),
+            out_specs=((P(ax),) * nb, P())))
+
+    @functools.cached_property
+    def reduce_fn(self):
+        """The per-buffer mean-all-reduce collective the queue issues; one
+        jitted function, recompiled per bucket shape by jax.jit's own
+        cache."""
+        coll, ax, n = self.cfg.collective, self.ax, self.n
+
+        def shard_reduce(g):
+            if coll.impl == "xla":
+                red = lax.pcast(lax.psum(g, ax), ax, to="varying")
+            else:
+                red = ring_ops.ring_all_reduce(
+                    g, ax, compression=coll.compression,
+                    slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
+            return red / n
+
+        return jax.jit(jax.shard_map(shard_reduce, mesh=self.mesh,
+                                     in_specs=P(ax), out_specs=P(ax)))
+
+    @functools.cached_property
+    def update_fn(self):
+        opt_cfg = self.cfg.optimizer
+        meta, plan = self._meta, self._plan
+        nb = len(plan.buckets)
+
+        def shard_update(bucket_means, w_master, opt_state, step):
+            flat_g = bucketed.assemble_flat(list(bucket_means), plan)
+            w_new, opt_state2 = optim.apply(opt_cfg, w_master, flat_g,
+                                            opt_state, step)
+            params2 = fused_update.unflatten_tree(w_new, meta)
+            return params2, w_new, opt_state2
+
+        ax = self.ax
+        # donate the master/opt buffers (the fused trainer donates its whole
+        # state): without this each step holds two replicated f32 copies
+        return jax.jit(jax.shard_map(
+            shard_update, mesh=self.mesh,
+            in_specs=((P(ax),) * nb, P(), P(), P()),
+            out_specs=(P(), P(), P()), check_vma=False),
+            donate_argnums=(1, 2))
+
+    # -- step ---------------------------------------------------------------
+
+    def step(self, state: DDPState, batch) -> Tuple[DDPState, jax.Array]:
+        coll, plan, n = self.cfg.collective, self._plan, self.n
+        with self.profiler.bucket("grads"):
+            bucket_g, loss = self.grads_fn(state.params, batch)
+        tickets = []
+        with self.profiler.bucket("issue"):
+            for b, g in zip(plan.buckets, bucket_g):
+                raw = ring_ops.wire_bytes_per_device(b.padded_len, n, None)
+                wire = ring_ops.wire_bytes_per_device(b.padded_len, n,
+                                                      coll.compression)
+                tickets.append(self.queue.issue(
+                    self.reduce_fn, g, raw_bytes=raw, wire_bytes=wire))
+        means = tuple(self.queue.wait(t) for t in tickets)
+        with self.profiler.bucket("update"):
+            params, w_master, opt_state = self.update_fn(
+                means, state.w_master, state.opt_state, state.step)
+        return DDPState(params, w_master, opt_state, state.step + 1), loss
