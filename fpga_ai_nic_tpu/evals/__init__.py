@@ -1,0 +1,3 @@
+from . import bfp_convergence
+
+__all__ = ["bfp_convergence"]

@@ -1,0 +1,40 @@
+"""Regression gate on BFP training quality (SURVEY.md §7 "BFP accuracy
+bounds"): at the reference's 8-bit mantissa config, compressed training must
+land within 5% of the uncompressed final loss.
+
+The full 200-step, 3-model, 4-arm evaluation is the committed artifact
+docs/bfp_convergence.json (examples/eval_bfp.py); this test runs a short
+version of the two transformer-free/transformer arms so the bound is
+enforced in CI, not just measured once.  Both arms share the explicit ring
+(identical hop order), so the ratio isolates quantization error.
+"""
+
+import numpy as np
+import pytest
+
+from fpga_ai_nic_tpu.evals import bfp_convergence as ev
+
+STEPS = 60
+
+
+@pytest.mark.parametrize("model", ["mlp", "bert"])
+def test_bfp_m8_final_loss_within_5pct(model):
+    rep = ev.run_comparison(model, STEPS, mantissa_sweep=(8,), batch=32)
+    ratio = rep["bfp_m8"]["final_loss_ratio"]
+    assert np.isfinite(rep["baseline"]["final_loss"])
+    assert ratio <= 1.05, (model, ratio)
+    # both arms must actually have learned something, or the ratio is
+    # vacuous (initial CE for these configs is > 1)
+    assert rep["baseline"]["final_loss"] < rep["baseline"]["losses"][0]
+    assert rep["bfp_m8"]["final_loss"] < rep["bfp_m8"]["losses"][0]
+
+
+def test_codec_error_monotone_in_mantissa_bits():
+    rows = ev.codec_error_table(mantissa_sweep=(4, 6, 8), n=1 << 12)
+    errs = [r["rel_l2_error"] for r in rows]
+    assert errs[0] > errs[1] > errs[2]
+    # 8-bit mantissa on N(0,1) blocks: sub-1% relative error
+    assert errs[2] < 0.01
+    # wire bytes/value grows with mantissa width but stays < f32's 4
+    wires = [r["wire_bytes_per_value"] for r in rows]
+    assert wires[0] < wires[1] < wires[2] < 4
