@@ -97,7 +97,7 @@ def main(argv):
     loader = data.ShardedLoader(
         data.synthetic_batches(make_batch, seed=cfg.seed,
                                num_batches=cfg.iters + 1),
-        mesh, tr._bspec, prefetch=2)
+        mesh, tr.batch_spec, prefetch=2)
 
     losses = []
     t0 = None

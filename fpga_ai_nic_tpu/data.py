@@ -72,7 +72,12 @@ def epochs_of(arrays: Any, batch_size: int, *, seed: int = 0,
               epochs: Optional[int] = None,
               drop_remainder: bool = True) -> Iterator[Any]:
     """Shuffled minibatch epochs over in-memory arrays (pytree with a
-    shared leading example axis)."""
+    shared leading example axis).
+
+    ``drop_remainder=False`` yields a ragged final batch per epoch — fine
+    for host-side eval loops, but INCOMPATIBLE with the sharded trainers:
+    their batch size must divide the dp(*ep)/sp mesh axes and a new shape
+    forces an XLA recompile.  Keep the default for training."""
     leaves = jax.tree_util.tree_leaves(arrays)
     n = leaves[0].shape[0]
     assert all(l.shape[0] == n for l in leaves), "ragged leading axis"

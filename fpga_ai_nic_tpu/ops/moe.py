@@ -12,7 +12,11 @@ Design (GShard/Switch-style, static shapes for XLA):
 - top-k routing with renormalized gates;
 - fixed per-expert capacity C = ceil(T*k/E * capacity_factor); overflow
   tokens are dropped deterministically in token-major priority order (their
-  residual path still carries them);
+  residual path still carries them).  NOTE: under ep/sp sharding, capacity
+  and drop priority are computed over each rank's LOCAL tokens (T = local
+  token count), so once capacity binds, sharded and unsharded runs drop
+  different tokens and diverge numerically — by design, matching how every
+  capacity-based MoE shards; parity tests use generous capacity;
 - dispatch/combine via scatter-add / gather, not [T,E,C] one-hot einsums —
   O(T*k*D) memory;
 - load-balance aux loss computed over the *global* batch (psum over the

@@ -88,6 +88,12 @@ class ShardedTrainer:
         self.n_dp = mesh.shape[dp_axis]
         self._meta = None
 
+    @property
+    def batch_spec(self):
+        """PartitionSpec batch leaves are sharded with — the public handle
+        for data loaders (`data.ShardedLoader(..., tr.batch_spec)`)."""
+        return self._bspec
+
     # -- init ---------------------------------------------------------------
 
     def shard_params(self, params):

@@ -212,8 +212,19 @@ def from_flags(cls, argv: Sequence[str]):
             raise ValueError(f"flags must look like --key=value, got {arg!r}")
         key, _, val = arg[2:].partition("=")
         path = key.split(".")
-        cfg = _replace_path(cfg, path, val)
+        try:
+            cfg = _replace_path(cfg, path, val)
+        except (ValueError, TypeError) as e:
+            raise ValueError(f"--{key}={val}: {e}") from e
     return cfg
+
+
+def _declared_type(cfg, name):
+    """The field's annotation with Optional[...] unwrapped."""
+    import typing
+    T = typing.get_type_hints(type(cfg)).get(name)
+    args = [a for a in typing.get_args(T) if a is not type(None)]
+    return args[0] if len(args) == 1 else T
 
 
 def _replace_path(cfg, path, val):
@@ -222,18 +233,26 @@ def _replace_path(cfg, path, val):
     if name not in fields:
         raise ValueError(f"unknown config field {name!r} on {type(cfg).__name__}")
     cur = getattr(cfg, name)
+    T = _declared_type(cfg, name) if cur is None else type(cur)
     if rest:
+        if cur is None:
+            if not dataclasses.is_dataclass(T):
+                raise ValueError(f"{name} is not a nested config")
+            # Optional nested config defaulting to None (e.g.
+            # collective.compression): setting any sub-field turns it on
+            # with defaults for the rest
+            cur = T()
         new = _replace_path(cur, rest, val)
-    elif dataclasses.is_dataclass(cur):
-        raise ValueError(f"{name} is a nested config; use --{name}.<field>=...")
+    elif dataclasses.is_dataclass(T):
+        raise ValueError(f"{name} is a nested config; set a sub-field "
+                         f"(...{name}.<field>=...)")
     elif cur is not None:
-        new = coerce_value(type(cur), val)
+        new = coerce_value(T, val)
     else:
-        # Optional field with a None default: the live value carries no
-        # type, so parse literally (ints/floats) and fall back to string.
-        import ast
-        try:
-            new = ast.literal_eval(val)
-        except (ValueError, SyntaxError):
-            new = val
+        # Optional scalar with a None default: the live value carries no
+        # type, so coerce against the *declared* annotation — e.g.
+        # '--num_classes=10' must become int 10, not whatever a literal
+        # parse guesses.
+        import typing
+        new = coerce_value(typing.get_origin(T) or T, val)
     return dataclasses.replace(cfg, **{name: new})

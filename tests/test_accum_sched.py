@@ -49,6 +49,23 @@ def test_from_flags_optional_and_tuple_fields():
                                  "--layer_sizes=32,64,16"])
     assert cfg.num_classes == 10                    # Optional[int] coerced
     assert cfg.layer_sizes == (32, 64, 16)
+    # coercion is driven by the declared annotation, not literal guessing:
+    # a non-int literal for Optional[int] must fail loudly, not silently
+    # pass through as a string
+    with pytest.raises(ValueError):
+        from_flags(MLPConfig, ["--num_classes=true"])
+
+
+def test_from_flags_optional_nested_config_on_demand():
+    from fpga_ai_nic_tpu.utils.config import TrainConfig, from_flags
+    # setting a sub-field of a None-default nested config instantiates it
+    cfg = from_flags(TrainConfig, ["--collective.impl=ring",
+                                   "--collective.compression.mantissa_bits=6"])
+    assert cfg.collective.compression.mantissa_bits == 6
+    # assigning the nested config itself (not a sub-field) fails with a
+    # message naming the full flag, not a crash
+    with pytest.raises(ValueError, match="collective.compression=1"):
+        from_flags(TrainConfig, ["--collective.compression=1"])
 
 
 MCFG = MLPConfig(layer_sizes=(32, 64, 64, 16), dtype="float32")
