@@ -1,0 +1,13 @@
+# Top-level targets mirroring CI (.github/workflows/ci.yml).
+.PHONY: ci test codec bench
+
+codec:
+	$(MAKE) -C fpga_ai_nic_tpu/csrc
+
+test:
+	python -m pytest tests/ -q
+
+ci: codec test
+
+bench:
+	python bench.py
