@@ -1,0 +1,165 @@
+"""Profiler-trace overlap analysis — stall attribution the TPU way.
+
+The reference attributes stalls with hardware counters (stall_host_in/out,
+stall_eth_in/out, hw/all_reduce.sv:94-97) because it owns every queue.  On
+TPU the runtime hides queues, so SURVEY.md §5 concludes stall attribution
+"must come from profiler trace analysis".  This module is that analysis:
+it reads a JAX profiler trace (jax.profiler.trace / --trace-dir), walks the
+device plane's sync ("XLA Ops") and async ("Async XLA Ops") lines, and
+reports for every async op — collectives (all-reduce / all-gather /
+reduce-scatter / collective-permute / all-to-all) and DMAs (copy/slice
+starts) — how much of its wall time was *overlapped* by synchronous device
+compute vs *exposed* (device otherwise idle: the TPU analogue of
+stall_eth_in, wire time nothing hid).
+
+Pure-python interval math over jax.profiler.ProfileData; no tensorboard /
+xprof dependency.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Iterable, List, Optional, Sequence, Tuple
+
+_COLLECTIVE_MARKERS = (
+    "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
+    "all-to-all", "collective-broadcast", "ragged-all-to-all",
+)
+
+Interval = Tuple[float, float]          # (start_ns, end_ns)
+
+
+# ---------------------------------------------------------------------------
+# interval arithmetic (pure, unit-tested)
+# ---------------------------------------------------------------------------
+
+def merge_intervals(ivs: Iterable[Interval]) -> List[Interval]:
+    """Union of possibly-overlapping intervals, sorted, coalesced."""
+    out: List[Interval] = []
+    for s, e in sorted(ivs):
+        if e <= s:
+            continue
+        if out and s <= out[-1][1]:
+            out[-1] = (out[-1][0], max(out[-1][1], e))
+        else:
+            out.append((s, e))
+    return out
+
+
+def total_len(ivs: Sequence[Interval]) -> float:
+    return sum(e - s for s, e in ivs)
+
+
+def overlap_len(iv: Interval, merged: Sequence[Interval]) -> float:
+    """Length of iv covered by a *merged* (sorted, disjoint) interval set."""
+    s, e = iv
+    cov = 0.0
+    for ms, me in merged:
+        if me <= s:
+            continue
+        if ms >= e:
+            break
+        cov += min(e, me) - max(s, ms)
+    return cov
+
+
+# ---------------------------------------------------------------------------
+# trace loading
+# ---------------------------------------------------------------------------
+
+def find_xplane(trace_dir: str) -> str:
+    """Newest .xplane.pb under a jax.profiler.trace output directory."""
+    cands = []
+    for root, _, files in os.walk(trace_dir):
+        for f in files:
+            if f.endswith(".xplane.pb"):
+                p = os.path.join(root, f)
+                cands.append((os.path.getmtime(p), p))
+    if not cands:
+        raise FileNotFoundError(f"no .xplane.pb under {trace_dir}")
+    return max(cands)[1]
+
+
+def _is_collective(name: str) -> bool:
+    n = name.lower()
+    return any(m in n for m in _COLLECTIVE_MARKERS)
+
+
+def analyze_trace(trace_dir: str, *,
+                  plane_substr: str = "/device:") -> Dict:
+    """Overlap/stall report for every device plane in the trace.
+
+    Returns {"devices": {plane_name: report}, "xplane": path}; each report:
+      sync_busy_s      — total synchronous device compute ("XLA Ops")
+      async{,_collective,_dma}_s — async op wall time by class
+      overlapped_s     — async time hidden under sync compute
+      exposed_s        — async time with the device otherwise idle (stall)
+      top_exposed      — worst offenders [(op, exposed_s)], most first
+    """
+    from jax.profiler import ProfileData
+    path = find_xplane(trace_dir)
+    data = ProfileData.from_file(path)
+    devices: Dict[str, Dict] = {}
+    for plane in data.planes:
+        if plane_substr not in plane.name:
+            continue
+        sync_ivs: List[Interval] = []
+        async_evs: List[Tuple[str, Interval]] = []
+        for line in plane.lines:
+            if line.name == "XLA Ops":
+                for ev in line.events:
+                    sync_ivs.append((ev.start_ns,
+                                     ev.start_ns + ev.duration_ns))
+            elif line.name == "Async XLA Ops":
+                for ev in line.events:
+                    async_evs.append((ev.name.split(" = ")[0],
+                                      (ev.start_ns,
+                                       ev.start_ns + ev.duration_ns)))
+        if not sync_ivs and not async_evs:
+            continue
+        merged = merge_intervals(sync_ivs)
+        rep = {"sync_busy_s": total_len(merged) / 1e9,
+               "async_s": 0.0, "async_collective_s": 0.0,
+               "async_dma_s": 0.0, "overlapped_s": 0.0, "exposed_s": 0.0}
+        exposed_by_op: Dict[str, float] = {}
+        for name, iv in async_evs:
+            dur = (iv[1] - iv[0]) / 1e9
+            cov = overlap_len(iv, merged) / 1e9
+            rep["async_s"] += dur
+            key = ("async_collective_s" if _is_collective(name)
+                   else "async_dma_s")
+            rep[key] += dur
+            rep["overlapped_s"] += cov
+            exposed = dur - cov
+            rep["exposed_s"] += exposed
+            if exposed > 0:
+                exposed_by_op[name] = exposed_by_op.get(name, 0.0) + exposed
+        rep["overlap_frac"] = (rep["overlapped_s"] / rep["async_s"]
+                               if rep["async_s"] else 1.0)
+        rep["top_exposed"] = sorted(exposed_by_op.items(),
+                                    key=lambda kv: -kv[1])[:5]
+        devices[plane.name] = rep
+    if not devices:
+        raise ValueError(
+            f"{path} has no '{plane_substr}' plane with XLA Ops lines "
+            "(CPU traces carry host thunk lines only; capture on TPU)")
+    return {"devices": devices, "xplane": path}
+
+
+def summarize(report: Dict) -> Dict:
+    """Single flattened summary across device planes (the JSON-line shape
+    examples embed), keeping the ranked worst stall offenders so the
+    attribution names the op, not just the seconds."""
+    devs = report["devices"].values()
+    agg = {k: sum(d[k] for d in devs)
+           for k in ("sync_busy_s", "async_s", "async_collective_s",
+                     "async_dma_s", "overlapped_s", "exposed_s")}
+    agg["overlap_frac"] = (agg["overlapped_s"] / agg["async_s"]
+                           if agg["async_s"] else 1.0)
+    agg["n_devices"] = len(report["devices"])
+    by_op: Dict[str, float] = {}
+    for d in devs:
+        for name, s in d.get("top_exposed", ()):
+            by_op[name] = by_op.get(name, 0.0) + s
+    agg["top_exposed"] = sorted(by_op.items(), key=lambda kv: -kv[1])[:5]
+    return agg

@@ -1,0 +1,61 @@
+"""Interval math + classification for the trace-based stall attribution
+(utils/trace_analysis.py).  End-to-end xplane parsing needs a real-TPU
+trace (CPU traces carry host thunk lines only), so these tests pin the
+pure logic the report is computed from; the TPU path is exercised by
+`examples/train_mlp.py --trace-dir` (see README component #15).
+"""
+
+import pytest
+
+from fpga_ai_nic_tpu.utils import trace_analysis as ta
+
+
+def test_merge_intervals_coalesces_and_sorts():
+    ivs = [(5, 7), (0, 2), (1, 3), (7, 7), (10, 12)]
+    assert ta.merge_intervals(ivs) == [(0, 3), (5, 7), (10, 12)]
+    assert ta.total_len(ta.merge_intervals(ivs)) == 7
+
+
+def test_merge_intervals_drops_empty_and_inverted():
+    assert ta.merge_intervals([(3, 3), (5, 4)]) == []
+
+
+def test_overlap_len_partial_and_spanning():
+    merged = [(0, 10), (20, 30)]
+    assert ta.overlap_len((5, 25), merged) == 10   # 5-10 and 20-25
+    assert ta.overlap_len((10, 20), merged) == 0   # gap exactly
+    assert ta.overlap_len((-5, 50), merged) == 20  # covers both
+
+
+def test_collective_classification():
+    assert ta._is_collective("%all-reduce-start.1 = ...")
+    assert ta._is_collective("%collective-permute-start")
+    assert ta._is_collective("%ALL-GATHER-start")
+    assert not ta._is_collective("%copy-start.4")
+    assert not ta._is_collective("%slice-start")
+
+
+def test_summarize_aggregates_planes():
+    rep = {"devices": {
+        "/device:TPU:0": {"sync_busy_s": 1.0, "async_s": 0.5,
+                          "async_collective_s": 0.3, "async_dma_s": 0.2,
+                          "overlapped_s": 0.4, "exposed_s": 0.1,
+                          "top_exposed": [("%all-reduce-start", 0.08),
+                                          ("%copy-start", 0.02)]},
+        "/device:TPU:1": {"sync_busy_s": 2.0, "async_s": 0.5,
+                          "async_collective_s": 0.5, "async_dma_s": 0.0,
+                          "overlapped_s": 0.25, "exposed_s": 0.25,
+                          "top_exposed": [("%all-reduce-start", 0.25)]},
+    }}
+    s = ta.summarize(rep)
+    assert s["n_devices"] == 2
+    assert s["sync_busy_s"] == 3.0
+    assert s["exposed_s"] == pytest.approx(0.35)
+    assert s["overlap_frac"] == pytest.approx(0.65)
+    # offenders merge across devices, worst first
+    assert s["top_exposed"][0] == ("%all-reduce-start", pytest.approx(0.33))
+
+
+def test_find_xplane_missing_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        ta.find_xplane(str(tmp_path))
