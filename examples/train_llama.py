@@ -111,13 +111,17 @@ def main(argv):
         losses = [float(l) for l in losses]  # one sync after the loop
     wall = time.perf_counter() - t0
     toks_per_s = cfg.iters * B * seq / wall
-    print(json.dumps({
+    out = {
         "loss_first": losses[0], "loss_last": losses[-1],
         "tokens_per_sec": toks_per_s, "wall_s": wall,
         "params": llama.num_params(mcfg),
         "mesh": {"dp": m.dp, "tp": m.tp, "sp": m.sp, "pp": m.pp, "ep": m.ep},
         "profile": prof.report(),
-    }))
+    }
+    if pp_ax:
+        from fpga_ai_nic_tpu.parallel import pipeline
+        out["pipeline_cost"] = pipeline.cost_model(n_mb, m.pp)
+    print(json.dumps(out))
 
 
 if __name__ == "__main__":

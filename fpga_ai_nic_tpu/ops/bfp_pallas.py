@@ -34,26 +34,32 @@ def _is_tpu() -> bool:
     return jax.devices()[0].platform in ("tpu", "axon")
 
 
-def _encode_kernel(x_ref, mant_ref, scale_ref, *, mantissa_bits, rounding):
-    x = x_ref[:]                                   # (T, B, 128) f32
+def _encode_kernel(x_ref, mant_ref, scale_ref, *, block_size, mantissa_bits,
+                   rounding):
+    # refs are 2D (T*B, 128) so every operand/result sits in NATIVE tiles —
+    # f32 (8,128), int8 (32,128); a 3D (T, B=16, 128) int8 block would leave
+    # each row-group half a native int8 tile and force packed relayouts on
+    # every store.  The block view exists only on registers.
+    x = x_ref[:]                                   # (T*B, 128) f32
+    T = x.shape[0] // block_size
     bits = pltpu.bitcast(x, jnp.uint32)
     e = jnp.right_shift(bits, 23).astype(jnp.int32) & 0xFF
-    emax = jnp.max(e, axis=1, keepdims=True)       # (T, 1, 128)
+    emax = jnp.max(e.reshape(T, block_size, LANES), axis=1)   # (T, 128)
     scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
     inv = pltpu.bitcast(((127 - scale_e) << 23).astype(jnp.uint32),
                         jnp.float32)               # 2.0**-scale_e, exact
-    q = x * inv
+    q = x * jnp.repeat(inv, block_size, axis=0)
     q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
     lim = float(2 ** (mantissa_bits - 1) - 1)
     mant_ref[:] = jnp.clip(q, -lim, lim).astype(jnp.int8)
-    scale_ref[:] = scale_e[:, 0, :].astype(jnp.int8)
+    scale_ref[:] = scale_e.astype(jnp.int8)
 
 
-def _decode_kernel(mant_ref, scale_ref, out_ref):
-    m = mant_ref[:].astype(jnp.float32)            # (T, B, 128)
-    se = scale_ref[:].astype(jnp.int32)[:, None, :]
+def _decode_kernel(mant_ref, scale_ref, out_ref, *, block_size):
+    m = mant_ref[:].astype(jnp.float32)            # (T*B, 128)
+    se = scale_ref[:].astype(jnp.int32)            # (T, 128)
     scale = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
-    out_ref[:] = m * scale
+    out_ref[:] = m * jnp.repeat(scale, block_size, axis=0)
 
 
 def _grid(n_tiles: int, block_size: int, tiles_per_step: int):
@@ -76,27 +82,28 @@ def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
         interpret = not _is_tpu()
     n = x.shape[0]
     assert n % (block_size * LANES) == 0, (n, block_size * LANES)
-    x3 = x.astype(jnp.float32).reshape(-1, block_size, LANES)
-    t, steps = _grid(x3.shape[0], block_size, tiles_per_step)
-    kern = functools.partial(_encode_kernel, mantissa_bits=mantissa_bits,
-                             rounding=rounding)
+    x2 = x.astype(jnp.float32).reshape(-1, LANES)       # (tiles*B, 128)
+    n_tiles = x2.shape[0] // block_size
+    t, steps = _grid(n_tiles, block_size, tiles_per_step)
+    kern = functools.partial(_encode_kernel, block_size=block_size,
+                             mantissa_bits=mantissa_bits, rounding=rounding)
     mant, scale = pl.pallas_call(
         kern,
         grid=(steps,),
-        in_specs=[pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+        in_specs=[pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM)],
         out_specs=[
-            pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+            pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((t, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x3.shape, jnp.int8),
-            jax.ShapeDtypeStruct((x3.shape[0], LANES), jnp.int8),
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
+            jax.ShapeDtypeStruct((n_tiles, LANES), jnp.int8),
         ],
         interpret=interpret,
-    )(x3)
+    )(x2)
     return mant.reshape(n), scale.reshape(n // block_size)
 
 
@@ -108,21 +115,21 @@ def bfp_decode(mant: jax.Array, scale: jax.Array, block_size: int = 16,
     if interpret is None:
         interpret = not _is_tpu()
     n = mant.shape[0]
-    m3 = mant.reshape(-1, block_size, LANES)
+    m2 = mant.reshape(-1, LANES)
     s2 = scale.reshape(-1, LANES)
-    t, steps = _grid(m3.shape[0], block_size, tiles_per_step)
+    t, steps = _grid(s2.shape[0], block_size, tiles_per_step)
     out = pl.pallas_call(
-        _decode_kernel,
+        functools.partial(_decode_kernel, block_size=block_size),
         grid=(steps,),
         in_specs=[
-            pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+            pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((t, LANES), lambda i: (i, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((t, block_size, LANES), lambda i: (i, 0, 0),
+        out_specs=pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(m3.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(m2.shape, jnp.float32),
         interpret=interpret,
-    )(m3, s2)
+    )(m2, s2)
     return out.reshape(n).astype(dtype)

@@ -82,6 +82,65 @@ def _expert_ffn(params: Dict, h: jax.Array) -> jax.Array:
     return jnp.einsum("ecf,efd->ecd", g * u, params["w2"])
 
 
+def _route(params: Dict, xf: jax.Array, cfg: MoEConfig, C: int):
+    """Top-k routing + token-major capacity assignment for local tokens
+    xf [T, D].  Returns (gates [T,k], e_flat [T*k], onehot [T*k,E],
+    keep [T*k] bool, slot [T*k], probs [T,E])."""
+    E, k = cfg.num_experts, cfg.top_k
+    logits = (xf.astype(jnp.float32) @ params["wr"])          # [T, E]
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, eidx = lax.top_k(probs, k)                         # [T, k]
+    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
+
+    # deterministic token-major priority: earlier tokens win capacity slots
+    # (the reference drops nothing but orders everything by stream position;
+    # same discipline here)
+    e_flat = eidx.reshape(-1)                                 # [T*k]
+    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [T*k, E]
+    prio = jnp.cumsum(onehot, axis=0) - onehot
+    pos = jnp.sum(prio * onehot, axis=-1)                     # [T*k]
+    keep = (pos < C)
+    slot = jnp.where(keep, pos, 0)
+    return gates, e_flat, onehot, keep, slot, probs
+
+
+def expert_stats(params: Dict, x: jax.Array, cfg: MoEConfig, *,
+                 batch_axes: Sequence[str] = ()) -> Dict[str, jax.Array]:
+    """Expert-utilization observability (the reference's flit/stall-counter
+    discipline, hw/bfp_adapter.sv:705-729, applied to routing): per-expert
+    load fractions, dropped-assignment fraction, and capacity occupancy for
+    one batch.  Jit-safe; call inside the same shard_map/batch_axes setup as
+    the training loss, or unsharded on a debug batch.
+
+    Returns (E = num_experts):
+      load_frac      [E]  fraction of kept assignments per expert (sums ~1)
+      capacity_frac  [E]  kept assignments / capacity slots per expert
+      drop_frac      []   fraction of routed assignments dropped
+      capacity       []   per-expert capacity C used
+    """
+    B, S, D = x.shape
+    T = B * S
+    C = cfg.capacity(T)
+    _, e_flat, onehot, keep, _, _ = _route(params, x.reshape(T, D), cfg, C)
+    kept = jnp.sum(onehot * keep[:, None].astype(jnp.int32),
+                   axis=0).astype(jnp.float32)                # [E]
+    total = jnp.float32(keep.size)                            # T*k local
+    kept_total = jnp.sum(kept)
+    n_ranks = jnp.float32(1.0)
+    if batch_axes:
+        axes = tuple(batch_axes)
+        kept = lax.psum(kept, axes)
+        total = lax.psum(total, axes)
+        kept_total = lax.psum(kept_total, axes)
+        n_ranks = lax.psum(n_ranks, axes)    # slots scale with rank count
+    return {
+        "load_frac": kept / jnp.maximum(kept_total, 1.0),
+        "capacity_frac": kept / (C * n_ranks),
+        "drop_frac": 1.0 - kept_total / total,
+        "capacity": jnp.int32(C),
+    }
+
+
 def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig, *,
             ep_axis: Optional[str] = None,
             batch_axes: Sequence[str] = ()) -> Tuple[jax.Array, jax.Array]:
@@ -97,21 +156,7 @@ def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig, *,
     E, k = cfg.num_experts, cfg.top_k
     C = cfg.capacity(T)
     xf = x.reshape(T, D)
-
-    logits = (xf.astype(jnp.float32) @ params["wr"])          # [T, E]
-    probs = jax.nn.softmax(logits, axis=-1)
-    gates, eidx = lax.top_k(probs, k)                         # [T, k]
-    gates = gates / jnp.sum(gates, axis=-1, keepdims=True)
-
-    # deterministic token-major priority: earlier tokens win capacity slots
-    # (the reference drops nothing but orders everything by stream position;
-    # same discipline here)
-    e_flat = eidx.reshape(-1)                                 # [T*k]
-    onehot = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [T*k, E]
-    prio = jnp.cumsum(onehot, axis=0) - onehot
-    pos = jnp.sum(prio * onehot, axis=-1)                     # [T*k]
-    keep = (pos < C)
-    slot = jnp.where(keep, pos, 0)
+    gates, e_flat, onehot, keep, slot, probs = _route(params, xf, cfg, C)
 
     toks = jnp.repeat(xf, k, axis=0)                          # [T*k, D]
     buf = jnp.zeros((E, C, D), x.dtype).at[e_flat, slot].add(

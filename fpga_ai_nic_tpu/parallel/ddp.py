@@ -13,6 +13,12 @@ rather than commented out) — is exactly this trainer.
 Master weights / optimizer state: one flat replicated f32 vector, updated
 from the bucketed gradient means; working params are re-materialized in the
 model dtype each step (same cast discipline as the fused path).
+
+Memory: every device holds the FULL f32 master + optimizer state + flat
+gradient — simple and right for models that fit comfortably (BERT-base on
+any modern chip).  When master+state pressure matters, prefer
+`parallel.train.DPTrainer` (ZeRO-1: masters sharded over dp, ~1/n the
+state) or `parallel.fsdp.FSDPTrainer` (ZeRO-3: params sharded too).
 """
 
 from __future__ import annotations

@@ -124,6 +124,30 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
     return outputs.reshape(x.shape)
 
 
+def cost_model(num_microbatches: int, pp: int) -> dict:
+    """GPipe schedule cost report — the bubble arithmetic users need to
+    size num_microbatches (this implementation computes on ring garbage
+    during bubble ticks, so `bubble_fraction` IS the wasted-compute
+    fraction, not just idle time).
+
+    ticks            total schedule ticks (M + pp - 1)
+    bubble_ticks     ticks any given stage spends on garbage (pp - 1)
+    bubble_fraction  wasted fraction of stage compute
+    utilization      1 - bubble_fraction
+    """
+    if num_microbatches < 1 or pp < 1:
+        raise ValueError((num_microbatches, pp))
+    ticks = num_microbatches + pp - 1
+    return {
+        "num_microbatches": num_microbatches,
+        "pp": pp,
+        "ticks": ticks,
+        "bubble_ticks": pp - 1,
+        "bubble_fraction": (pp - 1) / ticks,
+        "utilization": num_microbatches / ticks,
+    }
+
+
 def from_last_stage(val: jax.Array, pp_axis: str) -> jax.Array:
     """psum-broadcast a value that is only valid on the last pp stage.
     Cheap for scalars (per-microbatch losses); use sparingly on big tensors."""

@@ -51,15 +51,20 @@ def total_len(ivs: Sequence[Interval]) -> float:
 
 
 def overlap_len(iv: Interval, merged: Sequence[Interval]) -> float:
-    """Length of iv covered by a *merged* (sorted, disjoint) interval set."""
+    """Length of iv covered by a *merged* (sorted, disjoint) interval set.
+    Bisects to the first candidate: real traces have ~1e5+ sync ops and a
+    linear scan per async event would be O(A*S)."""
+    import bisect
     s, e = iv
     cov = 0.0
-    for ms, me in merged:
-        if me <= s:
-            continue
-        if ms >= e:
-            break
+    i = bisect.bisect_right(merged, (s, float("inf"))) - 1
+    if i >= 0 and merged[i][1] <= s:
+        i += 1
+    i = max(i, 0)
+    while i < len(merged) and merged[i][0] < e:
+        ms, me = merged[i]
         cov += min(e, me) - max(s, ms)
+        i += 1
     return cov
 
 
@@ -136,6 +141,9 @@ def analyze_trace(trace_dir: str, *,
                 exposed_by_op[name] = exposed_by_op.get(name, 0.0) + exposed
         rep["overlap_frac"] = (rep["overlapped_s"] / rep["async_s"]
                                if rep["async_s"] else 1.0)
+        # full map kept so cross-device aggregation never drops an op that
+        # is small per device but large fleet-wide; top_exposed is display
+        rep["exposed_by_op"] = exposed_by_op
         rep["top_exposed"] = sorted(exposed_by_op.items(),
                                     key=lambda kv: -kv[1])[:5]
         devices[plane.name] = rep
@@ -159,7 +167,11 @@ def summarize(report: Dict) -> Dict:
     agg["n_devices"] = len(report["devices"])
     by_op: Dict[str, float] = {}
     for d in devs:
-        for name, s in d.get("top_exposed", ()):
+        # aggregate the FULL per-op maps (falling back to the truncated
+        # display list for hand-built reports) — a per-device top-5 merge
+        # would drop ops that are small everywhere but large in total
+        for name, s in (d.get("exposed_by_op") or
+                        dict(d.get("top_exposed", ()))).items():
             by_op[name] = by_op.get(name, 0.0) + s
     agg["top_exposed"] = sorted(by_op.items(), key=lambda kv: -kv[1])[:5]
     return agg

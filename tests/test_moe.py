@@ -134,3 +134,72 @@ def test_moe_llama_training_matches_unsharded(dp, ep):
         np.testing.assert_allclose(
             np.asarray(pg[1], np.float32), np.asarray(pw[1], np.float32),
             rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
+
+
+# -- expert-utilization observability ----------------------------------------
+
+def test_expert_stats_accounting(rng):
+    """load_frac sums to 1, capacity_frac consistent with kept counts, and
+    a tight capacity produces a nonzero drop_frac that matches moe_ffn's
+    keep mask."""
+    params = _params(rng)
+    x = jnp.asarray(rng.standard_normal((2, 8, D)), jnp.float32)
+    stats = jax.jit(lambda p, v: moe.expert_stats(p, v, MCFG))(params, x)
+    assert float(jnp.sum(stats["load_frac"])) == pytest.approx(1.0, abs=1e-6)
+    assert float(stats["drop_frac"]) == pytest.approx(0.0, abs=1e-6)
+    # generous capacity: occupancy strictly below 1 for every expert
+    assert np.all(np.asarray(stats["capacity_frac"]) <= 1.0)
+
+    tight = dataclasses.replace(MCFG, capacity_factor=0.5)
+    st2 = jax.jit(lambda p, v: moe.expert_stats(p, v, tight))(params, x)
+    assert float(st2["drop_frac"]) > 0.0
+    # kept never exceeds capacity
+    assert np.all(np.asarray(st2["capacity_frac"]) <= 1.0 + 1e-6)
+
+
+def test_expert_stats_sharded_matches_unsharded(rng):
+    """Global stats over dp-sharded tokens == unsharded stats on the same
+    batch when capacity does not bind (the rank-local capacity caveat
+    documented in the module docstring)."""
+    n = 8
+    mesh = Mesh(np.array(jax.devices()[:n]), ("dp",))
+    params = _params(rng)
+    x = jnp.asarray(rng.standard_normal((n * 2, 4, D)), jnp.float32)
+
+    want = moe.expert_stats(params, x, MCFG)
+
+    def run(p, v):
+        return moe.expert_stats(p, v, MCFG, batch_axes=("dp",))
+
+    got = jax.jit(jax.shard_map(
+        run, mesh=mesh, in_specs=(P(), P("dp")),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), want),
+        check_vma=False))(params, x)
+    np.testing.assert_allclose(np.asarray(got["load_frac"]),
+                               np.asarray(want["load_frac"]), atol=1e-6)
+    assert float(got["drop_frac"]) == pytest.approx(
+        float(want["drop_frac"]), abs=1e-6)
+
+
+def test_moe_llama_converges(rng):
+    """8 adamw steps on a fixed batch must reduce the loss (the convergence
+    smoke the round-1 review flagged as missing)."""
+    mcfg = dataclasses.replace(
+        llama.LlamaConfig.tiny(n_layers=2, ffn_dim=32),
+        moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+    params = llama.init(jax.random.PRNGKey(0), mcfg)
+    toks = jnp.asarray(rng.integers(0, mcfg.vocab, (4, 17)), jnp.int32)
+    batch = (toks[:, :-1], toks[:, 1:])
+    import optax  # replicated single-device loop: optimizer alone suffices
+    opt = optax.adamw(3e-3)
+    st = opt.init(params)
+    loss_fn = jax.jit(jax.value_and_grad(
+        lambda p: llama.loss_fn(p, batch, mcfg)))
+    first = None
+    for _ in range(8):
+        loss, g = loss_fn(params)
+        up, st = opt.update(g, st, params)
+        params = optax.apply_updates(params, up)
+        first = float(loss) if first is None else first
+    assert np.isfinite(float(loss))
+    assert float(loss) < first, (float(loss), first)

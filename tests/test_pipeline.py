@@ -160,3 +160,17 @@ def test_pp_training_matches_unsharded(dp, pp, remat, masked):
         np.testing.assert_allclose(
             np.asarray(pg[1], np.float32), np.asarray(pw[1], np.float32),
             rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
+
+
+def test_cost_model_bubble_arithmetic():
+    from fpga_ai_nic_tpu.parallel import pipeline
+    cm = pipeline.cost_model(num_microbatches=4, pp=2)
+    assert cm["ticks"] == 5
+    assert cm["bubble_ticks"] == 1
+    assert cm["bubble_fraction"] == pytest.approx(0.2)
+    assert cm["utilization"] == pytest.approx(0.8)
+    # more microbatches amortize the bubble
+    assert (pipeline.cost_model(16, 2)["bubble_fraction"]
+            < cm["bubble_fraction"])
+    with pytest.raises(ValueError):
+        pipeline.cost_model(0, 2)
