@@ -1,0 +1,165 @@
+// Native batch-staging engine: the host-side buffer plane of the runtime.
+//
+// Role parity: the reference's C++ driver owns host buffer staging — OPAE
+// pinned allocations plus the per-iteration activation layout loops that
+// feed the device DMA (sw/mlp_mpi_example_f32.cpp:381-424,452-460).  The
+// TPU-native equivalent is assembling shuffled minibatches: dst[i, :] =
+// src[idx[i], :], the row-gather every epoch loop performs before
+// device_put.  In Python/numpy that gather is a single-threaded memcpy
+// holding the GIL; here it runs on an OpenMP team inside a worker thread,
+// so batch k+1 stages while the interpreter dispatches batch k — the same
+// copy/compute overlap the reference gets from its 4-CL read bursts
+// running behind the ring (readme.pdf §2.1).
+//
+// Design: a fixed pool of reusable aligned slot buffers + one worker
+// thread draining a job queue (gathers are internally OpenMP-parallel, so
+// one drain thread saturates memory bandwidth).  States: FREE -> QUEUED ->
+// READY -> (release) FREE.  The C ABI below is loaded via ctypes
+// (runtime/staging.py); no Python headers involved.
+//
+// Build: make -C fpga_ai_nic_tpu/csrc   (libstaging.so)
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdlib>
+#include <cstring>
+#include <deque>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+enum class SlotState : int { FREE = 0, QUEUED = 1, READY = 2 };
+
+struct Job {
+  int slot;
+  const unsigned char* src;
+  const int64_t* idx;     // caller keeps alive until wait() returns
+  int64_t n_rows;
+  int64_t row_bytes;
+};
+
+struct Pool {
+  std::vector<unsigned char*> buffers;
+  std::vector<SlotState> state;
+  size_t slot_bytes;
+  std::deque<Job> queue;
+  std::mutex mu;
+  std::condition_variable cv;      // slot state changes / queue pushes
+  std::thread worker;
+  bool stop = false;
+
+  explicit Pool(int n_slots, size_t bytes) : slot_bytes(bytes) {
+    buffers.reserve(n_slots);
+    for (int i = 0; i < n_slots; ++i) {
+      void* p = nullptr;
+      // 4096: page alignment so the runtime's host->device DMA never
+      // straddles a partial first page
+      if (posix_memalign(&p, 4096, bytes) != 0) p = nullptr;
+      buffers.push_back(static_cast<unsigned char*>(p));
+      state.push_back(SlotState::FREE);
+    }
+    worker = std::thread([this] { run(); });
+  }
+
+  ~Pool() {
+    {
+      std::lock_guard<std::mutex> g(mu);
+      stop = true;
+    }
+    cv.notify_all();
+    worker.join();
+    for (auto* b : buffers) free(b);
+  }
+
+  void run() {
+    for (;;) {
+      Job job;
+      {
+        std::unique_lock<std::mutex> g(mu);
+        cv.wait(g, [this] { return stop || !queue.empty(); });
+        if (stop) return;
+        job = queue.front();
+        queue.pop_front();
+      }
+      gather(job);
+      {
+        std::lock_guard<std::mutex> g(mu);
+        state[job.slot] = SlotState::READY;
+      }
+      cv.notify_all();
+    }
+  }
+
+  void gather(const Job& j) {
+    unsigned char* dst = buffers[j.slot];
+#pragma omp parallel for schedule(static)
+    for (int64_t i = 0; i < j.n_rows; ++i) {
+      std::memcpy(dst + i * j.row_bytes, j.src + j.idx[i] * j.row_bytes,
+                  static_cast<size_t>(j.row_bytes));
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* stage_create(int n_slots, int64_t slot_bytes) {
+  if (n_slots < 1 || slot_bytes < 1) return nullptr;
+  Pool* p = new Pool(n_slots, static_cast<size_t>(slot_bytes));
+  for (auto* b : p->buffers)
+    if (b == nullptr) {
+      delete p;
+      return nullptr;
+    }
+  return p;
+}
+
+void stage_destroy(void* pool) { delete static_cast<Pool*>(pool); }
+
+// Claim a FREE slot (blocking) and enqueue the gather.  Returns slot id,
+// or -1 if the job does not fit the slot.
+int stage_submit(void* pool, const void* src, const int64_t* idx,
+                 int64_t n_rows, int64_t row_bytes) {
+  Pool* p = static_cast<Pool*>(pool);
+  if (static_cast<size_t>(n_rows * row_bytes) > p->slot_bytes) return -1;
+  std::unique_lock<std::mutex> g(p->mu);
+  int slot = -1;
+  p->cv.wait(g, [&] {
+    for (size_t i = 0; i < p->state.size(); ++i)
+      if (p->state[i] == SlotState::FREE) {
+        slot = static_cast<int>(i);
+        return true;
+      }
+    return false;
+  });
+  p->state[slot] = SlotState::QUEUED;
+  p->queue.push_back(Job{slot, static_cast<const unsigned char*>(src), idx,
+                         n_rows, row_bytes});
+  g.unlock();
+  p->cv.notify_all();
+  return slot;
+}
+
+// Block until the slot's gather completes; returns the buffer pointer.
+void* stage_wait(void* pool, int slot) {
+  Pool* p = static_cast<Pool*>(pool);
+  std::unique_lock<std::mutex> g(p->mu);
+  p->cv.wait(g, [&] { return p->state[slot] == SlotState::READY; });
+  return p->buffers[slot];
+}
+
+// Return a READY slot to the pool (its buffer may be overwritten after).
+void stage_release(void* pool, int slot) {
+  Pool* p = static_cast<Pool*>(pool);
+  {
+    std::lock_guard<std::mutex> g(p->mu);
+    p->state[slot] = SlotState::FREE;
+  }
+  p->cv.notify_all();
+}
+
+}  // extern "C"

@@ -70,18 +70,37 @@ def synthetic_batches(make_batch: Callable[[np.random.Generator], Any],
 
 def epochs_of(arrays: Any, batch_size: int, *, seed: int = 0,
               epochs: Optional[int] = None,
-              drop_remainder: bool = True) -> Iterator[Any]:
+              drop_remainder: bool = True,
+              native: bool = False) -> Iterator[Any]:
     """Shuffled minibatch epochs over in-memory arrays (pytree with a
     shared leading example axis).
 
     ``drop_remainder=False`` yields a ragged final batch per epoch — fine
     for host-side eval loops, but INCOMPATIBLE with the sharded trainers:
     their batch size must divide the dp(*ep)/sp mesh axes and a new shape
-    forces an XLA recompile.  Keep the default for training."""
-    leaves = jax.tree_util.tree_leaves(arrays)
+    forces an XLA recompile.  Keep the default for training.
+
+    ``native=True`` stages batches through the C++ gather engine
+    (runtime/staging.py): the row gather runs on an OpenMP team in a
+    background thread and the NEXT batch stages while the caller consumes
+    the current one.  Requires drop_remainder (fixed slot sizes); falls
+    back to numpy when the native library is unavailable.  Yielded leaves
+    are OWNED arrays (copied out of the pool on yield — pool buffers are
+    freed when the generator closes, so views would dangle); the parallel
+    gather + copy still beats the single-threaded numpy fancy-index, and
+    the gather overlaps the consumer."""
+    leaves, treedef = jax.tree_util.tree_flatten(arrays)
     n = leaves[0].shape[0]
     assert all(l.shape[0] == n for l in leaves), "ragged leading axis"
     rng = np.random.default_rng(seed)
+
+    if native and drop_remainder:
+        from .runtime import staging
+        if staging.available():
+            yield from _epochs_native(leaves, treedef, n, batch_size, rng,
+                                      epochs)
+            return
+
     e = 0
     while epochs is None or e < epochs:
         order = rng.permutation(n)
@@ -91,3 +110,51 @@ def epochs_of(arrays: Any, batch_size: int, *, seed: int = 0,
             yield jax.tree_util.tree_map(lambda x: np.asarray(x)[idx],
                                          arrays)
         e += 1
+
+
+def _epochs_native(leaves, treedef, n, batch_size, rng, epochs):
+    """Double-buffered native staging: submit batch k+1's gathers before
+    yielding batch k, so the OpenMP copy overlaps the consumer."""
+    from .runtime.staging import Stager
+    np_leaves = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
+    slot_bytes = [batch_size * l.dtype.itemsize
+                  * int(np.prod(l.shape[1:], dtype=np.int64))
+                  for l in np_leaves]
+    # one pool per leaf (slot sizes differ); 2 slots = double buffering
+    pools = [Stager(2, b) for b in slot_bytes]
+    try:
+        def submit(idx):
+            return [p.submit(l, idx) for p, l in zip(pools, np_leaves)]
+
+        def index_stream():
+            e = 0
+            while epochs is None or e < epochs:
+                order = rng.permutation(n)
+                for lo in range(0, (n // batch_size) * batch_size,
+                                batch_size):
+                    yield order[lo:lo + batch_size]
+                e += 1
+
+        it = index_stream()
+        pending = None
+        for idx in it:
+            slots = submit(idx)
+            if pending is not None:
+                yield _materialize(pending, pools, treedef)
+            pending = slots
+        if pending is not None:
+            yield _materialize(pending, pools, treedef)
+    finally:
+        for p in pools:
+            p.close()
+
+
+def _materialize(slots, pools, treedef):
+    # copy out of the pool buffer: the generator's close() frees the native
+    # buffers, so a yielded VIEW would dangle for any batch kept past the
+    # loop (e.g. list(epochs_of(...))).  The copy is one parallel-friendly
+    # memcpy; the expensive shuffled gather already happened natively.
+    leaves = [np.array(p.wait(s)) for p, s in zip(pools, slots)]
+    for p, s in zip(pools, slots):
+        p.release(s)
+    return jax.tree_util.tree_unflatten(treedef, leaves)

@@ -32,7 +32,10 @@ Deviations from the RTL (deliberate, documented):
   quantization error at identical wire cost.
 - storage is (int8 mantissa, int8 scale_exp) rather than the RTL's biased
   uint8 shared exponent; scale_exp = shared_biased - 133 is a relabeling,
-  wire size is identical (8 bits per block either way).
+  wire size is identical (8 bits per block either way).  The RTL's NX_MODE
+  parameter (hw/bf16_to_bfp_core.sv:34,100: report emax-6 instead of emax)
+  is another constant relabeling of the same field, so it is subsumed —
+  both conventions decode to identical values.
 """
 
 from __future__ import annotations

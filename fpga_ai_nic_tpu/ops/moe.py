@@ -110,7 +110,10 @@ def expert_stats(params: Dict, x: jax.Array, cfg: MoEConfig, *,
     discipline, hw/bfp_adapter.sv:705-729, applied to routing): per-expert
     load fractions, dropped-assignment fraction, and capacity occupancy for
     one batch.  Jit-safe; call inside the same shard_map/batch_axes setup as
-    the training loss, or unsharded on a debug batch.
+    the training loss, or unsharded on a debug batch.  Standalone entry —
+    reruns the router; inside a forward pass use
+    ``moe_ffn(..., with_stats=True)``, which reuses the routing it already
+    computed.
 
     Returns (E = num_experts):
       load_frac      [E]  fraction of kept assignments per expert (sums ~1)
@@ -121,7 +124,13 @@ def expert_stats(params: Dict, x: jax.Array, cfg: MoEConfig, *,
     B, S, D = x.shape
     T = B * S
     C = cfg.capacity(T)
-    _, e_flat, onehot, keep, _, _ = _route(params, x.reshape(T, D), cfg, C)
+    _, _, onehot, keep, _, _ = _route(params, x.reshape(T, D), cfg, C)
+    return _stats_from_routing(onehot, keep, C, batch_axes)
+
+
+def _stats_from_routing(onehot: jax.Array, keep: jax.Array, C: int,
+                        batch_axes: Sequence[str] = ()
+                        ) -> Dict[str, jax.Array]:
     kept = jnp.sum(onehot * keep[:, None].astype(jnp.int32),
                    axis=0).astype(jnp.float32)                # [E]
     total = jnp.float32(keep.size)                            # T*k local
@@ -143,8 +152,11 @@ def expert_stats(params: Dict, x: jax.Array, cfg: MoEConfig, *,
 
 def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig, *,
             ep_axis: Optional[str] = None,
-            batch_axes: Sequence[str] = ()) -> Tuple[jax.Array, jax.Array]:
-    """x: [B, S, D] local tokens -> (y [B, S, D], aux scalar).
+            batch_axes: Sequence[str] = (),
+            with_stats: bool = False):
+    """x: [B, S, D] local tokens -> (y [B, S, D], aux scalar)
+    [, stats dict when with_stats — see `expert_stats`; reuses this pass's
+    routing rather than rerunning the router].
 
     With ep_axis set (inside shard_map), expert leaves are the local
     [E/ep, ...] shards and tokens are exchanged with two all_to_alls
@@ -193,4 +205,6 @@ def moe_ffn(params: Dict, x: jax.Array, cfg: MoEConfig, *,
     f = counts / (n_tok * k)
     p = psum_p / n_tok
     aux = cfg.aux_weight * E * jnp.dot(f, p)
+    if with_stats:
+        return y, aux, _stats_from_routing(onehot, keep, C, batch_axes)
     return y, aux

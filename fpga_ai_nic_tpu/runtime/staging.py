@@ -1,0 +1,129 @@
+"""ctypes wrapper for the native batch-staging engine (csrc/staging.cpp).
+
+Shuffled-minibatch assembly is a row gather — dst[i] = src[idx[i]] — that
+numpy performs single-threaded under the GIL.  The native engine runs it on
+an OpenMP team inside a worker thread over a pool of reusable page-aligned
+buffers, so batch k+1 stages while Python dispatches batch k (the staging
+role the reference's C++ driver plays for its device DMA,
+sw/mlp_mpi_example_f32.cpp:381-424).
+
+Degrades gracefully: `Stager.available()` is False when the .so is absent
+and cannot be built, and `data.epochs_of(native=...)` falls back to numpy.
+Zero-copy: `wait()` returns a numpy view of the slot buffer — valid until
+`release(slot)`; callers hand it to `jax.device_put` before releasing.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
+_SO = os.path.join(_DIR, "libstaging.so")
+_lib = None
+_tried = False
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    if not os.path.exists(_SO):
+        try:
+            subprocess.run(["make", "-C", _DIR], check=True,
+                           capture_output=True, timeout=120)
+        except Exception:
+            return None
+    try:
+        l = ctypes.CDLL(_SO)
+    except OSError:
+        return None
+    l.stage_create.restype = ctypes.c_void_p
+    l.stage_create.argtypes = [ctypes.c_int, ctypes.c_int64]
+    l.stage_destroy.argtypes = [ctypes.c_void_p]
+    l.stage_submit.restype = ctypes.c_int
+    l.stage_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                               ctypes.POINTER(ctypes.c_int64),
+                               ctypes.c_int64, ctypes.c_int64]
+    l.stage_wait.restype = ctypes.c_void_p
+    l.stage_wait.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    l.stage_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    _lib = l
+    return _lib
+
+
+def available() -> bool:
+    return lib() is not None
+
+
+class Stager:
+    """Pool of `n_slots` staging buffers of `slot_bytes` each."""
+
+    def __init__(self, n_slots: int, slot_bytes: int):
+        l = lib()
+        assert l is not None, "native staging unavailable (csrc build failed)"
+        self._l = l
+        self._pool = l.stage_create(n_slots, slot_bytes)
+        if not self._pool:
+            raise MemoryError(f"stage_create({n_slots}, {slot_bytes})")
+        self.n_slots = n_slots
+        self.slot_bytes = slot_bytes
+        # submitted job keepalives: src/idx arrays must outlive the gather
+        self._live = {}
+
+    def submit(self, src: np.ndarray, idx: np.ndarray) -> int:
+        """Enqueue dst[i] = src[idx[i]] over axis 0; returns a slot id.
+
+        Raises when every slot is outstanding (submitted, not released):
+        slots only return to the pool via release(), which only this thread
+        can call, so blocking here would deadlock inside native code."""
+        if len(self._live) >= self.n_slots:
+            raise RuntimeError(
+                f"all {self.n_slots} slots outstanding; release() one "
+                "before submitting more (bounded prefetch window)")
+        src = np.ascontiguousarray(src)
+        idx = np.ascontiguousarray(idx, np.int64)
+        if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
+            # the C++ gather memcpys unchecked in a worker thread; an OOB
+            # index there is a silent wild read, so bound it here
+            raise IndexError(f"index out of range [0, {src.shape[0]})")
+        row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+        slot = self._l.stage_submit(
+            self._pool, src.ctypes.data_as(ctypes.c_void_p),
+            idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+            len(idx), row_bytes)
+        if slot < 0:
+            raise ValueError(
+                f"batch ({len(idx)} rows x {row_bytes} B) exceeds slot size "
+                f"{self.slot_bytes}")
+        self._live[slot] = (src, idx, (len(idx),) + src.shape[1:], src.dtype)
+        return slot
+
+    def wait(self, slot: int) -> np.ndarray:
+        """Block until the slot's gather is done; returns a VIEW of the slot
+        buffer (valid until release)."""
+        ptr = self._l.stage_wait(self._pool, slot)
+        src, idx, shape, dtype = self._live[slot]
+        n = int(np.prod(shape, dtype=np.int64))
+        buf = (ctypes.c_char * (n * dtype.itemsize)).from_address(ptr)
+        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+
+    def release(self, slot: int) -> None:
+        self._live.pop(slot, None)
+        self._l.stage_release(self._pool, slot)
+
+    def close(self) -> None:
+        if self._pool:
+            self._l.stage_destroy(self._pool)
+            self._pool = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass

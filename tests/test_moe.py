@@ -203,3 +203,15 @@ def test_moe_llama_converges(rng):
         first = float(loss) if first is None else first
     assert np.isfinite(float(loss))
     assert float(loss) < first, (float(loss), first)
+
+
+def test_moe_ffn_with_stats_matches_standalone(rng):
+    params = _params(rng)
+    x = jnp.asarray(rng.standard_normal((2, 8, D)), jnp.float32)
+    y1, aux1 = moe.moe_ffn(params, x, MCFG)
+    y2, aux2, stats = moe.moe_ffn(params, x, MCFG, with_stats=True)
+    np.testing.assert_array_equal(np.asarray(y1), np.asarray(y2))
+    assert float(aux1) == float(aux2)
+    want = moe.expert_stats(params, x, MCFG)
+    np.testing.assert_allclose(np.asarray(stats["load_frac"]),
+                               np.asarray(want["load_frac"]), atol=1e-6)
