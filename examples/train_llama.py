@@ -34,10 +34,15 @@ def main(argv):
 
     from fpga_ai_nic_tpu import data
     from fpga_ai_nic_tpu.models import llama
-    from fpga_ai_nic_tpu.parallel import ShardedTrainer, make_mesh
+    from fpga_ai_nic_tpu.parallel import ShardedTrainer, make_mesh, multihost
     from fpga_ai_nic_tpu.utils.config import TrainConfig, from_flags
     from fpga_ai_nic_tpu.utils.observability import Profiler
     from jax.sharding import PartitionSpec as P
+
+    # control plane: no-op single-process; on a pod / JAX_COORDINATOR_*
+    # env it joins the job before any device query (the mpirun ritual,
+    # sw/README:1-3, as one idempotent call)
+    multihost.initialize()
 
     model_flags = [a.replace("--model.", "--") for a in argv
                    if a.startswith("--model.")]
@@ -116,6 +121,7 @@ def main(argv):
         "tokens_per_sec": toks_per_s, "wall_s": wall,
         "params": llama.num_params(mcfg),
         "mesh": {"dp": m.dp, "tp": m.tp, "sp": m.sp, "pp": m.pp, "ep": m.ep},
+        "process": multihost.process_info(),
         "profile": prof.report(),
     }
     if pp_ax:

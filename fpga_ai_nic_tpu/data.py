@@ -114,17 +114,28 @@ def epochs_of(arrays: Any, batch_size: int, *, seed: int = 0,
 
 def _epochs_native(leaves, treedef, n, batch_size, rng, epochs):
     """Double-buffered native staging: submit batch k+1's gathers before
-    yielding batch k, so the OpenMP copy overlaps the consumer."""
+    yielding batch k, so the OpenMP copy overlaps the consumer.  ONE pool
+    (one worker thread — each gather is internally OpenMP-parallel) with
+    2 slot generations x n_leaves uniform max-size slots."""
     from .runtime.staging import Stager
     np_leaves = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
-    slot_bytes = [batch_size * l.dtype.itemsize
-                  * int(np.prod(l.shape[1:], dtype=np.int64))
-                  for l in np_leaves]
-    # one pool per leaf (slot sizes differ); 2 slots = double buffering
-    pools = [Stager(2, b) for b in slot_bytes]
+    slot_bytes = max(batch_size * l.dtype.itemsize
+                     * int(np.prod(l.shape[1:], dtype=np.int64))
+                     for l in np_leaves)
+    pool = Stager(2 * len(np_leaves), slot_bytes)
     try:
         def submit(idx):
-            return [p.submit(l, idx) for p, l in zip(pools, np_leaves)]
+            return [pool.submit(l, idx) for l in np_leaves]
+
+        def materialize(slots):
+            # copy out of the pool buffer: the generator's close() frees
+            # the native buffers, so a yielded VIEW would dangle for any
+            # batch kept past the loop (e.g. list(epochs_of(...))); the
+            # expensive shuffled gather already happened natively
+            out = [np.array(pool.wait(s)) for s in slots]
+            for s in slots:
+                pool.release(s)
+            return jax.tree_util.tree_unflatten(treedef, out)
 
         def index_stream():
             e = 0
@@ -135,26 +146,13 @@ def _epochs_native(leaves, treedef, n, batch_size, rng, epochs):
                     yield order[lo:lo + batch_size]
                 e += 1
 
-        it = index_stream()
         pending = None
-        for idx in it:
+        for idx in index_stream():
             slots = submit(idx)
             if pending is not None:
-                yield _materialize(pending, pools, treedef)
+                yield materialize(pending)
             pending = slots
         if pending is not None:
-            yield _materialize(pending, pools, treedef)
+            yield materialize(pending)
     finally:
-        for p in pools:
-            p.close()
-
-
-def _materialize(slots, pools, treedef):
-    # copy out of the pool buffer: the generator's close() frees the native
-    # buffers, so a yielded VIEW would dangle for any batch kept past the
-    # loop (e.g. list(epochs_of(...))).  The copy is one parallel-friendly
-    # memcpy; the expensive shuffled gather already happened natively.
-    leaves = [np.array(p.wait(s)) for p, s in zip(pools, slots)]
-    for p, s in zip(pools, slots):
-        p.release(s)
-    return jax.tree_util.tree_unflatten(treedef, leaves)
+        pool.close()

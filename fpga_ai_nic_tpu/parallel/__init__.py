@@ -1,4 +1,4 @@
-from . import pipeline
+from . import multihost, pipeline
 from .ddp import DDPState, DDPTrainer
 from .fsdp import FSDPState, FSDPTrainer
 from .mesh import make_mesh
@@ -9,4 +9,4 @@ from .train import DPTrainer, TrainState
 __all__ = ["make_mesh", "DPTrainer", "TrainState",
            "ShardedTrainer", "ShardedState",
            "DDPTrainer", "DDPState", "QueuedDDPTrainer",
-           "FSDPTrainer", "FSDPState", "pipeline"]
+           "FSDPTrainer", "FSDPState", "pipeline", "multihost"]

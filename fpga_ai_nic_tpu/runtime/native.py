@@ -22,21 +22,31 @@ _lib = None
 _tried = False
 
 
-def lib() -> Optional[ctypes.CDLL]:
-    """Load (building on first use if needed) the native codec library."""
-    global _lib, _tried
-    if _lib is not None or _tried:
-        return _lib
-    _tried = True
-    if not os.path.exists(_SO):
+def load_native(so_name: str) -> Optional[ctypes.CDLL]:
+    """Shared build-on-first-use loader for every csrc library: runs
+    `make -C csrc` when the .so is absent, returns None on any failure so
+    callers degrade to their Python fallbacks."""
+    so_path = os.path.join(_DIR, so_name)
+    if not os.path.exists(so_path):
         try:
             subprocess.run(["make", "-C", _DIR], check=True,
                            capture_output=True, timeout=120)
         except Exception:
             return None
     try:
-        l = ctypes.CDLL(_SO)
+        return ctypes.CDLL(so_path)
     except OSError:
+        return None
+
+
+def lib() -> Optional[ctypes.CDLL]:
+    """Load (building on first use if needed) the native codec library."""
+    global _lib, _tried
+    if _lib is not None or _tried:
+        return _lib
+    _tried = True
+    l = load_native("libbfp_codec.so")
+    if l is None:
         return None
     l.bfp_encode_f32.argtypes = [
         ctypes.POINTER(ctypes.c_float), ctypes.c_int64, ctypes.c_int32,

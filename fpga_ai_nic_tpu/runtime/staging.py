@@ -16,14 +16,10 @@ Zero-copy: `wait()` returns a numpy view of the slot buffer — valid until
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
-from typing import Optional, Tuple
+from typing import Optional
 
 import numpy as np
 
-_DIR = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
-_SO = os.path.join(_DIR, "libstaging.so")
 _lib = None
 _tried = False
 
@@ -33,15 +29,9 @@ def lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
-    if not os.path.exists(_SO):
-        try:
-            subprocess.run(["make", "-C", _DIR], check=True,
-                           capture_output=True, timeout=120)
-        except Exception:
-            return None
-    try:
-        l = ctypes.CDLL(_SO)
-    except OSError:
+    from .native import load_native
+    l = load_native("libstaging.so")
+    if l is None:
         return None
     l.stage_create.restype = ctypes.c_void_p
     l.stage_create.argtypes = [ctypes.c_int, ctypes.c_int64]
@@ -107,8 +97,12 @@ class Stager:
     def wait(self, slot: int) -> np.ndarray:
         """Block until the slot's gather is done; returns a VIEW of the slot
         buffer (valid until release)."""
-        ptr = self._l.stage_wait(self._pool, slot)
+        if slot not in self._live:
+            # the native wait would block forever on a FREE/unknown slot
+            # (and index out of bounds for an invalid id)
+            raise KeyError(f"slot {slot} is not outstanding")
         src, idx, shape, dtype = self._live[slot]
+        ptr = self._l.stage_wait(self._pool, slot)
         n = int(np.prod(shape, dtype=np.int64))
         buf = (ctypes.c_char * (n * dtype.itemsize)).from_address(ptr)
         return np.frombuffer(buf, dtype=dtype).reshape(shape)

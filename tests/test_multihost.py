@@ -1,0 +1,40 @@
+"""Multi-host control plane (parallel/multihost.py) on the single-process
+CPU mesh: initialize() no-op semantics, process_info readback,
+local_batch_to_global == shard_host_batch in the degenerate case, and the
+barrier.  True multi-process behavior rides jax.distributed /
+make_array_from_process_local_data, which these wrap thinly; the contract
+here is that single-process and multi-process use the SAME calls.
+"""
+
+import numpy as np
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from fpga_ai_nic_tpu.parallel import make_mesh, multihost
+from fpga_ai_nic_tpu.parallel.mesh import shard_host_batch
+from fpga_ai_nic_tpu.utils.config import MeshConfig
+
+
+def test_initialize_single_process_is_noop():
+    multihost.initialize()          # no coordinator/env: must not raise
+    info = multihost.process_info()
+    assert info["num_processes"] == 1
+    assert info["process_id"] == 0
+    assert info["global_devices"] == info["local_devices"] == 8
+
+
+def test_local_batch_to_global_matches_shard_host_batch(rng):
+    mesh = make_mesh(MeshConfig(dp=8))
+    x = rng.standard_normal((16, 4)).astype(np.float32)
+    got = multihost.local_batch_to_global({"x": x}, mesh, P("dp"))
+    want = shard_host_batch({"x": x}, mesh, P("dp"))
+    assert got["x"].sharding == want["x"].sharding
+    np.testing.assert_array_equal(np.asarray(got["x"]),
+                                  np.asarray(want["x"]))
+    # result is consumable by a jitted sum like any global array
+    assert np.isfinite(float(jax.jit(lambda v: v.sum())(got["x"])))
+
+
+def test_barrier_single_process():
+    multihost.barrier("test")       # must return, not hang
