@@ -43,22 +43,24 @@ struct Job {
 
 struct Pool {
   std::vector<unsigned char*> buffers;
+  std::vector<size_t> capacity;    // per-slot byte capacity
   std::vector<SlotState> state;
-  size_t slot_bytes;
   std::deque<Job> queue;
   std::mutex mu;
   std::condition_variable cv;      // slot state changes / queue pushes
   std::thread worker;
   bool stop = false;
 
-  explicit Pool(int n_slots, size_t bytes) : slot_bytes(bytes) {
+  Pool(const int64_t* sizes, int n_slots) {
     buffers.reserve(n_slots);
     for (int i = 0; i < n_slots; ++i) {
       void* p = nullptr;
       // 4096: page alignment so the runtime's host->device DMA never
       // straddles a partial first page
-      if (posix_memalign(&p, 4096, bytes) != 0) p = nullptr;
+      if (posix_memalign(&p, 4096, static_cast<size_t>(sizes[i])) != 0)
+        p = nullptr;
       buffers.push_back(static_cast<unsigned char*>(p));
+      capacity.push_back(static_cast<size_t>(sizes[i]));
       state.push_back(SlotState::FREE);
     }
     worker = std::thread([this] { run(); });
@@ -107,9 +109,13 @@ struct Pool {
 
 extern "C" {
 
-void* stage_create(int n_slots, int64_t slot_bytes) {
-  if (n_slots < 1 || slot_bytes < 1) return nullptr;
-  Pool* p = new Pool(n_slots, static_cast<size_t>(slot_bytes));
+// Per-slot sizes: mixed-width batch pytrees get right-sized slots (a
+// uniform max-size pool would waste ~row_bytes ratio per small leaf).
+void* stage_create_sized(const int64_t* slot_bytes, int n_slots) {
+  if (n_slots < 1) return nullptr;
+  for (int i = 0; i < n_slots; ++i)
+    if (slot_bytes[i] < 1) return nullptr;
+  Pool* p = new Pool(slot_bytes, n_slots);
   for (auto* b : p->buffers)
     if (b == nullptr) {
       delete p;
@@ -118,23 +124,34 @@ void* stage_create(int n_slots, int64_t slot_bytes) {
   return p;
 }
 
+void* stage_create(int n_slots, int64_t slot_bytes) {
+  if (n_slots < 1) return nullptr;
+  std::vector<int64_t> sizes(n_slots, slot_bytes);
+  return stage_create_sized(sizes.data(), n_slots);
+}
+
 void stage_destroy(void* pool) { delete static_cast<Pool*>(pool); }
 
-// Claim a FREE slot (blocking) and enqueue the gather.  Returns slot id,
-// or -1 if the job does not fit the slot.
+// Claim the smallest FREE slot that fits (blocking) and enqueue the
+// gather.  Returns slot id, or -1 if no slot could ever fit the job.
 int stage_submit(void* pool, const void* src, const int64_t* idx,
                  int64_t n_rows, int64_t row_bytes) {
   Pool* p = static_cast<Pool*>(pool);
-  if (static_cast<size_t>(n_rows * row_bytes) > p->slot_bytes) return -1;
+  const size_t need = static_cast<size_t>(n_rows * row_bytes);
+  bool fits_any = false;
+  for (size_t cap : p->capacity) fits_any |= (cap >= need);
+  if (!fits_any) return -1;
   std::unique_lock<std::mutex> g(p->mu);
   int slot = -1;
   p->cv.wait(g, [&] {
+    size_t best = SIZE_MAX;
     for (size_t i = 0; i < p->state.size(); ++i)
-      if (p->state[i] == SlotState::FREE) {
+      if (p->state[i] == SlotState::FREE && p->capacity[i] >= need &&
+          p->capacity[i] < best) {
+        best = p->capacity[i];
         slot = static_cast<int>(i);
-        return true;
       }
-    return false;
+    return slot >= 0;
   });
   p->state[slot] = SlotState::QUEUED;
   p->queue.push_back(Job{slot, static_cast<const unsigned char*>(src), idx,

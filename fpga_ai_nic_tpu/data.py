@@ -119,10 +119,12 @@ def _epochs_native(leaves, treedef, n, batch_size, rng, epochs):
     2 slot generations x n_leaves uniform max-size slots."""
     from .runtime.staging import Stager
     np_leaves = [np.ascontiguousarray(np.asarray(l)) for l in leaves]
-    slot_bytes = max(batch_size * l.dtype.itemsize
-                     * int(np.prod(l.shape[1:], dtype=np.int64))
-                     for l in np_leaves)
-    pool = Stager(2 * len(np_leaves), slot_bytes)
+    leaf_bytes = [batch_size * l.dtype.itemsize
+                  * int(np.prod(l.shape[1:], dtype=np.int64))
+                  for l in np_leaves]
+    # two right-sized slots per leaf (a uniform max-size pool would waste
+    # image-sized buffers on label-sized leaves)
+    pool = Stager.sized(sorted(leaf_bytes * 2))
     try:
         def submit(idx):
             return [pool.submit(l, idx) for l in np_leaves]

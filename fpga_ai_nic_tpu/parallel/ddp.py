@@ -135,5 +135,11 @@ class DDPTrainer:
 
     # -- data ---------------------------------------------------------------
 
+    @property
+    def batch_spec(self):
+        """PartitionSpec for batch leaves (same public handle as the other
+        trainers)."""
+        return P(self.ax)
+
     def shard_batch(self, batch):
-        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))
+        return mesh_lib.shard_host_batch(batch, self.mesh, self.batch_spec)

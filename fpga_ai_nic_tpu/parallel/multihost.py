@@ -42,11 +42,14 @@ def initialize(coordinator_address: Optional[str] = None,
     """Idempotent `jax.distributed.initialize` with env fallbacks.
 
     Resolution order per field: explicit arg -> JAX_COORDINATOR_ADDRESS /
-    JAX_NUM_PROCESSES / JAX_PROCESS_ID env -> platform autodetection (TPU
-    pods need no configuration at all).  Single-process (num_processes in
-    (None-with-no-env, 1)) is a no-op so the same training script runs
-    unmodified on a laptop, one host, or a pod — unlike the reference,
-    which hard-requires mpirun + hostlist even for one node.
+    JAX_NUM_PROCESSES / JAX_PROCESS_ID env -> platform autodetection when
+    a multi-host TPU environment is detected (no-arg
+    jax.distributed.initialize; libtpu publishes worker topology via
+    TPU_WORKER_HOSTNAMES / MEGASCALE_COORDINATOR_ADDRESS on pods).
+    Plain single-process runs (no args, no env, no pod markers) are a
+    no-op, so the same training script runs unmodified on a laptop, one
+    host, or a pod — unlike the reference, which hard-requires mpirun +
+    hostlist even for one node.
     """
     global _initialized
     if _initialized:
@@ -58,11 +61,25 @@ def initialize(coordinator_address: Optional[str] = None,
         int(os.environ["JAX_PROCESS_ID"])
         if "JAX_PROCESS_ID" in os.environ else None)
     if coord is None and nproc in (None, 1):
+        if _on_multihost_tpu():
+            # pod slice: let jax autodetect coordinator + process ids
+            jax.distributed.initialize()
+            _initialized = True
         return                       # single-process: nothing to coordinate
     jax.distributed.initialize(coordinator_address=coord,
                                num_processes=nproc, process_id=pid,
                                local_device_ids=local_device_ids)
     _initialized = True
+
+
+def _on_multihost_tpu() -> bool:
+    """Detect a multi-worker TPU environment from env alone (never probes
+    jax — backend queries can hang on a wedged transport; same rule as
+    tests/conftest.py)."""
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if len([h for h in hostnames.split(",") if h.strip()]) > 1:
+        return True
+    return bool(os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
 
 
 def process_info() -> dict:

@@ -171,6 +171,12 @@ class DPTrainer:
 
     # -- data ---------------------------------------------------------------
 
+    @property
+    def batch_spec(self):
+        """PartitionSpec for batch leaves (loaders pass this to
+        ShardedLoader) — same public handle as ShardedTrainer."""
+        return P(self.ax)
+
     def shard_batch(self, batch):
         """Place a host batch with sharding over dp (MPI_Scatter analogue)."""
-        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))
+        return mesh_lib.shard_host_batch(batch, self.mesh, self.batch_spec)

@@ -17,7 +17,6 @@ from typing import Optional, Tuple
 import numpy as np
 
 _DIR = os.path.join(os.path.dirname(__file__), os.pardir, "csrc")
-_SO = os.path.join(_DIR, "libbfp_codec.so")
 _lib = None
 _tried = False
 

@@ -35,6 +35,9 @@ def lib() -> Optional[ctypes.CDLL]:
         return None
     l.stage_create.restype = ctypes.c_void_p
     l.stage_create.argtypes = [ctypes.c_int, ctypes.c_int64]
+    l.stage_create_sized.restype = ctypes.c_void_p
+    l.stage_create_sized.argtypes = [ctypes.POINTER(ctypes.c_int64),
+                                     ctypes.c_int]
     l.stage_destroy.argtypes = [ctypes.c_void_p]
     l.stage_submit.restype = ctypes.c_int
     l.stage_submit.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
@@ -52,17 +55,29 @@ def available() -> bool:
 
 
 class Stager:
-    """Pool of `n_slots` staging buffers of `slot_bytes` each."""
+    """Pool of staging buffers: `Stager(n_slots, bytes)` for uniform slots
+    or `Stager.sized([b0, b1, ...])` for per-slot capacities (submits claim
+    the smallest FREE slot that fits)."""
 
     def __init__(self, n_slots: int, slot_bytes: int):
+        self._init([slot_bytes] * n_slots)
+
+    @classmethod
+    def sized(cls, slot_bytes_list) -> "Stager":
+        self = cls.__new__(cls)
+        self._init(list(slot_bytes_list))
+        return self
+
+    def _init(self, sizes):
         l = lib()
         assert l is not None, "native staging unavailable (csrc build failed)"
         self._l = l
-        self._pool = l.stage_create(n_slots, slot_bytes)
+        arr = (ctypes.c_int64 * len(sizes))(*sizes)
+        self._pool = l.stage_create_sized(arr, len(sizes))
         if not self._pool:
-            raise MemoryError(f"stage_create({n_slots}, {slot_bytes})")
-        self.n_slots = n_slots
-        self.slot_bytes = slot_bytes
+            raise MemoryError(f"stage_create_sized({sizes})")
+        self.n_slots = len(sizes)
+        self.slot_bytes = max(sizes)
         # submitted job keepalives: src/idx arrays must outlive the gather
         self._live = {}
 
