@@ -56,17 +56,29 @@ def decompress_array(blob: Dict[str, Any]) -> np.ndarray:
 
 class Checkpointer:
     """Orbax-backed checkpoint manager with optional BFP-compressed
-    optimizer/master state."""
+    optimizer/master state.
+
+    ``async_save=True`` writes in a background thread (orbax
+    AsyncCheckpointer): ``save`` returns as soon as the host copy is
+    snapshotted, so checkpoint IO overlaps the next training steps; call
+    ``wait_until_finished()`` (or just the next ``save``, which waits on
+    the previous one) before reading the files.  Caveat: with ``compress``
+    set, the BFP encode of the master/optimizer shards still runs
+    synchronously inside ``save`` — only the file IO overlaps — so for
+    GB-scale compressed state the async win is the write, not the
+    encode."""
 
     def __init__(self, directory: str,
-                 compress: Optional[BFPConfig] = None):
+                 compress: Optional[BFPConfig] = None,
+                 async_save: bool = False):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.compress = compress
-        self._ckptr = ocp.PyTreeCheckpointer()
+        self._ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
+                       if async_save else ocp.PyTreeCheckpointer())
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
@@ -96,6 +108,11 @@ class Checkpointer:
                     k: decompress_array(v) if isinstance(v, dict) else v
                     for k, v in tree["opt_state"].items()}
         return tree
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed to disk."""
+        if hasattr(self._ckptr, "wait_until_finished"):
+            self._ckptr.wait_until_finished()
 
     def latest_step(self) -> Optional[int]:
         # ignore orbax atomic-write temp dirs (step_N.orbax-checkpoint-tmp-*)
