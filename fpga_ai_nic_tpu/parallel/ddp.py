@@ -29,7 +29,7 @@ from typing import Any, Callable, NamedTuple, Tuple
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import Mesh, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import accum
 from . import mesh as mesh_lib
@@ -66,11 +66,18 @@ class DDPTrainer:
 
     # -- init ---------------------------------------------------------------
 
+    def _ensure_meta(self, params_like) -> None:
+        """Flat layout + bucket plan from a params tree or ShapeDtypeStructs
+        (no device work — restore paths use jax.eval_shape output)."""
+        coll = self.cfg.collective
+        self._meta = fused_update.flat_meta(params_like,
+                                            _unbucketed_meta(coll), 1)
+        self._plan = bucketed.plan_buckets(params_like, coll, self.n)
+        self.__dict__.pop("step_fn", None)
+
     def init_state(self, params) -> DDPState:
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
-        self._meta = fused_update.flat_meta(params, _unbucketed_meta(coll), 1)
-        self._plan = bucketed.plan_buckets(params, coll, self.n)
-        self.__dict__.pop("step_fn", None)
+        self._ensure_meta(params)
 
         def _init(p):
             flat, _ = fused_update.flatten_tree(p, _unbucketed_meta(coll), 1)
@@ -79,6 +86,25 @@ class DDPTrainer:
         w_master, opt_state = jax.jit(_init)(params)
         return DDPState(params=params, w_master=w_master,
                         opt_state=opt_state, step=jnp.zeros((), jnp.int32))
+
+    def restore_state(self, restored: dict, params_like=None) -> DDPState:
+        """DDPState from a Checkpointer.restore() payload (masters only —
+        working params are rematerialized).  Layout must be known: call
+        init_state first or pass params_like (tree or ShapeDtypeStructs)."""
+        if params_like is not None:
+            self._ensure_meta(params_like)
+        assert self._meta is not None, (
+            "flat layout unknown: call init_state first or pass params_like")
+        meta = self._meta
+        sh = NamedSharding(self.mesh, P())
+        w_master = jax.device_put(jnp.asarray(restored["w_master"]), sh)
+        opt_state = {k: jax.device_put(jnp.asarray(v), sh)
+                     for k, v in restored["opt_state"].items()}
+        params = jax.jit(
+            lambda w: fused_update.unflatten_tree(w, meta))(w_master)
+        return DDPState(params=params, w_master=w_master,
+                        opt_state=opt_state,
+                        step=jnp.asarray(restored["step"]))
 
     # -- step ---------------------------------------------------------------
 

@@ -61,12 +61,13 @@ class QueuedDDPTrainer(DDPTrainer):
 
     # -- init ---------------------------------------------------------------
 
-    def init_state(self, params) -> DDPState:
-        state = super().init_state(params)   # sets _meta/_plan, clears caches
+    def _ensure_meta(self, params_like) -> None:
+        # invalidate this subclass's jitted phases whenever the flat
+        # layout changes (init_state AND restore_state(params_like=...))
+        super()._ensure_meta(params_like)
         self.__dict__.pop("grads_fn", None)
         self.__dict__.pop("reduce_fn", None)
         self.__dict__.pop("update_fn", None)
-        return state
 
     # -- jitted phases ------------------------------------------------------
 

@@ -102,12 +102,21 @@ class ShardedTrainer:
             params, self.param_specs,
             is_leaf=lambda x: isinstance(x, P))
 
+    def _ensure_meta(self, params_like) -> None:
+        """Derive the flat-master layout from a params tree OR a tree of
+        ShapeDtypeStructs (e.g. ``jax.eval_shape(model.init, ...)``) — no
+        device work, so a restoring process never materializes throwaway
+        params."""
+        local = local_shape_tree(params_like, self.param_specs, self.mesh)
+        self._meta = fused_update.flat_meta(local, self.cfg.collective,
+                                            self.n_dp)
+        self.__dict__.pop("step_fn", None)
+        self.__dict__.pop("_gather_fn", None)
+
     def init_state(self, params) -> ShardedState:
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
         params = self.shard_params(params)
-        local = local_shape_tree(params, self.param_specs, self.mesh)
-        self._meta = fused_update.flat_meta(local, coll, self.n_dp)
-        self.__dict__.pop("step_fn", None)
+        self._ensure_meta(params)
         meta, dp = self._meta, self.dp
 
         def _init(p):
@@ -161,11 +170,7 @@ class ShardedTrainer:
                 loss = lax.pmean(loss, ep)  # identity: loss_fn psums ep
             return w_new, opt_state2, loss
 
-        # Phase 2 (no autodiff): gather updated weights back to the
-        # tp-sharded replicated working copy.
-        def shard_gather(w_new):
-            flat_w = fused_update.all_gather_flat(w_new, dp, coll)
-            return fused_update.unflatten_tree(flat_w, meta)
+        gather = self._gather_fn       # phase 2: weights back to working copy
 
         def _step(state: ShardedState, batch):
             w_own, opt_state, loss = jax.shard_map(
@@ -174,16 +179,60 @@ class ShardedTrainer:
                           b_spec),
                 out_specs=(w_spec, w_spec, P()),
             )(state.params, state.w_own, state.opt_state, state.step, batch)
-            new_params = jax.shard_map(
-                shard_gather, mesh=self.mesh, in_specs=w_spec,
-                out_specs=self.param_specs, check_vma=False)(w_own)
-            return ShardedState(new_params, w_own, opt_state,
+            return ShardedState(gather(w_own), w_own, opt_state,
                                 state.step + 1), loss
 
         return jax.jit(_step, donate_argnums=(0,))
 
+    @functools.cached_property
+    def _gather_fn(self):
+        """Jitted gather of the flat masters into the working params tree —
+        phase 2 of the fused step AND the checkpoint-restore
+        rematerialization (one definition so they cannot drift; cached so
+        repeated params_from_master calls hit jit's cache, invalidated by
+        _ensure_meta)."""
+        meta, coll, dp = self._meta, self.cfg.collective, self.dp
+        assert meta is not None, "call init_state/_ensure_meta first"
+
+        def shard_gather(w_new):
+            flat_w = fused_update.all_gather_flat(w_new, dp, coll)
+            return fused_update.unflatten_tree(flat_w, meta)
+
+        return jax.jit(jax.shard_map(shard_gather, mesh=self.mesh,
+                                     in_specs=P(self._waxes),
+                                     out_specs=self.param_specs,
+                                     check_vma=False))
+
     def step(self, state: ShardedState, batch) -> Tuple[ShardedState, jax.Array]:
         return self.step_fn(state, batch)
+
+    # -- restore ------------------------------------------------------------
+
+    def params_from_master(self, w_own: jax.Array):
+        """Rematerialize the working params tree from the flat master shards
+        (the fused step's gather phase, run standalone — checkpoint-restore
+        needs it because checkpoints persist only the masters)."""
+        return self._gather_fn(w_own)
+
+    def restore_state(self, restored: dict,
+                      params_like=None) -> ShardedState:
+        """ShardedState from a Checkpointer.restore() payload.
+
+        The flat layout must be known: either call init_state first, or
+        pass ``params_like`` — a params tree or ShapeDtypeStructs (e.g.
+        ``jax.eval_shape(functools.partial(model.init, key), cfg)``), which
+        sets it with zero device work."""
+        if params_like is not None:
+            self._ensure_meta(params_like)
+        assert self._meta is not None, (
+            "flat layout unknown: call init_state first or pass params_like")
+        sh = NamedSharding(self.mesh, P(self._waxes))
+        w_own = jax.device_put(jnp.asarray(restored["w_own"]), sh)
+        opt_state = {k: jax.device_put(jnp.asarray(v), sh)
+                     for k, v in restored["opt_state"].items()}
+        return ShardedState(
+            params=self.params_from_master(w_own), w_own=w_own,
+            opt_state=opt_state, step=jnp.asarray(restored["step"]))
 
     def shard_batch(self, batch):
         return mesh_lib.shard_host_batch(batch, self.mesh, self._bspec)

@@ -65,6 +65,14 @@ class DPTrainer:
 
     # -- init ---------------------------------------------------------------
 
+    def _ensure_meta(self, params_like) -> None:
+        """Flat-master layout from a params tree or ShapeDtypeStructs —
+        meta is static, derived without touching device memory; invalidate
+        any step_fn cached against a previous model's meta."""
+        self._meta = fused_update.flat_meta(params_like,
+                                            self.cfg.collective, self.n)
+        self.__dict__.pop("step_fn", None)
+
     def init_state(self, params) -> TrainState:
         """Split replicated params into ZeRO-1 master shards (the analogue
         of the first-iteration weight download to FPGA DDR, flags=1 path,
@@ -76,10 +84,7 @@ class DPTrainer:
                 params, self.ax, coll, opt_cfg)
             return w_own, opt_state
 
-        # meta is static — derive it without touching device memory, and
-        # invalidate any step_fn cached against a previous model's meta
-        self._meta = fused_update.flat_meta(params, coll, self.n)
-        self.__dict__.pop("step_fn", None)
+        self._ensure_meta(params)
 
         w_own, opt_state = jax.jit(jax.shard_map(
             _init, mesh=self.mesh, in_specs=P(),
@@ -156,8 +161,15 @@ class DPTrainer:
             _gather, mesh=self.mesh, in_specs=P(self.ax), out_specs=P(),
             check_vma=False))(w_own)
 
-    def restore_state(self, restored: dict) -> TrainState:
-        """TrainState from a Checkpointer.restore() payload."""
+    def restore_state(self, restored: dict,
+                      params_like=None) -> TrainState:
+        """TrainState from a Checkpointer.restore() payload.  Layout must
+        be known: call init_state first or pass params_like (a params tree
+        or jax.eval_shape output — zero device work)."""
+        if params_like is not None:
+            self._ensure_meta(params_like)
+        assert self._meta is not None, (
+            "flat layout unknown: call init_state first or pass params_like")
         w_own = jax.device_put(
             jnp.asarray(restored["w_own"]),
             NamedSharding(self.mesh, P(self.ax)))

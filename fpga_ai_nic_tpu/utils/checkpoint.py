@@ -84,10 +84,18 @@ class Checkpointer:
         return os.path.join(self.directory, f"step_{step:08d}")
 
     def save(self, step: int, state) -> str:
-        tree = jax.tree_util.tree_map(np.asarray, jax.device_get(state))
-        if self.compress is not None:
-            tree = dict(tree._asdict()) if hasattr(state, "_asdict") else tree
-            for key in ("w_own",):
+        """Persist a trainer state.  States carrying a flat master copy
+        (w_own / w_master) drop their working ``params`` tree: every
+        trainer's ``restore_state`` rematerializes params from the masters,
+        so persisting both would double checkpoint size (and wipe out the
+        BFP compression win for bf16 models)."""
+        tree = dict(state._asdict()) if hasattr(state, "_asdict") else state
+        if isinstance(tree, dict) and "params" in tree and (
+                "w_own" in tree or "w_master" in tree):
+            tree = {k: v for k, v in tree.items() if k != "params"}
+        tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+        if self.compress is not None and isinstance(tree, dict):
+            for key in ("w_own", "w_master"):
                 if key in tree:
                     tree[key] = compress_array(tree[key], self.compress)
             if "opt_state" in tree:
@@ -101,8 +109,9 @@ class Checkpointer:
     def restore(self, step: int):
         tree = self._ckptr.restore(self._path(step))
         if self.compress is not None:
-            if "w_own" in tree and isinstance(tree["w_own"], dict):
-                tree["w_own"] = decompress_array(tree["w_own"])
+            for key in ("w_own", "w_master"):
+                if key in tree and isinstance(tree[key], dict):
+                    tree[key] = decompress_array(tree[key])
             if "opt_state" in tree:
                 tree["opt_state"] = {
                     k: decompress_array(v) if isinstance(v, dict) else v

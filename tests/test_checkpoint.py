@@ -117,3 +117,82 @@ def test_async_checkpointer_save_restore(tmp_path, rng):
                                 .restore(3))
     np.testing.assert_array_equal(np.asarray(restored.w_own), w_saved)
     assert int(restored.step) == step_saved
+
+
+def test_sharded_trainer_checkpoint_roundtrip(tmp_path, rng):
+    """BASELINE config 5 shape: tp x dp Llama ZeRO-1 state checkpoints with
+    BFP-compressed masters and restores to a training-identical state."""
+    from fpga_ai_nic_tpu.models import llama
+    from fpga_ai_nic_tpu.parallel import ShardedTrainer
+    from jax.sharding import Mesh
+    import numpy as onp
+
+    mcfg = llama.LlamaConfig.tiny()
+    mesh = Mesh(onp.array(jax.devices()[:8]).reshape(4, 2, 1),
+                ("dp", "tp", "sp"))
+    cfg = TrainConfig(iters=1, global_batch=8,
+                      mesh=MeshConfig(dp=4, tp=2),
+                      collective=CollectiveConfig(),
+                      optimizer=OptimizerConfig(kind="adamw",
+                                                learning_rate=1e-3))
+    tr = ShardedTrainer(
+        lambda p, b: llama.loss_fn(p, b, mcfg, tp_axis="tp"),
+        mesh, cfg, llama.param_specs(mcfg))
+    state = tr.init_state(llama.init(jax.random.PRNGKey(0), mcfg))
+    toks = jnp.asarray(rng.integers(0, mcfg.vocab, (8, 17)), jnp.int32)
+    batch = tr.shard_batch((toks[:, :-1], toks[:, 1:]))
+    state, _ = tr.step(state, batch)
+
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), compress=BFPConfig())
+    c.save(7, state)
+    w_saved = onp.asarray(state.w_own)
+    step_saved = int(state.step)
+    # masters-only: the working params tree must NOT be persisted (orbax
+    # OCDBT layout has no per-key files, so inspect the restored tree)
+    assert "params" not in c.restore(7)
+
+    # fresh trainer (simulating a new process): layout from eval_shape —
+    # zero device work, no throwaway init_state
+    tr2 = ShardedTrainer(
+        lambda p, b: llama.loss_fn(p, b, mcfg, tp_axis="tp"),
+        mesh, cfg, llama.param_specs(mcfg))
+    shapes = jax.eval_shape(lambda: llama.init(jax.random.PRNGKey(1), mcfg))
+    restored = tr2.restore_state(c.restore(7), params_like=shapes)
+    # BFP-compressed masters: bounded quantization error, exact step count
+    assert int(restored.step) == step_saved
+    err = onp.max(onp.abs(onp.asarray(restored.w_own) - w_saved))
+    assert err < 0.02, err
+    # restored state trains (one more step, finite loss)
+    _, loss = tr2.step(restored, batch)
+    assert onp.isfinite(float(loss)), float(loss)
+
+
+def test_ddp_trainer_checkpoint_roundtrip(tmp_path, rng):
+    """DDP masters-only checkpoint restores params bit-exactly via
+    unflatten (uncompressed path)."""
+    from fpga_ai_nic_tpu.parallel import DDPTrainer
+    mcfg = MLPConfig(layer_sizes=(16, 32, 8), dtype="float32")
+    cfg = TrainConfig(iters=1, global_batch=16, mesh=MeshConfig(dp=8),
+                      optimizer=OptimizerConfig(kind="momentum"))
+    tr = DDPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg),
+                    make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(mlp.init(jax.random.PRNGKey(0), mcfg))
+    batch = (jnp.asarray(rng.standard_normal((16, 16)), jnp.float32),
+             jnp.asarray(rng.integers(0, 8, 16), jnp.int32))
+    state, _ = tr.step(state, tr.shard_batch(batch))
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    c.save(1, state)
+    w_saved = np.asarray(state.w_master)
+    params_saved = jax.device_get(state.params)
+
+    tr2 = DDPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg),
+                     make_mesh(cfg.mesh), cfg)
+    shapes = jax.eval_shape(lambda: mlp.init(jax.random.PRNGKey(1), mcfg))
+    restored = tr2.restore_state(c.restore(1), params_like=shapes)
+    np.testing.assert_array_equal(np.asarray(restored.w_master), w_saved)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(np.asarray(a),
+                                                   np.asarray(b)),
+        restored.params, params_saved)
+    st2, loss = tr2.step(restored, tr2.shard_batch(batch))
+    assert np.isfinite(float(loss))
