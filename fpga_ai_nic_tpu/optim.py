@@ -59,6 +59,35 @@ def learning_rate_at(cfg: OptimizerConfig, step) -> jax.Array:
     return base * warm * (floor + (1.0 - floor) * decay)
 
 
+def clip_by_global_norm(cfg: OptimizerConfig, g: jax.Array,
+                        axes=(), weights=None) -> jax.Array:
+    """Scale a (possibly sharded) flat gradient so its GLOBAL L2 norm is at
+    most cfg.clip_norm.  ``axes``: the mesh axes the flat vector is sharded
+    over (psum of the local sum-of-squares — called inside shard_map); ()
+    when g is the full vector.  ``weights``: optional per-element norm
+    weights for layouts where some segments are REPLICATED across ``axes``
+    (tp/pp-replicated leaves in the sharded master layout) — weight
+    1/replication makes the psum count each parameter exactly once.
+    No-op when clip_norm is None.
+
+    Runs on the owned shard between reduce-scatter and the optimizer — the
+    same fusion point as the update itself (the reference's FFMA array has
+    no such guard; hw/weight_update.sv applies raw gradients)."""
+    if cfg.clip_norm is None:
+        return g
+    from jax import lax
+    sq_el = jnp.square(g.astype(jnp.float32))
+    if weights is not None:
+        sq_el = sq_el * weights
+    sq = jnp.sum(sq_el)
+    if axes:
+        sq = lax.psum(sq, tuple(axes))
+    norm = jnp.sqrt(sq)
+    scale = jnp.minimum(1.0, jnp.float32(cfg.clip_norm)
+                        / jnp.maximum(norm, 1e-12))
+    return (g.astype(jnp.float32) * scale).astype(g.dtype)
+
+
 def apply(cfg: OptimizerConfig, w: jax.Array, g: jax.Array,
           state: OptState, step=None) -> Tuple[jax.Array, OptState]:
     """w_new = step(w, g); w, g are flat f32 shards (ref semantics:

@@ -136,6 +136,7 @@ class DDPTrainer:
 
         # Phase 2 (no autodiff): replicated optimizer on the flat master.
         def shard_update(flat_g, w_master, opt_state, step):
+            flat_g = optim.clip_by_global_norm(opt_cfg, flat_g)
             w_new, opt_state2 = optim.apply(opt_cfg, w_master, flat_g,
                                             opt_state, step)
             params2 = fused_update.unflatten_tree(w_new, meta)

@@ -123,7 +123,8 @@ class FSDPTrainer:
                     self.loss_fn, self.cfg.accum_steps)(params, batch)
 
             loss, g_own = jax.value_and_grad(shard_loss)(w_own)
-            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own / n,
+            g_own = optim.clip_by_global_norm(opt_cfg, g_own / n, (ax,))
+            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
             return w_new, opt_state2, lax.pmean(loss, ax)
 

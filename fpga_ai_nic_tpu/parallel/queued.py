@@ -116,6 +116,7 @@ class QueuedDDPTrainer(DDPTrainer):
 
         def shard_update(bucket_means, w_master, opt_state, step):
             flat_g = bucketed.assemble_flat(list(bucket_means), plan)
+            flat_g = optim.clip_by_global_norm(opt_cfg, flat_g)
             w_new, opt_state2 = optim.apply(opt_cfg, w_master, flat_g,
                                             opt_state, step)
             params2 = fused_update.unflatten_tree(w_new, meta)

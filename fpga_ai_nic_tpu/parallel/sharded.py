@@ -113,6 +113,40 @@ class ShardedTrainer:
         self.__dict__.pop("step_fn", None)
         self.__dict__.pop("_gather_fn", None)
 
+    def _norm_weight_tables(self):
+        """Segment tables for per-element global-norm weights over the
+        LOCAL flat master layout: leaves replicated across the non-dp
+        master axes get weight 1/replication so the cross-axis psum counts
+        each parameter once; sharded leaves (disjoint across ranks) get 1;
+        padding gets 0.  Returned as (bounds [n+1], values [n]) so each
+        device materializes only ITS dp-chunk of weights (a searchsorted
+        over ~n_leaves boundaries), never the full flat vector."""
+        meta = self._meta
+        assert meta is not None, "call init_state/_ensure_meta first"
+        spec_leaves = jax.tree_util.tree_leaves(
+            self.param_specs, is_leaf=lambda x: isinstance(x, P))
+        assert len(spec_leaves) == len(meta.sizes), (
+            len(spec_leaves), len(meta.sizes))
+        non_dp = [a for a in self._waxes if a != self.dp]
+        bounds, values = [0], []
+        for spec, size in zip(spec_leaves, meta.sizes):
+            used = set()
+            for entry in tuple(spec):
+                if entry is None:
+                    continue
+                used.update(entry if isinstance(entry, tuple) else (entry,))
+            rep = 1
+            for a in non_dp:
+                if a not in used:
+                    rep *= self.mesh.shape[a]
+            bounds.append(bounds[-1] + size)
+            values.append(1.0 / rep)
+        if bounds[-1] < meta.padded_len:       # padding segment
+            bounds.append(meta.padded_len)
+            values.append(0.0)
+        return (np.asarray(bounds, np.int32),
+                np.asarray(values, np.float32))
+
     def init_state(self, params) -> ShardedState:
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
         params = self.shard_params(params)
@@ -141,6 +175,8 @@ class ShardedTrainer:
         n_sp = self.mesh.shape[sp]
         w_spec = P(self._waxes)
         b_spec = self._bspec
+        clip_tables = (self._norm_weight_tables()
+                       if opt_cfg.clip_norm is not None else None)
 
         # Phase 1 runs with check_vma=True: differentiating THROUGH
         # collectives (tp psum, sp loss reduction, ring-attention ppermute)
@@ -158,6 +194,20 @@ class ShardedTrainer:
                 self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n_dp)
             g_own = fused_update.reduce_scatter(flat_g, dp, coll) / self.n_dp
+            if opt_cfg.clip_norm is not None:
+                # per-element weights de-duplicate tp/pp/ep-REPLICATED
+                # leaves in the cross-axis psum (sharded leaves are
+                # disjoint, weight 1); built per-device from the tiny
+                # segment tables so no full-length constant is embedded
+                bounds, values = clip_tables
+                c = g_own.shape[0]
+                pos = (lax.axis_index(dp) * c
+                       + lax.broadcasted_iota(jnp.int32, (c, 1), 0)[:, 0])
+                seg = jnp.searchsorted(jnp.asarray(bounds), pos,
+                                       side="right") - 1
+                w_chunk = jnp.asarray(values)[seg]
+                g_own = optim.clip_by_global_norm(
+                    opt_cfg, g_own, self._waxes, weights=w_chunk)
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
             loss = lax.pmean(loss, dp)

@@ -116,6 +116,7 @@ class DPTrainer:
                 self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
             g_own = fused_update.reduce_scatter(flat_g, ax, coll) / self.n
+            g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
             return w_new, opt_state2, lax.pmean(loss, ax)

@@ -119,9 +119,15 @@ class OptimizerConfig:
     warmup_steps: int = 0
     decay_steps: int = 0          # horizon for cosine/linear (incl. warmup)
     min_lr_ratio: float = 0.0     # floor as a fraction of learning_rate
+    # global-norm gradient clipping (None = off).  The norm is computed
+    # over the FULL flat gradient (psum across master-sharding axes), so
+    # sharded and single-device training clip identically.
+    clip_norm: Optional[float] = None
 
     def __post_init__(self):
         assert self.kind in ("sgd", "momentum", "adamw")
+        # 0.0 would silently zero every gradient; "off" is None
+        assert self.clip_norm is None or self.clip_norm > 0, self.clip_norm
         assert self.schedule in ("constant", "cosine", "linear")
         if self.schedule != "constant":
             assert self.decay_steps > self.warmup_steps >= 0, (
