@@ -38,6 +38,15 @@ class LlamaConfig:
     n_kv_heads: int = 8
     ffn_dim: int = 14336
     rope_theta: float = 500000.0
+    # Llama-3.1-style NTK rope scaling for context extension (the
+    # long-context regime ring attention exists for): frequencies whose
+    # wavelength exceeds old_context are stretched by rope_scaling; the
+    # high-frequency band is untouched; in between interpolates smoothly.
+    # rope_scaling=1.0 disables (exact parity with unscaled rope).
+    rope_scaling: float = 1.0
+    rope_old_context: int = 8192
+    rope_low_freq_factor: float = 1.0
+    rope_high_freq_factor: float = 4.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
     # MoE: when moe_experts > 0, every FFN becomes a top-k routed expert
@@ -136,11 +145,37 @@ def _rmsnorm(x: jax.Array, w: jax.Array, eps: float) -> jax.Array:
     return (xf * scale).astype(x.dtype) * w
 
 
-def _rope(x: jax.Array, pos: jax.Array, theta: float) -> jax.Array:
+def _rope_freqs(cfg: LlamaConfig, half: int) -> jax.Array:
+    """Inverse frequencies, optionally NTK-scaled for context extension
+    (Llama-3.1 recipe): wavelengths longer than old_context/low_factor are
+    divided by rope_scaling, shorter than old_context/high_factor are
+    kept, the band between interpolates linearly in 1/wavelength."""
+    freqs = cfg.rope_theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    if cfg.rope_scaling == 1.0:
+        return freqs
+    wavelen = 2.0 * jnp.pi / freqs
+    low = cfg.rope_old_context / cfg.rope_low_freq_factor    # long cutoff
+    high = cfg.rope_old_context / cfg.rope_high_freq_factor  # short cutoff
+    if cfg.rope_low_freq_factor == cfg.rope_high_freq_factor:
+        smooth = jnp.zeros_like(wavelen)
+    else:
+        # 0 at wavelen == low cutoff (-> fully scaled), 1 at the high
+        # cutoff (-> original) — the Llama-3.1 interpolation
+        smooth = jnp.clip(
+            (cfg.rope_old_context / wavelen - cfg.rope_low_freq_factor)
+            / (cfg.rope_high_freq_factor - cfg.rope_low_freq_factor),
+            0.0, 1.0)
+    scaled = freqs / cfg.rope_scaling
+    mid = (1.0 - smooth) * scaled + smooth * freqs
+    return jnp.where(wavelen > low, scaled,
+                     jnp.where(wavelen < high, freqs, mid))
+
+
+def _rope(x: jax.Array, pos: jax.Array, cfg: LlamaConfig) -> jax.Array:
     """x: [B, H, S, dh]; pos: [S] global token positions (rotate-half)."""
     dh = x.shape[-1]
     half = dh // 2
-    freqs = theta ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    freqs = _rope_freqs(cfg, half)
     ang = pos.astype(jnp.float32)[:, None] * freqs[None, :]     # [S, half]
     cos, sin = jnp.cos(ang), jnp.sin(ang)
     x1, x2 = x[..., :half].astype(jnp.float32), x[..., half:].astype(jnp.float32)
@@ -165,8 +200,8 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
     q = (h @ lyr["wq"]).reshape(B, S, n_heads, Hd).transpose(0, 2, 1, 3)
     k = (h @ lyr["wk"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
     v = (h @ lyr["wv"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
-    q = _rope(q, pos, cfg.rope_theta)
-    k = _rope(k, pos, cfg.rope_theta)
+    q = _rope(q, pos, cfg)
+    k = _rope(k, pos, cfg)
     if n_kv != n_heads:                             # GQA: expand kv heads
         rep = n_heads // n_kv
         k = jnp.repeat(k, rep, axis=1)

@@ -135,3 +135,38 @@ def test_sharded_training_matches_unsharded(dp, tp, sp):
             np.asarray(path_got[1], np.float32),
             np.asarray(path_want[1], np.float32), rtol=5e-4, atol=5e-5,
             err_msg=str(path_want[0]))
+
+
+def test_rope_scaling_parity_and_bands(rng):
+    """rope_scaling=1.0 is exactly the unscaled path; with scaling on, the
+    lowest frequencies stretch by 1/factor, the highest band is untouched,
+    and the model still runs with finite outputs at long positions."""
+    import dataclasses
+    from fpga_ai_nic_tpu.models.llama import _rope_freqs
+    base = llama.LlamaConfig.tiny()
+    half = base.head_dim // 2
+    f0 = np.asarray(_rope_freqs(base, half))
+    # parity vs the inline unscaled formula (not another config — that
+    # would be vacuous)
+    want = base.rope_theta ** (-np.arange(half, dtype=np.float32) / half)
+    np.testing.assert_allclose(f0, want, rtol=1e-6)
+
+    scaled_cfg = dataclasses.replace(
+        base, rope_scaling=8.0, rope_old_context=64,
+        rope_low_freq_factor=1.0, rope_high_freq_factor=4.0)
+    fs = np.asarray(_rope_freqs(scaled_cfg, half))
+    wavelen = 2 * np.pi / f0
+    long_band = wavelen > 64 / 1.0
+    short_band = wavelen < 64 / 4.0
+    np.testing.assert_allclose(fs[long_band], f0[long_band] / 8.0,
+                               rtol=1e-6)
+    np.testing.assert_array_equal(fs[short_band], f0[short_band])
+    mid = ~(long_band | short_band)
+    if mid.any():   # interpolated band strictly between the two extremes
+        assert np.all(fs[mid] > f0[mid] / 8.0 - 1e-9)
+        assert np.all(fs[mid] < f0[mid] + 1e-9)
+
+    params = llama.init(jax.random.PRNGKey(0), scaled_cfg)
+    toks = jnp.asarray(rng.integers(0, scaled_cfg.vocab, (2, 96)), jnp.int32)
+    logits = llama.apply(params, toks, scaled_cfg)
+    assert np.all(np.isfinite(np.asarray(logits, np.float32)))
