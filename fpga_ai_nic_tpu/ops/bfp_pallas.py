@@ -99,8 +99,9 @@ def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct(x2.shape, jnp.int8),
-            jax.ShapeDtypeStruct((n_tiles, LANES), jnp.int8),
+            jax.ShapeDtypeStruct(x2.shape, jnp.int8, vma=jax.typeof(x2).vma),
+            jax.ShapeDtypeStruct((n_tiles, LANES), jnp.int8,
+                                 vma=jax.typeof(x2).vma),
         ],
         interpret=interpret,
     )(x2)
@@ -129,7 +130,9 @@ def bfp_decode(mant: jax.Array, scale: jax.Array, block_size: int = 16,
         ],
         out_specs=pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct(m2.shape, jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            m2.shape, jnp.float32,
+            vma=jax.typeof(m2).vma | jax.typeof(s2).vma),
         interpret=interpret,
     )(m2, s2)
     return out.reshape(n).astype(dtype)

@@ -28,7 +28,10 @@ def load_native(so_name: str) -> Optional[ctypes.CDLL]:
     so_path = os.path.join(_DIR, so_name)
     if not os.path.exists(so_path):
         try:
-            subprocess.run(["make", "-C", _DIR], check=True,
+            # build the specific .so (rules are named after the files), so
+            # non-default artifacts like libstaging_tsan.so build too
+            # instead of silently falling back to the Python path
+            subprocess.run(["make", "-C", _DIR, so_name], check=True,
                            capture_output=True, timeout=120)
         except Exception:
             return None

@@ -29,8 +29,12 @@ def lib() -> Optional[ctypes.CDLL]:
     if _lib is not None or _tried:
         return _lib
     _tried = True
+    import os
     from .native import load_native
-    l = load_native("libstaging.so")
+    # FPGA_AI_NIC_STAGING_SO=libstaging_tsan.so runs the suite under
+    # ThreadSanitizer (make -C csrc tsan)
+    l = load_native(os.environ.get("FPGA_AI_NIC_STAGING_SO",
+                                   "libstaging.so"))
     if l is None:
         return None
     l.stage_create.restype = ctypes.c_void_p

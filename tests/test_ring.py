@@ -153,3 +153,30 @@ def test_wire_bytes_accounting():
     comp = ring.wire_bytes_per_device(4096, 8, cfg)
     assert raw == 2 * 7 * 512 * 4
     assert abs(raw / comp - 512 / 136) < 1e-9
+
+
+def test_bfp_ring_pallas_codec_bounded_and_slicing_bitexact(rng):
+    """Forced codec='pallas' (interpret off-TPU): the ring's wire-path
+    kernel produces sum errors within the analytic bound, and sliced hops
+    are bit-identical to whole-chunk hops under the same codec (slicing
+    changes the schedule, never the bits).  check_vma=False: pallas
+    interpret-mode grid bookkeeping cannot carry vma types (real-TPU
+    lowering does not interpret, so the auto path is unaffected)."""
+    cfg = BFPConfig(codec="pallas")
+    Lp = N * 16 * 128 * 2          # per-device chunks tile onto (16,128)
+    shards = (rng.standard_normal((N, Lp)) * 3).astype(np.float32)
+
+    def run(slice_elems):
+        return np.asarray(jax.shard_map(
+            lambda x: ring.ring_all_reduce(
+                x[0], "dp", compression=cfg,
+                slice_elems=slice_elems)[None],
+            mesh=_mesh(), in_specs=P("dp", None),
+            out_specs=P("dp", None), check_vma=False)(jnp.asarray(shards)))[0]
+
+    whole = run(None)
+    sliced = run(16 * 128)         # 2 slices per hop chunk
+    np.testing.assert_array_equal(whole, sliced)
+    exact = shards.sum(0)
+    scale = np.abs(exact).max()
+    assert np.abs(whole - exact).max() <= scale * (2.0 ** -6) * N
