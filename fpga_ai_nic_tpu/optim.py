@@ -19,6 +19,7 @@ from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
+from jax import lax
 
 from .utils.config import OptimizerConfig
 
@@ -75,7 +76,6 @@ def clip_by_global_norm(cfg: OptimizerConfig, g: jax.Array,
     no such guard; hw/weight_update.sv applies raw gradients)."""
     if cfg.clip_norm is None:
         return g
-    from jax import lax
     sq_el = jnp.square(g.astype(jnp.float32))
     if weights is not None:
         sq_el = sq_el * weights
