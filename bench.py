@@ -144,7 +144,7 @@ def child_main(layers: int, batch: int, iters: int) -> None:
     samples_per_sec = cfg.iters * cfg.global_batch / dt
     per_chip = samples_per_sec / n_dev
     phase(f"done dt={dt:.3f}s")
-    print(json.dumps({
+    out = {
         "metric": METRIC,
         "value": round(per_chip, 1),
         "unit": "samples/s/chip",
@@ -152,7 +152,15 @@ def child_main(layers: int, batch: int, iters: int) -> None:
         "platform": platform,
         "n_devices": n_dev,
         "loss": float(loss),
-    }), flush=True)
+    }
+    from bench_common import is_tpu_platform
+    flops = mlp.flops_per_sample(mcfg) * per_chip
+    out["tflops_per_chip"] = round(flops / 1e12, 3)
+    if is_tpu_platform(platform):
+        # v5e bf16 peak ~197 TFLOP/s/chip — a rough MXU-utilization gauge,
+        # not a measurement (chip generation is not queryable here)
+        out["mxu_util_est_v5e"] = round(flops / 197e12, 3)
+    print(json.dumps(out), flush=True)
 
 
 # ---------------------------------------------------------------------------

@@ -86,7 +86,8 @@ def child_main() -> None:
     cfg = BFPConfig()   # 16-elem blocks, 8-bit mantissa — the wire format
     # On TPU use the fused Pallas codec (the wire-path kernel); off TPU the
     # XLA codec (pallas interpret mode would measure the emulator).
-    on_tpu = platform in ("tpu", "axon")
+    from bench_common import is_tpu_platform
+    on_tpu = is_tpu_platform(platform)
     codec_cfg = BFPConfig(codec="auto" if on_tpu else "xla")
 
     _scalar = jax.jit(lambda t: sum(

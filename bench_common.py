@@ -19,6 +19,12 @@ def log(msg: str) -> None:
     print(f"[bench] {msg}", file=sys.stderr, flush=True)
 
 
+def is_tpu_platform(platform: str) -> bool:
+    """One predicate for 'this backend is the TPU' — the tunnel plugin
+    reports 'axon' rather than 'tpu'."""
+    return platform in ("tpu", "axon")
+
+
 def run_attempt(name: str, cmd, *, env=None, budget_s: float,
                 silence_s: float, cwd=None) -> dict:
     """Run one child attempt; returns its parsed result JSON (the last line
