@@ -1,0 +1,89 @@
+"""The benchmark supervisor (bench_common.run_attempt) — the machinery the
+driver's BENCH/MULTICHIP checks ride on.  A hang here was round 1's only
+failure mode, so the kill paths get direct tests: result parsing, silence
+kill with forensic tail, budget kill, nonzero-exit annotation, and the
+result-before-unclean-exit salvage."""
+
+import os
+import sys
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench_common import cpu_env, is_tpu_platform, run_attempt
+
+
+def _cmd(body: str):
+    return [sys.executable, "-u", "-c", body]
+
+
+def test_returns_last_json_line():
+    r = run_attempt("ok", _cmd(
+        "print('[bench] phase=x')\n"
+        "print('{\"value\": 1}')\n"
+        "print('{\"value\": 2}')"), budget_s=30, silence_s=30)
+    assert r == {"value": 2}
+
+
+def test_silence_kill_carries_forensic_tail():
+    with pytest.raises(RuntimeError) as e:
+        run_attempt("hang", _cmd(
+            "import time\n"
+            "print('[bench] phase=import')\n"
+            "print('[bench] phase=devices')\n"
+            "time.sleep(60)"), budget_s=60, silence_s=2)
+    msg = str(e.value)
+    assert "silent for" in msg
+    assert "phase=devices" in msg          # the hang is localizable
+
+
+def test_budget_kill():
+    with pytest.raises(RuntimeError) as e:
+        run_attempt("slow", _cmd(
+            "import time\n"
+            "for i in range(100):\n"
+            "    print(f'[bench] tick {i}', flush=True)\n"
+            "    time.sleep(1)"), budget_s=3, silence_s=60)
+    assert "total budget" in str(e.value)
+
+
+def test_result_survives_unclean_exit():
+    r = run_attempt("dirty", _cmd(
+        "import sys\n"
+        "print('{\"value\": 7}')\n"
+        "sys.exit(3)"), budget_s=30, silence_s=30)
+    assert r["value"] == 7
+    assert "rc=3" in r["unclean_exit"]
+
+
+def test_no_json_failure_raises_with_tail():
+    with pytest.raises(RuntimeError) as e:
+        run_attempt("nojson", _cmd("print('only noise'); raise SystemExit(1)"),
+                    budget_s=30, silence_s=30)
+    assert "only noise" in str(e.value)
+
+
+def test_cpu_env_forces_platform_and_device_count():
+    env = cpu_env(8)
+    assert env["JAX_PLATFORMS"] == "cpu"
+    assert env["PALLAS_AXON_POOL_IPS"] == ""
+    assert "--xla_force_host_platform_device_count=8" in env["XLA_FLAGS"]
+    # replaces (not appends to) an inherited count; restore the
+    # conftest-set value afterwards
+    saved = os.environ.get("XLA_FLAGS")
+    os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+    try:
+        env2 = cpu_env(8)
+        assert "device_count=2" not in env2["XLA_FLAGS"]
+        assert "--xla_force_host_platform_device_count=8" in env2["XLA_FLAGS"]
+    finally:
+        if saved is None:
+            del os.environ["XLA_FLAGS"]
+        else:
+            os.environ["XLA_FLAGS"] = saved
+
+
+def test_is_tpu_platform():
+    assert is_tpu_platform("tpu") and is_tpu_platform("axon")
+    assert not is_tpu_platform("cpu")
