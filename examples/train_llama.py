@@ -46,19 +46,22 @@ def main(argv):
 
     model_flags = [a.replace("--model.", "--") for a in argv
                    if a.startswith("--model.")]
+    from fpga_ai_nic_tpu.utils.config import coerce_value
     seq = 64
     n_mb = 1
+    remat = False
     rest = []
     for a in argv:
         if a.startswith("--seq="):
             seq = int(a.partition("=")[2])
         elif a.startswith("--microbatches="):
             n_mb = int(a.partition("=")[2])
+        elif a.startswith("--remat="):
+            remat = coerce_value(bool, a.partition("=")[2])
         elif not a.startswith("--model."):
             rest.append(a)
     # tiny() defaults overlaid with --model.* flags (from_flags builds via
     # cls(), which here is the full llama3-8b default — too big for a demo)
-    from fpga_ai_nic_tpu.utils.config import coerce_value
     mcfg = llama.LlamaConfig.tiny()
     for f in model_flags:
         k, _, v = f[2:].partition("=")
@@ -85,7 +88,7 @@ def main(argv):
     else:
         loss = lambda p, b: llama.loss_fn(p, b, mcfg, tp_axis=tp_ax,
                                           sp_axis=sp_ax, dp_axis="dp",
-                                          ep_axis=ep_ax)
+                                          ep_axis=ep_ax, remat=remat)
         specs = llama.param_specs(mcfg, tp_axis=tp_ax, ep_axis=ep_ax)
         init_params = llama.init(jax.random.PRNGKey(cfg.seed), mcfg)
 

@@ -248,13 +248,17 @@ def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
           ep_axis: Optional[str] = None,
           batch_axes=(),
           gather_logits: bool = True,
-          with_aux: bool = False) -> jax.Array:
+          with_aux: bool = False,
+          remat: bool = False) -> jax.Array:
     """tokens [B, S_local] -> logits [B, S_local, vocab] (vocab/tp when
     gather_logits=False under tp); (logits, moe_aux) when with_aux.
 
     Call inside shard_map with params pre-sharded per ``param_specs`` when
     tp_axis is set; sequence shards must be contiguous when sp_axis is set;
     batch_axes lists every token-sharding axis for MoE aux statistics.
+    remat rematerializes each decoder block in backward (activation memory
+    O(1 block) instead of O(n_layers) at ~1/3 extra FLOPs — the standard
+    long-context/deep-model trade; the pipelined path has the same knob).
     """
     B, S = tokens.shape
     if cfg.moe is not None and tp_axis is not None:
@@ -265,11 +269,17 @@ def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
     pos = _positions(S, sp_axis)
 
+    def block(lyr, x):
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
+                      ep_axis, batch_axes)
+
+    if remat:
+        block = jax.checkpoint(block)
+
     x = params["tok_emb"][tokens]                       # [B, S, D]
     aux = jnp.float32(0.0)
     for lyr in params["layers"]:
-        x, a = _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
-                      ep_axis, batch_axes)
+        x, a = block(lyr, x)
         aux = aux + a
 
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
@@ -343,7 +353,8 @@ def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
             tp_axis: Optional[str] = None,
             sp_axis: Optional[str] = None,
             dp_axis: Optional[str] = None,
-            ep_axis: Optional[str] = None) -> jax.Array:
+            ep_axis: Optional[str] = None,
+            remat: bool = False) -> jax.Array:
     """Next-token cross-entropy.  batch = (tokens, labels), both [B, S_local]
     — labels are the globally-shifted targets (shift crosses sequence-shard
     boundaries, so the data pipeline provides them; -100 entries are
@@ -366,7 +377,7 @@ def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
                         sp_axis=sp_axis, ep_axis=ep_axis,
                         batch_axes=tuple(a for a in batch_axes
                                          if a is not None),
-                        gather_logits=False, with_aux=True)
+                        gather_logits=False, with_aux=True, remat=remat)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
     loss = _weighted_loss(jnp.sum(nll), jnp.sum(valid), batch_axes, dp_axis)
     if dp_axis is not None:     # same /n_dp cancellation as the ce term

@@ -170,3 +170,28 @@ def test_rope_scaling_parity_and_bands(rng):
     toks = jnp.asarray(rng.integers(0, scaled_cfg.vocab, (2, 96)), jnp.int32)
     logits = llama.apply(params, toks, scaled_cfg)
     assert np.all(np.isfinite(np.asarray(logits, np.float32)))
+
+
+def test_remat_grad_parity_and_memory(rng):
+    """remat=True: identical gradients (it is the same math recomputed) and
+    strictly smaller compiled temp memory for a deep config."""
+    import dataclasses
+    cfg = dataclasses.replace(llama.LlamaConfig.tiny(), n_layers=6)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (2, 33)), jnp.int32)
+    batch = (toks[:, :-1], toks[:, 1:])
+
+    g_plain = jax.grad(lambda p: llama.loss_fn(p, batch, cfg))(params)
+    g_remat = jax.grad(
+        lambda p: llama.loss_fn(p, batch, cfg, remat=True))(params)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=1e-5, atol=1e-6), g_plain, g_remat)
+
+    def mem(remat):
+        fn = jax.jit(jax.grad(
+            lambda p: llama.loss_fn(p, batch, cfg, remat=remat)))
+        return fn.lower(params).compile().memory_analysis().temp_size_in_bytes
+
+    assert mem(True) < mem(False), (mem(True), mem(False))
