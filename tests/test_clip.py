@@ -10,7 +10,8 @@ import jax
 import jax.numpy as jnp
 
 from fpga_ai_nic_tpu.models import llama, mlp
-from fpga_ai_nic_tpu.parallel import (DDPTrainer, DPTrainer, ShardedTrainer,
+from fpga_ai_nic_tpu.parallel import (DDPTrainer, DPTrainer, FSDPTrainer,
+                                      QueuedDDPTrainer, ShardedTrainer,
                                       make_mesh)
 from fpga_ai_nic_tpu.utils.config import (
     CollectiveConfig, MeshConfig, MLPConfig, OptimizerConfig, TrainConfig)
@@ -29,15 +30,24 @@ def _ref_sgd_clipped(params, batch, loss_fn, lr):
         params, g)
 
 
-@pytest.mark.parametrize("trainer_cls", [DPTrainer, DDPTrainer])
+@pytest.mark.parametrize("trainer_cls", [DPTrainer, DDPTrainer,
+                                         QueuedDDPTrainer, FSDPTrainer])
 def test_dp_clip_matches_optax_reference(rng, trainer_cls):
+    mesh_cfg = (MeshConfig(fsdp=8) if trainer_cls is FSDPTrainer
+                else MeshConfig(dp=8))
     cfg = TrainConfig(
-        iters=1, global_batch=16, mesh=MeshConfig(dp=8),
+        iters=1, global_batch=16, mesh=mesh_cfg,
         collective=CollectiveConfig(),
         optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1,
                                   clip_norm=CLIP))
     loss = lambda p, b: mlp.loss_fn(p, b, MCFG)  # noqa: E731
-    tr = trainer_cls(loss, make_mesh(cfg.mesh), cfg)
+    if trainer_cls is FSDPTrainer:
+        from jax.sharding import Mesh
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(1, 8, 1, 1, 1, 1),
+                    ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+        tr = trainer_cls(loss, mesh, cfg)
+    else:
+        tr = trainer_cls(loss, make_mesh(cfg.mesh), cfg)
     params = mlp.init(jax.random.PRNGKey(0), MCFG)
     batch = (jnp.asarray(rng.standard_normal((16, 16)), jnp.float32),
              jnp.asarray(rng.integers(0, 8, 16), jnp.int32))
@@ -51,10 +61,12 @@ def test_dp_clip_matches_optax_reference(rng, trainer_cls):
     assert gn > CLIP, gn
     state = tr.init_state(params)
     state, _ = tr.step(state, tr.shard_batch(batch))
+    got = (tr.gathered_params(state) if trainer_cls is FSDPTrainer
+           else state.params)
     jax.tree_util.tree_map(
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a, np.float32), np.asarray(b, np.float32),
-            rtol=2e-5, atol=1e-6), state.params, want)
+            rtol=2e-5, atol=1e-6), got, want)
 
 
 def test_sharded_tp_clip_matches_unsharded(rng):
