@@ -177,6 +177,10 @@ def child_main() -> None:
         # the codec sustains the link rate, which codec_roundtrip_gbps
         # bounds from below (it includes both encode and decode passes).
         phase("single device: projecting ring advantage from codec rate")
+        # the headline metric must not silently change meaning: a single
+        # device has no wire, so rename rather than report codec compute
+        # throughput under the busbw metric
+        report["metric"] = "bfp_codec_roundtrip_gbps"
         report["value"] = report["codec_roundtrip_gbps"]
         report["projected_max_speedup_vs_bf16_psum"] = round(
             cfg.compression_ratio_vs_f32 / 2, 3)

@@ -86,12 +86,24 @@ class FSDPTrainer:
 
     # -- init ---------------------------------------------------------------
 
+    def _ensure_meta(self, params_like) -> None:
+        """Flat layout from a params tree or ShapeDtypeStructs (no device
+        work — same restore contract as the other trainers)."""
+        self._meta = fused_update.flat_meta(params_like,
+                                            self.cfg.collective, self.n)
+        self.__dict__.pop("step_fn", None)
+
+    @property
+    def batch_spec(self):
+        """PartitionSpec for batch leaves (same public handle as the other
+        trainers)."""
+        return P(self.ax)
+
     def init_state(self, params) -> FSDPState:
         """Shard replicated init params into the persistent master shards
         (the only copy that survives the call — the ZeRO-3 memory claim)."""
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
-        self._meta = fused_update.flat_meta(params, coll, self.n)
-        self.__dict__.pop("step_fn", None)
+        self._ensure_meta(params)
 
         def _init(p):
             w_own, opt_state, _ = fused_update.init_master_shard(
@@ -157,9 +169,17 @@ class FSDPTrainer:
             _gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
             check_vma=False))(state.w_own)
 
-    def restore_state(self, restored: dict) -> FSDPState:
+    def restore_state(self, restored: dict,
+                      params_like=None) -> FSDPState:
         """FSDPState from a Checkpointer.restore() payload (same layout the
-        ZeRO-1 trainers persist: flat master + opt shards)."""
+        ZeRO-1 trainers persist: flat master + opt shards).  Layout must be
+        known: call init_state first or pass params_like (a params tree or
+        jax.eval_shape output — zero device work), same contract as every
+        other trainer."""
+        if params_like is not None:
+            self._ensure_meta(params_like)
+        assert self._meta is not None, (
+            "flat layout unknown: call init_state first or pass params_like")
         sh = NamedSharding(self.mesh, P(self.ax))
         return FSDPState(
             w_own=jax.device_put(jnp.asarray(restored["w_own"]), sh),
@@ -170,4 +190,4 @@ class FSDPTrainer:
     # -- data ---------------------------------------------------------------
 
     def shard_batch(self, batch):
-        return mesh_lib.shard_host_batch(batch, self.mesh, P(self.ax))
+        return mesh_lib.shard_host_batch(batch, self.mesh, self.batch_spec)

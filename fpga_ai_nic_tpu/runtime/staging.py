@@ -81,20 +81,20 @@ class Stager:
         if not self._pool:
             raise MemoryError(f"stage_create_sized({sizes})")
         self.n_slots = len(sizes)
+        self._sizes = list(sizes)
         self.slot_bytes = max(sizes)
+        self._waited = set()
         # submitted job keepalives: src/idx arrays must outlive the gather
         self._live = {}
 
     def submit(self, src: np.ndarray, idx: np.ndarray) -> int:
         """Enqueue dst[i] = src[idx[i]] over axis 0; returns a slot id.
 
-        Raises when every slot is outstanding (submitted, not released):
-        slots only return to the pool via release(), which only this thread
-        can call, so blocking here would deadlock inside native code."""
-        if len(self._live) >= self.n_slots:
-            raise RuntimeError(
-                f"all {self.n_slots} slots outstanding; release() one "
-                "before submitting more (bounded prefetch window)")
+        Raises when no FREE slot can fit the job: slots only return to the
+        pool via release(), which only this thread can call, so blocking in
+        the native wait would deadlock — with heterogeneous slot sizes the
+        guard must consider capacities, not just counts (a free-but-small
+        slot cannot satisfy a large job)."""
         src = np.ascontiguousarray(src)
         idx = np.ascontiguousarray(idx, np.int64)
         if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
@@ -102,6 +102,15 @@ class Stager:
             # index there is a silent wild read, so bound it here
             raise IndexError(f"index out of range [0, {src.shape[0]})")
         row_bytes = src.dtype.itemsize * int(np.prod(src.shape[1:], dtype=np.int64))
+        need = len(idx) * row_bytes
+        free_caps = [c for i, c in enumerate(self._sizes)
+                     if i not in self._live]
+        if not any(c >= need for c in free_caps):
+            if any(c >= need for c in self._sizes):
+                raise RuntimeError(
+                    f"no FREE slot fits {need} B (free capacities "
+                    f"{sorted(free_caps)}); release() one before submitting "
+                    "more (bounded prefetch window)")
         slot = self._l.stage_submit(
             self._pool, src.ctypes.data_as(ctypes.c_void_p),
             idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
@@ -122,12 +131,22 @@ class Stager:
             raise KeyError(f"slot {slot} is not outstanding")
         src, idx, shape, dtype = self._live[slot]
         ptr = self._l.stage_wait(self._pool, slot)
+        self._waited.add(slot)
         n = int(np.prod(shape, dtype=np.int64))
         buf = (ctypes.c_char * (n * dtype.itemsize)).from_address(ptr)
         return np.frombuffer(buf, dtype=dtype).reshape(shape)
 
     def release(self, slot: int) -> None:
+        """Return a slot to the pool.  Waits for the gather first if the
+        caller has not: freeing a QUEUED slot would drop the src/idx
+        keepalives while the worker still reads them (use-after-free) and
+        desync the C++ slot state machine."""
+        if slot not in self._live:
+            raise KeyError(f"slot {slot} is not outstanding")
+        if slot not in self._waited:
+            self._l.stage_wait(self._pool, slot)
         self._live.pop(slot, None)
+        self._waited.discard(slot)
         self._l.stage_release(self._pool, slot)
 
     def close(self) -> None:

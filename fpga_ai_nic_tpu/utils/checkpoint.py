@@ -84,13 +84,16 @@ class Checkpointer:
         return os.path.join(self.directory, f"step_{step:08d}")
 
     def save(self, step: int, state) -> str:
-        """Persist a trainer state.  States carrying a flat master copy
-        (w_own / w_master) drop their working ``params`` tree: every
-        trainer's ``restore_state`` rematerializes params from the masters,
-        so persisting both would double checkpoint size (and wipe out the
-        BFP compression win for bf16 models)."""
-        tree = dict(state._asdict()) if hasattr(state, "_asdict") else state
-        if isinstance(tree, dict) and "params" in tree and (
+        """Persist a trainer state.  TRAINER STATES (NamedTuples) carrying
+        a flat master copy (w_own / w_master) drop their working ``params``
+        tree: every trainer's ``restore_state`` rematerializes params from
+        the masters, so persisting both would double checkpoint size (and
+        wipe out the BFP compression win for bf16 models).  Plain dicts are
+        saved verbatim — the masters-only heuristic never applies to user
+        payloads whose keys merely resemble a trainer state's."""
+        is_trainer_state = hasattr(state, "_asdict")
+        tree = dict(state._asdict()) if is_trainer_state else state
+        if is_trainer_state and "params" in tree and (
                 "w_own" in tree or "w_master" in tree):
             tree = {k: v for k, v in tree.items() if k != "params"}
         tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))

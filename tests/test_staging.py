@@ -70,8 +70,8 @@ def test_submit_bounds_and_window(rng):
         with pytest.raises(IndexError):
             st.submit(src, np.array([-1]))
         s = st.submit(src, np.arange(8))
-        # all slots outstanding: raise, never deadlock in native wait
-        with pytest.raises(RuntimeError, match="outstanding"):
+        # all fitting slots outstanding: raise, never deadlock in native wait
+        with pytest.raises(RuntimeError, match="no FREE slot fits"):
             st.submit(src, np.arange(8))
         st.wait(s)
         st.release(s)
@@ -87,3 +87,39 @@ def test_epochs_native_batches_are_owned(rng):
     got = list(data.epochs_of(arrays, 8, seed=7, epochs=1, native=True))
     for w, g in zip(want, got):
         np.testing.assert_array_equal(g["x"], w["x"])
+
+
+def test_sized_pool_guard_counts_fitting_slots(rng):
+    """With heterogeneous slots, submit must raise (not deadlock in native
+    code) when the only slots large enough are outstanding."""
+    src = rng.standard_normal((20, 8)).astype(np.float32)  # 32 B rows
+    st = staging.Stager.sized([4 * 32, 10 * 32])
+    try:
+        s_big = st.submit(src, np.arange(8))      # claims the 10-row slot
+        with pytest.raises(RuntimeError, match="no FREE slot fits"):
+            st.submit(src, np.arange(8))          # only the 4-row slot free
+        sm = st.submit(src, np.arange(4))         # small job fits small slot
+        np.testing.assert_array_equal(st.wait(sm), src[:4])
+        np.testing.assert_array_equal(st.wait(s_big), src[:8])
+        st.release(sm)
+        st.release(s_big)
+    finally:
+        st.close()
+
+
+def test_release_before_wait_is_safe(rng):
+    """release() on an un-waited slot must complete the gather first (no
+    use-after-free of src/idx, no slot-state desync) and the slot must be
+    reusable afterwards."""
+    src = rng.standard_normal((100, 16)).astype(np.float32)
+    st = staging.Stager(1, 32 * 16 * 4)
+    try:
+        s = st.submit(src, np.arange(32))
+        st.release(s)                  # never waited
+        with pytest.raises(KeyError):
+            st.release(s)              # double-release
+        s2 = st.submit(src, np.arange(10))   # slot came back usable
+        np.testing.assert_array_equal(st.wait(s2), src[:10])
+        st.release(s2)
+    finally:
+        st.close()
