@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench
+.PHONY: ci test codec bench collective perf
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -11,3 +11,17 @@ ci: codec test
 
 bench:
 	python bench.py
+
+# run the collective/codec benchmark and snapshot its newest artifact as
+# the round's committed record (the round-2 review's item 3: the
+# first-named BASELINE metric must land in a committed file every round)
+ROUND ?= r03
+collective:
+	python bench_collective.py
+	@latest=$$(ls -t artifacts/collective_2*.json | head -1); \
+	  cp $$latest COLLECTIVE_$(ROUND).json; \
+	  echo "saved $$latest -> COLLECTIVE_$(ROUND).json"
+
+# regenerate docs/PERF.md strictly from committed artifacts
+perf:
+	python tools/gen_perf_md.py

@@ -38,22 +38,33 @@ import os
 import sys
 import time
 
-from bench_common import cpu_env, enable_compile_cache, log as _log, run_attempt
+from bench_common import (cpu_env, enable_compile_cache, is_tpu_platform,
+                          log as _log, probe_tpu, run_attempt, save_artifact)
 
 BASELINE_SAMPLES_PER_SEC_PER_NODE = 14_000.0
 METRIC = "mlp_train_samples_per_sec_per_chip"
 
-# Attempt ladder: (name, env overrides, config knobs, budget_s, silence_s).
-# Budgets sum to ~430s so the whole ladder fits a driver-side timeout of
-# ~8 minutes even when every TPU attempt hangs to its limit.
-ATTEMPTS = [
-    {"name": "tpu", "cpu": False, "layers": 10, "batch": 4096, "iters": 20,
-     "budget_s": 240.0, "silence_s": 150.0, "degraded": False},
-    {"name": "tpu_small", "cpu": False, "layers": 3, "batch": 512, "iters": 10,
-     "budget_s": 110.0, "silence_s": 75.0, "degraded": True},
-    {"name": "cpu", "cpu": True, "layers": 3, "batch": 512, "iters": 3,
-     "budget_s": 80.0, "silence_s": 60.0, "degraded": True},
-]
+# Global wall budget for the whole ladder (driver-side timeout ~8 min).
+GLOBAL_BUDGET_S = 450.0
+
+# Rung configs.  The ladder is *probe-gated and reordered* (round-2 lesson:
+# spending the whole TPU budget on one early shot guarantees a degraded
+# record whenever the driver's single invocation lands in a tunnel wedge):
+#   1. probe (~40s): import jax / enumerate devices / one tiny dispatch.
+#   2. probe healthy  -> tpu full; fallback tpu_small; fallback cpu.
+#   3. probe wedged   -> cpu FIRST (bank a number), then spaced re-probes
+#      with the remaining budget; any healthy window runs the TPU rungs.
+# Every successful TPU rung also writes artifacts/bench_tpu_*.json
+# (timestamp + git sha), so opportunistic mid-round runs leave committed
+# evidence even if the end-of-round invocation hits a wedge.
+TPU_FULL = {"name": "tpu", "cpu": False, "layers": 10, "batch": 4096,
+            "iters": 20, "budget_s": 220.0, "silence_s": 120.0,
+            "degraded": False}
+TPU_SMALL = {"name": "tpu_small", "cpu": False, "layers": 3, "batch": 512,
+             "iters": 10, "budget_s": 110.0, "silence_s": 75.0,
+             "degraded": True}
+CPU_RUNG = {"name": "cpu", "cpu": True, "layers": 3, "batch": 512, "iters": 3,
+            "budget_s": 80.0, "silence_s": 60.0, "degraded": True}
 
 
 # ---------------------------------------------------------------------------
@@ -167,32 +178,72 @@ def child_main(layers: int, batch: int, iters: int) -> None:
 # parent: attempt ladder with activity watchdog
 # ---------------------------------------------------------------------------
 
-def _run_attempt(att: dict) -> dict:
+def _run_attempt(att: dict, budget_s: float = None) -> dict:
     env = cpu_env(1) if att["cpu"] else dict(os.environ)
     here = os.path.abspath(__file__)
     cmd = [sys.executable, "-u", here, "--child", str(att["layers"]),
            str(att["batch"]), str(att["iters"])]
-    return run_attempt(att["name"], cmd, env=env,
-                       budget_s=att["budget_s"], silence_s=att["silence_s"],
-                       cwd=os.path.dirname(here))
+    result = run_attempt(att["name"], cmd, env=env,
+                         budget_s=budget_s or att["budget_s"],
+                         silence_s=att["silence_s"],
+                         cwd=os.path.dirname(here))
+    if att["degraded"]:
+        result["degraded"] = True
+        result["degraded_config"] = f"{att['layers']}x2048 batch={att['batch']}"
+    if is_tpu_platform(result.get("platform", "")):
+        save_artifact("bench_tpu", result)
+    return result
 
 
 def main() -> None:
+    t_end = time.time() + GLOBAL_BUDGET_S
     errors = []
-    for att in ATTEMPTS:
+    banked = None            # best result so far (cpu fallback)
+
+    def remaining() -> float:
+        return t_end - time.time()
+
+    def attempt(att, cap=None) -> dict:
+        budget = min(cap or att["budget_s"], max(remaining(), 20.0))
         try:
-            result = _run_attempt(att)
-        except Exception as e:  # noqa: BLE001 — the one JSON line must happen
+            return _run_attempt(att, budget_s=budget)
+        except Exception as e:  # noqa: BLE001 — ladder must fall through
             _log(str(e))
             errors.append(f"{att['name']}: {e}")
-            continue
-        if att["degraded"]:
-            result["degraded"] = True
-            result["degraded_config"] = (
-                f"{att['layers']}x2048 batch={att['batch']}")
+            return None
+
+    def emit(result) -> None:
         if errors:
             result["failed_attempts"] = errors
         print(json.dumps(result), flush=True)
+
+    if probe_tpu(budget_s=min(40.0, remaining())):
+        for att in (TPU_FULL, TPU_SMALL):
+            result = attempt(att)
+            if result is not None:
+                emit(result)
+                return
+    else:
+        errors.append("probe: tunnel wedged at ladder start")
+
+    # wedged (or TPU rungs failed): bank the CPU number FIRST, then spend
+    # every remaining second on spaced re-probes — a wedge that clears
+    # mid-ladder still yields a real TPU record
+    banked = attempt(CPU_RUNG)
+    while remaining() > TPU_SMALL["budget_s"] + 45.0:
+        time.sleep(min(20.0, max(remaining() - TPU_SMALL["budget_s"] - 40, 0)))
+        if not probe_tpu(budget_s=min(40.0, remaining())):
+            continue
+        att = TPU_FULL if remaining() > TPU_FULL["budget_s"] + 5 else TPU_SMALL
+        result = attempt(att)
+        if result is None and att is TPU_FULL \
+                and remaining() > TPU_SMALL["budget_s"]:
+            result = attempt(TPU_SMALL)
+        if result is not None:
+            emit(result)
+            return
+    if banked is not None:
+        emit(banked)
         return
     # every rung failed — one diagnosable JSON line, nonzero exit
     print(json.dumps({

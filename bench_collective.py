@@ -34,7 +34,8 @@ import os
 import sys
 import time
 
-from bench_common import cpu_env, enable_compile_cache, log, run_attempt
+from bench_common import (cpu_env, enable_compile_cache, is_tpu_platform,
+                          log, run_attempt, save_artifact)
 
 ATTEMPTS = [
     {"name": "tpu", "cpu": False, "budget_s": 240.0, "silence_s": 120.0},
@@ -110,6 +111,7 @@ def child_main() -> None:
     phase(f"codec throughput ({CODEC_MB} MiB)")
     n_elems = CODEC_MB * (1 << 20) // 4
     x = jax.random.normal(jax.random.PRNGKey(0), (n_elems,), jnp.float32)
+    enc_fn, dec_fn = ring_ops._codec(codec_cfg, n_elems)
 
     @jax.jit
     def enc_dec_chain(x):
@@ -117,14 +119,102 @@ def child_main() -> None:
         # (~0.3ms through the tunnel) amortizes; carry feeds forward so
         # nothing is dead-code-eliminated.
         def body(i, v):
-            m, s = ring_ops._codec(codec_cfg, n_elems)[0](v)
-            return ring_ops._codec(codec_cfg, n_elems)[1](m, s, v.dtype)
+            m, s = enc_fn(v)
+            return dec_fn(m, s, v.dtype)
         return lax.fori_loop(0, 4, body, x)
 
     dt = _timeit(lambda: enc_dec_chain(x), sync) / 4   # per roundtrip
     gb = n_elems * 4 / 1e9
     report["codec_roundtrip_gbps"] = round(gb / dt, 2)
     log(f"codec roundtrip {report['codec_roundtrip_gbps']} GB/s")
+
+    # encode-only: perturb the input per iteration (one extra elementwise
+    # add) so the loop body cannot be hoisted — the reported rate is a
+    # slight UNDERestimate of the pure encode rate
+    @jax.jit
+    def enc_chain(x):
+        def body(i, carry):
+            v, acc = carry
+            m, s = enc_fn(v + i.astype(jnp.float32) * 1e-30)
+            return v, acc + jnp.sum(m.astype(jnp.int32))
+        return lax.fori_loop(0, 4, body, (x, jnp.int32(0)))[1]
+
+    dt_e = _timeit(lambda: enc_chain(x), lambda t: float(jnp.sum(t))) / 4
+    report["codec_encode_gbps"] = round(gb / dt_e, 2)
+
+    # decode-only: roll the (small) scale vector per iteration so the
+    # decode is not loop-invariant; the big mantissa buffer is re-read
+    # every iteration, which is what bounds the rate
+    mant0, se0 = jax.jit(enc_fn)(x)
+
+    @jax.jit
+    def dec_chain(mant, se):
+        def body(i, acc):
+            out = dec_fn(mant, jnp.roll(se, i), jnp.float32)
+            return acc + out[0]
+        return lax.fori_loop(0, 4, body, jnp.float32(0))
+
+    dt_d = _timeit(lambda: dec_chain(mant0, se0),
+                   lambda t: float(t)) / 4
+    report["codec_decode_gbps"] = round(gb / dt_d, 2)
+    log(f"codec encode {report['codec_encode_gbps']} / decode "
+        f"{report['codec_decode_gbps']} GB/s")
+
+    # -- fused compress-into-hop kernel, single-chip loopback ---------------
+    # (ops.ring_pallas: encode slice g+1 on the VPU while slice g's DMA is
+    # in flight; RDMAs self-addressed on the 1-chip surface)
+    if on_tpu:
+        phase("fused ring kernel (loopback)")
+        try:
+            from fpga_ai_nic_tpu.ops import ring_pallas
+            vn, slice_elems = 8, 1 << 16
+            L = vn * 4 * slice_elems            # 8 MiB f32, VMEM-resident
+            xf = jax.random.normal(jax.random.PRNGKey(2), (L,), jnp.float32)
+            run = jax.jit(lambda v: ring_pallas.loopback_microbench(
+                v, vn, slice_elems=slice_elems))
+            dt_f = _timeit(lambda: run(xf), sync)
+            hop_bytes = (vn - 1) * (L // vn) * 4   # f32 bytes through pipe
+            report["fused_ring_loopback_gbps"] = round(hop_bytes / dt_f / 1e9, 2)
+            report["fused_ring_loopback_note"] = (
+                "self-addressed RDMA on one chip: sustained rate of the "
+                "fused encode->DMA->decode+add pipeline per hop direction; "
+                "on multi-chip ICI the DMA stage rides the interconnect "
+                "instead of local HBM")
+            log(f"fused loopback {report['fused_ring_loopback_gbps']} GB/s")
+        except Exception as e:  # noqa: BLE001 — measurement is best-effort
+            report["fused_ring_loopback_error"] = repr(e)[:300]
+            log(f"fused loopback failed: {e!r}")
+
+    # -- break-even: when does the BFP wire path beat bf16 psum? ------------
+    # Pipelined hop of B f32 bytes: t = B*max(1/enc, 1/(r*W), 1/dec) vs
+    # uncompressed t = B/(W*2) for bf16 (2x smaller payload than f32).
+    # => BFP beats bf16-psum iff min(enc, dec) > 2*W/ (r/ ... ) — computed
+    # per candidate per-direction link rate W below (chip generation is not
+    # queryable through the tunnel, so the table parameterizes W).
+    r = cfg.compression_ratio_vs_f32                   # 3.76x vs f32
+    enc_g = report.get("codec_encode_gbps", 0.0)
+    dec_g = report.get("codec_decode_gbps", 0.0)
+    rows = {}
+    for W in (45.0, 90.0, 180.0):                      # GB/s per direction
+        # payload B f32 bytes; bf16 psum moves B/2 at rate W; BFP ring
+        # moves B/r at rate W overlapped with codec at enc/dec rates
+        t_bf16 = 0.5 / W
+        t_bfp = max(1.0 / enc_g if enc_g else 9e9,
+                    1.0 / dec_g if dec_g else 9e9,
+                    (1.0 / r) / W)
+        rows[f"link_{int(W)}GBps"] = {
+            "bfp_speedup_vs_bf16_psum": round(t_bf16 / t_bfp, 3),
+            "bfp_wins": t_bfp < t_bf16,
+            "required_codec_gbps_to_win": round(2 * W, 1),
+        }
+    report["break_even"] = {
+        "model": ("hop time per f32 byte = max(1/encode, 1/decode, "
+                  "1/(3.76*W)) vs bf16 psum's 1/(2*W); codec stages must "
+                  "each sustain 2*W to win at all, and the max speedup is "
+                  "3.76/2 = 1.88x"),
+        "wire_ratio_vs_f32": round(r, 3),
+        "per_link_rate": rows,
+    }
 
     # -- ring sweep (needs a multi-device axis) -----------------------------
     if n_dev >= 2:
@@ -202,10 +292,16 @@ def main() -> None:
     codec throughput, but the ring sweep still needs a multi-device mesh —
     so the cpu_mesh rung always runs unless the TPU rung already produced a
     sweep (i.e. multi-chip ICI was available)."""
+    from bench_common import probe_tpu
     errors, results = [], {}
     for att in ATTEMPTS:
         if results and any("sweep" in r for r in results.values()):
             break       # a multi-device sweep exists; nothing left to add
+        if not att["cpu"] and not probe_tpu():
+            # don't burn the rung budget on a wedged tunnel (round-2
+            # lesson); the cpu_mesh rung still runs below
+            errors.append(f"{att['name']}: skipped, tunnel wedged at probe")
+            continue
         env = cpu_env(8) if att["cpu"] else dict(os.environ)
         here = os.path.abspath(__file__)
         try:
@@ -213,6 +309,8 @@ def main() -> None:
                 att["name"], [sys.executable, "-u", here, "--child"],
                 env=env, budget_s=att["budget_s"],
                 silence_s=att["silence_s"], cwd=os.path.dirname(here))
+            if is_tpu_platform(results[att["name"]].get("platform", "")):
+                save_artifact("collective_tpu", results[att["name"]])
         except Exception as e:  # noqa: BLE001 — one JSON line must happen
             log(str(e))
             errors.append(f"{att['name']}: {e}")
@@ -234,6 +332,7 @@ def main() -> None:
                            other.get("codec_roundtrip_gbps"))
     if errors:
         primary["failed_attempts"] = errors
+    save_artifact("collective", primary)
     print(json.dumps(primary), flush=True)
 
 

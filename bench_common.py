@@ -86,6 +86,74 @@ def run_attempt(name: str, cmd, *, env=None, budget_s: float,
         + " | ".join(l.strip() for l in lines[-4:]))
 
 
+PROBE_SRC = r"""
+import json, time
+t0 = time.time()
+print("[bench] phase=import t=0.0s", flush=True)
+import jax
+print("[bench] phase=devices t=%.1fs" % (time.time()-t0), flush=True)
+d = jax.devices()
+print("[bench] phase=compute t=%.1fs" % (time.time()-t0), flush=True)
+import jax.numpy as jnp
+v = float(jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16)))
+print(json.dumps({"ok": v == 128.0 * 128, "platform": d[0].platform,
+                  "n_devices": len(d), "t": round(time.time()-t0, 1)}),
+      flush=True)
+"""
+
+
+def probe_tpu(budget_s: float = 40.0, silence_s: float = 35.0) -> bool:
+    """Is the TPU tunnel healthy *right now*?  A subprocess imports jax,
+    enumerates devices, and runs one tiny jitted matmul under an activity
+    watchdog — the three places a wedged tunnel hangs (import / devices /
+    first dispatch).  Cheap enough to retry between ladder rungs, which is
+    what turns a mid-round healthy window into a committed artifact instead
+    of a lost one (round-2 lesson: one early shot per rung guarantees a
+    degraded record whenever the driver lands in a wedge)."""
+    import sys as _sys
+    try:
+        r = run_attempt("probe", [_sys.executable, "-u", "-c", PROBE_SRC],
+                        budget_s=budget_s, silence_s=silence_s)
+        ok = bool(r.get("ok")) and is_tpu_platform(r.get("platform", ""))
+        log(f"probe: platform={r.get('platform')} ok={ok}")
+        return ok
+    except Exception as e:  # noqa: BLE001 — a failed probe is just "wedged"
+        log(f"probe failed: {e}")
+        return False
+
+
+def git_sha(repo_dir=None) -> str:
+    import subprocess
+    try:
+        return subprocess.run(
+            ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
+            cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
+            timeout=10).stdout.strip()
+    except Exception:  # noqa: BLE001
+        return "unknown"
+
+
+def save_artifact(prefix: str, result: dict) -> str:
+    """Write a timestamped raw-evidence JSON under artifacts/.  Every perf
+    claim in docs/PERF.md must trace to one of these files (round-2 verdict:
+    a number without a committed artifact is asserted, not measured)."""
+    here = os.path.dirname(os.path.abspath(__file__))
+    art_dir = os.path.join(here, "artifacts")
+    os.makedirs(art_dir, exist_ok=True)
+    ts = time.strftime("%Y%m%dT%H%M%SZ", time.gmtime())
+    path = os.path.join(art_dir, f"{prefix}_{ts}.json")
+    payload = dict(result)
+    payload["_provenance"] = {
+        "timestamp_utc": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+        "git_sha": git_sha(here),
+        "argv": sys.argv,
+    }
+    with open(path, "w") as f:
+        json.dump(payload, f, indent=1)
+    log(f"artifact saved: {os.path.relpath(path, here)}")
+    return path
+
+
 def cpu_env(n_devices: int = 8) -> dict:
     """Env overrides forcing an n-device virtual CPU mesh (and disabling the
     eager TPU-tunnel registration)."""

@@ -1,0 +1,154 @@
+#!/usr/bin/env python
+"""Regenerate docs/PERF.md STRICTLY from committed artifacts.
+
+Round-2 lesson (VERDICT item 4): a perf number whose raw measurement is
+not committed is asserted, not measured.  This generator renders every
+performance row from a JSON file in the repo and cites it; anything
+without an artifact simply does not appear.  Run via `make perf`.
+"""
+
+import glob
+import json
+import os
+import sys
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _rel(path):
+    return os.path.relpath(path, ROOT)
+
+
+def _newest(pattern):
+    paths = sorted(glob.glob(os.path.join(ROOT, pattern)))
+    return paths[-1] if paths else None
+
+
+def main():
+    L = ["# Measured performance",
+         "",
+         "Every number in this file is read from a committed JSON artifact",
+         "(cited per row) — regenerate with `make perf`; nothing here is",
+         "hand-written.  Artifacts carry timestamp + git sha + platform in",
+         "`_provenance` (bench drivers write them on every TPU",
+         "measurement; `tools/harvest_tpu.sh` banks healthy tunnel",
+         "windows).",
+         ""]
+
+    # -- headline training throughput ---------------------------------------
+    L += ["## Headline: MLP training throughput", ""]
+    tpu_art = _newest("artifacts/bench_tpu_*.json")
+    rows = []
+    if tpu_art:
+        d = _load(tpu_art)
+        rows.append((d, _rel(tpu_art)))
+    for rec in ("BENCH_r02.json", "BENCH_r01.json"):
+        p = os.path.join(ROOT, rec)
+        if os.path.exists(p):
+            d = _load(p).get("parsed") or {}
+            if d:
+                rows.append((d, _rel(p) + " (driver record)"))
+                break
+    if rows:
+        L += ["| samples/s/chip | vs baseline | platform | degraded "
+              "| artifact |", "|---|---|---|---|---|"]
+        for d, src in rows:
+            L.append(f"| {d.get('value')} | {d.get('vs_baseline')} "
+                     f"| {d.get('platform')} "
+                     f"| {bool(d.get('degraded', False))} | `{src}` |")
+    else:
+        L.append("*(no committed throughput artifact yet)*")
+    L.append("")
+
+    # -- collective / codec --------------------------------------------------
+    col_art = (_newest("COLLECTIVE_r*.json")
+               or _newest("artifacts/collective_2*.json"))
+    if col_art:
+        d = _load(col_art)
+        src = _rel(col_art)
+        L += ["## Collective / wire path", "",
+              f"Source: `{src}` (platform: {d.get('platform')}, "
+              f"{d.get('n_devices')} device(s))", ""]
+        pairs = [
+            ("codec roundtrip", "codec_roundtrip_gbps"),
+            ("codec encode-only", "codec_encode_gbps"),
+            ("codec decode-only", "codec_decode_gbps"),
+            ("fused ring kernel, single-chip loopback",
+             "fused_ring_loopback_gbps"),
+        ]
+        L += ["| measurement | GB/s |", "|---|---|"]
+        for name, key in pairs:
+            if key in d:
+                L.append(f"| {name} | {d[key]} |")
+        L.append("")
+        sweep = d.get("sweep") or d.get("mesh_sweep")
+        if sweep:
+            plat = (d.get("platform") if d.get("sweep")
+                    else d.get("mesh_sweep_platform", "cpu"))
+            L += [f"Ring busbw sweep (platform: {plat} — the virtual CPU "
+                  "mesh is memory-bound, not ICI-representative):", "",
+                  "| size MiB | psum bf16 | ring f32 | ring BFP | "
+                  "BFP/f32 |", "|---|---|---|---|---|"]
+            for r in sweep:
+                L.append(f"| {r['size_mb']} | {r['psum_bf16_gbps']} "
+                         f"| {r['ring_f32_gbps']} | {r['ring_bfp_gbps']} "
+                         f"| {r['bfp_speedup_vs_ring_f32']}x |")
+            L.append("")
+        be = d.get("break_even")
+        if be:
+            L += ["### Break-even: can the BFP wire path win?", "",
+                  be["model"], "",
+                  "| per-direction link rate | BFP speedup vs bf16 psum | "
+                  "wins? | codec GB/s needed |", "|---|---|---|---|"]
+            for k, v in be["per_link_rate"].items():
+                L.append(f"| {k.replace('link_', '').replace('GBps', '')} "
+                         f"GB/s | {v['bfp_speedup_vs_bf16_psum']}x "
+                         f"| {'yes' if v['bfp_wins'] else 'no'} "
+                         f"| {v['required_codec_gbps_to_win']} |")
+            L.append("")
+
+    # -- convergence ---------------------------------------------------------
+    conv = os.path.join(ROOT, "docs", "bfp_convergence.json")
+    if os.path.exists(conv):
+        d = _load(conv)
+        L += ["## BFP accuracy (lossy-wire training quality)", "",
+              "Source: `docs/bfp_convergence.json` "
+              "(full table: docs/BFP_CONVERGENCE.md).", ""]
+        can = d.get("mlp_canonical")
+        if can and "seeds" in can:
+            m8 = can["bfp_m8"]
+            L.append(f"- canonical-width MLP, {can['steps']} steps x "
+                     f"{len(can['seeds'])} seeds: m8 final-loss ratio "
+                     f"**{m8['ratio_mean']:.3f} +/- {m8['ratio_std']:.3f}**"
+                     f" (gate: mean <= 1.05)")
+        fsdp = d.get("mlp_fsdp")
+        if fsdp and "bfp_m8" in fsdp:
+            L.append(f"- ZeRO-3 + compressed gather/reduce-scatter "
+                     f"(mlp_fsdp): m8 ratio "
+                     f"{fsdp['bfp_m8']['final_loss_ratio']:.3f}")
+        L.append("")
+
+    # -- withdrawn claims ----------------------------------------------------
+    L += ["## Withdrawn round-2 claims", "",
+          "The round-2 PERF.md asserted 490,217 samples/s/chip, 35x "
+          "baseline, ~60% MXU, 99.9% DMA overlap, and 10.1 GB/s codec "
+          "roundtrip as measured-on-TPU.  No committed artifact "
+          "substantiates them, and the driver's contemporaneous record "
+          "(BENCH_r02.json) is a degraded CPU fallback — so they are "
+          "withdrawn rather than repeated.  They return if and when a "
+          "committed artifact reproduces them.", ""]
+
+    out = os.path.join(ROOT, "docs", "PERF.md")
+    with open(out, "w") as f:
+        f.write("\n".join(L))
+    print(f"wrote {out}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

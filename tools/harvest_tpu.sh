@@ -1,0 +1,30 @@
+#!/bin/bash
+# Opportunistic TPU evidence harvester (round-2 verdict item 1b).
+#
+# The axon tunnel wedges for multi-hour stretches; a single end-of-round
+# bench invocation that lands in a wedge produces a degraded CPU record.
+# This loop probes the tunnel cheaply (no jax import in the parent) every
+# PERIOD seconds and, on the first healthy window, runs the two benchmark
+# ladders — each of which saves timestamped artifacts/ JSON on any
+# successful TPU measurement — then keeps re-harvesting on a longer period
+# so the freshest healthy window is always on file.
+#
+# The flock serializes TPU access between this harvester and interactive
+# runs (single tunneled chip; concurrent clients can wedge each other).
+cd "$(dirname "$0")/.."
+PERIOD=${PERIOD:-360}
+LONG_PERIOD=${LONG_PERIOD:-1800}
+MAX_HOURS=${MAX_HOURS:-10}
+LOCK=/tmp/tpu.lock
+DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+have_artifacts() { ls artifacts/bench_tpu_*.json >/dev/null 2>&1; }
+while [ "$(date +%s)" -lt "$DEADLINE" ]; do
+  if flock -n "$LOCK" -c "python -c 'from bench_common import probe_tpu; import sys; sys.exit(0 if probe_tpu() else 1)'"; then
+    echo "[harvest] tunnel healthy at $(date -u +%FT%TZ)"
+    flock "$LOCK" -c "python bench.py" >/tmp/harvest_bench.out 2>&1
+    flock "$LOCK" -c "python bench_collective.py" >/tmp/harvest_collective.out 2>&1
+    echo "[harvest] ladders done at $(date -u +%FT%TZ); artifacts:"
+    ls -la artifacts/ 2>/dev/null
+  fi
+  if have_artifacts; then sleep "$LONG_PERIOD"; else sleep "$PERIOD"; fi
+done
