@@ -84,7 +84,8 @@ def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    *, causal: bool = True,
                    sm_scale: Optional[float] = None,
-                   k_block: Optional[int] = 512) -> jax.Array:
+                   k_block: Optional[int] = 512,
+                   unroll: bool = False) -> jax.Array:
     """Sequence-parallel exact attention inside ``shard_map``.
 
     q, k, v: [B, H, S_local, dh] — the local sequence shard; shards are
@@ -95,6 +96,11 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     `_attend_chunk`); the default keeps peak score memory at
     [B, H, S_local, 512] regardless of sequence length.  None disables
     blocking (the whole-chunk reference schedule).
+
+    unroll: unroll the n-1 hop loop at trace time — same knob and default
+    as ``CollectiveConfig.unroll_hops`` (marginally better codegen at tiny
+    n, O(n) compile-time blowup at pod scale; the rolled ``fori_loop`` is
+    the default for the same reason as in ops.ring).
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -140,7 +146,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
             m, l, o = attend((m, l, o))
         return m, l, o, kc, vc
 
-    m, l, o, _, _ = lax.fori_loop(1, n, hop, (m, l, o, k, v), unroll=True)
+    m, l, o, _, _ = lax.fori_loop(1, n, hop, (m, l, o, k, v), unroll=unroll)
     # rows with no visible keys (can't happen causally: a token sees itself)
     l = jnp.where(l == 0, 1.0, l)
     return (o / l).astype(q.dtype)

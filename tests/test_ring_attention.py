@@ -99,6 +99,46 @@ def test_blockwise_peak_memory_is_o_s():
     assert blocked_4k < 8 * max(blocked_1k, 1), (blocked_1k, blocked_4k)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_unrolled_matches_rolled(rng, causal):
+    """The hop-loop unroll knob (CollectiveConfig.unroll_hops analogue) is a
+    schedule choice only — unrolled and rolled must agree bitwise-ish."""
+    q, k, v = _qkv(rng)
+
+    def run(unroll):
+        return np.asarray(jax.jit(jax.shard_map(
+            lambda q_, k_, v_: ra.ring_attention(
+                q_, k_, v_, "sp", causal=causal, unroll=unroll),
+            mesh=_mesh(), in_specs=(P(None, None, "sp"),) * 3,
+            out_specs=P(None, None, "sp")))(q, k, v))
+
+    np.testing.assert_allclose(run(True), run(False), rtol=1e-6, atol=1e-6)
+
+
+@pytest.mark.parametrize("unroll", [True, False])
+def test_causal_skip_lowers_to_conditional(unroll):
+    """The future-block skip must survive compilation as a real HLO
+    ``conditional`` — which executes only the taken branch — not a
+    select-both-branches rewrite that would silently keep the dead
+    attention FLOPs.  Static cost analysis cannot show the elision (it
+    counts every conditional branch once regardless), so the honest check
+    is structural: causal keeps >= 1 conditional (n-1 when unrolled, one
+    per hop), non-causal has none."""
+    q = jnp.zeros((1, 2, SP * 8, 32), jnp.float32)
+
+    def compiled(causal):
+        return jax.jit(jax.shard_map(
+            lambda q_, k_, v_: ra.ring_attention(
+                q_, k_, v_, "sp", causal=causal, k_block=None, unroll=unroll),
+            mesh=_mesh(), in_specs=(P(None, None, "sp"),) * 3,
+            out_specs=P(None, None, "sp"))).lower(q, q, q).compile()
+
+    n_causal = compiled(True).as_text().count("conditional(")
+    n_full = compiled(False).as_text().count("conditional(")
+    assert n_full == 0, n_full
+    assert n_causal >= (SP - 1 if unroll else 1), (n_causal, unroll)
+
+
 def test_blockwise_nondivisor_kblock(rng):
     """k_block that doesn't divide S_local drops to the largest divisor,
     keeping the memory bound instead of silently going whole-chunk."""
