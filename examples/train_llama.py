@@ -78,18 +78,20 @@ def main(argv):
     prof = Profiler()
 
     if pp_ax:
-        assert ep_ax is None, "MoE+pp not supported (models.llama.apply_pp)"
         loss = lambda p, b: llama.loss_fn_pp(
             p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb, tp_axis=tp_ax,
-            sp_axis=sp_ax, dp_axis="dp", remat=True)
-        specs = llama.stacked_param_specs(mcfg, tp_axis=tp_ax)
+            sp_axis=sp_ax, dp_axis="dp", ep_axis=ep_ax, remat=True)
+        # tp_size enables kv-head replication when tp > n_kv_heads
+        specs = llama.stacked_param_specs(mcfg, tp_axis=tp_ax,
+                                          ep_axis=ep_ax, tp_size=m.tp)
         init_params = llama.stack_params(
             llama.init(jax.random.PRNGKey(cfg.seed), mcfg))
     else:
         loss = lambda p, b: llama.loss_fn(p, b, mcfg, tp_axis=tp_ax,
                                           sp_axis=sp_ax, dp_axis="dp",
                                           ep_axis=ep_ax, remat=remat)
-        specs = llama.param_specs(mcfg, tp_axis=tp_ax, ep_axis=ep_ax)
+        specs = llama.param_specs(mcfg, tp_axis=tp_ax, ep_axis=ep_ax,
+                                  tp_size=m.tp)
         init_params = llama.init(jax.random.PRNGKey(cfg.seed), mcfg)
 
     tr = ShardedTrainer(loss, mesh, cfg, specs, pp_axis=pp_ax, ep_axis=ep_ax)

@@ -122,15 +122,27 @@ def init(key: jax.Array, cfg: LlamaConfig) -> Dict:
 
 
 def param_specs(cfg: LlamaConfig, tp_axis: Optional[str] = "tp",
-                ep_axis: Optional[str] = None) -> Dict:
+                ep_axis: Optional[str] = None,
+                tp_size: Optional[int] = None) -> Dict:
     """PartitionSpecs: Megatron column/row sharding over the tp axis
     (tp_axis=None replicates — for meshes without a tp axis); MoE expert
-    weights shard over ep_axis."""
+    weights shard over ep_axis (per-expert hidden over tp, see
+    moe_ops.param_specs).
+
+    tp_size: pass the mesh's tp extent when it may exceed n_kv_heads —
+    wk/wv then REPLICATE over tp and each rank slices its kv group's head
+    inside the block (kv-head replication; Llama-3-8B's 8 kv heads cap
+    head-sharded tp at 8, this lifts it to tp = any multiple of n_kv that
+    divides n_heads)."""
     col, row, rep = P(None, tp_axis), P(tp_axis, None), P()
-    layer = {"attn_norm": rep, "wq": col, "wk": col, "wv": col, "wo": row,
+    kv = col
+    if (tp_axis is not None and tp_size is not None
+            and cfg.n_kv_heads % tp_size != 0):
+        kv = rep    # kv-head replication: sliced per rank in _block
+    layer = {"attn_norm": rep, "wq": col, "wk": kv, "wv": kv, "wo": row,
              "mlp_norm": rep}
     if cfg.moe is not None:
-        layer["moe"] = moe_ops.param_specs(cfg.moe, ep_axis)
+        layer["moe"] = moe_ops.param_specs(cfg.moe, ep_axis, tp_axis)
     else:
         layer.update({"w1": col, "w3": col, "w2": row})
     return {"tok_emb": rep, "final_norm": rep, "lm_head": col,
@@ -197,9 +209,30 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
     B, S = x.shape[:2]
     Hd = cfg.head_dim
     h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
+    if n_kv == 0:
+        # kv-head replication (tp > n_kv): wk/wv arrive replicated; this
+        # rank slices the ONE kv head serving its query group (head
+        # g = r*n_kv//tp — rank r's n_heads/tp query heads all map to it
+        # because n_kv | tp).  The slice transpose scatter-adds the
+        # cotangent back into the replicated weight, and vma-typed
+        # autodiff inserts the tp-psum that ties the replicas — the same
+        # mechanism every tp-replicated leaf (norms, embeddings) uses.
+        tp = lax.axis_size(tp_axis)
+        if lyr["wk"].shape[1] != cfg.n_kv_heads * Hd:
+            raise ValueError(
+                f"tp={tp} > n_kv_heads={cfg.n_kv_heads} needs wk/wv "
+                f"REPLICATED over tp (local width {lyr['wk'].shape[1]}, "
+                f"expected {cfg.n_kv_heads * Hd}) — pass tp_size to "
+                f"param_specs/stacked_param_specs")
+        g = (lax.axis_index(tp_axis) * cfg.n_kv_heads) // tp
+        wk = lax.dynamic_slice_in_dim(lyr["wk"], g * Hd, Hd, axis=1)
+        wv = lax.dynamic_slice_in_dim(lyr["wv"], g * Hd, Hd, axis=1)
+        n_kv = 1
+    else:
+        wk, wv = lyr["wk"], lyr["wv"]
     q = (h @ lyr["wq"]).reshape(B, S, n_heads, Hd).transpose(0, 2, 1, 3)
-    k = (h @ lyr["wk"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
-    v = (h @ lyr["wv"]).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+    k = (h @ wk).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
+    v = (h @ wv).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
     q = _rope(q, pos, cfg)
     k = _rope(k, pos, cfg)
     if n_kv != n_heads:                             # GQA: expand kv heads
@@ -225,15 +258,23 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
 
 
 def _shard_counts(cfg: LlamaConfig, tp_axis: Optional[str]):
+    """Per-rank (n_heads, n_kv) head counts; n_kv == 0 flags kv-head
+    replication (tp > n_kv: wk/wv replicate and each rank slices ONE kv
+    head — its query group's — inside _block)."""
     n_heads, n_kv = cfg.n_heads, cfg.n_kv_heads
     if tp_axis is not None:
         tp = lax.axis_size(tp_axis)
-        if n_heads % tp or n_kv % tp:
-            raise ValueError(
-                f"tp={tp} must divide n_heads={n_heads} and "
-                f"n_kv_heads={n_kv} (kv-head replication not implemented)")
+        if n_heads % tp:
+            raise ValueError(f"tp={tp} must divide n_heads={n_heads}")
         n_heads //= tp
-        n_kv //= tp
+        if n_kv % tp == 0:
+            n_kv //= tp
+        elif tp % n_kv == 0:
+            n_kv = 0        # replicated-kv mode: 1 sliced head per rank
+        else:
+            raise ValueError(
+                f"tp={tp} must divide n_kv_heads={cfg.n_kv_heads}, or be a "
+                f"multiple of it (kv-head replication)")
     return n_heads, n_kv
 
 
@@ -261,11 +302,6 @@ def apply(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
     long-context/deep-model trade; the pipelined path has the same knob).
     """
     B, S = tokens.shape
-    if cfg.moe is not None and tp_axis is not None:
-        raise NotImplementedError(
-            "MoE + tensor parallelism is not supported: experts replicate "
-            "over tp, so the row-parallel psum would multiply the FFN "
-            "residual by n_tp (shard experts over ep instead)")
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
     pos = _positions(S, sp_axis)
 
@@ -398,48 +434,54 @@ def stack_params(params: Dict) -> Dict:
 
 
 def stacked_param_specs(cfg: LlamaConfig, pp_axis: str = "pp",
-                        tp_axis: Optional[str] = "tp") -> Dict:
+                        tp_axis: Optional[str] = "tp",
+                        ep_axis: Optional[str] = None,
+                        tp_size: Optional[int] = None) -> Dict:
     """PartitionSpecs for stack_params output: the layer stack's leading axis
-    shards over pp; within a layer, Megatron col/row over tp; embedding and
-    head replicated over pp (they run on every stage, only stage 0 / the
-    last stage contribute gradients)."""
-    def pp_spec(spec: P) -> P:
-        return P(pp_axis, *spec)
-
-    base = param_specs(cfg, tp_axis)
+    shards over pp; within a layer, Megatron col/row over tp (MoE experts
+    over ep, hidden over tp); embedding and head replicated over pp (they
+    run on every stage, only stage 0 / the last stage contribute
+    gradients)."""
+    base = param_specs(cfg, tp_axis, ep_axis, tp_size)
+    layers = jax.tree_util.tree_map(lambda spec: P(pp_axis, *spec),
+                                    base["layers"][0],
+                                    is_leaf=lambda x: isinstance(x, P))
     return {"tok_emb": base["tok_emb"], "final_norm": base["final_norm"],
-            "lm_head": base["lm_head"],
-            "layers": {k: pp_spec(v) for k, v in base["layers"][0].items()}}
+            "lm_head": base["lm_head"], "layers": layers}
 
 
 def apply_pp(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
              pp_axis: str, num_microbatches: int,
              tp_axis: Optional[str] = None,
              sp_axis: Optional[str] = None,
+             ep_axis: Optional[str] = None,
+             batch_axes=(),
+             with_aux: bool = False,
              remat: bool = False) -> jax.Array:
     """Pipelined forward; call inside shard_map with stack_params params
     sharded per ``stacked_param_specs``.  Returns logits valid on the LAST
-    pp stage only (loss_fn handles the mask; see parallel.pipeline)."""
+    pp stage only (loss_fn handles the mask; see parallel.pipeline);
+    (logits, moe_aux) when with_aux — aux rides the microbatch scan with
+    garbage ticks masked (parallel.pipeline.pipeline_apply_aux)."""
     from ..parallel import pipeline as pl
 
-    if cfg.moe is not None:
-        raise NotImplementedError("MoE layers are not supported on the "
-                                  "pipelined path yet (aux-loss carry)")
     S = tokens.shape[1]
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
     pos = _positions(S, sp_axis)
 
     def block(lyr, x):
-        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis)[0]
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
+                      ep_axis, batch_axes)
 
     def stage_fn(stacked, x):
-        return pl.scan_layers(block, stacked, x, remat=remat)
+        return pl.scan_layers_aux(block, stacked, x, remat=remat)
 
     x = params["tok_emb"][tokens]                       # [B, S, D]
-    x = pl.pipeline_apply(stage_fn, params["layers"], x,
-                          num_microbatches, pp_axis)
+    x, aux = pl.pipeline_apply_aux(stage_fn, params["layers"], x,
+                                   num_microbatches, pp_axis)
     x = _rmsnorm(x, params["final_norm"], cfg.norm_eps)
-    return x @ params["lm_head"]                        # [B, S, V/tp]
+    logits = x @ params["lm_head"]                      # [B, S, V/tp]
+    return (logits, aux) if with_aux else logits
 
 
 def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
@@ -447,24 +489,41 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
                tp_axis: Optional[str] = None,
                sp_axis: Optional[str] = None,
                dp_axis: Optional[str] = None,
+               ep_axis: Optional[str] = None,
                remat: bool = False) -> jax.Array:
     """Next-token cross-entropy through the pipeline.  Every pp stage
     computes the head on its own (mostly garbage) activations — unavoidable
     under SPMD — so the token NLL sum is psum-masked from the last stage
     before the global token-weighted reduction; gradients flow only through
-    real activations.  dp_axis as in loss_fn (masked-label weighting)."""
+    real activations.  dp_axis as in loss_fn (masked-label weighting);
+    the MoE aux loss rides the microbatch scan (apply_pp with_aux)."""
     from ..parallel import pipeline as pl
 
     tokens, labels = batch
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
-    logits = apply_pp(params, tokens, cfg, pp_axis=pp_axis,
-                      num_microbatches=num_microbatches, tp_axis=tp_axis,
-                      sp_axis=sp_axis, remat=remat)
+    batch_axes = tuple(a for a in (sp_axis, dp_axis, ep_axis)
+                       if a is not None)
+    logits, aux = apply_pp(params, tokens, cfg, pp_axis=pp_axis,
+                           num_microbatches=num_microbatches, tp_axis=tp_axis,
+                           sp_axis=sp_axis, ep_axis=ep_axis,
+                           batch_axes=batch_axes, with_aux=True, remat=remat)
+    if batch_axes:
+        # Value-preserving: the per-rank aux copies are identical over the
+        # batch axes (moe_ffn psums its statistics over them), but the
+        # pipeline scan carry leaves aux TYPED varying.  Without this
+        # pmean, adding a varying-typed scalar to the invariant ce loss
+        # makes the loss varying, and vma autodiff then seeds one cotangent
+        # per rank whose pvary-transpose psum silently multiplies every ce
+        # gradient by the axis size.
+        aux = lax.pmean(aux, batch_axes)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
     local_sum = pl.from_last_stage(jnp.sum(nll), pp_axis)
-    return _weighted_loss(local_sum, jnp.sum(valid), (sp_axis, dp_axis),
+    loss = _weighted_loss(local_sum, jnp.sum(valid), (sp_axis, dp_axis),
                           dp_axis)
+    if dp_axis is not None:     # same /n_dp cancellation as the ce term
+        aux = _grad_scale(aux, lax.axis_size(dp_axis))
+    return loss + aux
 
 
 def num_params(cfg: LlamaConfig) -> int:

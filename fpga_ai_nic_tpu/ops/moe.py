@@ -68,10 +68,19 @@ def init_ffn(key: jax.Array, dim: int, ffn_dim: int, cfg: MoEConfig,
             "w2": dense(k2, F, (E, F, D))}
 
 
-def param_specs(cfg: MoEConfig, ep_axis: Optional[str] = None) -> Dict:
-    """Experts shard over ep on their leading axis; the router replicates."""
-    e = P(ep_axis, None, None)
-    return {"wr": P(), "w1": e, "w3": e, "w2": e}
+def param_specs(cfg: MoEConfig, ep_axis: Optional[str] = None,
+                tp_axis: Optional[str] = None) -> Dict:
+    """Experts shard over ep on their leading axis; the router replicates.
+
+    With tp_axis, each expert's SwiGLU additionally Megatron-shards its
+    hidden dim over tp (w1/w3 column, w2 row) — the same col/row split the
+    dense FFN uses, applied per expert.  Every rank then computes a
+    *partial* expert output over its hidden slice, and the model's existing
+    row-parallel ``psum(tp)`` closes it; dispatch/routing run identically
+    on every tp rank (tokens are tp-replicated), so tp composes with ep
+    without touching the all_to_all."""
+    return {"wr": P(), "w1": P(ep_axis, None, tp_axis),
+            "w3": P(ep_axis, None, tp_axis), "w2": P(ep_axis, tp_axis, None)}
 
 
 def _expert_ffn(params: Dict, h: jax.Array) -> jax.Array:

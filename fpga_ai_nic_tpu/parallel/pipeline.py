@@ -63,26 +63,55 @@ def unstack_layers(stacked) -> List[Any]:
 def scan_layers(block_fn: Callable, stacked_params, x, *,
                 remat: bool = False):
     """Apply block_fn(layer_params, x) -> x over a stacked [L, ...] slice."""
-    fn = jax.checkpoint(block_fn) if remat else block_fn
+    def fn(lyr, h):
+        return block_fn(lyr, h), jnp.float32(0.0)
 
-    def body(h, lyr):
-        return fn(lyr, h), None
+    out, _ = scan_layers_aux(fn, stacked_params, x, remat=remat)
+    return out
+
+
+def scan_layers_aux(block_fn: Callable, stacked_params, x, *,
+                    remat: bool = False):
+    """Apply block_fn(layer_params, x) -> (x, aux) over a stacked [L, ...]
+    slice, summing the per-layer aux scalars (MoE load-balance loss)."""
+    fn = jax.checkpoint(block_fn) if remat else block_fn
 
     # carry must enter varying over every axis the block output varies over
     # (block_fn is assumed vma-monotone, e.g. residual-style)
-    out, _ = lax.scan(body, _pcast_to(x, _tree_vma(x, stacked_params)),
-                      stacked_params)
-    return out
+    vma = _tree_vma(x, stacked_params)
+
+    def body(carry, lyr):
+        h, acc = carry
+        h, aux = fn(lyr, h)
+        return (h, acc + aux.astype(jnp.float32)), None
+
+    (out, aux), _ = lax.scan(
+        body, (_pcast_to(x, vma), _pcast_to(jnp.float32(0.0), vma)),
+        stacked_params)
+    return out, aux
 
 
 def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
                    num_microbatches: int, pp_axis: str) -> jax.Array:
+    """`pipeline_apply_aux` for aux-free stage_fn(stage_params, mb) -> mb."""
+    out, _ = pipeline_apply_aux(
+        lambda p, mb: (stage_fn(p, mb), jnp.float32(0.0)),
+        stage_params, x, num_microbatches, pp_axis)
+    return out
+
+
+def pipeline_apply_aux(stage_fn: Callable, stage_params, x: jax.Array,
+                       num_microbatches: int, pp_axis: str):
     """Run x through the full pipeline; call inside shard_map.
 
-    stage_fn(stage_params, mb) -> mb applies this device's layer slice to one
-    microbatch.  x: [B, ...] replicated over pp, B % num_microbatches == 0.
-    Returns [B, ...] — valid ONLY on the last stage (mask with
-    `from_last_stage`).
+    stage_fn(stage_params, mb) -> (mb, aux) applies this device's layer
+    slice to one microbatch, returning an auxiliary scalar (MoE
+    load-balance loss; 0.0 for dense stacks).  x: [B, ...] replicated over
+    pp, B % num_microbatches == 0.  Returns (out [B, ...], aux scalar) —
+    out valid ONLY on the last stage (mask with `from_last_stage`); aux is
+    already pp-invariant (psum over stages) and averaged over microbatches,
+    matching the unpipelined path's one-full-batch aux up to the
+    per-microbatch routing granularity.
 
     Schedule (per tick t of num_microbatches + pp - 1):
       stage 0 injects microbatch t; every stage applies its slice; the
@@ -90,7 +119,9 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
       reference's SEND_LOCAL -> REDUCE -> FORWARD slice rotation
       (hw/all_reduce.sv:891-1086) with layers in place of partial sums.
     Ticks where a stage holds no real microbatch compute on ring garbage;
-    those results land in output slots that a later tick overwrites.
+    those results land in output slots that a later tick overwrites, and
+    their aux contributions are masked out (stage s holds real microbatch
+    t - s only when 0 <= t - s < num_microbatches).
     """
     n = lax.axis_size(pp_axis)
     stage = lax.axis_index(pp_axis)
@@ -105,23 +136,27 @@ def pipeline_apply(stage_fn: Callable, stage_params, x: jax.Array,
     vma = _tree_vma(x, stage_params) | {pp_axis}
     state = _pcast_to(jnp.zeros_like(x_mb[0]), vma)
     outputs = _pcast_to(jnp.zeros_like(x_mb), vma)
+    aux0 = _pcast_to(jnp.float32(0.0), vma)
 
     def tick(carry, t):
-        state, outputs = carry
+        state, outputs, aux_acc = carry
         inject = lax.dynamic_index_in_dim(x_mb, t % num_microbatches, 0,
                                           keepdims=False)
         cur = jnp.where(stage == 0, inject, state)
-        out = stage_fn(stage_params, cur)
+        out, aux = stage_fn(stage_params, cur)
+        real = ((t >= stage) & (t - stage < num_microbatches))
+        aux_acc = aux_acc + jnp.where(real, aux.astype(jnp.float32), 0.0)
         # Last stage finished microbatch t-(n-1); earlier ticks write garbage
         # at wrapped indices that tick t+num_microbatches overwrites.
         outputs = lax.dynamic_update_index_in_dim(
             outputs, out, (t - (n - 1)) % num_microbatches, 0)
         state = lax.ppermute(out, pp_axis, perm)
-        return (state, outputs), None
+        return (state, outputs, aux_acc), None
 
     ticks = jnp.arange(num_microbatches + n - 1)
-    (_, outputs), _ = lax.scan(tick, (state, outputs), ticks)
-    return outputs.reshape(x.shape)
+    (_, outputs, aux_acc), _ = lax.scan(tick, (state, outputs, aux0), ticks)
+    aux = lax.psum(aux_acc, pp_axis) / num_microbatches
+    return outputs.reshape(x.shape), aux
 
 
 def cost_model(num_microbatches: int, pp: int) -> dict:

@@ -137,6 +137,64 @@ def test_sharded_training_matches_unsharded(dp, tp, sp):
             err_msg=str(path_want[0]))
 
 
+def test_kv_replicated_tp_matches_unsharded():
+    """tp > n_kv_heads (tp=4, n_kv=2): wk/wv replicate over tp, each rank
+    slices its query group's kv head, and the tied-replica gradient (vma
+    psum) must still reproduce the single-device update exactly."""
+    rng = np.random.default_rng(0)
+    toks, labels = _batch(rng)
+    params0 = llama.init(jax.random.PRNGKey(0), CFG)   # n_heads=4, n_kv=2
+
+    def ref_step(params):
+        g = jax.grad(lambda p: llama.loss_fn(p, (toks, labels), CFG))(params)
+        return jax.tree_util.tree_map(
+            lambda w, gg: (w.astype(jnp.float32)
+                           - 0.1 * gg.astype(jnp.float32)).astype(w.dtype),
+            params, g)
+
+    want = ref_step(ref_step(params0))
+
+    dp, tp = 2, 4
+    mesh = make_mesh(MeshConfig(dp=dp, tp=tp))
+    mesh = Mesh(np.asarray(mesh.devices).reshape(dp, tp, 1),
+                ("dp", "tp", "sp"))
+    cfg = TrainConfig(iters=2, global_batch=B,
+                      mesh=MeshConfig(dp=dp, tp=tp),
+                      collective=CollectiveConfig(impl="xla"),
+                      optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1))
+    specs = llama.param_specs(CFG, tp_size=tp)
+    # the specs must actually replicate kv (this test exists for that mode)
+    assert specs["layers"][0]["wk"] == jax.sharding.PartitionSpec()
+    tr = ShardedTrainer(
+        lambda p, b: llama.loss_fn(p, b, CFG, tp_axis="tp"),
+        mesh, cfg, specs)
+    state = tr.init_state(llama.init(jax.random.PRNGKey(0), CFG))
+    batch = tr.shard_batch((toks, labels))
+    for _ in range(2):
+        state, loss = tr.step(state, batch)
+    assert np.isfinite(float(loss))
+    for pw, pg in zip(jax.tree_util.tree_leaves_with_path(want),
+                      jax.tree_util.tree_leaves_with_path(state.params)):
+        np.testing.assert_allclose(
+            np.asarray(pg[1], np.float32), np.asarray(pw[1], np.float32),
+            rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
+
+
+def test_kv_replication_rejects_non_multiple():
+    """tp that neither divides n_kv nor is a multiple of it must still
+    raise (tp=3 with n_kv=2 has no aligned query grouping)."""
+    mesh = Mesh(np.asarray(jax.devices()[:6]).reshape(6,), ("tp",))
+    toks = jnp.zeros((2, 8), jnp.int32)
+    params = llama.init(jax.random.PRNGKey(0), llama.LlamaConfig.tiny(
+        n_heads=6, n_kv_heads=4))
+    with pytest.raises(ValueError, match="multiple"):
+        jax.jit(jax.shard_map(
+            lambda p, t: llama.apply(p, t, llama.LlamaConfig.tiny(
+                n_heads=6, n_kv_heads=4), tp_axis="tp"),
+            mesh=mesh, in_specs=(P(), P()), out_specs=P(),
+            check_vma=False))(params, toks)
+
+
 def test_rope_scaling_parity_and_bands(rng):
     """rope_scaling=1.0 is exactly the unscaled path; with scaling on, the
     lowest frequencies stretch by 1/factor, the highest band is untouched,

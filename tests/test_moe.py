@@ -136,6 +136,56 @@ def test_moe_llama_training_matches_unsharded(dp, ep):
             rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
 
 
+@pytest.mark.parametrize("dp,tp,ep", [(1, 4, 1), (2, 2, 2)])
+def test_moe_tp_training_matches_unsharded(dp, tp, ep):
+    """MoE x tp (x ep): each expert's SwiGLU hidden Megatron-shards over tp
+    and the model's row-parallel psum closes the partial sums — training
+    must reproduce the single-device update (the composition the round-2
+    review flagged as a raise)."""
+    cfg_m = dataclasses.replace(
+        llama.LlamaConfig.tiny(n_layers=2, ffn_dim=64),
+        moe_experts=4, moe_top_k=2, moe_capacity_factor=16.0)
+    B, S = 8, 16
+    rng = np.random.default_rng(0)
+    toks = rng.integers(0, cfg_m.vocab, (B, S + 1)).astype(np.int32)
+    batch = (jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:]))
+    params0 = llama.init(jax.random.PRNGKey(0), cfg_m)
+
+    def ref_step(params):
+        g = jax.grad(lambda p: llama.loss_fn(p, batch, cfg_m))(params)
+        return jax.tree_util.tree_map(
+            lambda w, gg: (w.astype(jnp.float32)
+                           - 0.1 * gg.astype(jnp.float32)).astype(w.dtype),
+            params, g)
+
+    want = ref_step(ref_step(params0))
+
+    ep_ax = "ep" if ep > 1 else None
+    dp_ax = "dp" if dp > 1 else None
+    mesh = Mesh(np.array(jax.devices()[:dp * tp * ep]).reshape(dp, tp, 1, ep),
+                ("dp", "tp", "sp", "ep"))
+    cfg = TrainConfig(iters=2, global_batch=B,
+                      mesh=MeshConfig(dp=dp, tp=tp, ep=ep),
+                      collective=CollectiveConfig(impl="xla"),
+                      optimizer=OptimizerConfig(kind="sgd", learning_rate=0.1))
+    tr = ShardedTrainer(
+        lambda p, b: llama.loss_fn(p, b, cfg_m, tp_axis="tp", dp_axis=dp_ax,
+                                   ep_axis=ep_ax),
+        mesh, cfg,
+        llama.param_specs(cfg_m, tp_axis="tp", ep_axis=ep_ax, tp_size=tp),
+        ep_axis=ep_ax)
+    state = tr.init_state(llama.init(jax.random.PRNGKey(0), cfg_m))
+    sb = tr.shard_batch(batch)
+    for _ in range(2):
+        state, loss = tr.step(state, sb)
+    assert np.isfinite(float(loss))
+    for pw, pg in zip(jax.tree_util.tree_leaves_with_path(want),
+                      jax.tree_util.tree_leaves_with_path(state.params)):
+        np.testing.assert_allclose(
+            np.asarray(pg[1], np.float32), np.asarray(pw[1], np.float32),
+            rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
+
+
 # -- expert-utilization observability ----------------------------------------
 
 def test_expert_stats_accounting(rng):
