@@ -24,6 +24,7 @@ blocks (hw/all_reduce.sv:101-103,246-253).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, NamedTuple, Tuple
 
 import jax
@@ -111,6 +112,45 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
     return ring_ops.ring_all_gather(owned, axis_name,
                                     compression=coll.compression,
                                     unroll=coll.unroll_hops)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))
+def all_gather_flat_vjp(owned: jax.Array, axis_name: str,
+                        coll: CollectiveConfig) -> jax.Array:
+    """`all_gather_flat` with an explicit VJP: differentiable ring/BFP path.
+
+    ZeRO-3's gather-on-use sits INSIDE autodiff, where the explicit ring is
+    a dead end for jax's automatic transpose: the rolled ppermute fori_loop
+    has no reverse-mode rule and the BFP codec's int8 casts have no
+    gradient.  But the *mathematical* transpose of an all-gather is simply
+    the reduce-scatter — so this custom VJP declares it directly:
+
+      forward:  ring all-gather of the (optionally BFP-encoded-once)
+                master shards — replicas see wire-identical quantized bytes
+                (hw/bfp_adapter.sv compressing the weight-output stream,
+                hw/all_reduce.sv FORWARD_OUTPUT:996-1086);
+      backward: the per-hop-compressed ring reduce-scatter of the full
+                gradient cotangent (the adapter on the gradient stream).
+
+    Quantized-forward semantics: with compression, the loss/grad are
+    evaluated at the BFP-rounded parameters while the optimizer updates the
+    exact f32 master — straight-through estimation, the same contract as
+    the ZeRO-1 trainers' compressed weight gather.
+    """
+    return all_gather_flat(owned, axis_name, coll)
+
+
+def _gather_vjp_fwd(owned, axis_name, coll):
+    return all_gather_flat(owned, axis_name, coll), None
+
+
+def _gather_vjp_bwd(axis_name, coll, _res, ct):
+    return (ring_ops.ring_reduce_scatter(
+        ct, axis_name, compression=coll.compression,
+        slice_elems=coll.slice_elems, unroll=coll.unroll_hops),)
+
+
+all_gather_flat_vjp.defvjp(_gather_vjp_fwd, _gather_vjp_bwd)
 
 
 def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):

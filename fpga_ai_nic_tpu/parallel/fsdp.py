@@ -66,17 +66,6 @@ class FSDPTrainer:
 
     def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
                  axis_name: str = "fsdp"):
-        if cfg.collective.impl != "xla":
-            # The on-use gather sits INSIDE autodiff (its transpose is the
-            # gradient reduce-scatter); the explicit ring is built from a
-            # rolled fori_loop (no reverse-mode rule) and the BFP codec's
-            # int8 casts have no gradient. The ring/BFP wire path belongs to
-            # the ZeRO-1 trainers, whose collectives run outside autodiff.
-            raise ValueError(
-                "FSDPTrainer requires collective.impl='xla'; the ring/BFP "
-                "path applies to the ZeRO-1 trainers (parallel.train/"
-                "parallel.sharded) where the collective is not "
-                "differentiated through")
         self.loss_fn = loss_fn
         self.mesh = mesh
         self.cfg = cfg
@@ -127,9 +116,16 @@ class FSDPTrainer:
 
         def shard_step(w_own, opt_state, step, batch):
             def shard_loss(w_own):
-                # all-gather-on-use; its autodiff transpose is the
-                # reduce-scatter that lands gradients on the owning shard
-                flat = fused_update.all_gather_flat(w_own, ax, coll)
+                # all-gather-on-use; its transpose is the reduce-scatter
+                # that lands gradients on the owning shard.  impl="xla"
+                # relies on jax's automatic all_gather transpose; the
+                # explicit ring (and the BFP wire format with it) needs the
+                # declared VJP — forward gathers (possibly quantized)
+                # masters, backward is the per-hop-compressed ring
+                # reduce-scatter (ops.fused_update.all_gather_flat_vjp).
+                gather = (fused_update.all_gather_flat if coll.impl == "xla"
+                          else fused_update.all_gather_flat_vjp)
+                flat = gather(w_own, ax, coll)
                 params = fused_update.unflatten_tree(flat, meta)
                 return accum.accumulated_loss(
                     self.loss_fn, self.cfg.accum_steps)(params, batch)

@@ -86,13 +86,60 @@ def test_fsdp_state_is_sharded_only(rng):
         got, params)
 
 
-def test_fsdp_rejects_ring_impl():
+def test_fsdp_ring_impl_matches_xla(rng):
+    """impl='ring' (uncompressed) through the custom-VJP gather must track
+    the XLA-collective path: same math, only hop/add schedule differs."""
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    batch_host = _batch(rng)
     mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
                 ("dp", "fsdp", "tp", "sp", "pp", "ep"))
-    with pytest.raises(ValueError, match="impl='xla'"):
-        FSDPTrainer(_loss, mesh,
-                    _cfg(mesh=MeshConfig(fsdp=N),
-                         collective=CollectiveConfig(impl="ring")))
+    tr_x = FSDPTrainer(_loss, mesh, _cfg(mesh=MeshConfig(fsdp=N)))
+    tr_r = FSDPTrainer(_loss, mesh,
+                       _cfg(mesh=MeshConfig(fsdp=N),
+                            collective=CollectiveConfig(impl="ring")))
+    st_x, st_r = tr_x.init_state(params), tr_r.init_state(params)
+    for _ in range(4):
+        st_x, lx = tr_x.step(st_x, tr_x.shard_batch(batch_host))
+        st_r, lr = tr_r.step(st_r, tr_r.shard_batch(batch_host))
+        np.testing.assert_allclose(float(lr), float(lx), rtol=1e-4)
+    np.testing.assert_allclose(np.asarray(st_r.w_own), np.asarray(st_x.w_own),
+                               rtol=1e-4, atol=1e-6)
+
+
+def test_fsdp_bfp_quantized_forward_semantics(rng):
+    """ZeRO-3 with the BFP wire format (the round-2 review's missing
+    composition): the first-step loss must equal the loss at the
+    BFP-roundtripped parameters exactly — the gather distributes quantized
+    bytes while the master stays f32 — and training must still descend
+    through the compressed-cotangent backward ring."""
+    from fpga_ai_nic_tpu.ops import bfp, fused_update
+    from fpga_ai_nic_tpu.utils.config import BFPConfig
+    params = mlp.init(jax.random.PRNGKey(0), MCFG)
+    batch_host = _batch(rng)
+    comp = BFPConfig()                              # the reference's m8
+    coll = CollectiveConfig(impl="ring", compression=comp)
+    mesh = Mesh(np.array(jax.devices()[:N]).reshape(1, N, 1, 1, 1, 1),
+                ("dp", "fsdp", "tp", "sp", "pp", "ep"))
+    tr = FSDPTrainer(_loss, mesh, _cfg(mesh=MeshConfig(fsdp=N),
+                                       collective=coll))
+    st = tr.init_state(params)
+
+    # expected first loss: quantize the padded flat vector with the same
+    # block partition the per-chunk wire encode uses (chunk length is a
+    # block multiple, so partitions coincide)
+    flat, meta = fused_update.flatten_tree(params, coll, N)
+    mant, se = bfp.bfp_encode(flat, comp.block_size, comp.mantissa_bits,
+                              comp.rounding)
+    qparams = fused_update.unflatten_tree(
+        bfp.bfp_decode(mant, se, comp.block_size, jnp.float32), meta)
+    want = float(mlp.loss_fn(qparams, batch_host, MCFG))
+
+    losses = []
+    for _ in range(4):
+        st, loss = tr.step(st, tr.shard_batch(batch_host))
+        losses.append(float(loss))
+    np.testing.assert_allclose(losses[0], want, rtol=1e-6)
+    assert losses[-1] < losses[0], losses
 
 
 def test_fsdp_grad_accumulation(rng):
