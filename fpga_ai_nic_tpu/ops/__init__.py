@@ -1,2 +1,2 @@
 from . import (bfp, bfp_golden, bfp_pallas, bucketed, fused_update, moe,
-               ring, ring_attention, ring_golden)  # noqa: F401
+               ring, ring_attention, ring_golden, ring_pallas)  # noqa: F401

@@ -1,0 +1,314 @@
+"""Fused BFP-compressed ring reduce-scatter — ONE Pallas kernel.
+
+The reference's bfp_adapter sits *inside* the wire datapath: the engine
+streams 512b groups through compress -> Ethernet -> decompress without ever
+materializing the compressed frame in host-visible memory
+(hw/bfp_adapter.sv:33-741 between hw/all_reduce.sv's engine and the IKL
+shell).  `ops.ring` approximates that with separate XLA ops (encode /
+ppermute / decode) and leaves the overlap to XLA's scheduler; THIS module
+is the real analogue: a single kernel that, per 32 KiB-class slice,
+
+    encodes slice g+1 into a send buffer        (VPU compute)
+  while
+    slice g's RDMA is in flight on the ICI      (DMA engine)
+  then
+    decodes + accumulates the received slice    (VPU compute)
+
+double-buffered over two comm slots with explicit credit-based flow
+control — the same producer/consumer discipline the reference implements
+with its dual-clock FIFOs and valid/ready handshakes (hw/fifo.v,
+hw/bfp_adapter.sv:57-98).
+
+Wire format: one int8 frame per slice packing `R` mantissa rows followed
+by `R/B` shared-exponent rows (B = block_size) — byte-for-byte the rate of
+the reference's 17-flit frame (16 mantissa flits : 1 exponent flit,
+hw/bfp_adapter.sv:30,63-77), so one RDMA moves the whole compressed slice.
+
+Numerics are bit-identical to `ops.ring.ring_reduce_scatter` with
+codec="pallas" and the same slice_elems (same add order, same per-hop
+lane-layout quantization): slicing and fusion change the schedule, never
+the bits (tests/test_ring_pallas.py enforces this on the CPU interpreter).
+
+Residency: the full per-device vector lives in VMEM scratch for the
+duration of the kernel (acc buffer) — right for collective payloads up to
+a few MiB per device (the reference's own streaming granularity is 32 KiB
+slices of multi-MiB gradients).  Larger payloads should fall back to
+`ops.ring`'s XLA path, which streams from HBM.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .bfp_pallas import LANES, _is_tpu
+from ..utils.config import BFPConfig
+
+
+def _encode_rows(x, block_size: int, mantissa_bits: int, rounding: str):
+    """(R, 128) f32 -> ((R, 128) int8 mantissas, (R/B, 128) int8 scales).
+    Register-level port of bfp_pallas._encode_kernel (the bit spec is
+    bfp_golden layout="sublane"; hw/bf16_to_bfp_core.sv:30-132)."""
+    R = x.shape[0]
+    T = R // block_size
+    bits = pltpu.bitcast(x, jnp.uint32)
+    e = jnp.right_shift(bits, 23).astype(jnp.int32) & 0xFF
+    emax = jnp.max(e.reshape(T, block_size, LANES), axis=1)
+    scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
+    inv = pltpu.bitcast(((127 - scale_e) << 23).astype(jnp.uint32),
+                        jnp.float32)                 # 2.0**-scale_e, exact
+    q = x * jnp.repeat(inv, block_size, axis=0)
+    q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
+    lim = float(2 ** (mantissa_bits - 1) - 1)
+    return (jnp.clip(q, -lim, lim).astype(jnp.int8),
+            scale_e.astype(jnp.int8))
+
+
+def _decode_rows(mant, scale, block_size: int):
+    """Inverse of _encode_rows (hw/bfp_to_bf16_core.sv:30-125)."""
+    se = scale.astype(jnp.int32)
+    s = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
+    return mant.astype(jnp.float32) * jnp.repeat(s, block_size, axis=0)
+
+
+def _rs_kernel(x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem, recv_sem,
+               credit_sem, *, axis_name: str, n: int, n_slices: int,
+               slice_rows: int, block_size: int, mantissa_bits: int,
+               rounding: str, flow_control: bool):
+    """The whole sliced ring reduce-scatter, one kernel invocation.
+
+    acc:       (L_rows, 128) f32 — running partials (starts as x)
+    send_pkt:  (2, R + R/B, 128) int8 — packed frames, double-buffered
+    recv_pkt:  (2, R + R/B, 128) int8
+    send/recv_sem: DMA (2,) — one per comm slot
+    credit_sem: REGULAR — downstream-consumed-slot credits (flow control)
+    """
+    if axis_name is None:            # single-chip loopback microbench mode
+        idx = jnp.int32(0)
+        right = left = jnp.int32(0)
+    else:
+        idx = lax.axis_index(axis_name)
+        right = (idx + 1) % n        # we send downstream (IKL ring order,
+        left = (idx - 1) % n         # sw/setup_route.sh:12-40)
+    S = n_slices
+    R = slice_rows
+    SB = R // block_size             # scale rows per slice
+    chunk_rows = S * R
+    total = (n - 1) * S              # global send/consume count
+
+    acc[:] = x_ref[:]
+
+    def rdma(g):
+        slot = g % 2
+        return pltpu.make_async_remote_copy(
+            src_ref=send_pkt.at[slot], dst_ref=recv_pkt.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def encode_to_slot(g):
+        s, k = g // S, g % S
+        chunk = (idx - s - 1) % n    # hop s sends partial chunk idx-s-1
+        off = chunk * chunk_rows + k * R
+        mant, scale = _encode_rows(acc[pl.ds(off, R)], block_size,
+                                   mantissa_bits, rounding)
+        slot = g % 2
+        send_pkt[slot, pl.ds(0, R)] = mant
+        send_pkt[slot, pl.ds(R, SB)] = scale
+
+    # all devices must have entered the kernel before the first RDMA lands
+    # in a neighbor's scratch (the analogue of ikl_setup's reset barrier,
+    # sw/mlp_mpi_example_f32.cpp:50-63).  flow_control=False only under
+    # the CPU interpreter, whose emulation executes the lockstep program
+    # without real concurrency (and does not implement remote semaphore
+    # signal); on hardware the barrier + credits are always on.
+    if flow_control:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    # prologue: slice 0 has no in-flight RDMA to overlap with
+    encode_to_slot(0)
+    rdma(0).start()
+
+    def launch(q):
+        # launch send q while RDMA q-1 is in flight — the encode/wire
+        # overlap the reference gets by pipelining compress into the
+        # egress path
+        @pl.when(q < total)
+        def _launch():
+            @pl.when(q >= 2)
+            def _reuse():                 # slot q%2 was used by RDMA q-2:
+                rdma(q - 2).wait_send()   # source buffer must be drained
+            encode_to_slot(q)
+
+            if flow_control:
+                @pl.when(q >= 2)
+                def _credit():            # destination slot safety: the
+                    pltpu.semaphore_wait(credit_sem, 1)  # recvr freed q-2
+            rdma(q).start()
+
+    def consume(g):
+        # decode slice g + accumulate into the chunk this hop owns
+        rdma(g).wait_recv()
+        s, k = g // S, g % S
+        slot = g % 2
+        chunk = (idx - s - 2) % n
+        off = chunk * chunk_rows + k * R
+        dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                           recv_pkt[slot, pl.ds(R, SB)], block_size)
+        acc[pl.ds(off, R)] = acc[pl.ds(off, R)] + dec
+        if flow_control:
+            # free the slot for our upstream sender
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    # Send q's source chunk is finalized by consume q-S (hop s reads what
+    # hop s-1 accumulated into the same slice index).  With S >= 2 slices
+    # per chunk the launch-ahead at iteration g = q-1 is safe (q-S <= g-1
+    # already consumed) and buys the encode/RDMA overlap; at S == 1 the
+    # dependency is the CURRENT iteration's consume, so order flips —
+    # single-slice hops cannot pipeline across the hop boundary (the
+    # reference has the same serialization: a slice is forwarded only
+    # after it is reduced, hw/all_reduce.sv REDUCE->FORWARD).
+    if S >= 2:
+        def step(g, _):
+            launch(g + 1)
+            consume(g)
+            return 0
+    else:
+        def step(g, _):
+            consume(g)
+            launch(g + 1)
+            return 0
+
+    lax.fori_loop(0, total, step, 0)
+
+    # drain: the last two sends' source-buffer semaphores, and the two
+    # residual credits our receiver signaled but no later send consumed
+    rdma(total - 1).wait_send()
+
+    @pl.when(total >= 2)
+    def _drain_prev():
+        rdma(total - 2).wait_send()
+    if flow_control:
+        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+
+    out_ref[:] = acc[pl.ds(idx * chunk_rows, chunk_rows)]
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
+    "interpret", "collective_id", "loopback_n"))
+def _rs_call(x2, axis_name: Optional[str], block_size: int,
+             mantissa_bits: int, rounding: str, slice_elems: int,
+             interpret: bool, collective_id: int,
+             loopback_n: Optional[int] = None):
+    n = loopback_n if axis_name is None else lax.axis_size(axis_name)
+    L_rows = x2.shape[0]
+    chunk_rows = L_rows // n
+    R = slice_elems // LANES
+    S = chunk_rows // R
+    pkt_rows = R + R // block_size
+    kern = functools.partial(
+        _rs_kernel, axis_name=axis_name, n=n, n_slices=S, slice_rows=R,
+        block_size=block_size, mantissa_bits=mantissa_bits,
+        rounding=rounding, flow_control=not interpret)
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((chunk_rows, LANES), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((L_rows, LANES), jnp.float32),      # acc
+            pltpu.VMEM((2, pkt_rows, LANES), jnp.int8),    # send frames
+            pltpu.VMEM((2, pkt_rows, LANES), jnp.int8),    # recv frames
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=interpret,
+    )(x2)
+
+
+def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
+                              compression: Optional[BFPConfig] = None,
+                              slice_elems: int = 8192,
+                              interpret: Optional[bool] = None,
+                              collective_id: int = 7) -> jax.Array:
+    """Fused compress-into-hop ring reduce-scatter of a flat f32 [L].
+
+    Drop-in for `ops.ring.ring_reduce_scatter(..., codec="pallas")` where
+    the payload meets the tiling constraints below; bit-identical result.
+
+    Constraints (assert, don't silently repartition — changing the block
+    partition would change the bits):
+      - L % n == 0, chunk C = L/n
+      - C % slice_elems == 0, slice_elems % (block_size * 128) == 0
+    """
+    cfg = compression or BFPConfig()
+    n = lax.axis_size(axis_name)
+    L = x.shape[0]
+    if interpret is None:
+        interpret = not _is_tpu()
+    assert L % n == 0, (L, n)
+    C = L // n
+    if C % slice_elems or slice_elems % (cfg.block_size * LANES):
+        raise ValueError(
+            f"fused ring needs chunk {C} % slice_elems {slice_elems} == 0 "
+            f"and slice_elems % {cfg.block_size * LANES} == 0")
+    if n == 1:
+        return x
+    x2 = x.astype(jnp.float32).reshape(-1, LANES)
+    out = _rs_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+                   cfg.rounding, slice_elems, interpret, collective_id)
+    return out.reshape(C)
+
+
+def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
+                        compression: Optional[BFPConfig] = None,
+                        slice_elems: int = 8192,
+                        interpret: Optional[bool] = None) -> jax.Array:
+    """Single-chip exercise of the fused pipeline: the same kernel with
+    every RDMA addressed to this device (virtual ring of `virtual_n`).
+
+    The numerics are a self-accumulation (not a real reduce-scatter), but
+    the DATAFLOW — encode slice g+1 on the VPU while slice g's DMA is in
+    flight, decode+accumulate on arrival, credit flow control — is
+    identical, so its sustained GB/s bounds the compressed ring's per-hop
+    rate on real multi-chip ICI (where the DMA engine drives the
+    interconnect instead of a local loopback).  This exists because the
+    bench surface has ONE chip (BASELINE.md); the multi-chip bit-exactness
+    story runs on the CPU interpreter (tests/test_ring_pallas.py).
+    """
+    cfg = compression or BFPConfig()
+    if interpret is None:
+        interpret = not _is_tpu()
+    L = x.shape[0]
+    assert L % virtual_n == 0, (L, virtual_n)
+    C = L // virtual_n
+    if C % slice_elems or slice_elems % (cfg.block_size * LANES):
+        raise ValueError((C, slice_elems, cfg.block_size * LANES))
+    x2 = x.astype(jnp.float32).reshape(-1, LANES)
+    # the LOGICAL device-id space needs a mesh axis to resolve against,
+    # even for self-addressed copies: run under a 1-device shard_map
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+    mesh = Mesh(np.array(jax.devices()[:1]), ("lb",))
+    out = jax.shard_map(
+        lambda v: _rs_call(v, None, cfg.block_size, cfg.mantissa_bits,
+                           cfg.rounding, slice_elems, interpret, 7,
+                           loopback_n=virtual_n),
+        mesh=mesh, in_specs=PartitionSpec(), out_specs=PartitionSpec(),
+        check_vma=False)(x2)
+    return out.reshape(C)
