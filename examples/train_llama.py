@@ -50,6 +50,7 @@ def main(argv):
     seq = 64
     n_mb = 1
     remat = False
+    data_path = None
     rest = []
     for a in argv:
         if a.startswith("--seq="):
@@ -58,6 +59,8 @@ def main(argv):
             n_mb = int(a.partition("=")[2])
         elif a.startswith("--remat="):
             remat = coerce_value(bool, a.partition("=")[2])
+        elif a.startswith("--data="):
+            data_path = a.partition("=")[2]   # text file or dir of *.txt
         elif not a.startswith("--model."):
             rest.append(a)
     # tiny() defaults overlaid with --model.* flags (from_flags builds via
@@ -100,14 +103,27 @@ def main(argv):
 
     B = cfg.global_batch
 
-    def make_batch(r):
-        toks = r.integers(0, mcfg.vocab, (B, seq + 1)).astype(np.int32)
-        return jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
+    if data_path:
+        # real text: byte-level tokenizer (self-contained; swap in
+        # text.HFTokenizer(path) for a locally-cached BPE vocab)
+        from fpga_ai_nic_tpu import text
+        tok = text.ByteTokenizer()
+        assert mcfg.vocab >= tok.vocab_size, (
+            f"--model.vocab={mcfg.vocab} < tokenizer vocab "
+            f"{tok.vocab_size}")
+        import itertools
+        stream = itertools.islice(
+            text.lm_batches(data_path, tok, batch_size=B, seq_len=seq,
+                            seed=cfg.seed, epochs=None),
+            cfg.iters + 1)   # +1: first batch is the compile/warmup step
+    else:
+        def make_batch(r):
+            toks = r.integers(0, mcfg.vocab, (B, seq + 1)).astype(np.int32)
+            return jnp.asarray(toks[:, :-1]), jnp.asarray(toks[:, 1:])
 
-    loader = data.ShardedLoader(
-        data.synthetic_batches(make_batch, seed=cfg.seed,
-                               num_batches=cfg.iters + 1),
-        mesh, tr.batch_spec, prefetch=2)
+        stream = data.synthetic_batches(make_batch, seed=cfg.seed,
+                                        num_batches=cfg.iters + 1)
+    loader = data.ShardedLoader(stream, mesh, tr.batch_spec, prefetch=2)
 
     losses = []
     t0 = None

@@ -1,0 +1,186 @@
+"""Real-data text pipeline: tokenizer -> packed LM windows -> ShardedLoader.
+
+The reference trains on host-random synthetic activations scattered once at
+startup (sw/mlp_mpi_example_f32.cpp:414-424,452-460); its benchmark needs no
+dataset.  A framework does: this module turns raw text (strings or files)
+into the fixed-shape (tokens, labels) batches every Llama trainer in
+`parallel/` consumes, streaming through `data.ShardedLoader` so host->HBM
+copies overlap compute.
+
+TPU-first choices:
+- **Static shapes.** Documents are packed into fixed [seq_len] windows
+  (concatenate with EOS separators, no padding inside a window), so every
+  batch compiles once; ragged/padded per-document batches would recompile
+  or waste MXU cycles on pad tokens.
+- **Globally-shifted labels.** labels[i] = tokens[i+1] is computed at pack
+  time, BEFORE any sequence sharding — the shift crosses sequence-shard
+  boundaries, which is exactly the contract `models.llama.loss_fn`
+  documents for sp meshes.  Cross-document positions are masked with -100
+  (the loss's ignore value) so a token never predicts across an EOS.
+- **No downloads.** The built-in tokenizer is byte-level (vocab = 256
+  bytes + specials): self-contained, reversible, language-agnostic — the
+  zero-egress environment cannot fetch BPE vocabularies.  Anything with
+  ``encode/decode/vocab_size`` (e.g. a locally-cached HuggingFace
+  tokenizer via `HFTokenizer`) plugs into the same pipeline.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterable, Iterator, List, Optional, Sequence, Union
+
+import numpy as np
+
+
+@dataclass(frozen=True)
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: ids 0..255 are raw bytes, then
+    pad/bos/eos.  vocab_size is 259; size the model's vocab to any value
+    >= this (round up to a multiple of 128 to keep the lm_head/embedding
+    lane-aligned on TPU)."""
+
+    pad_id: int = 256
+    bos_id: int = 257
+    eos_id: int = 258
+
+    @property
+    def vocab_size(self) -> int:
+        return 259
+
+    def encode(self, text: str) -> List[int]:
+        return list(text.encode("utf-8"))
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return bytes(i for i in ids if i < 256).decode("utf-8",
+                                                       errors="replace")
+
+
+class HFTokenizer:
+    """Adapter for a locally-available HuggingFace tokenizer (no downloads:
+    pass a filesystem path; raises if the files are not already on disk)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+        self._tok = AutoTokenizer.from_pretrained(path,
+                                                  local_files_only=True)
+        self.eos_id = self._tok.eos_token_id
+        if self.eos_id is None:      # e.g. bert-style: no eos; sep works
+            self.eos_id = self._tok.sep_token_id
+        if self.eos_id is None:
+            raise ValueError(f"tokenizer at {path} has neither eos nor sep "
+                             "token; LM packing needs a document separator")
+        bos, pad = self._tok.bos_token_id, self._tok.pad_token_id
+        self.bos_id = bos if bos is not None else self.eos_id
+        self.pad_id = pad if pad is not None else self.eos_id
+
+    @property
+    def vocab_size(self) -> int:
+        return len(self._tok)
+
+    def encode(self, text: str) -> List[int]:
+        return self._tok.encode(text, add_special_tokens=False)
+
+    def decode(self, ids: Sequence[int]) -> str:
+        return self._tok.decode(ids)
+
+
+def _iter_texts(source: Union[str, Iterable[str]]) -> Iterator[str]:
+    """Yield documents: an iterable of strings, a text-file path (one doc
+    per blank-line-separated block), or a directory of *.txt files."""
+    if isinstance(source, str):
+        if os.path.isdir(source):
+            for name in sorted(os.listdir(source)):
+                if name.endswith(".txt"):
+                    yield from _iter_texts(os.path.join(source, name))
+            return
+        with open(source, encoding="utf-8") as f:
+            block: List[str] = []
+            for line in f:
+                if line.strip():
+                    block.append(line)
+                elif block:
+                    yield "".join(block)
+                    block = []
+            if block:
+                yield "".join(block)
+        return
+    yield from source
+
+
+def pack_windows(source: Union[str, Iterable[str]], tokenizer,
+                 seq_len: int, *, epochs: Optional[int] = 1,
+                 ) -> Iterator[np.ndarray]:
+    """Tokenize documents and pack them into fixed [seq_len + 1] int32
+    windows: [bos] doc [eos] doc [eos] ... concatenated, no padding (the
+    final partial window is dropped — static shapes).
+
+    Yields windows w; a training pair is (w[:-1], labels(w[1:])) — built
+    with boundary masking by `lm_batches`.  The token buffer carries over
+    between epochs, so a corpus smaller than one window still fills
+    windows over repeated epochs instead of stalling; a corpus that yields
+    no documents at all raises."""
+    buf: List[int] = [tokenizer.bos_id]
+    off = 0
+    e = 0
+    while epochs is None or e < epochs:
+        any_doc = False
+        for doc in _iter_texts(source):
+            any_doc = True
+            buf.extend(tokenizer.encode(doc))
+            buf.append(tokenizer.eos_id)
+            # window off the buffer via a read offset (re-slicing the tail
+            # per window would be quadratic in document length), overlap
+            # by one token so every next-token target exists
+            while len(buf) - off >= seq_len + 1:
+                yield np.asarray(buf[off:off + seq_len + 1], np.int32)
+                off += seq_len
+            if off:
+                buf = buf[off:]
+                off = 0
+        if not any_doc:
+            raise ValueError("empty corpus: source yielded no documents")
+        e += 1
+
+
+def lm_batches(source: Union[str, Iterable[str]], tokenizer, *,
+               batch_size: int, seq_len: int, seed: int = 0,
+               shuffle_buffer: int = 256, epochs: Optional[int] = 1,
+               mask_boundaries: bool = True) -> Iterator[tuple]:
+    """(tokens [B, S], labels [B, S]) int32 batches for the Llama trainers
+    (feed through ``data.ShardedLoader(stream, mesh, tr.batch_spec)``).
+
+    Window-level shuffling with a bounded reservoir (documents stream;
+    nothing is materialized beyond shuffle_buffer windows)."""
+    rng = np.random.default_rng(seed)
+    eos = tokenizer.eos_id
+
+    def pairs():
+        for w in pack_windows(source, tokenizer, seq_len, epochs=epochs):
+            toks, labels = w[:-1], w[1:].copy()
+            if mask_boundaries:
+                # a target that STARTS a new document (its predecessor in
+                # the stream is eos) carries no signal from this context
+                labels[toks == eos] = -100
+            yield toks, labels
+
+    buf: List[tuple] = []
+    batch: List[tuple] = []
+    for p in pairs():
+        if len(buf) < shuffle_buffer:
+            buf.append(p)
+            continue
+        j = int(rng.integers(len(buf)))
+        buf[j], p = p, buf[j]
+        batch.append(p)
+        if len(batch) == batch_size:
+            yield (np.stack([t for t, _ in batch]),
+                   np.stack([l for _, l in batch]))
+            batch = []
+    rng.shuffle(buf)
+    for p in buf:
+        batch.append(p)
+        if len(batch) == batch_size:
+            yield (np.stack([t for t, _ in batch]),
+                   np.stack([l for _, l in batch]))
+            batch = []
