@@ -69,15 +69,18 @@ def _grid(n_tiles: int, block_size: int, tiles_per_step: int):
     return t, n_tiles // t
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "block_size", "mantissa_bits", "rounding", "interpret", "tiles_per_step"))
-def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
-               rounding: str = "nearest", interpret: Optional[bool] = None,
-               tiles_per_step: int = _DEF_TILES
-               ) -> Tuple[jax.Array, jax.Array]:
+def bfp_encode_inline(x: jax.Array, block_size: int = 16,
+                      mantissa_bits: int = 8, rounding: str = "nearest",
+                      interpret: Optional[bool] = None,
+                      tiles_per_step: int = _DEF_TILES
+                      ) -> Tuple[jax.Array, jax.Array]:
     """Flat f32/bf16 [N] (N % (block*128) == 0) -> (int8 [N], int8 [N/block])
     in the "sublane" layout (bit-identical to
-    ``bfp_golden.bfp_encode(..., layout="sublane")``)."""
+    ``bfp_golden.bfp_encode(..., layout="sublane")``).
+
+    Un-jitted entry for callers already inside jit/shard_map (a nested
+    closed_call trips the vma checker); ``bfp_encode`` is the jitted
+    public wrapper."""
     if interpret is None:
         interpret = not _is_tpu()
     n = x.shape[0]
@@ -108,11 +111,15 @@ def bfp_encode(x: jax.Array, block_size: int = 16, mantissa_bits: int = 8,
     return mant.reshape(n), scale.reshape(n // block_size)
 
 
-@functools.partial(jax.jit, static_argnames=(
-    "block_size", "dtype", "interpret", "tiles_per_step"))
-def bfp_decode(mant: jax.Array, scale: jax.Array, block_size: int = 16,
-               dtype=jnp.float32, interpret: Optional[bool] = None,
-               tiles_per_step: int = _DEF_TILES) -> jax.Array:
+bfp_encode = functools.partial(jax.jit, static_argnames=(
+    "block_size", "mantissa_bits", "rounding", "interpret",
+    "tiles_per_step"))(bfp_encode_inline)
+
+
+def bfp_decode_inline(mant: jax.Array, scale: jax.Array,
+                      block_size: int = 16, dtype=jnp.float32,
+                      interpret: Optional[bool] = None,
+                      tiles_per_step: int = _DEF_TILES) -> jax.Array:
     if interpret is None:
         interpret = not _is_tpu()
     n = mant.shape[0]
@@ -136,3 +143,8 @@ def bfp_decode(mant: jax.Array, scale: jax.Array, block_size: int = 16,
         interpret=interpret,
     )(m2, s2)
     return out.reshape(n).astype(dtype)
+
+
+bfp_decode = functools.partial(jax.jit, static_argnames=(
+    "block_size", "dtype", "interpret", "tiles_per_step"))(
+        bfp_decode_inline)

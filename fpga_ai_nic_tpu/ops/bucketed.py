@@ -98,10 +98,9 @@ def _reduce_bucket(leaves: Sequence[jax.Array], b: Bucket, axis_name: str,
     if coll.impl == "xla":
         red = lax.psum(flat, axis_name)
     else:
-        red = ring_ops.ring_all_reduce(flat, axis_name,
-                                       compression=coll.compression,
-                                       slice_elems=coll.slice_elems,
-                                       unroll=coll.unroll_hops)
+        from .fused_update import ring_all_reduce_routed
+        red = ring_all_reduce_routed(flat, axis_name, coll,
+                                     b.padded_len // lax.axis_size(axis_name))
     return red / n
 
 

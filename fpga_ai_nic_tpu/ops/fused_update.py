@@ -47,8 +47,13 @@ class FlatMeta(NamedTuple):
 
 def pad_multiple(coll: CollectiveConfig, n: int) -> int:
     """Padding multiple for flat vectors fed to the n-way collective: the
-    per-device chunk (len / n) must be a whole number of BFP blocks."""
+    per-device chunk (len / n) must be a whole number of BFP blocks — and
+    of (block, 128)-lane tiles when the fused Pallas kernel carries the
+    wire (its frames are native int8 tiles)."""
     if coll.compression is not None:
+        if getattr(coll, "fused_kernel", False):
+            from . import ring_pallas
+            return n * coll.compression.block_size * ring_pallas.LANES
         return n * coll.compression.block_size
     return n
 
@@ -94,11 +99,52 @@ def shard_slice(flat: jax.Array, axis_name: str) -> jax.Array:
     return lax.dynamic_slice_in_dim(flat, idx * c, c)
 
 
+def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
+                           coll: CollectiveConfig,
+                           chunk_len: int) -> jax.Array:
+    """Explicit-ring all-reduce respecting the fused_kernel routing (one
+    definition shared by all_reduce_mean and ops.bucketed so the
+    fallback/slice policy cannot drift between call sites)."""
+    if coll.fused_kernel:
+        from . import ring_pallas
+        slice_e = ring_pallas.pick_slice_elems(
+            chunk_len, coll.slice_elems, coll.compression.block_size)
+        if ring_pallas._is_tpu():
+            return ring_pallas.ring_all_reduce_fused(
+                flat, axis_name, compression=coll.compression,
+                slice_elems=slice_e)
+        return ring_ops.ring_all_reduce(
+            flat, axis_name, compression=coll.compression,
+            slice_elems=slice_e, unroll=coll.unroll_hops)
+    return ring_ops.ring_all_reduce(flat, axis_name,
+                                    compression=coll.compression,
+                                    slice_elems=coll.slice_elems,
+                                    unroll=coll.unroll_hops)
+
+
 def reduce_scatter(flat_g: jax.Array, axis_name: str,
                    coll: CollectiveConfig) -> jax.Array:
     if coll.impl == "xla":
         return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
                                 tiled=True)
+    if coll.fused_kernel:
+        from . import ring_pallas
+        n = lax.axis_size(axis_name)
+        slice_e = ring_pallas.pick_slice_elems(
+            flat_g.shape[0] // n, coll.slice_elems,
+            coll.compression.block_size)
+        if ring_pallas._is_tpu():
+            return ring_pallas.ring_reduce_scatter_fused(
+                flat_g, axis_name, compression=coll.compression,
+                slice_elems=slice_e)
+        # off-TPU: the separate-op ring with the CONFIGURED codec —
+        # same wire rate and error bound as the TPU kernel, but the
+        # block grouping differs (the pallas interpret codec cannot run
+        # inside vma-checked shard_maps); the kernel's own bit-exactness
+        # story lives in tests/test_ring_pallas.py
+        return ring_ops.ring_reduce_scatter(
+            flat_g, axis_name, compression=coll.compression,
+            slice_elems=slice_e, unroll=coll.unroll_hops)
     return ring_ops.ring_reduce_scatter(flat_g, axis_name,
                                         compression=coll.compression,
                                         slice_elems=coll.slice_elems,
@@ -109,6 +155,14 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
                     coll: CollectiveConfig) -> jax.Array:
     if coll.impl == "xla":
         return lax.all_gather(owned, axis_name, tiled=True)
+    if coll.fused_kernel:
+        from . import ring_pallas
+        if ring_pallas._is_tpu():
+            return ring_pallas.ring_all_gather_fused(
+                owned, axis_name, compression=coll.compression)
+        return ring_ops.ring_all_gather(owned, axis_name,
+                                        compression=coll.compression,
+                                        unroll=coll.unroll_hops)
     return ring_ops.ring_all_gather(owned, axis_name,
                                     compression=coll.compression,
                                     unroll=coll.unroll_hops)
@@ -145,9 +199,10 @@ def _gather_vjp_fwd(owned, axis_name, coll):
 
 
 def _gather_vjp_bwd(axis_name, coll, _res, ct):
-    return (ring_ops.ring_reduce_scatter(
-        ct, axis_name, compression=coll.compression,
-        slice_elems=coll.slice_elems, unroll=coll.unroll_hops),)
+    # same routing as the forward collectives (incl. the fused-kernel
+    # path and its slice plan) — the gradient stream is where most of the
+    # wire bytes are
+    return (reduce_scatter(ct, axis_name, coll),)
 
 
 all_gather_flat_vjp.defvjp(_gather_vjp_fwd, _gather_vjp_bwd)
@@ -161,10 +216,7 @@ def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):
         return jax.tree_util.tree_map(
             lambda g: lax.psum(g, axis_name) / n, tree)
     flat, meta = flatten_tree(tree, coll, n)
-    red = ring_ops.ring_all_reduce(flat, axis_name,
-                                   compression=coll.compression,
-                                   slice_elems=coll.slice_elems,
-                                   unroll=coll.unroll_hops)
+    red = ring_all_reduce_routed(flat, axis_name, coll, flat.shape[0] // n)
     return unflatten_tree(red / n, meta)
 
 

@@ -64,14 +64,24 @@ def _codec(cfg: BFPConfig, n_elems: int):
     codec="auto" picks the fused Pallas kernels on TPU when the payload
     tiles onto (block, 128)-lane registers, else the XLA ops; the default
     "xla" keeps golden bit-exactness on every platform (see BFPConfig)."""
-    mod = _bfp_pl if _use_pallas(cfg, n_elems) else _bfp_xla
+    if _use_pallas(cfg, n_elems):
+        # inline (un-jitted) kernels: a nested closed_call inside a
+        # vma-checked shard_map trips the checker
+        def enc(x):
+            return _bfp_pl.bfp_encode_inline(x, cfg.block_size,
+                                             cfg.mantissa_bits,
+                                             cfg.rounding)
 
-    def enc(x):
-        return mod.bfp_encode(x, cfg.block_size, cfg.mantissa_bits,
-                              cfg.rounding)
+        def dec(mant, se, dtype):
+            return _bfp_pl.bfp_decode_inline(mant, se, cfg.block_size,
+                                             dtype)
+    else:
+        def enc(x):
+            return _bfp_xla.bfp_encode(x, cfg.block_size,
+                                       cfg.mantissa_bits, cfg.rounding)
 
-    def dec(mant, se, dtype):
-        return mod.bfp_decode(mant, se, cfg.block_size, dtype)
+        def dec(mant, se, dtype):
+            return _bfp_xla.bfp_decode(mant, se, cfg.block_size, dtype)
 
     return enc, dec
 

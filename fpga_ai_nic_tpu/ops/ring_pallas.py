@@ -77,25 +77,39 @@ def _decode_rows(mant, scale, block_size: int):
     return mant.astype(jnp.float32) * jnp.repeat(s, block_size, axis=0)
 
 
-def _rs_kernel(x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem, recv_sem,
-               credit_sem, *, axis_name: str, n: int, n_slices: int,
+def _when(cond, static: bool):
+    """pl.when for the rolled (compiled) schedule; a plain python ``if``
+    for the statically-unrolled schedule the interpreter runs — the
+    vma-checked interpreter rejects lax.cond branch joins inside kernels
+    (invariant vs varying branch outputs), and every schedule decision is
+    a static counter comparison anyway."""
+    if static:
+        def deco(f):
+            if cond:
+                f()
+        return deco
+    return pl.when(cond)
+
+
+def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
+               recv_sem, credit_sem, *, n: int, n_slices: int,
                slice_rows: int, block_size: int, mantissa_bits: int,
-               rounding: str, flow_control: bool):
+               rounding: str, flow_control: bool, unrolled: bool):
     """The whole sliced ring reduce-scatter, one kernel invocation.
 
+    ids_ref:   SMEM [3] int32 — (my index, right neighbor, left neighbor),
+               computed OUTSIDE the kernel: in-kernel axis_index arithmetic
+               trips vma typing under the checked interpreter, and the ring
+               position is launch-time data anyway
     acc:       (L_rows, 128) f32 — running partials (starts as x)
     send_pkt:  (2, R + R/B, 128) int8 — packed frames, double-buffered
     recv_pkt:  (2, R + R/B, 128) int8
     send/recv_sem: DMA (2,) — one per comm slot
     credit_sem: REGULAR — downstream-consumed-slot credits (flow control)
     """
-    if axis_name is None:            # single-chip loopback microbench mode
-        idx = jnp.int32(0)
-        right = left = jnp.int32(0)
-    else:
-        idx = lax.axis_index(axis_name)
-        right = (idx + 1) % n        # we send downstream (IKL ring order,
-        left = (idx - 1) % n         # sw/setup_route.sh:12-40)
+    idx = ids_ref[0]
+    right = ids_ref[1]               # we send downstream (IKL ring order,
+    left = ids_ref[2]                # sw/setup_route.sh:12-40)
     S = n_slices
     R = slice_rows
     SB = R // block_size             # scale rows per slice
@@ -143,15 +157,15 @@ def _rs_kernel(x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem, recv_sem,
         # launch send q while RDMA q-1 is in flight — the encode/wire
         # overlap the reference gets by pipelining compress into the
         # egress path
-        @pl.when(q < total)
+        @_when(q < total, unrolled)
         def _launch():
-            @pl.when(q >= 2)
+            @_when(q >= 2, unrolled)
             def _reuse():                 # slot q%2 was used by RDMA q-2:
                 rdma(q - 2).wait_send()   # source buffer must be drained
             encode_to_slot(q)
 
             if flow_control:
-                @pl.when(q >= 2)
+                @_when(q >= 2, unrolled)
                 def _credit():            # destination slot safety: the
                     pltpu.semaphore_wait(credit_sem, 1)  # recvr freed q-2
             rdma(q).start()
@@ -180,29 +194,59 @@ def _rs_kernel(x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem, recv_sem,
     # reference has the same serialization: a slice is forwarded only
     # after it is reduced, hw/all_reduce.sv REDUCE->FORWARD).
     if S >= 2:
-        def step(g, _):
+        def step(g):
             launch(g + 1)
             consume(g)
-            return 0
     else:
-        def step(g, _):
+        def step(g):
             consume(g)
             launch(g + 1)
-            return 0
 
-    lax.fori_loop(0, total, step, 0)
+    if unrolled:
+        # static schedule (the interpreter path): every counter decision
+        # is a python bool, no lax.cond joins for the vma checker to fight
+        for g in range(total):
+            step(g)
+    else:
+        def body(g, _):
+            step(g)
+            return 0
+        lax.fori_loop(0, total, body, 0)
 
     # drain: the last two sends' source-buffer semaphores, and the two
     # residual credits our receiver signaled but no later send consumed
     rdma(total - 1).wait_send()
-
-    @pl.when(total >= 2)
-    def _drain_prev():
+    if total >= 2:
         rdma(total - 2).wait_send()
     if flow_control:
         pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
 
     out_ref[:] = acc[pl.ds(idx * chunk_rows, chunk_rows)]
+
+
+def _ring_ids(axis_name: Optional[str]) -> jax.Array:
+    """[my, right, left] int32 — ring coordinates as kernel data; all-self
+    when axis_name is None (single-chip loopback mode).
+
+    The values feed make_async_remote_copy's LOGICAL device id, which is
+    the FLAT index into the whole mesh — equal to the ring-axis index only
+    when every other manual axis has extent 1.  Guard that here at trace
+    time: a silent mismatch would RDMA to the wrong chip."""
+    if axis_name is None:
+        return jnp.zeros((3,), jnp.int32)
+    from jax.sharding import get_abstract_mesh
+    sizes = dict(get_abstract_mesh().shape)
+    other = {a: s for a, s in sizes.items()
+             if a != axis_name and s != 1}
+    if other:
+        raise ValueError(
+            f"fused ring collectives need '{axis_name}' to be the only "
+            f"nontrivial mesh axis (LOGICAL RDMA ids are flat mesh "
+            f"indices); other axes with extent > 1: {other} — use the "
+            f"XLA-op ring (ops.ring) on multi-axis meshes")
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return jnp.stack([idx, (idx + 1) % n, (idx - 1) % n]).astype(jnp.int32)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -218,14 +262,19 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     R = slice_elems // LANES
     S = chunk_rows // R
     pkt_rows = R + R // block_size
+    ids = _ring_ids(axis_name)
     kern = functools.partial(
-        _rs_kernel, axis_name=axis_name, n=n, n_slices=S, slice_rows=R,
+        _rs_kernel, n=n, n_slices=S, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=not interpret)
+        rounding=rounding, flow_control=not interpret,
+        unrolled=interpret)
+    vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((chunk_rows, LANES), jnp.float32),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_shape=jax.ShapeDtypeStruct((chunk_rows, LANES), jnp.float32,
+                                       vma=vma),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
         out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
         scratch_shapes=[
             pltpu.VMEM((L_rows, LANES), jnp.float32),      # acc
@@ -238,7 +287,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
         interpret=interpret,
-    )(x2)
+    )(ids, x2)
 
 
 def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
@@ -273,6 +322,197 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
     out = _rs_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
                    cfg.rounding, slice_elems, interpret, collective_id)
     return out.reshape(C)
+
+
+def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
+               recv_sem, credit_sem, *, n: int, block_size: int,
+               mantissa_bits: int, rounding: str, flow_control: bool,
+               unrolled: bool):
+    """Fused compressed ring all-gather: encode the owned chunk ONCE, then
+    forward the received frame VERBATIM each hop (BFP roundtrip is
+    idempotent, so every replica sees identical bytes — the semantics of
+    ops.ring.ring_all_gather and the golden model), decoding each arrival
+    while its onward RDMA is in flight.  This is the phase that
+    distributes updated weights in the fused collective
+    (hw/all_reduce.sv FORWARD_OUTPUT/OUTPUT_SEND:996-1086)."""
+    idx = ids_ref[0]
+    right = ids_ref[1]
+    left = ids_ref[2]
+    R = own_ref.shape[0]             # chunk rows
+    SB = R // block_size
+
+    def rdma(s, src):
+        slot = s % 2
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=recv_pkt.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    if flow_control:
+        barrier = pltpu.get_barrier_semaphore()
+        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                               device_id_type=pltpu.DeviceIdType.LOGICAL)
+        pltpu.semaphore_wait(barrier, 2)
+
+    mant, scale = _encode_rows(own_ref[:], block_size, mantissa_bits,
+                               rounding)
+    send_pkt[pl.ds(0, R)] = mant
+    send_pkt[pl.ds(R, SB)] = scale
+    # the local replica stores the same quantized values it sends
+    out_ref[pl.ds(idx * R, R)] = _decode_rows(mant, scale, block_size)
+    rdma(0, send_pkt).start()
+
+    def hop(s):
+        p = (s - 1) % 2
+        rdma(s - 1, send_pkt).wait_recv()     # frame s-1 has landed
+
+        @_when(s < n - 1, unrolled)
+        def _forward():
+            @_when(s == 2, unrolled)
+            def _initial_send_drained():
+                # forward hop 2 reuses send_sem[0], which the INITIAL
+                # owned-chunk RDMA signaled; without this wait the later
+                # _done_fwd could consume that stale signal and credit the
+                # slot while the forward is still reading it (every other
+                # same-slot predecessor is a forward already waited in its
+                # own _done_fwd)
+                rdma(0, send_pkt).wait_send()
+            if flow_control:
+                @_when(s >= 2, unrolled)
+                def _credit():                # remote slot s%2 freed?
+                    pltpu.semaphore_wait(credit_sem, 1)
+            rdma(s, recv_pkt.at[p]).start()
+
+        # decode while the forward RDMA is on the wire
+        chunk = (idx - s) % n
+        dec = _decode_rows(recv_pkt[p, pl.ds(0, R)],
+                           recv_pkt[p, pl.ds(R, SB)], block_size)
+        out_ref[pl.ds(chunk * R, R)] = dec
+        @_when(s < n - 1, unrolled)
+        def _done_fwd():
+            # our recv slot p is the upstream's NEXT delivery target; it
+            # must not be freed until the onward send has drained it
+            rdma(s, recv_pkt.at[p]).wait_send()
+        if flow_control:
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    if unrolled:
+        for s in range(1, n):
+            hop(s)
+    else:
+        def body(s, _):
+            hop(s)
+            return 0
+        lax.fori_loop(1, n, body, 0)
+    if n <= 3:
+        # rings without a forward at hop 2 never consumed the initial
+        # send's semaphore in _initial_send_drained — drain it here
+        rdma(0, send_pkt).wait_send()
+    if flow_control:
+        pltpu.semaphore_wait(credit_sem, 2 if n > 2 else 1)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "axis_name", "block_size", "mantissa_bits", "rounding", "interpret",
+    "collective_id"))
+def _ag_call(own2, axis_name: str, block_size: int, mantissa_bits: int,
+             rounding: str, interpret: bool, collective_id: int):
+    n = lax.axis_size(axis_name)
+    R = own2.shape[0]
+    pkt_rows = R + R // block_size
+    ids = _ring_ids(axis_name)
+    kern = functools.partial(
+        _ag_kernel, n=n, block_size=block_size,
+        mantissa_bits=mantissa_bits, rounding=rounding,
+        flow_control=not interpret, unrolled=interpret)
+    vma = jax.typeof(own2).vma | jax.typeof(ids).vma
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n * R, LANES), jnp.float32,
+                                       vma=vma),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.VMEM)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        scratch_shapes=[
+            pltpu.VMEM((pkt_rows, LANES), jnp.int8),       # own frame
+            pltpu.VMEM((2, pkt_rows, LANES), jnp.int8),    # recv frames
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.DMA((2,)),
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=interpret,
+    )(ids, own2)
+
+
+def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
+                          compression: Optional[BFPConfig] = None,
+                          interpret: Optional[bool] = None,
+                          collective_id: int = 8) -> jax.Array:
+    """Fused compressed ring all-gather of an owned chunk [C] -> [n*C].
+    Bit-identical to ops.ring.ring_all_gather with codec="pallas"."""
+    cfg = compression or BFPConfig()
+    n = lax.axis_size(axis_name)
+    C = owned.shape[0]
+    if interpret is None:
+        interpret = not _is_tpu()
+    if C % (cfg.block_size * LANES):
+        raise ValueError(
+            f"fused ring gather needs chunk {C} % "
+            f"{cfg.block_size * LANES} == 0")
+    if n == 1:
+        # quantize roundtrip via the same lane-layout codec kernels
+        # (matches ops.ring's n==1 semantics: replicas see wire bytes);
+        # inline entries — a nested jitted closed_call trips the vma
+        # checker inside checked shard_maps
+        from . import bfp_pallas
+        mant, se = bfp_pallas.bfp_encode_inline(
+            owned.astype(jnp.float32), cfg.block_size, cfg.mantissa_bits,
+            cfg.rounding, interpret=interpret)
+        return bfp_pallas.bfp_decode_inline(mant, se, cfg.block_size,
+                                            owned.dtype,
+                                            interpret=interpret)
+    x2 = owned.astype(jnp.float32).reshape(-1, LANES)
+    out = _ag_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+                   cfg.rounding, interpret, collective_id)
+    return out.reshape(n * C)
+
+
+def ring_all_reduce_fused(x: jax.Array, axis_name: str, *,
+                          compression: Optional[BFPConfig] = None,
+                          slice_elems: int = 8192,
+                          interpret: Optional[bool] = None) -> jax.Array:
+    """Fused all-reduce = fused reduce-scatter + fused all-gather."""
+    owned = ring_reduce_scatter_fused(x, axis_name,
+                                      compression=compression,
+                                      slice_elems=slice_elems,
+                                      interpret=interpret)
+    return ring_all_gather_fused(owned, axis_name, compression=compression,
+                                 interpret=interpret)
+
+
+def pick_slice_elems(C: int, target: int, block_size: int) -> int:
+    """Largest divisor of chunk C that is a multiple of block_size*LANES
+    and <= target — the fused kernel's slice plan for arbitrary
+    (padded-to-tile) payloads.  Slicing at block boundaries never changes
+    the block partition, so this is a schedule choice, not a numerics
+    choice."""
+    tile = block_size * LANES
+    assert C % tile == 0, (C, tile)
+    k = C // tile
+    best = 1
+    d = 1
+    while d * d <= k:
+        if k % d == 0:
+            for c in (d, k // d):
+                if c * tile <= target and c > best:
+                    best = c
+        d += 1
+    return best * tile
 
 
 def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,

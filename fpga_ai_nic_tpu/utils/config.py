@@ -81,6 +81,14 @@ class CollectiveConfig:
 
     impl: str = "xla"             # "xla" | "ring"
     compression: Optional[BFPConfig] = None
+    # run the compressed ring through the single fused Pallas kernel
+    # (ops.ring_pallas: encode-into-hop with RDMA overlap) instead of the
+    # separate encode/ppermute/decode XLA ops.  Implies the lane-layout
+    # ("pallas") block partition; payloads are padded to (block*128)-lane
+    # tiles per device chunk (ops.fused_update.pad_multiple) and must be
+    # VMEM-resident — right for the multi-MiB gradient vectors the ring
+    # streams, not for GiB-scale payloads (use the XLA-op ring there).
+    fused_kernel: bool = False
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
     # unroll the n-1 ring-hop loop at trace time: marginally better codegen
     # for tiny rings, O(n) compile-time blowup for real ones — rolled
@@ -98,6 +106,10 @@ class CollectiveConfig:
         if self.compression is not None and self.impl != "ring":
             raise ValueError("BFP compression requires impl='ring' "
                              "(XLA collectives cannot compress on the wire)")
+        if self.fused_kernel and (self.impl != "ring"
+                                  or self.compression is None):
+            raise ValueError("fused_kernel is the compressed-ring Pallas "
+                             "path: requires impl='ring' and compression")
 
 
 @dataclass(frozen=True)

@@ -55,6 +55,40 @@ def test_fused_mantissa_sweep_bitexact(rng):
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("n", [2, 4, 8])
+def test_fused_all_gather_matches_xla_op_ring_bitexact(rng, n):
+    """The fused gather forwards the encoded frame verbatim: every
+    replica must hold the identical quantized bytes the XLA-op ring
+    produces (the updated-weights distribution phase)."""
+    C = SLICE * 2
+    owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
+
+    got = _run(lambda v: rp.ring_all_gather_fused(
+        v, "dp", compression=CFG), n)(owned.reshape(-1))
+    want = _run(lambda v: ring_ops.ring_all_gather(
+        v, "dp", compression=CFG), n)(owned.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_all_reduce_matches_xla_op_ring_bitexact(rng):
+    n, C = 4, SLICE * 2
+    x = jnp.asarray(rng.standard_normal((n, n * C)), jnp.float32)
+    got = _run(lambda v: rp.ring_all_reduce_fused(
+        v, "dp", compression=CFG, slice_elems=SLICE), n)(x.reshape(-1))
+    want = _run(lambda v: ring_ops.ring_all_reduce(
+        v, "dp", compression=CFG, slice_elems=SLICE), n)(x.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_pick_slice_elems():
+    tile = CFG.block_size * rp.LANES            # 2048
+    assert rp.pick_slice_elems(8 * tile, 8192, 16) == 8192
+    assert rp.pick_slice_elems(6 * tile, 8192, 16) == 3 * tile
+    assert rp.pick_slice_elems(7 * tile, 8192, 16) == tile  # 7*tile > cap
+    assert rp.pick_slice_elems(13 * tile, 8192, 16) == tile
+    assert rp.pick_slice_elems(tile, 8192, 16) == tile
+
+
 def test_fused_rejects_bad_slice_plan(rng):
     """Silent repartitioning would change the block partition (and the
     bits): unsatisfiable slice plans must raise, not adapt."""
@@ -64,6 +98,56 @@ def test_fused_rejects_bad_slice_plan(rng):
         _run(lambda v: rp.ring_reduce_scatter_fused(
             v, "dp", compression=CFG, slice_elems=SLICE // 2), n)(
                 x.reshape(-1))
+
+
+def test_fused_kernel_trainer_integration(rng):
+    """CollectiveConfig.fused_kernel end-to-end through a ZeRO-1 training
+    step.  On this CPU surface the routing takes the documented off-TPU
+    fallback (separate-op ring; the fused kernels themselves run only
+    under the single-axis op-level tests above and on real TPU) — the
+    test pins the routing, padding, and slice-plan plumbing: must track
+    the uncompressed XLA-collective trainer within the m8 quantization
+    band and descend."""
+    import jax
+    from fpga_ai_nic_tpu.models import mlp
+    from fpga_ai_nic_tpu.parallel import DPTrainer
+    from fpga_ai_nic_tpu.utils.config import (CollectiveConfig, MeshConfig,
+                                              MLPConfig, OptimizerConfig,
+                                              TrainConfig)
+    mcfg = MLPConfig(layer_sizes=(128, 256, 32), dtype="float32")
+    x = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 32, 64), jnp.int32)
+    # single-axis mesh: the fused kernels' LOGICAL RDMA ids are flat mesh
+    # indices (see ring_pallas._ring_ids)
+    mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+    def train(coll):
+        cfg = TrainConfig(iters=4, global_batch=64,
+                          mesh=MeshConfig(dp=8), collective=coll,
+                          optimizer=OptimizerConfig(kind="momentum",
+                                                    learning_rate=1e-2))
+        tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg), mesh, cfg)
+        # fresh identical params per run (init_state donates its input)
+        st = tr.init_state(mlp.init(jax.random.PRNGKey(0), mcfg))
+        out = []
+        for _ in range(4):
+            st, loss = tr.step(st, tr.shard_batch((x, y)))
+            out.append(float(loss))
+        return out
+
+    ref = train(CollectiveConfig(impl="xla"))
+    fused = train(CollectiveConfig(impl="ring", compression=BFPConfig(),
+                                   fused_kernel=True))
+    np.testing.assert_allclose(fused, ref, rtol=0.02)
+    assert fused[-1] < fused[0], fused
+
+
+def test_fused_kernel_config_validation():
+    from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+    with pytest.raises(ValueError, match="fused_kernel"):
+        CollectiveConfig(impl="xla", fused_kernel=True)
+    with pytest.raises(ValueError, match="fused_kernel"):
+        CollectiveConfig(impl="ring", fused_kernel=True)
 
 
 def test_loopback_microbench_runs(rng):
