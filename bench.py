@@ -171,7 +171,33 @@ def child_main(layers: int, batch: int, iters: int) -> None:
         # v5e bf16 peak ~197 TFLOP/s/chip — a rough MXU-utilization gauge,
         # not a measurement (chip generation is not queryable here)
         out["mxu_util_est_v5e"] = round(flops / 197e12, 3)
+    # bank the measured number FIRST: the parent keeps the last parseable
+    # JSON line, so if anything below wedges, this result still stands
     print(json.dumps(out), flush=True)
+
+    # On the real chip, also bank a profiler-trace overlap analysis (the
+    # round-2 review's weak #3: the trace-attribution pipeline had never
+    # produced a committed artifact from real hardware).  Best-effort:
+    # re-emits the result augmented with the summary; a hang here is
+    # killed by the parent watchdog WITHOUT losing the line above.
+    if is_tpu_platform(platform):
+        import shutil
+        import tempfile
+        tdir = tempfile.mkdtemp(prefix="bench_trace_")
+        try:
+            phase("trace")
+            from fpga_ai_nic_tpu.utils import trace_analysis
+            with jax.profiler.trace(tdir):
+                for _ in range(3):
+                    state, loss = tr.step(state, batch_dev)
+                sync(state.params)
+            out["trace_overlap"] = trace_analysis.summarize(
+                trace_analysis.analyze_trace(tdir))
+            print(json.dumps(out), flush=True)
+        except Exception as e:  # noqa: BLE001 — trace is a bonus
+            _log(f"trace capture failed: {e!r}")
+        finally:
+            shutil.rmtree(tdir, ignore_errors=True)
 
 
 # ---------------------------------------------------------------------------
