@@ -29,11 +29,14 @@ codec="pallas" and the same slice_elems (same add order, same per-hop
 lane-layout quantization): slicing and fusion change the schedule, never
 the bits (tests/test_ring_pallas.py enforces this on the CPU interpreter).
 
-Residency: the full per-device vector lives in VMEM scratch for the
-duration of the kernel (acc buffer) — right for collective payloads up to
-a few MiB per device (the reference's own streaming granularity is 32 KiB
-slices of multi-MiB gradients).  Larger payloads should fall back to
-`ops.ring`'s XLA path, which streams from HBM.
+Residency: two reduce-scatter kernels share the schedule.  The
+VMEM-resident one holds the whole per-device vector on-chip (fastest for
+payloads up to a few MiB); `_rs_stream_kernel` keeps the vector in HBM
+(aliased with the input) and streams two slices of working f32 through
+VMEM with load/writeback DMAs — the reference's memory shape exactly:
+arbitrarily long vectors through a fixed 32 KiB-class working set
+(hw/all_reduce.sv:101-103,246-253).  `ring_reduce_scatter_fused` picks by
+payload size; both are bit-identical.
 """
 
 from __future__ import annotations
@@ -75,6 +78,18 @@ def _decode_rows(mant, scale, block_size: int):
     se = scale.astype(jnp.int32)
     s = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
     return mant.astype(jnp.float32) * jnp.repeat(s, block_size, axis=0)
+
+
+def _neighbor_barrier(left, right):
+    """All ring members must have entered the kernel before the first RDMA
+    lands in a neighbor's scratch (the analogue of ikl_setup's reset
+    barrier, sw/mlp_mpi_example_f32.cpp:50-63)."""
+    barrier = pltpu.get_barrier_semaphore()
+    pltpu.semaphore_signal(barrier, inc=1, device_id=left,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_signal(barrier, inc=1, device_id=right,
+                           device_id_type=pltpu.DeviceIdType.LOGICAL)
+    pltpu.semaphore_wait(barrier, 2)
 
 
 def _when(cond, static: bool):
@@ -135,19 +150,12 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
         send_pkt[slot, pl.ds(0, R)] = mant
         send_pkt[slot, pl.ds(R, SB)] = scale
 
-    # all devices must have entered the kernel before the first RDMA lands
-    # in a neighbor's scratch (the analogue of ikl_setup's reset barrier,
-    # sw/mlp_mpi_example_f32.cpp:50-63).  flow_control=False only under
-    # the CPU interpreter, whose emulation executes the lockstep program
-    # without real concurrency (and does not implement remote semaphore
-    # signal); on hardware the barrier + credits are always on.
+    # flow_control=False only under the CPU interpreter, whose emulation
+    # executes the lockstep program without real concurrency (and does not
+    # implement remote semaphore signal); on hardware the barrier +
+    # credits are always on.
     if flow_control:
-        barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_wait(barrier, 2)
+        _neighbor_barrier(left, right)
 
     # prologue: slice 0 has no in-flight RDMA to overlap with
     encode_to_slot(0)
@@ -290,15 +298,28 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     )(ids, x2)
 
 
+# above this per-device payload, the whole-vector VMEM-resident kernel
+# (input + acc copies) stops fitting on-chip; the streaming kernel keeps
+# only two slices + frames in VMEM
+_VMEM_RESIDENT_MAX_BYTES = 4 << 20
+
+
 def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
                               compression: Optional[BFPConfig] = None,
                               slice_elems: int = 8192,
+                              streaming: Optional[bool] = None,
                               interpret: Optional[bool] = None,
                               collective_id: int = 7) -> jax.Array:
     """Fused compress-into-hop ring reduce-scatter of a flat f32 [L].
 
     Drop-in for `ops.ring.ring_reduce_scatter(..., codec="pallas")` where
     the payload meets the tiling constraints below; bit-identical result.
+
+    streaming=None picks by size: payloads over ~4 MiB/device stream
+    HBM->VMEM slice by slice (the vector never lives on-chip, matching
+    the reference's fixed 32 KiB working set over arbitrarily long
+    vectors); smaller payloads use the VMEM-resident kernel.  Both are
+    bit-identical — the choice is residency, not numerics.
 
     Constraints (assert, don't silently repartition — changing the block
     partition would change the bits):
@@ -318,10 +339,201 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
             f"and slice_elems % {cfg.block_size * LANES} == 0")
     if n == 1:
         return x
+    if streaming is None:
+        streaming = L * 4 > _VMEM_RESIDENT_MAX_BYTES
     x2 = x.astype(jnp.float32).reshape(-1, LANES)
-    out = _rs_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
-                   cfg.rounding, slice_elems, interpret, collective_id)
+    if streaming:
+        out = _rs_stream_call(x2, axis_name, cfg.block_size,
+                              cfg.mantissa_bits, cfg.rounding, slice_elems,
+                              interpret, collective_id)
+    else:
+        out = _rs_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+                       cfg.rounding, slice_elems, interpret, collective_id)
     return out.reshape(C)
+
+
+def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
+                      ld_sem, st_ld_sem, wb_sem, send_sem, recv_sem,
+                      credit_sem, *, n: int, n_slices: int, slice_rows: int,
+                      block_size: int, mantissa_bits: int, rounding: str,
+                      flow_control: bool, unrolled: bool):
+    """HBM-streaming variant of _rs_kernel: the vector stays in HBM (acc
+    aliases the input buffer) and only two slices of working f32 plus the
+    int8 frames live in VMEM — the reference's exact memory shape, which
+    streams arbitrarily long vectors through fixed 32 KiB slices and a
+    handful of FIFOs (hw/all_reduce.sv:101-103,246-253) instead of
+    buffering the vector on-chip.  Slice loads, accumulate-writebacks, the
+    codec, and the RDMA all overlap through per-slot DMA semaphores; the
+    cross-hop RAW hazard (hop s sends what hop s-1 wrote back) is guarded
+    by waiting writeback q-S before the send-side load of q.
+
+    del x_hbm: the aliased acc ref IS the input buffer.
+    """
+    del x_hbm
+    idx = ids_ref[0]
+    right = ids_ref[1]
+    left = ids_ref[2]
+    S = n_slices
+    R = slice_rows
+    SB = R // block_size
+    chunk_rows = S * R
+    total = (n - 1) * S
+
+    def send_off(q):
+        s, k = q // S, q % S
+        return ((idx - s - 1) % n) * chunk_rows + k * R
+
+    def recv_off(g):
+        s, k = g // S, g % S
+        return ((idx - s - 2) % n) * chunk_rows + k * R
+
+    def ld_dma(q):
+        return pltpu.make_async_copy(acc.at[pl.ds(send_off(q), R)],
+                                     ld.at[q % 2], ld_sem.at[q % 2])
+
+    def stld_dma(g):
+        return pltpu.make_async_copy(acc.at[pl.ds(recv_off(g), R)],
+                                     st.at[g % 2], st_ld_sem.at[g % 2])
+
+    def wb_dma(g):
+        return pltpu.make_async_copy(st.at[g % 2],
+                                     acc.at[pl.ds(recv_off(g), R)],
+                                     wb_sem.at[g % 2])
+
+    def rdma(g):
+        slot = g % 2
+        return pltpu.make_async_remote_copy(
+            src_ref=send_pkt.at[slot], dst_ref=recv_pkt.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def encode_from_ld(q):
+        mant, scale = _encode_rows(ld[q % 2], block_size, mantissa_bits,
+                                   rounding)
+        slot = q % 2
+        send_pkt[slot, pl.ds(0, R)] = mant
+        send_pkt[slot, pl.ds(R, SB)] = scale
+
+    if flow_control:
+        _neighbor_barrier(left, right)
+
+    ld_dma(0).start()
+    ld_dma(0).wait()
+    encode_from_ld(0)
+    rdma(0).start()
+
+    def launch(q):
+        @_when(q < total, unrolled)
+        def _launch():
+            ld_dma(q).start()
+            @_when(q >= 2, unrolled)
+            def _reuse():
+                rdma(q - 2).wait_send()    # frame slot q%2 drained
+            ld_dma(q).wait()
+            encode_from_ld(q)
+            if flow_control:
+                @_when(q >= 2, unrolled)
+                def _credit():
+                    pltpu.semaphore_wait(credit_sem, 1)
+            rdma(q).start()
+
+    def consume(g):
+        stld_dma(g).start()                # overlap load with the wire
+        rdma(g).wait_recv()
+        stld_dma(g).wait()
+        slot = g % 2
+        dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                           recv_pkt[slot, pl.ds(R, SB)], block_size)
+        st[slot] = st[slot] + dec
+        if flow_control:
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        wb_dma(g).start()
+
+    # Writeback discipline: each wb_dma is waited EXACTLY ONCE, at a point
+    # that dominates both of its consumers — the send-side RAW (launch q
+    # reads what wb q-S wrote) and the st-slot reuse (stld g overwrites
+    # what wb g-2 drained).  Two independent waits on one DMA signal would
+    # deadlock on hardware (one signal per DMA), invisibly to the
+    # interpreter (which does not block on semaphore counts).
+    if S == 1:
+        def step(g):                       # RAW is immediate at S=1: the
+            consume(g)                     # next send reads THIS writeback
+            wb_dma(g).wait()
+            launch(g + 1)
+    else:
+        def step(g):
+            @_when(g >= 1, unrolled)
+            def _wb_prev():                # single wait, 1-iteration lag:
+                wb_dma(g - 1).wait()       # every wb <= g-1 complete here,
+            launch(g + 1)                  # dominating RAW (q-S <= g-1 for
+            consume(g)                     # S >= 2) and slot reuse (g-2)
+
+    if unrolled:
+        for g in range(total):
+            step(g)
+    else:
+        def body(g, _):
+            step(g)
+            return 0
+        lax.fori_loop(0, total, body, 0)
+
+    if S >= 2:
+        wb_dma(total - 1).wait()           # S=1 waits each wb in-loop
+    rdma(total - 1).wait_send()
+    if total >= 2:
+        rdma(total - 2).wait_send()
+    if flow_control:
+        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+
+
+@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
+    "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
+    "interpret", "collective_id"))
+def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
+                    mantissa_bits: int, rounding: str, slice_elems: int,
+                    interpret: bool, collective_id: int):
+    n = lax.axis_size(axis_name)
+    L_rows = x2.shape[0]
+    chunk_rows = L_rows // n
+    R = slice_elems // LANES
+    S = chunk_rows // R
+    pkt_rows = R + R // block_size
+    ids = _ring_ids(axis_name)
+    kern = functools.partial(
+        _rs_stream_kernel, n=n, n_slices=S, slice_rows=R,
+        block_size=block_size, mantissa_bits=mantissa_bits,
+        rounding=rounding, flow_control=not interpret, unrolled=interpret)
+    vma = jax.typeof(x2).vma | jax.typeof(ids).vma
+    acc = pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((L_rows, LANES), jnp.float32,
+                                       vma=vma),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        input_output_aliases={1: 0},
+        scratch_shapes=[
+            pltpu.VMEM((2, R, LANES), jnp.float32),        # send loads
+            pltpu.VMEM((2, R, LANES), jnp.float32),        # recv acc
+            pltpu.VMEM((2, pkt_rows, LANES), jnp.int8),    # send frames
+            pltpu.VMEM((2, pkt_rows, LANES), jnp.int8),    # recv frames
+            pltpu.SemaphoreType.DMA((2,)),                 # ld
+            pltpu.SemaphoreType.DMA((2,)),                 # st load
+            pltpu.SemaphoreType.DMA((2,)),                 # writeback
+            pltpu.SemaphoreType.DMA((2,)),                 # rdma send
+            pltpu.SemaphoreType.DMA((2,)),                 # rdma recv
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=interpret,
+    )(ids, x2)
+    # the owned chunk lives at rows [idx*chunk_rows, +chunk_rows) of the
+    # accumulated (aliased) vector
+    idx = lax.axis_index(axis_name)
+    return lax.dynamic_slice_in_dim(acc, idx * chunk_rows, chunk_rows,
+                                    axis=0)
 
 
 def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
@@ -349,12 +561,7 @@ def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
 
     if flow_control:
-        barrier = pltpu.get_barrier_semaphore()
-        pltpu.semaphore_signal(barrier, inc=1, device_id=left,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_signal(barrier, inc=1, device_id=right,
-                               device_id_type=pltpu.DeviceIdType.LOGICAL)
-        pltpu.semaphore_wait(barrier, 2)
+        _neighbor_barrier(left, right)
 
     mant, scale = _encode_rows(own_ref[:], block_size, mantissa_bits,
                                rounding)
@@ -464,6 +671,16 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
         raise ValueError(
             f"fused ring gather needs chunk {C} % "
             f"{cfg.block_size * LANES} == 0")
+    if n * C * 4 > _VMEM_RESIDENT_MAX_BYTES and n > 1:
+        # the gather kernel's [n*C] output is VMEM-resident; for payloads
+        # past the budget fall back to the separate-op ring with the SAME
+        # lane-layout codec (bit-identical bytes; a sliced streaming
+        # gather kernel is future work — see docs/ROUND3_NOTES.md)
+        import dataclasses
+        from . import ring as _ring_ops
+        return _ring_ops.ring_all_gather(
+            owned, axis_name,
+            compression=dataclasses.replace(cfg, codec="pallas"))
     if n == 1:
         # quantize roundtrip via the same lane-layout codec kernels
         # (matches ops.ring's n==1 semantics: replicas see wire bytes);

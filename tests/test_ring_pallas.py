@@ -41,6 +41,23 @@ def test_fused_matches_xla_op_ring_bitexact(rng, n, slices_per_chunk):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
+@pytest.mark.parametrize("n,slices_per_chunk", [(8, 2), (4, 1), (2, 4)])
+def test_streaming_matches_resident_bitexact(rng, n, slices_per_chunk):
+    """The HBM-streaming kernel (two VMEM slices, aliased HBM acc,
+    load/writeback DMAs around the codec/RDMA pipeline) is a residency
+    choice, never a numerics choice: bit-identical to the VMEM-resident
+    kernel and the XLA-op ring."""
+    C = SLICE * slices_per_chunk
+    x = jnp.asarray(rng.standard_normal((n, n * C)), jnp.float32)
+
+    got = _run(lambda v: rp.ring_reduce_scatter_fused(
+        v, "dp", compression=CFG, slice_elems=SLICE,
+        streaming=True), n)(x.reshape(-1))
+    want = _run(lambda v: ring_ops.ring_reduce_scatter(
+        v, "dp", compression=CFG, slice_elems=SLICE), n)(x.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_fused_mantissa_sweep_bitexact(rng):
     """Narrower mantissas (more quantization per hop) stay bit-identical
     too — error accumulation is part of the spec, not schedule-dependent."""
@@ -63,6 +80,19 @@ def test_fused_all_gather_matches_xla_op_ring_bitexact(rng, n):
     C = SLICE * 2
     owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
 
+    got = _run(lambda v: rp.ring_all_gather_fused(
+        v, "dp", compression=CFG), n)(owned.reshape(-1))
+    want = _run(lambda v: ring_ops.ring_all_gather(
+        v, "dp", compression=CFG), n)(owned.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_all_gather_large_payload_fallback(rng, monkeypatch):
+    """Past the VMEM budget the gather delegates to the separate-op ring
+    with the same lane-layout codec — byte-identical output."""
+    monkeypatch.setattr(rp, "_VMEM_RESIDENT_MAX_BYTES", 1024)
+    n, C = 4, SLICE * 2
+    owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
     got = _run(lambda v: rp.ring_all_gather_fused(
         v, "dp", compression=CFG), n)(owned.reshape(-1))
     want = _run(lambda v: ring_ops.ring_all_gather(
