@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Text generation driver: byte-tokenize a prompt, greedy/sampled decode
+through the KV cache, print the continuation.
+
+The reference has no inference surface at all; this closes the loop from
+`train_llama.py --data=...` to using the trained model.
+
+Usage (CPU mesh or TPU):
+  python examples/generate_llama.py --prompt="the ring" --new=32 \
+      [--temperature=0.8] [--ckpt=ckpts] [--model.dim=...]
+Without --ckpt, runs random-init weights (a smoke of the decode path).
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv):
+    import jax
+    import jax.numpy as jnp
+
+    from fpga_ai_nic_tpu import text
+    from fpga_ai_nic_tpu.models import llama, llama_decode as dec
+    from fpga_ai_nic_tpu.utils.config import coerce_value
+
+    prompt_s, n_new, temp, ckpt_dir = "the quick brown fox", 16, 0.0, None
+    model_flags = []
+    for a in argv:
+        if a.startswith("--prompt="):
+            prompt_s = a.partition("=")[2]
+        elif a.startswith("--new="):
+            n_new = int(a.partition("=")[2])
+        elif a.startswith("--temperature="):
+            temp = float(a.partition("=")[2])
+        elif a.startswith("--ckpt="):
+            ckpt_dir = a.partition("=")[2]
+        elif a.startswith("--model."):
+            model_flags.append(a.replace("--model.", ""))
+
+    tok = text.ByteTokenizer()
+    mcfg = dataclasses.replace(llama.LlamaConfig.tiny(), vocab=384)
+    for f in model_flags:
+        k, _, v = f.partition("=")
+        mcfg = dataclasses.replace(
+            mcfg, **{k: coerce_value(type(getattr(mcfg, k)), v)})
+    assert mcfg.vocab >= tok.vocab_size
+
+    if ckpt_dir:
+        # restore a dp-only flat-master checkpoint (w_own in forward leaf
+        # order).  tp/pp/ep-sharded layouts flatten per-rank local shapes
+        # and are NOT restorable from the flat bytes alone — rematerialize
+        # those with the trainer's params_from_master instead.
+        from fpga_ai_nic_tpu.ops import fused_update
+        from fpga_ai_nic_tpu.utils import checkpoint as ckpt
+        from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+        c = ckpt.Checkpointer(ckpt_dir)
+        step = c.latest_step()
+        if step is None:
+            raise SystemExit(f"no checkpoint found in {ckpt_dir}")
+        payload = c.restore(step)
+        shapes = jax.eval_shape(
+            lambda: llama.init(jax.random.PRNGKey(0), mcfg))
+        meta = fused_update.flat_meta(shapes, CollectiveConfig(), 1)
+        flat = jnp.asarray(payload["w_own"])
+        total = sum(meta.sizes)
+        if not total <= flat.shape[0] <= meta.padded_len + (1 << 16):
+            raise SystemExit(
+                f"checkpoint w_own has {flat.shape[0]} elements; expected "
+                f"~{total} — this looks like a tp/pp/ep-sharded layout, "
+                "which this driver cannot restore (see docstring)")
+        params = fused_update.unflatten_tree(flat[:meta.padded_len], meta)
+    else:
+        params = llama.init(jax.random.PRNGKey(0), mcfg)
+
+    ids = jnp.asarray([[tok.bos_id] + tok.encode(prompt_s)], jnp.int32)
+    out = dec.generate(params, ids, n_new, mcfg, temperature=temp,
+                       rng=jax.random.PRNGKey(0))
+    cont = tok.decode(list(map(int, out[0, ids.shape[1]:])))
+    print(json.dumps({"prompt": prompt_s, "continuation": cont,
+                      "tokens": out.shape[1]}))
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

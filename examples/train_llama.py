@@ -51,6 +51,7 @@ def main(argv):
     n_mb = 1
     remat = False
     data_path = None
+    save_dir = None
     rest = []
     for a in argv:
         if a.startswith("--seq="):
@@ -61,6 +62,8 @@ def main(argv):
             remat = coerce_value(bool, a.partition("=")[2])
         elif a.startswith("--data="):
             data_path = a.partition("=")[2]   # text file or dir of *.txt
+        elif a.startswith("--save="):
+            save_dir = a.partition("=")[2]    # checkpoint the final state
         elif not a.startswith("--model."):
             rest.append(a)
     # tiny() defaults overlaid with --model.* flags (from_flags builds via
@@ -148,6 +151,9 @@ def main(argv):
     if pp_ax:
         from fpga_ai_nic_tpu.parallel import pipeline
         out["pipeline_cost"] = pipeline.cost_model(n_mb, m.pp)
+    if save_dir:
+        from fpga_ai_nic_tpu.utils.checkpoint import Checkpointer
+        out["checkpoint"] = Checkpointer(save_dir).save(cfg.iters, state)
     print(json.dumps(out))
 
 

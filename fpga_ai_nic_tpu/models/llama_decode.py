@@ -1,0 +1,171 @@
+"""Incremental (KV-cache) decoding for the Llama family.
+
+The reference is a training-only system (SURVEY.md: an MLP trainer with a
+hardware all-reduce; no inference path exists to mirror), but a framework
+whose flagship model is a decoder owes its users generation.  TPU-first
+shape of the problem:
+
+- **Static shapes everywhere.**  The cache is allocated at ``max_seq`` up
+  front and written with ``dynamic_update_slice``; attention always scores
+  against the full cache with an ``iota <= pos`` mask.  Nothing recompiles
+  as the sequence grows — the XLA contract (one trace, one binary) that
+  data-dependent cache growth would break.
+- **The decode loop is a ``lax.scan``** over generated positions: one
+  compiled program for the whole generation, host round-trip free.
+- **tp composes** exactly as in training: heads shard over tp, the cache
+  shards with them ([B, n_kv/tp, max_seq, hd] per rank), and the same
+  row-parallel psum closes each block (call inside shard_map with
+  ``llama.param_specs`` shardings).  kv-head replication (tp > n_kv) is a
+  training-scale knob and is not supported here.
+
+Layer-stack params use the same pytree as ``llama.init``; weights trained
+by any trainer in `parallel/` drop straight in.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import llama
+from .llama import LlamaConfig
+
+
+def init_cache(cfg: LlamaConfig, batch: int, max_seq: int, *,
+               tp_size: int = 1, dtype=None) -> List[Dict]:
+    """Per-layer K/V cache [B, n_kv/tp, max_seq, head_dim], zero-filled."""
+    if cfg.n_kv_heads % tp_size:
+        raise ValueError(
+            f"decode needs tp ({tp_size}) | n_kv_heads ({cfg.n_kv_heads}); "
+            "kv-head replication is a training-scale feature")
+    dt = jnp.dtype(dtype or cfg.dtype)
+    shape = (batch, cfg.n_kv_heads // tp_size, max_seq, cfg.head_dim)
+    return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+            for _ in range(cfg.n_layers)]
+
+
+def _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale):
+    """q: [B,H,T,hd] (T = tokens this call, ending at position pos+T-1);
+    ck/cv: [B,Hkv,Smax,hd] cache AFTER this call's keys were written.
+    Scores the full static cache with a two-sided mask: key j visible to
+    query t iff j <= pos + t (causal) and j < pos + T (written)."""
+    B, H, T, hd = q.shape
+    Smax = ck.shape[2]
+    if n_kv != n_heads:
+        rep = n_heads // n_kv
+        ck = jnp.repeat(ck, rep, axis=1)
+        cv = jnp.repeat(cv, rep, axis=1)
+    s = jnp.einsum("bhtd,bhjd->bhtj", q.astype(jnp.float32),
+                   ck.astype(jnp.float32),
+                   preferred_element_type=jnp.float32) * sm_scale
+    j = lax.broadcasted_iota(jnp.int32, (T, Smax), 1)
+    t = lax.broadcasted_iota(jnp.int32, (T, Smax), 0)
+    visible = j <= (pos + t)                       # causal + written bound
+    s = jnp.where(visible[None, None], s, jnp.float32(-1e30))
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhtj,bhjd->bhtd", p, cv.astype(jnp.float32))
+
+
+def forward(params: Dict, tokens: jax.Array, cache: List[Dict],
+            pos: jax.Array, cfg: LlamaConfig, *,
+            tp_axis: Optional[str] = None
+            ) -> Tuple[jax.Array, List[Dict]]:
+    """Run ``tokens [B, T]`` (their global positions are pos..pos+T-1)
+    through the decoder, reading and extending the cache.
+
+    T is static: call once with the whole prompt (prefill), then with
+    T == 1 per generated token.  Returns (logits [B, T, vocab], cache').
+    pos is a traced scalar — one compiled program serves every step.
+    """
+    B, T = tokens.shape
+    Hd = cfg.head_dim
+    n_heads, n_kv = llama._shard_counts(cfg, tp_axis)
+    if n_kv == 0:
+        raise ValueError("decode does not support kv-head replication "
+                         "(tp > n_kv_heads)")
+    sm_scale = Hd ** -0.5
+    positions = pos + llama._positions(T, None)
+
+    x = params["tok_emb"][tokens]
+    new_cache: List[Dict] = []
+    for lyr, c in zip(params["layers"], cache):
+        h = llama._rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
+        q = (h @ lyr["wq"]).reshape(B, T, n_heads, Hd).transpose(0, 2, 1, 3)
+        k = (h @ lyr["wk"]).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        v = (h @ lyr["wv"]).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        q = llama._rope(q, positions, cfg)
+        k = llama._rope(k, positions, cfg)
+        ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),
+                                      (0, 0, pos, 0))
+        cv = lax.dynamic_update_slice(c["v"], v.astype(c["v"].dtype),
+                                      (0, 0, pos, 0))
+        new_cache.append({"k": ck, "v": cv})
+        att = _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale)
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+            B, T, n_heads * Hd)
+        x = x + llama._psum_if(att @ lyr["wo"], tp_axis)
+
+        h = llama._rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
+        if "moe" in lyr:
+            from ..ops import moe as moe_ops
+            ff, _ = moe_ops.moe_ffn(lyr["moe"], h, cfg.moe)
+        else:
+            gate = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)
+                               ).astype(x.dtype)
+            ff = (gate * (h @ lyr["w3"])) @ lyr["w2"]
+        x = x + llama._psum_if(ff, tp_axis)
+
+    x = llama._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]                  # [B, T, V/tp]
+    if tp_axis is not None:
+        logits = lax.all_gather(logits, tp_axis, axis=2, tiled=True)
+    return logits, new_cache
+
+
+def generate(params: Dict, prompt: jax.Array, n_new: int,
+             cfg: LlamaConfig, *, max_seq: Optional[int] = None,
+             tp_axis: Optional[str] = None,
+             temperature: float = 0.0,
+             rng: Optional[jax.Array] = None) -> jax.Array:
+    """Greedy (temperature=0) or sampled generation.
+
+    prompt: [B, S0] int32.  Returns [B, S0 + n_new].  One prefill call
+    plus one scanned decode program; everything stays on device.
+    """
+    B, S0 = prompt.shape
+    if n_new <= 0:
+        return prompt
+    max_seq = max_seq or (S0 + n_new)
+    assert max_seq >= S0 + n_new, (max_seq, S0, n_new)
+    tp = lax.axis_size(tp_axis) if tp_axis is not None else 1
+    cache = init_cache(cfg, B, max_seq, tp_size=tp)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+
+    logits, cache = forward(params, prompt, cache, jnp.int32(0), cfg,
+                            tp_axis=tp_axis)
+
+    def pick(logits_last, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits_last, axis=-1).astype(jnp.int32)
+        return jax.random.categorical(
+            key, logits_last.astype(jnp.float32) / temperature,
+            axis=-1).astype(jnp.int32)
+
+    first = pick(logits[:, -1], rng)
+
+    def step(carry, key):
+        tok, cache, pos = carry
+        logits, cache = forward(params, tok[:, None], cache, pos, cfg,
+                                tp_axis=tp_axis)
+        nxt = pick(logits[:, -1], key)
+        return (nxt, cache, pos + 1), tok
+
+    keys = jax.random.split(jax.random.fold_in(rng, 1), max(n_new - 1, 1))
+    (last, _, _), toks = lax.scan(step, (first, cache, jnp.int32(S0)),
+                                  keys[:n_new - 1])
+    out = jnp.concatenate([prompt, toks.T, last[:, None]], axis=1)
+    return out
