@@ -125,10 +125,11 @@ def probe_tpu(budget_s: float = 40.0, silence_s: float = 35.0) -> bool:
 def git_sha(repo_dir=None) -> str:
     import subprocess
     try:
-        return subprocess.run(
+        out = subprocess.run(
             ["git", "rev-parse", "HEAD"], capture_output=True, text=True,
             cwd=repo_dir or os.path.dirname(os.path.abspath(__file__)),
             timeout=10).stdout.strip()
+        return out or "unknown"
     except Exception:  # noqa: BLE001
         return "unknown"
 

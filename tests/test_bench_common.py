@@ -87,3 +87,48 @@ def test_cpu_env_forces_platform_and_device_count():
 def test_is_tpu_platform():
     assert is_tpu_platform("tpu") and is_tpu_platform("axon")
     assert not is_tpu_platform("cpu")
+
+
+def test_save_artifact_provenance(tmp_path, monkeypatch):
+    """Every artifact must carry the provenance that makes a perf claim
+    checkable: timestamp, git sha, argv — the round-2 lesson codified."""
+    import json
+
+    import bench_common
+    monkeypatch.setattr(os.path, "dirname", os.path.dirname)
+    # redirect the artifacts dir by pointing the module's file anchor
+    monkeypatch.setattr(bench_common, "__file__",
+                        str(tmp_path / "bench_common.py"))
+    path = bench_common.save_artifact("unittest", {"value": 42})
+    assert os.path.dirname(path) == str(tmp_path / "artifacts")
+    with open(path) as f:
+        d = json.load(f)
+    assert d["value"] == 42
+    prov = d["_provenance"]
+    assert len(prov["git_sha"]) >= 7 or prov["git_sha"] == "unknown"
+    assert "timestamp_utc" in prov and "argv" in prov
+
+
+def test_probe_tpu_reports_wedge_as_false(monkeypatch):
+    """A probe that hangs (or dies) must come back False quickly — the
+    ladder's reorder decision rides on this never raising."""
+    import bench_common
+
+    def fake_run_attempt(name, cmd, **kw):
+        raise RuntimeError("attempt probe failed (silent for 35s)")
+
+    monkeypatch.setattr(bench_common, "run_attempt", fake_run_attempt)
+    assert bench_common.probe_tpu() is False
+
+
+def test_probe_tpu_requires_tpu_platform(monkeypatch):
+    """A healthy CPU-platform child is NOT a healthy tunnel."""
+    import bench_common
+    monkeypatch.setattr(
+        bench_common, "run_attempt",
+        lambda *a, **k: {"ok": True, "platform": "cpu", "n_devices": 1})
+    assert bench_common.probe_tpu() is False
+    monkeypatch.setattr(
+        bench_common, "run_attempt",
+        lambda *a, **k: {"ok": True, "platform": "axon", "n_devices": 1})
+    assert bench_common.probe_tpu() is True
