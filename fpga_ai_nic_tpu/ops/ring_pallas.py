@@ -656,12 +656,353 @@ def _ag_call(own2, axis_name: str, block_size: int, mantissa_bits: int,
     )(ids, own2)
 
 
+def _ag_schedule(n: int, S: int):
+    """Explicit interleaved emission schedule for the streaming gather.
+
+    Every node runs the SAME emission sequence E (the reference's
+    SEND_LOCAL/FORWARD beat multiplexing, hw/all_reduce.sv:891-1086),
+    built by simulating one node: per arrival step m, emit own slice m+1
+    (while the own phase lasts) and forward arrival m onward unless its
+    content is at the last hop.  Because arrivals ARE the upstream's
+    emissions in E order, wire slots and semaphores cycle by EMISSION
+    index j, and a node's m-th arrival has the content of E[m] one hop
+    deeper.  Simple closed forms exist only for n >= 4 or S <= 2 (for
+    n == 3, S >= 3 the terminal arrivals interleave non-contiguously and
+    punch holes in any arithmetic j assignment), so the schedule is built
+    explicitly — it is static per (n, S).
+
+    Returns (content[m], fwd_j[m], own_at[m], own_j[k], own_js,
+    tail_own_js):
+      content[m]   (chunk_depth_hops - 1) * S + slice of arrival m
+      fwd_j[m]     emission index of arrival m's onward forward, -1 if
+                   terminal (content at depth n-2)
+      own_at[m]    own slice emitted AFTER consuming arrival m (-1 none)
+      own_j[k]     emission index of own slice k
+      own_js       set(own_j) — membership drives the pre-wait rule
+      tail_own_js  own emissions never followed by a same-slot emission
+                   (their send semaphores drain at kernel exit)
+    """
+    total = (n - 1) * S
+    own_j = [0] * S
+    content = [0] * total
+    fwd_j = [-1] * total
+    own_at = [-1] * total
+    j = 0
+
+    def emit_own(k):
+        nonlocal j
+        own_j[k] = j
+        j += 1
+
+    emit_own(0)
+    # arrival m's content: my arrival stream is the upstream's emission
+    # stream; its k-th own is my depth-0 content (chunk idx-1, slice k),
+    # and its forward of ITS arrival m' is my (content[m'] + one hop)
+    emissions = [("own", 0)]            # E, in order
+
+    for m in range(total):
+        kind, val = emissions[m]
+        content[m] = val if kind == "own" else content[val] + S
+        if m + 1 < S:
+            own_at[m] = m + 1
+            emit_own(m + 1)
+            emissions.append(("own", m + 1))
+        if content[m] < (n - 2) * S:    # not yet at the last hop
+            fwd_j[m] = j
+            j += 1
+            emissions.append(("fwd", m))
+    assert j == total and len(emissions) == total, (j, len(emissions))
+    assert sorted(content) == list(range(total))
+
+    # single-wait bookkeeping for send semaphores: a forward's send is
+    # waited at its own consume step; an own send is waited by the NEXT
+    # same-slot emission's pre-wait iff that emission exists AND the
+    # preceding same-slot emission was an own (forwards self-wait)
+    own_js = set(own_j)
+    tail_own_js = [oj for oj in own_j
+                   if oj + 2 >= total]   # no same-slot successor
+    return content, fwd_j, own_at, own_j, own_js, tail_own_js
+
+
+def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
+                      recv_pkt, ld_sem, own_wb_sem, wb_sem, send_sem,
+                      recv_sem, credit_sem, *, n: int, n_slices: int,
+                      slice_rows: int, block_size: int, mantissa_bits: int,
+                      rounding: str, flow_control: bool, unrolled: bool):
+    """HBM-streaming fused ring all-gather, interleaved emission order.
+
+    Loop index m = arrival order (== upstream's emission order; wire slots
+    and semaphores cycle by emission index j%2 on BOTH ends).  Per m:
+    consume arrival content(m) — wait recv, start the onward forward
+    (emission j_fwd), decode into a VMEM slice, write back to the out
+    vector in HBM — then emit the next own-slice send if this content
+    step schedules one.  Single-wait semaphore discipline:
+
+      send j:  forwards wait their own send right before crediting the
+               recv slot; own sends are waited by the next same-slot
+               emitter (pre-wait when j-2 is an own), tail-drained
+               statically.
+      wb m:    one-iteration-lag head wait + final drain.
+      own_wb:  guarded at own_st slot reuse + tail drain.
+      credit:  wait one before any send with j >= 2; signal per consume.
+    """
+    idx = ids_ref[0]
+    right = ids_ref[1]
+    left = ids_ref[2]
+    S = n_slices
+    R = slice_rows
+    SB = R // block_size
+    chunk_rows = S * R
+    total = (n - 1) * S                 # arrivals == emissions
+    (content_t, fwd_j_t, own_at_t, own_j_t, own_js,
+     tail_own_js) = _ag_schedule(n, S)
+    # Interpret-mode DMA semantics materialize the copy at the RECEIVER's
+    # wait, reading the sender's buffer at that later point — so any slot
+    # reuse between a send's start and the remote wait corrupts the
+    # emulation (the RS kernels are safe by a full-step separation; the
+    # gather emits twice per step).  Unique slots per emission under the
+    # interpreter; depth-2 slots + credits on hardware.
+    def wslot(x):
+        return x % 2
+
+    if unrolled:
+        def content(m):
+            return content_t[m]
+
+        def fwd_j(m):
+            return fwd_j_t[m]
+
+        def own_at(m):
+            return own_at_t[m]
+
+        def own_j(k):
+            return own_j_t[k]
+
+        def is_own_j(j):
+            return j >= 0 and j in own_js
+    else:
+        # static dispatch tables embedded as constants; one scalar gather
+        # per slice step (n, S are compile-time, so the tables are too)
+        CONTENT = jnp.asarray(content_t, jnp.int32)
+        FWDJ = jnp.asarray(fwd_j_t, jnp.int32)
+        OWNAT = jnp.asarray(own_at_t, jnp.int32)
+        OWNJ = jnp.asarray(own_j_t, jnp.int32)
+        OWNMASK = jnp.asarray([1 if j2 in own_js else 0
+                               for j2 in range(total)], jnp.int32)
+
+        def content(m):
+            return CONTENT[m]
+
+        def fwd_j(m):
+            return FWDJ[m]
+
+        def own_at(m):
+            return OWNAT[m]
+
+        def own_j(k):
+            return OWNJ[k]
+
+        def is_own_j(j):
+            return (j >= 0) & (OWNMASK[jnp.clip(j, 0, total - 1)] == 1)
+
+    def out_rdma(j, src):
+        slot = wslot(j)
+        return pltpu.make_async_remote_copy(
+            src_ref=src, dst_ref=recv_pkt.at[slot],
+            send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
+            device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
+
+    def wait_send(j):
+        # wait_send consumes emission j's send sem; frame shapes are
+        # uniform, so any same-shape src is a valid descriptor
+        out_rdma(j, send_pkt.at[wslot(j)]).wait_send()
+
+    def ld_dma(k):
+        return pltpu.make_async_copy(
+            own_hbm.at[pl.ds(k * R, R)], ld.at[k % 2], ld_sem.at[k % 2])
+
+    def own_wb_dma(k):
+        return pltpu.make_async_copy(
+            own_st.at[k % 2],
+            out_hbm.at[pl.ds(idx * chunk_rows + k * R, R)],
+            own_wb_sem.at[k % 2])
+
+    def wb_dma(m):
+        t = content(m)
+        s, k = t // S + 1, t % S
+        off = ((idx - s) % n) * chunk_rows + k * R
+        return pltpu.make_async_copy(st.at[m % 2],
+                                     out_hbm.at[pl.ds(off, R)],
+                                     wb_sem.at[m % 2])
+
+    if flow_control:
+        _neighbor_barrier(left, right)
+
+    def send_own(k):
+        """Emit own slice k (emission own_j(k)): load, encode, locally
+        decode (the replica stores its own wire bytes), send."""
+        j = own_j(k)
+        ld_dma(k).start()
+        @_when(is_own_j(j - 2), unrolled)
+        def _pre_wait():                  # previous same-slot emission was
+            wait_send(j - 2)              # an own send (unwaited) AND its
+                                          # frame lives in this buffer slot:
+                                          # drain before overwriting below
+        ld_dma(k).wait()
+        mant, scale = _encode_rows(ld[k % 2], block_size, mantissa_bits,
+                                   rounding)
+        slot = wslot(j)
+        send_pkt[slot, pl.ds(0, R)] = mant
+        send_pkt[slot, pl.ds(R, SB)] = scale
+        @_when(k >= 2, unrolled)
+        def _own_slot():
+            own_wb_dma(k - 2).wait()
+        own_st[k % 2] = _decode_rows(mant, scale, block_size)
+        own_wb_dma(k).start()
+        if flow_control:
+            @_when(j >= 2, unrolled)
+            def _credit():
+                pltpu.semaphore_wait(credit_sem, 1)
+        out_rdma(j, send_pkt.at[slot]).start()
+
+    def consume(m):
+        @_when(m >= 1, unrolled)
+        def _wb_prev():                   # 1-lag single wait: st slot
+            wb_dma(m - 1).wait()          # reuse at m covers wb(m-2)
+        slot = wslot(m)                   # arrival m's recv slot
+        out_rdma(m, send_pkt.at[wslot(m)]).wait_recv()
+        jf = fwd_j(m)                     # -1 when arrival m is terminal
+        fwd = jf >= 0
+
+        def start_forward():
+            @_when(is_own_j(jf - 2), unrolled)
+            def _pre_wait():
+                wait_send(jf - 2)
+            if flow_control:
+                @_when(jf >= 2, unrolled)
+                def _credit():
+                    pltpu.semaphore_wait(credit_sem, 1)
+            out_rdma(jf, recv_pkt.at[slot]).start()
+
+        def decode_arrival():
+            st[slot] = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                                    recv_pkt[slot, pl.ds(R, SB)],
+                                    block_size)
+
+        if unrolled:
+            # Interpreter primitive-lockstep hazard: a neighbor's emission
+            # primitive in THIS step can land in my recv slot before my
+            # decode primitive runs (the RS kernels are safe by a full
+            # iteration of separation; the interleaved gather is not).
+            # All reads first, then emissions — identical programs then
+            # order every device's reads before any device's same-step
+            # writes.  Hardware keeps forward-then-decode for overlap;
+            # its slot occupancy is credit-protected.
+            decode_arrival()
+            @_when(fwd, unrolled)
+            def _fwd_i():
+                start_forward()
+        else:
+            @_when(fwd, unrolled)
+            def _fwd_c():
+                start_forward()
+            decode_arrival()
+        @_when(fwd, unrolled)
+        def _fwd_done():                  # recv slot is upstream's next
+            wait_send(jf)                 # target: drain my forward first
+        if flow_control:
+            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
+                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+        wb_dma(m).start()
+
+    send_own(0)
+
+    def step(m):
+        consume(m)
+        k = own_at(m)                     # next own-slice emission, if this
+        @_when(k >= 0, unrolled)          # arrival step schedules one
+        def _own():
+            send_own(k)
+
+    if unrolled:
+        for m in range(total):
+            step(m)
+    else:
+        def body(m, _):
+            step(m)
+            return 0
+        lax.fori_loop(0, total, body, 0)
+
+    wb_dma(total - 1).wait()
+    own_wb_dma(S - 1).wait()
+    if S >= 2:
+        own_wb_dma(S - 2).wait()
+    for jk in tail_own_js:                # own sends with no same-slot
+        wait_send(jk)                     # successor (static list)
+    if flow_control:
+        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+
+
+@functools.partial(jax.jit, static_argnames=(
+    "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
+    "interpret", "collective_id"))
+def _ag_stream_call(own2, axis_name: str, block_size: int,
+                    mantissa_bits: int, rounding: str, slice_elems: int,
+                    interpret: bool, collective_id: int):
+    n = lax.axis_size(axis_name)
+    C_rows = own2.shape[0]
+    R = slice_elems // LANES
+    S = C_rows // R
+    pkt_rows = R + R // block_size
+    ids = _ring_ids(axis_name)
+    kern = functools.partial(
+        _ag_stream_kernel, n=n, n_slices=S, slice_rows=R,
+        block_size=block_size, mantissa_bits=mantissa_bits,
+        rounding=rounding, flow_control=not interpret, unrolled=interpret)
+    n_slots = 2
+    vma = jax.typeof(own2).vma | jax.typeof(ids).vma
+    return pl.pallas_call(
+        kern,
+        out_shape=jax.ShapeDtypeStruct((n * C_rows, LANES), jnp.float32,
+                                       vma=vma),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pl.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pl.ANY),
+        scratch_shapes=[
+            pltpu.VMEM((2, R, LANES), jnp.float32),        # own loads
+            pltpu.VMEM((2, R, LANES), jnp.float32),        # own decode
+            pltpu.VMEM((2, R, LANES), jnp.float32),        # recv decode
+            pltpu.VMEM((n_slots, pkt_rows, LANES), jnp.int8),  # own frames
+            pltpu.VMEM((n_slots, pkt_rows, LANES), jnp.int8),  # recv frames
+            pltpu.SemaphoreType.DMA((2,)),                 # ld
+            pltpu.SemaphoreType.DMA((2,)),                 # own wb
+            pltpu.SemaphoreType.DMA((2,)),                 # recv wb
+            pltpu.SemaphoreType.DMA((n_slots,)),           # rdma send
+            pltpu.SemaphoreType.DMA((n_slots,)),           # rdma recv
+            pltpu.SemaphoreType.REGULAR,
+        ],
+        compiler_params=pltpu.CompilerParams(
+            has_side_effects=True, collective_id=collective_id),
+        interpret=interpret,
+    )(ids, own2)
+
+
 def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
                           compression: Optional[BFPConfig] = None,
+                          slice_elems: int = 8192,
+                          streaming: Optional[bool] = None,
                           interpret: Optional[bool] = None,
                           collective_id: int = 8) -> jax.Array:
     """Fused compressed ring all-gather of an owned chunk [C] -> [n*C].
-    Bit-identical to ops.ring.ring_all_gather with codec="pallas"."""
+    Bit-identical to ops.ring.ring_all_gather with codec="pallas" (the
+    streaming kernel slices the chunk, but frames forward verbatim and
+    blocks align to slice boundaries, so the bytes are unchanged).
+
+    Large payloads (past ~4 MiB/device of gathered output) route to the
+    separate-op ring with the identical codec (HBM-resident via XLA);
+    streaming=True opts into the experimental interleaved-emission
+    streaming kernel (slice plan clamped to <= 3 slices/chunk — see the
+    inline note)."""
     cfg = compression or BFPConfig()
     n = lax.axis_size(axis_name)
     C = owned.shape[0]
@@ -671,11 +1012,28 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
         raise ValueError(
             f"fused ring gather needs chunk {C} % "
             f"{cfg.block_size * LANES} == 0")
+    if streaming and n > 1:
+        # EXPERIMENTAL opt-in: the interleaved-emission streaming gather.
+        # Its own phase emits two frames per consume step, so the depth-2
+        # slot window is only verified for slice plans with S <= 3 slices
+        # per chunk (beyond that the emulation shows slot clobbering, and
+        # the credit window's deadlock-freedom is unproven) — the slice
+        # plan is clamped accordingly.
+        x2 = owned.astype(jnp.float32).reshape(-1, LANES)
+        slice_e = pick_slice_elems(C, slice_elems, cfg.block_size)
+        if C // slice_e > 3:
+            # smallest tile-multiple divisor of C giving <= 3 slices
+            tile = cfg.block_size * LANES
+            k = C // tile
+            slice_e = next(d * tile for d in range(-(-k // 3), k + 1)
+                           if k % d == 0)
+        out = _ag_stream_call(x2, axis_name, cfg.block_size,
+                              cfg.mantissa_bits, cfg.rounding, slice_e,
+                              interpret, collective_id)
+        return out.reshape(n * C)
     if n * C * 4 > _VMEM_RESIDENT_MAX_BYTES and n > 1:
-        # the gather kernel's [n*C] output is VMEM-resident; for payloads
-        # past the budget fall back to the separate-op ring with the SAME
-        # lane-layout codec (bit-identical bytes; a sliced streaming
-        # gather kernel is future work — see docs/ROUND3_NOTES.md)
+        # default big-payload route: the separate-op ring with the SAME
+        # lane-layout codec — bit-identical bytes, HBM-resident via XLA
         import dataclasses
         from . import ring as _ring_ops
         return _ring_ops.ring_all_gather(

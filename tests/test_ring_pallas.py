@@ -87,14 +87,32 @@ def test_fused_all_gather_matches_xla_op_ring_bitexact(rng, n):
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
 
-def test_fused_all_gather_large_payload_fallback(rng, monkeypatch):
-    """Past the VMEM budget the gather delegates to the separate-op ring
-    with the same lane-layout codec — byte-identical output."""
+@pytest.mark.parametrize("n,slices_per_chunk", [(8, 2), (4, 4), (4, 1),
+                                                (2, 3), (3, 2)])
+def test_streaming_all_gather_matches_xla_op_ring_bitexact(
+        rng, n, slices_per_chunk):
+    """The interleaved-emission streaming gather (HBM out, sliced frames,
+    closed-form emission indices) forwards bytes verbatim: byte-identical
+    to the whole-chunk XLA-op ring across ring sizes, odd/even slice
+    counts, and S=1."""
+    C = SLICE * slices_per_chunk
+    owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
+    got = _run(lambda v: rp.ring_all_gather_fused(
+        v, "dp", compression=CFG, slice_elems=SLICE,
+        streaming=True), n)(owned.reshape(-1))
+    want = _run(lambda v: ring_ops.ring_all_gather(
+        v, "dp", compression=CFG), n)(owned.reshape(-1))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_fused_all_gather_large_payload_delegates(rng, monkeypatch):
+    """Past the VMEM budget the gather auto-routes to the separate-op
+    ring with the identical codec — byte-identical output."""
     monkeypatch.setattr(rp, "_VMEM_RESIDENT_MAX_BYTES", 1024)
     n, C = 4, SLICE * 2
     owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
     got = _run(lambda v: rp.ring_all_gather_fused(
-        v, "dp", compression=CFG), n)(owned.reshape(-1))
+        v, "dp", compression=CFG, slice_elems=SLICE), n)(owned.reshape(-1))
     want = _run(lambda v: ring_ops.ring_all_gather(
         v, "dp", compression=CFG), n)(owned.reshape(-1))
     np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
