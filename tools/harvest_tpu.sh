@@ -21,9 +21,11 @@ have_artifacts() { ls artifacts/bench_tpu_*.json >/dev/null 2>&1; }
 while [ "$(date +%s)" -lt "$DEADLINE" ]; do
   if flock -n "$LOCK" -c "python -c 'from bench_common import probe_tpu; import sys; sys.exit(0 if probe_tpu() else 1)'"; then
     echo "[harvest] tunnel healthy at $(date -u +%FT%TZ)"
-    flock "$LOCK" -c "python bench.py" >/tmp/harvest_bench.out 2>&1
-    flock "$LOCK" -c "python bench_collective.py" >/tmp/harvest_collective.out 2>&1
-    echo "[harvest] ladders done at $(date -u +%FT%TZ); artifacts:"
+    # staged first-contact ladder: deadlock canary -> loopback GB/s ->
+    # bench -> collective -> trace; each stage banks + git-commits its
+    # artifact before the next runs (round-3 verdict item 1)
+    flock "$LOCK" -c "python tools/first_contact.py" >/tmp/harvest_contact.out 2>&1
+    echo "[harvest] ladder exited rc=$? at $(date -u +%FT%TZ); artifacts:"
     ls -la artifacts/ 2>/dev/null
   fi
   if have_artifacts; then sleep "$LONG_PERIOD"; else sleep "$PERIOD"; fi
