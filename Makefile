@@ -7,6 +7,11 @@ codec:
 test:
 	python -m pytest tests/ -q
 
+# fast inner loop: skip the marked long-running tests (full suite stays
+# the CI gate)
+test-fast:
+	python -m pytest tests/ -q -m "not slow"
+
 ci: codec test
 
 bench:

@@ -199,6 +199,30 @@ def _psum_if(x: jax.Array, axis: Optional[str]) -> jax.Array:
     return lax.psum(x, axis) if axis is not None else x
 
 
+def _kv_rep_slice(lyr: Dict, cfg: LlamaConfig, tp_axis: str):
+    """kv-head replication (tp > n_kv): wk/wv arrive replicated; this
+    rank slices the ONE kv head serving its query group (head
+    g = r*n_kv//tp — rank r's n_heads/tp query heads all map to it
+    because n_kv | tp).  The slice transpose scatter-adds the cotangent
+    back into the replicated weight, and vma-typed autodiff inserts the
+    tp-psum that ties the replicas — the same mechanism every
+    tp-replicated leaf (norms, embeddings) uses.  Shared by training
+    (_block) and decode (llama_decode.forward) so the mapping can never
+    diverge between them.  Returns (wk, wv) sliced to ONE head."""
+    Hd = cfg.head_dim
+    tp = lax.axis_size(tp_axis)
+    if lyr["wk"].shape[1] != cfg.n_kv_heads * Hd:
+        raise ValueError(
+            f"tp={tp} > n_kv_heads={cfg.n_kv_heads} needs wk/wv "
+            f"REPLICATED over tp (local width {lyr['wk'].shape[1]}, "
+            f"expected {cfg.n_kv_heads * Hd}) — pass tp_size to "
+            f"param_specs/stacked_param_specs")
+    g = (lax.axis_index(tp_axis) * cfg.n_kv_heads) // tp
+    wk = lax.dynamic_slice_in_dim(lyr["wk"], g * Hd, Hd, axis=1)
+    wv = lax.dynamic_slice_in_dim(lyr["wv"], g * Hd, Hd, axis=1)
+    return wk, wv
+
+
 def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
            n_heads: int, n_kv: int, tp_axis: Optional[str],
            sp_axis: Optional[str], ep_axis: Optional[str] = None,
@@ -210,23 +234,7 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
     Hd = cfg.head_dim
     h = _rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
     if n_kv == 0:
-        # kv-head replication (tp > n_kv): wk/wv arrive replicated; this
-        # rank slices the ONE kv head serving its query group (head
-        # g = r*n_kv//tp — rank r's n_heads/tp query heads all map to it
-        # because n_kv | tp).  The slice transpose scatter-adds the
-        # cotangent back into the replicated weight, and vma-typed
-        # autodiff inserts the tp-psum that ties the replicas — the same
-        # mechanism every tp-replicated leaf (norms, embeddings) uses.
-        tp = lax.axis_size(tp_axis)
-        if lyr["wk"].shape[1] != cfg.n_kv_heads * Hd:
-            raise ValueError(
-                f"tp={tp} > n_kv_heads={cfg.n_kv_heads} needs wk/wv "
-                f"REPLICATED over tp (local width {lyr['wk'].shape[1]}, "
-                f"expected {cfg.n_kv_heads * Hd}) — pass tp_size to "
-                f"param_specs/stacked_param_specs")
-        g = (lax.axis_index(tp_axis) * cfg.n_kv_heads) // tp
-        wk = lax.dynamic_slice_in_dim(lyr["wk"], g * Hd, Hd, axis=1)
-        wv = lax.dynamic_slice_in_dim(lyr["wv"], g * Hd, Hd, axis=1)
+        wk, wv = _kv_rep_slice(lyr, cfg, tp_axis)
         n_kv = 1
     else:
         wk, wv = lyr["wk"], lyr["wv"]

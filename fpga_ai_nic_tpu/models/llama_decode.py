@@ -15,8 +15,11 @@ shape of the problem:
 - **tp composes** exactly as in training: heads shard over tp, the cache
   shards with them ([B, n_kv/tp, max_seq, hd] per rank), and the same
   row-parallel psum closes each block (call inside shard_map with
-  ``llama.param_specs`` shardings).  kv-head replication (tp > n_kv) is a
-  training-scale knob and is not supported here.
+  ``llama.param_specs`` shardings).  kv-head replication (tp > n_kv)
+  works the same way training's does (llama._block): wk/wv arrive
+  replicated, each rank slices the ONE kv head serving its query group,
+  and the cache holds that single head per rank — a config that trains
+  can always generate.
 
 Layer-stack params use the same pytree as ``llama.init``; weights trained
 by any trainer in `parallel/` drop straight in.
@@ -36,13 +39,18 @@ from .llama import LlamaConfig
 
 def init_cache(cfg: LlamaConfig, batch: int, max_seq: int, *,
                tp_size: int = 1, dtype=None) -> List[Dict]:
-    """Per-layer K/V cache [B, n_kv/tp, max_seq, head_dim], zero-filled."""
-    if cfg.n_kv_heads % tp_size:
+    """Per-layer K/V cache [B, kv_local, max_seq, head_dim], zero-filled;
+    kv_local = n_kv/tp, or 1 under kv-head replication (tp > n_kv)."""
+    if cfg.n_kv_heads % tp_size == 0:
+        kv_local = cfg.n_kv_heads // tp_size
+    elif tp_size % cfg.n_kv_heads == 0:
+        kv_local = 1                  # replicated-kv: one sliced head/rank
+    else:
         raise ValueError(
-            f"decode needs tp ({tp_size}) | n_kv_heads ({cfg.n_kv_heads}); "
-            "kv-head replication is a training-scale feature")
+            f"tp={tp_size} must divide n_kv_heads={cfg.n_kv_heads}, or be "
+            f"a multiple of it (kv-head replication)")
     dt = jnp.dtype(dtype or cfg.dtype)
-    shape = (batch, cfg.n_kv_heads // tp_size, max_seq, cfg.head_dim)
+    shape = (batch, kv_local, max_seq, cfg.head_dim)
     return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
             for _ in range(cfg.n_layers)]
 
@@ -83,19 +91,27 @@ def forward(params: Dict, tokens: jax.Array, cache: List[Dict],
     B, T = tokens.shape
     Hd = cfg.head_dim
     n_heads, n_kv = llama._shard_counts(cfg, tp_axis)
-    if n_kv == 0:
-        raise ValueError("decode does not support kv-head replication "
-                         "(tp > n_kv_heads)")
+    kv_rep = n_kv == 0
+    if kv_rep:
+        # kv-head replication (tp > n_kv), same mechanism as training
+        # (llama._block): wk/wv arrive replicated over tp; each rank
+        # slices the ONE kv head serving its query group and caches just
+        # that head
+        n_kv = 1
     sm_scale = Hd ** -0.5
     positions = pos + llama._positions(T, None)
 
     x = params["tok_emb"][tokens]
     new_cache: List[Dict] = []
     for lyr, c in zip(params["layers"], cache):
+        if kv_rep:
+            wk, wv = llama._kv_rep_slice(lyr, cfg, tp_axis)
+        else:
+            wk, wv = lyr["wk"], lyr["wv"]
         h = llama._rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
         q = (h @ lyr["wq"]).reshape(B, T, n_heads, Hd).transpose(0, 2, 1, 3)
-        k = (h @ lyr["wk"]).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
-        v = (h @ lyr["wv"]).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        k = (h @ wk).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        v = (h @ wv).reshape(B, T, n_kv, Hd).transpose(0, 2, 1, 3)
         q = llama._rope(q, positions, cfg)
         k = llama._rope(k, positions, cfg)
         ck = lax.dynamic_update_slice(c["k"], k.astype(c["k"].dtype),

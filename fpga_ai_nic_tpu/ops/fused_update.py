@@ -99,6 +99,30 @@ def shard_slice(flat: jax.Array, axis_name: str) -> jax.Array:
     return lax.dynamic_slice_in_dim(flat, idx * c, c)
 
 
+_warned_fused_fallback = False
+
+
+def _warn_fused_fallback() -> None:
+    """fused_kernel=True off TPU falls back to the separate-op ring with
+    the CONFIGURED codec (default "xla": contiguous block grouping) — the
+    pallas interpret codec cannot run inside vma-checked shard_maps — so
+    the quantization bits differ from the TPU kernel's lane-layout
+    partition.  Same wire rate and error bound, but training runs are not
+    bit-reproducible across platforms; surface that once instead of
+    silently diverging (round-3 advisor finding)."""
+    global _warned_fused_fallback
+    if not _warned_fused_fallback:
+        _warned_fused_fallback = True
+        import warnings
+        warnings.warn(
+            "CollectiveConfig.fused_kernel=True on a non-TPU backend: "
+            "routing to the separate-op ring with the configured codec. "
+            "Quantization block grouping (and therefore the exact bits) "
+            "differs from the TPU fused kernel's lane layout; numerics "
+            "are equivalent in rate/error but not bit-reproducible "
+            "across platforms.", stacklevel=3)
+
+
 def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
                            coll: CollectiveConfig,
                            chunk_len: int) -> jax.Array:
@@ -113,6 +137,7 @@ def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
             return ring_pallas.ring_all_reduce_fused(
                 flat, axis_name, compression=coll.compression,
                 slice_elems=slice_e)
+        _warn_fused_fallback()
         return ring_ops.ring_all_reduce(
             flat, axis_name, compression=coll.compression,
             slice_elems=slice_e, unroll=coll.unroll_hops)
@@ -137,11 +162,10 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
             return ring_pallas.ring_reduce_scatter_fused(
                 flat_g, axis_name, compression=coll.compression,
                 slice_elems=slice_e)
-        # off-TPU: the separate-op ring with the CONFIGURED codec —
-        # same wire rate and error bound as the TPU kernel, but the
-        # block grouping differs (the pallas interpret codec cannot run
-        # inside vma-checked shard_maps); the kernel's own bit-exactness
-        # story lives in tests/test_ring_pallas.py
+        # off-TPU: the separate-op ring with the CONFIGURED codec (see
+        # _warn_fused_fallback); the kernel's own bit-exactness story
+        # lives in tests/test_ring_pallas.py
+        _warn_fused_fallback()
         return ring_ops.ring_reduce_scatter(
             flat_g, axis_name, compression=coll.compression,
             slice_elems=slice_e, unroll=coll.unroll_hops)
@@ -160,6 +184,7 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
         if ring_pallas._is_tpu():
             return ring_pallas.ring_all_gather_fused(
                 owned, axis_name, compression=coll.compression)
+        _warn_fused_fallback()
         return ring_ops.ring_all_gather(owned, axis_name,
                                         compression=coll.compression,
                                         unroll=coll.unroll_hops)

@@ -22,7 +22,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-_NEG = jnp.float32(-1e30)   # "minus infinity" that survives exp() safely
+# "minus infinity" that survives exp() safely.  A plain float, NOT a
+# jnp scalar: creating a device array at import time initializes the XLA
+# backend, which breaks jax.distributed.initialize() in every process
+# that imports this package before calling it (multihost.initialize must
+# come first)
+_NEG = -1e30
 
 
 def _block_attend(q, k, v, q_pos, k_pos, m, l, o, sm_scale, causal):

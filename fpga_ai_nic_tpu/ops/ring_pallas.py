@@ -92,6 +92,29 @@ def _neighbor_barrier(left, right):
     pltpu.semaphore_wait(barrier, 2)
 
 
+def _interp_args(interpret):
+    """Map the public tri-state ``interpret`` flag to (pallas interpret
+    argument, flow_control, unrolled).
+
+    False       hardware: compiled kernel, rolled schedule, flow control ON
+    True        discharge interpreter (fast lockstep emulation; copies
+                materialize at dma_start in SPMD program order): flow
+                control OFF — it cannot execute remote semaphore signals —
+                and safety rests on the static schedule's program-order
+                properties (_ag_schedule P1/P2)
+    "threaded"  pltpu.InterpretParams: one thread per device, BLOCKING
+                semaphores, remote signals, race detection — the real
+                flow-control protocol (neighbor barrier + credit window)
+                executes end-to-end; a protocol deadlock hangs the test
+                and a data race is reported by the interpreter.  This is
+                the strongest off-hardware evidence the credit protocol
+                admits (tests/test_ring_pallas.py::TestFlowControl).
+    """
+    if interpret == "threaded":
+        return pltpu.InterpretParams(detect_races=True), True, True
+    return bool(interpret), not interpret, bool(interpret)
+
+
 def _when(cond, static: bool):
     """pl.when for the rolled (compiled) schedule; a plain python ``if``
     for the statically-unrolled schedule the interpreter runs — the
@@ -150,10 +173,10 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
         send_pkt[slot, pl.ds(0, R)] = mant
         send_pkt[slot, pl.ds(R, SB)] = scale
 
-    # flow_control=False only under the CPU interpreter, whose emulation
-    # executes the lockstep program without real concurrency (and does not
-    # implement remote semaphore signal); on hardware the barrier +
-    # credits are always on.
+    # flow_control=False only under the discharge interpreter, whose
+    # lockstep emulation cannot execute remote semaphore signals; the
+    # threaded interpreter (interpret="threaded") and hardware both run
+    # the barrier + credits for real (see _interp_args).
     if flow_control:
         _neighbor_barrier(left, right)
 
@@ -271,11 +294,11 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     S = chunk_rows // R
     pkt_rows = R + R // block_size
     ids = _ring_ids(axis_name)
+    _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
         _rs_kernel, n=n, n_slices=S, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=not interpret,
-        unrolled=interpret)
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
@@ -294,7 +317,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
-        interpret=interpret,
+        interpret=_interp,
     )(ids, x2)
 
 
@@ -489,21 +512,23 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id"))
+    "interpret", "collective_id", "loopback_n"))
 def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
                     mantissa_bits: int, rounding: str, slice_elems: int,
-                    interpret: bool, collective_id: int):
-    n = lax.axis_size(axis_name)
+                    interpret: bool, collective_id: int,
+                    loopback_n: Optional[int] = None):
+    n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
     R = slice_elems // LANES
     S = chunk_rows // R
     pkt_rows = R + R // block_size
     ids = _ring_ids(axis_name)
+    _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
         _rs_stream_kernel, n=n, n_slices=S, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=not interpret, unrolled=interpret)
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     acc = pl.pallas_call(
         kern,
@@ -527,11 +552,11 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
-        interpret=interpret,
+        interpret=_interp,
     )(ids, x2)
     # the owned chunk lives at rows [idx*chunk_rows, +chunk_rows) of the
     # accumulated (aliased) vector
-    idx = lax.axis_index(axis_name)
+    idx = jnp.int32(0) if axis_name is None else lax.axis_index(axis_name)
     return lax.dynamic_slice_in_dim(acc, idx * chunk_rows, chunk_rows,
                                     axis=0)
 
@@ -624,17 +649,19 @@ def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
 
 @functools.partial(jax.jit, static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "interpret",
-    "collective_id"))
-def _ag_call(own2, axis_name: str, block_size: int, mantissa_bits: int,
-             rounding: str, interpret: bool, collective_id: int):
-    n = lax.axis_size(axis_name)
+    "collective_id", "loopback_n"))
+def _ag_call(own2, axis_name: Optional[str], block_size: int,
+             mantissa_bits: int, rounding: str, interpret: bool,
+             collective_id: int, loopback_n: Optional[int] = None):
+    n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     R = own2.shape[0]
     pkt_rows = R + R // block_size
     ids = _ring_ids(axis_name)
+    _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
         _ag_kernel, n=n, block_size=block_size,
         mantissa_bits=mantissa_bits, rounding=rounding,
-        flow_control=not interpret, unrolled=interpret)
+        flow_control=_flow, unrolled=_unrolled)
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
@@ -652,11 +679,11 @@ def _ag_call(own2, axis_name: str, block_size: int, mantissa_bits: int,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
-        interpret=interpret,
+        interpret=_interp,
     )(ids, own2)
 
 
-def _ag_schedule(n: int, S: int):
+def _ag_schedule(n: int, S: int, n_slots: int):
     """Explicit interleaved emission schedule for the streaming gather.
 
     Every node runs the SAME emission sequence E (the reference's
@@ -665,11 +692,32 @@ def _ag_schedule(n: int, S: int):
     (while the own phase lasts) and forward arrival m onward unless its
     content is at the last hop.  Because arrivals ARE the upstream's
     emissions in E order, wire slots and semaphores cycle by EMISSION
-    index j, and a node's m-th arrival has the content of E[m] one hop
-    deeper.  Simple closed forms exist only for n >= 4 or S <= 2 (for
-    n == 3, S >= 3 the terminal arrivals interleave non-contiguously and
-    punch holes in any arithmetic j assignment), so the schedule is built
-    explicitly — it is static per (n, S).
+    index j (mod n_slots on BOTH ends), and a node's m-th arrival has the
+    content of E[m] one hop deeper.  Simple closed forms exist only for
+    n >= 4 or S <= 2 (for n == 3, S >= 3 the terminal arrivals interleave
+    non-contiguously and punch holes in any arithmetic j assignment), so
+    the schedule is built explicitly — it is static per (n, S).
+
+    Two properties are asserted here per (n, S) because the kernel's
+    safety rests on them (verified by sweep for n<=16, S<=16, and
+    re-checked statically on every trace):
+
+      P1  m_e(m) < m: arrival m's emission is issued at a consume step
+          STRICTLY before step m on the identical upstream program — so
+          in the interpreter's lockstep-primitive model the data has
+          landed before consume(m) decodes it, and on hardware wait_recv
+          can always be satisfied.
+      P2  j - m_e(j) <= S: no emission runs more than S ahead of its
+          consume step (the own phase emits two frames per step for S-1
+          steps, which is the worst case).  With n_slots >= S + 1, the
+          overwrite of wire slot j % n_slots (emission j) therefore comes
+          after the decode of arrival j - n_slots in program order
+          (interpreter safety), and the credit window never dead-ends
+          (hardware): emission j's credit waits on downstream consume
+          j - n_slots <= m_e(j) - 1, a strictly earlier step, so every
+          cross-node dependency edge points from (step m, node) to
+          (step < m, neighbor) and the dependency graph is acyclic for
+          ARBITRARY S and n.  n_slots = S + 2 adds one slot of margin.
 
     Returns (content[m], fwd_j[m], own_at[m], own_j[k], own_js,
     tail_own_js):
@@ -687,6 +735,7 @@ def _ag_schedule(n: int, S: int):
     content = [0] * total
     fwd_j = [-1] * total
     own_at = [-1] * total
+    step_at = {0: -1}                   # emission index -> consume step
     j = 0
 
     def emit_own(k):
@@ -705,14 +754,18 @@ def _ag_schedule(n: int, S: int):
         content[m] = val if kind == "own" else content[val] + S
         if m + 1 < S:
             own_at[m] = m + 1
+            step_at[j] = m
             emit_own(m + 1)
             emissions.append(("own", m + 1))
         if content[m] < (n - 2) * S:    # not yet at the last hop
             fwd_j[m] = j
+            step_at[j] = m
             j += 1
             emissions.append(("fwd", m))
     assert j == total and len(emissions) == total, (j, len(emissions))
     assert sorted(content) == list(range(total))
+    assert all(step_at[m] < m for m in range(total)), (n, S)        # P1
+    assert all(jj - st <= S for jj, st in step_at.items()), (n, S)  # P2
 
     # single-wait bookkeeping for send semaphores: a forward's send is
     # waited at its own consume step; an own send is waited by the NEXT
@@ -720,31 +773,44 @@ def _ag_schedule(n: int, S: int):
     # preceding same-slot emission was an own (forwards self-wait)
     own_js = set(own_j)
     tail_own_js = [oj for oj in own_j
-                   if oj + 2 >= total]   # no same-slot successor
+                   if oj + n_slots >= total]   # no same-slot successor
     return content, fwd_j, own_at, own_j, own_js, tail_own_js
 
 
 def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
                       recv_pkt, ld_sem, own_wb_sem, wb_sem, send_sem,
                       recv_sem, credit_sem, *, n: int, n_slices: int,
-                      slice_rows: int, block_size: int, mantissa_bits: int,
-                      rounding: str, flow_control: bool, unrolled: bool):
+                      n_slots: int, slice_rows: int, block_size: int,
+                      mantissa_bits: int, rounding: str, flow_control: bool,
+                      unrolled: bool):
     """HBM-streaming fused ring all-gather, interleaved emission order.
 
     Loop index m = arrival order (== upstream's emission order; wire slots
-    and semaphores cycle by emission index j%2 on BOTH ends).  Per m:
-    consume arrival content(m) — wait recv, start the onward forward
-    (emission j_fwd), decode into a VMEM slice, write back to the out
-    vector in HBM — then emit the next own-slice send if this content
+    and semaphores cycle by emission index j % n_slots on BOTH ends).
+    Per m: consume arrival content(m) — wait recv, start the onward
+    forward (emission j_fwd), decode into a VMEM slice, write back to the
+    out vector in HBM — then emit the next own-slice send if this content
     step schedules one.  Single-wait semaphore discipline:
 
       send j:  forwards wait their own send right before crediting the
                recv slot; own sends are waited by the next same-slot
-               emitter (pre-wait when j-2 is an own), tail-drained
-               statically.
+               emitter (pre-wait when j - n_slots is an own),
+               tail-drained statically.
       wb m:    one-iteration-lag head wait + final drain.
       own_wb:  guarded at own_st slot reuse + tail drain.
-      credit:  wait one before any send with j >= 2; signal per consume.
+      credit:  wait one before any send with j >= n_slots; signal per
+               consume.
+
+    Slot window: n_slots = S + 2 (capped at total).  The own phase emits
+    two frames per consume step, so an emission index can lead its step
+    by up to S (_ag_schedule property P2); S + 2 covers the lead with one
+    slot of margin, which makes slot reuse safe in BOTH execution
+    models — the interpreter's lockstep program order (overwrite of slot
+    j % n_slots comes after the decode of arrival j - n_slots) and
+    hardware's credit window (emission j waits a credit its downstream
+    released at consume j - n_slots, a strictly earlier step by P2, so
+    the wait-for graph is acyclic for arbitrary S and n — the proof is
+    in _ag_schedule's docstring).
     """
     idx = ids_ref[0]
     right = ids_ref[1]
@@ -755,15 +821,10 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
     chunk_rows = S * R
     total = (n - 1) * S                 # arrivals == emissions
     (content_t, fwd_j_t, own_at_t, own_j_t, own_js,
-     tail_own_js) = _ag_schedule(n, S)
-    # Interpret-mode DMA semantics materialize the copy at the RECEIVER's
-    # wait, reading the sender's buffer at that later point — so any slot
-    # reuse between a send's start and the remote wait corrupts the
-    # emulation (the RS kernels are safe by a full-step separation; the
-    # gather emits twice per step).  Unique slots per emission under the
-    # interpreter; depth-2 slots + credits on hardware.
+     tail_own_js) = _ag_schedule(n, S, n_slots)
+
     def wslot(x):
-        return x % 2
+        return x % n_slots
 
     if unrolled:
         def content(m):
@@ -843,9 +904,9 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
         decode (the replica stores its own wire bytes), send."""
         j = own_j(k)
         ld_dma(k).start()
-        @_when(is_own_j(j - 2), unrolled)
+        @_when(is_own_j(j - n_slots), unrolled)
         def _pre_wait():                  # previous same-slot emission was
-            wait_send(j - 2)              # an own send (unwaited) AND its
+            wait_send(j - n_slots)        # an own send (unwaited) AND its
                                           # frame lives in this buffer slot:
                                           # drain before overwriting below
         ld_dma(k).wait()
@@ -860,7 +921,7 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
         own_st[k % 2] = _decode_rows(mant, scale, block_size)
         own_wb_dma(k).start()
         if flow_control:
-            @_when(j >= 2, unrolled)
+            @_when(j >= n_slots, unrolled)
             def _credit():
                 pltpu.semaphore_wait(credit_sem, 1)
         out_rdma(j, send_pkt.at[slot]).start()
@@ -875,19 +936,24 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
         fwd = jf >= 0
 
         def start_forward():
-            @_when(is_own_j(jf - 2), unrolled)
+            @_when(is_own_j(jf - n_slots), unrolled)
             def _pre_wait():
-                wait_send(jf - 2)
+                wait_send(jf - n_slots)
             if flow_control:
-                @_when(jf >= 2, unrolled)
+                @_when(jf >= n_slots, unrolled)
                 def _credit():
                     pltpu.semaphore_wait(credit_sem, 1)
             out_rdma(jf, recv_pkt.at[slot]).start()
 
         def decode_arrival():
-            st[slot] = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
-                                    recv_pkt[slot, pl.ds(R, SB)],
-                                    block_size)
+            # dst slot is the LOCAL st pipeline's (depth 2, cycled by
+            # arrival index, drained by wb_dma(m) which reads st[m % 2]);
+            # only the SRC uses the wire slot — conflating the two was a
+            # real out-of-bounds bug the moment the wire window grew past
+            # the st depth
+            st[m % 2] = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                                     recv_pkt[slot, pl.ds(R, SB)],
+                                     block_size)
 
         if unrolled:
             # Interpreter primitive-lockstep hazard: a neighbor's emission
@@ -940,26 +1006,32 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
     for jk in tail_own_js:                # own sends with no same-slot
         wait_send(jk)                     # successor (static list)
     if flow_control:
-        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+        # residual credits: consumes signal `total`, sends with
+        # j >= n_slots consumed `total - n_slots` of them
+        pltpu.semaphore_wait(credit_sem, min(total, n_slots))
 
 
 @functools.partial(jax.jit, static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id"))
-def _ag_stream_call(own2, axis_name: str, block_size: int,
+    "interpret", "collective_id", "loopback_n"))
+def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
                     mantissa_bits: int, rounding: str, slice_elems: int,
-                    interpret: bool, collective_id: int):
-    n = lax.axis_size(axis_name)
+                    interpret: bool, collective_id: int,
+                    loopback_n: Optional[int] = None):
+    n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     C_rows = own2.shape[0]
     R = slice_elems // LANES
     S = C_rows // R
     pkt_rows = R + R // block_size
     ids = _ring_ids(axis_name)
+    # slot window sized to the slice plan: covers the own phase's maximum
+    # emission lead (== S, _ag_schedule P2) with one slot of margin
+    n_slots = min((n - 1) * S, S + 2)
+    _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
-        _ag_stream_kernel, n=n, n_slices=S, slice_rows=R,
+        _ag_stream_kernel, n=n, n_slices=S, n_slots=n_slots, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=not interpret, unrolled=interpret)
-    n_slots = 2
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
@@ -983,8 +1055,16 @@ def _ag_stream_call(own2, axis_name: str, block_size: int,
         ],
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
-        interpret=interpret,
+        interpret=_interp,
     )(ids, own2)
+
+
+# Frame VMEM for the streaming gather is ~2 * (S+2)/S * 17/16 bytes per
+# chunk f32 element (send + recv windows) regardless of the slice plan, so
+# the binding constraint is the CHUNK size; larger chunks are gathered in
+# sequential segments of at most this many elements (each segment is an
+# independent all-gather — BFP blocks never straddle a segment boundary).
+_AG_STREAM_MAX_CHUNK_ELEMS = 2 << 20      # ~4.5 MiB frame VMEM per segment
 
 
 def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
@@ -998,11 +1078,12 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
     streaming kernel slices the chunk, but frames forward verbatim and
     blocks align to slice boundaries, so the bytes are unchanged).
 
-    Large payloads (past ~4 MiB/device of gathered output) route to the
-    separate-op ring with the identical codec (HBM-resident via XLA);
-    streaming=True opts into the experimental interleaved-emission
-    streaming kernel (slice plan clamped to <= 3 slices/chunk — see the
-    inline note)."""
+    Routing: payloads whose gathered output fits the VMEM-resident budget
+    (~4 MiB) use the whole-chunk resident kernel; larger payloads default
+    to the HBM-streaming interleaved-emission kernel (slot window S + 2,
+    deadlock-free for arbitrary slice plans — _ag_schedule P1/P2), gathered
+    in sequential segments past the frame-VMEM budget.  streaming=False
+    opts out to the separate-op XLA ring with the identical codec."""
     cfg = compression or BFPConfig()
     n = lax.axis_size(axis_name)
     C = owned.shape[0]
@@ -1012,33 +1093,6 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
         raise ValueError(
             f"fused ring gather needs chunk {C} % "
             f"{cfg.block_size * LANES} == 0")
-    if streaming and n > 1:
-        # EXPERIMENTAL opt-in: the interleaved-emission streaming gather.
-        # Its own phase emits two frames per consume step, so the depth-2
-        # slot window is only verified for slice plans with S <= 3 slices
-        # per chunk (beyond that the emulation shows slot clobbering, and
-        # the credit window's deadlock-freedom is unproven) — the slice
-        # plan is clamped accordingly.
-        x2 = owned.astype(jnp.float32).reshape(-1, LANES)
-        slice_e = pick_slice_elems(C, slice_elems, cfg.block_size)
-        if C // slice_e > 3:
-            # smallest tile-multiple divisor of C giving <= 3 slices
-            tile = cfg.block_size * LANES
-            k = C // tile
-            slice_e = next(d * tile for d in range(-(-k // 3), k + 1)
-                           if k % d == 0)
-        out = _ag_stream_call(x2, axis_name, cfg.block_size,
-                              cfg.mantissa_bits, cfg.rounding, slice_e,
-                              interpret, collective_id)
-        return out.reshape(n * C)
-    if n * C * 4 > _VMEM_RESIDENT_MAX_BYTES and n > 1:
-        # default big-payload route: the separate-op ring with the SAME
-        # lane-layout codec — bit-identical bytes, HBM-resident via XLA
-        import dataclasses
-        from . import ring as _ring_ops
-        return _ring_ops.ring_all_gather(
-            owned, axis_name,
-            compression=dataclasses.replace(cfg, codec="pallas"))
     if n == 1:
         # quantize roundtrip via the same lane-layout codec kernels
         # (matches ops.ring's n==1 semantics: replicas see wire bytes);
@@ -1051,10 +1105,45 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
         return bfp_pallas.bfp_decode_inline(mant, se, cfg.block_size,
                                             owned.dtype,
                                             interpret=interpret)
-    x2 = owned.astype(jnp.float32).reshape(-1, LANES)
-    out = _ag_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
-                   cfg.rounding, interpret, collective_id)
-    return out.reshape(n * C)
+    big = n * C * 4 > _VMEM_RESIDENT_MAX_BYTES
+    if streaming is None:
+        streaming = big
+    if not streaming:
+        if big:
+            # explicit opt-out from the streaming kernel: the separate-op
+            # ring with the SAME lane-layout codec — bit-identical bytes,
+            # HBM-resident via XLA
+            import dataclasses
+            from . import ring as _ring_ops
+            return _ring_ops.ring_all_gather(
+                owned, axis_name,
+                compression=dataclasses.replace(cfg, codec="pallas"))
+        x2 = owned.astype(jnp.float32).reshape(-1, LANES)
+        out = _ag_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+                       cfg.rounding, interpret, collective_id)
+        return out.reshape(n * C)
+
+    # streaming kernel; frame VMEM scales with the chunk (not the slice
+    # plan), so chunks beyond the budget gather in independent sequential
+    # segments — blocks never straddle a segment boundary, so the bytes
+    # match the whole-chunk gather exactly
+    tile = cfg.block_size * LANES
+    cap = _AG_STREAM_MAX_CHUNK_ELEMS - (_AG_STREAM_MAX_CHUNK_ELEMS % tile)
+
+    def gather_seg(seg: jax.Array) -> jax.Array:
+        sz = seg.shape[0]
+        x2 = seg.astype(jnp.float32).reshape(-1, LANES)
+        slice_e = pick_slice_elems(sz, slice_elems, cfg.block_size)
+        out = _ag_stream_call(x2, axis_name, cfg.block_size,
+                              cfg.mantissa_bits, cfg.rounding, slice_e,
+                              interpret, collective_id)
+        return out.reshape(n, sz)
+
+    if C <= cap:
+        return gather_seg(owned).reshape(n * C)
+    outs = [gather_seg(owned[off:min(off + cap, C)])
+            for off in range(0, C, cap)]
+    return jnp.concatenate(outs, axis=1).reshape(n * C)
 
 
 def ring_all_reduce_fused(x: jax.Array, axis_name: str, *,
@@ -1090,12 +1179,25 @@ def pick_slice_elems(C: int, target: int, block_size: int) -> int:
     return best * tile
 
 
+def _loopback_shmap(fn, arg):
+    """Run a self-addressed kernel call under a 1-device shard_map — the
+    LOGICAL device-id space needs a mesh axis to resolve against, even
+    for self-addressed copies."""
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec
+    mesh = Mesh(np.array(jax.devices()[:1]), ("lb",))
+    return jax.shard_map(fn, mesh=mesh, in_specs=PartitionSpec(),
+                         out_specs=PartitionSpec(), check_vma=False)(arg)
+
+
 def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
                         compression: Optional[BFPConfig] = None,
                         slice_elems: int = 8192,
+                        streaming: bool = False,
                         interpret: Optional[bool] = None) -> jax.Array:
-    """Single-chip exercise of the fused pipeline: the same kernel with
-    every RDMA addressed to this device (virtual ring of `virtual_n`).
+    """Single-chip exercise of the fused reduce-scatter pipeline: the same
+    kernel with every RDMA addressed to this device (virtual ring of
+    `virtual_n`); streaming=True runs the HBM-streaming variant.
 
     The numerics are a self-accumulation (not a real reduce-scatter), but
     the DATAFLOW — encode slice g+1 on the VPU while slice g's DMA is in
@@ -1115,15 +1217,41 @@ def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
     if C % slice_elems or slice_elems % (cfg.block_size * LANES):
         raise ValueError((C, slice_elems, cfg.block_size * LANES))
     x2 = x.astype(jnp.float32).reshape(-1, LANES)
-    # the LOGICAL device-id space needs a mesh axis to resolve against,
-    # even for self-addressed copies: run under a 1-device shard_map
-    import numpy as np
-    from jax.sharding import Mesh, PartitionSpec
-    mesh = Mesh(np.array(jax.devices()[:1]), ("lb",))
-    out = jax.shard_map(
-        lambda v: _rs_call(v, None, cfg.block_size, cfg.mantissa_bits,
-                           cfg.rounding, slice_elems, interpret, 7,
-                           loopback_n=virtual_n),
-        mesh=mesh, in_specs=PartitionSpec(), out_specs=PartitionSpec(),
-        check_vma=False)(x2)
+    call = _rs_stream_call if streaming else _rs_call
+    out = _loopback_shmap(
+        lambda v: call(v, None, cfg.block_size, cfg.mantissa_bits,
+                       cfg.rounding, slice_elems, interpret, 7,
+                       loopback_n=virtual_n), x2)
     return out.reshape(C)
+
+
+def loopback_gather_microbench(owned: jax.Array, virtual_n: int = 4, *,
+                               compression: Optional[BFPConfig] = None,
+                               slice_elems: int = 8192,
+                               streaming: bool = False,
+                               interpret: Optional[bool] = None) -> jax.Array:
+    """Single-chip exercise of the fused all-gather pipeline (resident or
+    streaming), self-addressed like `loopback_microbench` — on one chip a
+    node's arrival stream is its own emission stream, so the interleaved
+    schedule, slot window, credits, and the encode/forward/decode overlap
+    all execute exactly as on a real ring.  Output is [virtual_n * C]
+    (deterministic; not a real gather)."""
+    cfg = compression or BFPConfig()
+    if interpret is None:
+        interpret = not _is_tpu()
+    C = owned.shape[0]
+    if C % slice_elems or slice_elems % (cfg.block_size * LANES):
+        raise ValueError((C, slice_elems, cfg.block_size * LANES))
+    x2 = owned.astype(jnp.float32).reshape(-1, LANES)
+    if streaming:
+        out = _loopback_shmap(
+            lambda v: _ag_stream_call(v, None, cfg.block_size,
+                                      cfg.mantissa_bits, cfg.rounding,
+                                      slice_elems, interpret, 8,
+                                      loopback_n=virtual_n), x2)
+    else:
+        out = _loopback_shmap(
+            lambda v: _ag_call(v, None, cfg.block_size, cfg.mantissa_bits,
+                               cfg.rounding, interpret, 8,
+                               loopback_n=virtual_n), x2)
+    return out.reshape(virtual_n * C)

@@ -119,13 +119,25 @@ def pack_windows(source: Union[str, Iterable[str]], tokenizer,
     with boundary masking by `lm_batches`.  The token buffer carries over
     between epochs, so a corpus smaller than one window still fills
     windows over repeated epochs instead of stalling; a corpus that yields
-    no documents at all raises."""
+    no documents at all raises.
+
+    A one-shot iterator source (a generator is its own iterator and
+    cannot be re-iterated) is captured to a list during epoch 1 and
+    replayed for later epochs, so epochs != 1 works for any documented
+    Iterable[str] instead of crashing with "empty corpus" at the start of
+    epoch 2 (round-3 advisor finding)."""
+    one_shot = not isinstance(source, str) and iter(source) is source
+    capture: Optional[List[str]] = [] if one_shot and epochs != 1 else None
     buf: List[int] = [tokenizer.bos_id]
     off = 0
     e = 0
     while epochs is None or e < epochs:
         any_doc = False
-        for doc in _iter_texts(source):
+        docs = capture if (capture is not None and e > 0) \
+            else _iter_texts(source)
+        for doc in docs:
+            if capture is not None and e == 0:
+                capture.append(doc)
             any_doc = True
             buf.extend(tokenizer.encode(doc))
             buf.append(tokenizer.eos_id)

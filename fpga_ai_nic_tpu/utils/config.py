@@ -85,9 +85,18 @@ class CollectiveConfig:
     # (ops.ring_pallas: encode-into-hop with RDMA overlap) instead of the
     # separate encode/ppermute/decode XLA ops.  Implies the lane-layout
     # ("pallas") block partition; payloads are padded to (block*128)-lane
-    # tiles per device chunk (ops.fused_update.pad_multiple) and must be
-    # VMEM-resident — right for the multi-MiB gradient vectors the ring
-    # streams, not for GiB-scale payloads (use the XLA-op ring there).
+    # tiles per device chunk (ops.fused_update.pad_multiple); large
+    # payloads stream HBM->VMEM through a fixed working set (resident /
+    # streaming / segmented routing is automatic by size).
+    #
+    # Validation status: bit-exactness and the full flow-control protocol
+    # (neighbor barrier + credit window) are exercised on every CI run —
+    # the discharge-interpreter sweep and the threaded-interpreter
+    # TestFlowControl battery in tests/test_ring_pallas.py — but the
+    # kernels have NOT yet run on multi-chip ICI hardware.  Before first
+    # production use on a real multi-chip mesh, run the hardware canary
+    # (tools/first_contact.py stage 'canary', or loopback_microbench /
+    # loopback_gather_microbench directly) on one chip of that platform.
     fused_kernel: bool = False
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
     # unroll the n-1 ring-hop loop at trace time: marginally better codegen

@@ -90,6 +90,24 @@ def test_decode_under_tp_matches_single_device(rng):
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+def test_decode_under_kv_replication_matches_single_device(rng):
+    """tp=4 > n_kv=2: wk/wv replicate, each rank slices its query group's
+    kv head and caches ONE head — generation must reproduce the unsharded
+    output token for token (round-3 verdict item 6: the last
+    train/generate asymmetry)."""
+    params = _params()
+    prompt = jnp.asarray(rng.integers(0, CFG.vocab, (B, 8)), jnp.int32)
+    want = np.asarray(dec.generate(params, prompt, 5, CFG))
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    specs = llama.param_specs(CFG, tp_axis="tp", tp_size=4)
+    got = jax.jit(jax.shard_map(
+        lambda p, t: dec.generate(p, t, 5, CFG, tp_axis="tp"),
+        mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+        check_vma=False))(params, prompt)
+    np.testing.assert_array_equal(np.asarray(got), want)
+
+
 def test_moe_decode_runs(rng):
     import dataclasses
     mcfg = dataclasses.replace(

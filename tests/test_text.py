@@ -42,6 +42,24 @@ def test_pack_windows_static_and_contiguous():
     assert stream == want[:len(stream)]
 
 
+def test_pack_windows_generator_source_multi_epoch():
+    """A one-shot iterator source must survive epochs != 1 (captured and
+    replayed), matching the restartable-list behavior window for window —
+    the round-3 advisor's mid-training 'empty corpus' crash."""
+    S = 32
+    want = list(text.pack_windows(DOCS, TOK, S, epochs=2))
+    got = list(text.pack_windows(iter(DOCS), TOK, S, epochs=2))
+    assert len(got) == len(want) > 0
+    for a, b in zip(got, want):
+        np.testing.assert_array_equal(a, b)
+    # epochs=None (the train_llama path): take a few windows past the
+    # first epoch boundary without exhausting the infinite stream
+    n_take = len(want) + 2
+    it = text.pack_windows(iter(DOCS), TOK, S, epochs=None)
+    got_inf = [next(it) for _ in range(n_take)]
+    assert len(got_inf) == n_take
+
+
 def test_lm_batches_shift_and_boundary_mask():
     B, S = 4, 32
     batches = list(text.lm_batches(DOCS * 8, TOK, batch_size=B, seq_len=S,

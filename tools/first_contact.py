@@ -107,8 +107,12 @@ def canary(name, fn):
         a, b = np.asarray(fn()), np.asarray(fn())
         ok = bool(np.isfinite(a).all() and (a == b).all())
         out["kernels"][name] = {"ok": ok, "t": round(time.time() - t0, 1)}
-    except TypeError as e:       # kwarg not in this build: skip, not fail
-        out["kernels"][name] = {"ok": True, "skipped": repr(e)[:120]}
+    except TypeError as e:
+        if "unexpected keyword argument" in str(e):
+            # entry point predates this kwarg in the running build: skip
+            out["kernels"][name] = {"ok": True, "skipped": repr(e)[:120]}
+        else:                    # any other TypeError is a real failure —
+            out["kernels"][name] = {"ok": False, "error": repr(e)[:200]}
     except Exception as e:
         out["kernels"][name] = {"ok": False, "error": repr(e)[:200]}
 
