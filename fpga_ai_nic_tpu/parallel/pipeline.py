@@ -25,6 +25,7 @@ Layout contract:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, List, Optional
 
 import jax
@@ -159,28 +160,222 @@ def pipeline_apply_aux(stage_fn: Callable, stage_params, x: jax.Array,
     return outputs.reshape(x.shape), aux
 
 
-def cost_model(num_microbatches: int, pp: int) -> dict:
-    """GPipe schedule cost report — the bubble arithmetic users need to
-    size num_microbatches (this implementation computes on ring garbage
-    during bubble ticks, so `bubble_fraction` IS the wasted-compute
-    fraction, not just idle time).
+def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
+                        stage_params, head_params, x: jax.Array,
+                        tgt: jax.Array, num_microbatches: int,
+                        pp_axis: str):
+    """One fused forward+backward pass under the 1F1B schedule — explicit
+    per-tick scheduling of forwards, backwards, and both ring directions,
+    returning gradients directly (no outer jax.grad).
 
-    ticks            total schedule ticks (M + pp - 1)
-    bubble_ticks     ticks any given stage spends on garbage (pp - 1)
-    bubble_fraction  wasted fraction of stage compute
-    utilization      1 - bubble_fraction
+    Why it exists: differentiating ``pipeline_apply`` (GPipe) makes jax
+    save the forward scan's carries — O(num_microbatches) live
+    activations per stage.  1F1B caps the in-flight window at the ring
+    depth: stage s never holds more than pp - s microbatch activations,
+    so the buffer here is a static [pp, ...] ring regardless of
+    num_microbatches (the standard perf-grade schedule for deep stacks
+    at large microbatch counts; beyond-reference — the reference has no
+    pipeline axis at all).
+
+    Schedule (derived; all stages lockstep, one work unit per tick):
+      fwd of microbatch m at stage s:  tick  s + 2m
+      bwd of microbatch m at stage s:  tick  2*pp - 1 - s + 2m
+    Forward ticks have parity s, backward ticks parity s + 1 — each
+    stage strictly alternates F,B,F,B with no same-tick collision, the
+    activation arrives exactly one tick after the upstream forward, and
+    the cotangent one tick after the downstream backward.  Total ticks
+    2*(M + pp) - 3 vs GPipe's 2*(M + pp - 1) forward+backward units —
+    same bubble, O(pp) memory.
+
+    Backward recompute: at a backward tick the stage re-runs its forward
+    under jax.vjp from the SAVED INPUT activation (stage-granular
+    rematerialization, like GPipe-with-remat) — the ring buffer then
+    stores one known-shape activation per in-flight microbatch instead
+    of arbitrary vjp residuals.
+
+    Contracts (call inside shard_map):
+      stage_fn(stage_params, mb) -> mb            this stage's layer slice
+      loss_head_fn(head_params, mb, tgt_mb) -> scalar mean loss (applied
+        on the LAST stage only; head_params replicated over pp)
+      x: [B, ...] tgt: [B, ...] replicated over pp, B % M == 0.
+    Returns (loss, d_stage_params, d_head_params): loss is the
+    microbatch-mean (pp-invariant); d_stage_params is stage-LOCAL
+    (sharded like stage_params); d_head_params is pp-invariant (psum).
+    Dense stacks only (no MoE aux routing on this schedule yet — use the
+    GPipe path for MoE).
+    """
+    n = lax.axis_size(pp_axis)
+    stage = lax.axis_index(pp_axis)
+    M = num_microbatches
+    B = x.shape[0]
+    assert B % M == 0, (B, M)
+    mb = B // M
+    x_mb = x.reshape((M, mb) + x.shape[1:])
+    tgt_mb = tgt.reshape((M, mb) + tgt.shape[1:])
+    fwd_perm = [(i, (i + 1) % n) for i in range(n)]
+    bwd_perm = [(i, (i - 1) % n) for i in range(n)]
+    is_last = stage == n - 1
+    act_shape = (mb,) + x.shape[1:]
+    vma = _tree_vma(x, stage_params, head_params) | {pp_axis}
+
+    def pc_tree(t):
+        return jax.tree_util.tree_map(lambda v: _pcast_to(v, vma), t)
+
+    # Widen EVERY input to the full varying set BEFORE the schedule runs.
+    # The scheduling conds are stage-divergent, and jax.vjp transposes an
+    # invariant-used-in-varying-math widening into a psum — a collective
+    # inside a divergent branch deadlocks the whole mesh (observed as an
+    # XLA rendezvous abort: 3 devices in collective-permute, 1 in
+    # all-reduce).  With all inputs varying, every vjp inside the conds
+    # is collective-free; invariantization happens exactly once, in the
+    # post-scan psum of the head grads.
+    sp_v = pc_tree(stage_params)
+    hp_v = pc_tree(head_params)
+    x_mb = pc_tree(x_mb)
+    tgt_mb = pc_tree(tgt_mb)
+
+    def g(sp, hp, x_in, t_in):
+        """The per-stage primal: layer slice, then the loss head on the
+        last stage.  The false branch derives its (varying) type from h
+        with a zero-gradient sum, NOT a pcast — a pcast's transpose is a
+        psum, which must not exist inside this divergent cond."""
+        h = stage_fn(sp, x_in)
+        loss = lax.cond(
+            is_last,
+            lambda: loss_head_fn(hp, h, t_in).astype(jnp.float32),
+            lambda: jnp.sum(h).astype(jnp.float32) * 0.0)
+        return h, loss
+
+    f32 = functools.partial(jax.tree_util.tree_map,
+                            lambda p: jnp.zeros(p.shape, jnp.float32))
+
+    def pc(v):
+        return _pcast_to(v, vma)
+
+    carry0 = (
+        pc(jnp.zeros(act_shape, x.dtype)),            # act in flight (down)
+        pc(jnp.zeros(act_shape, jnp.float32)),        # ct in flight (up)
+        pc(jnp.zeros((n,) + act_shape, x.dtype)),     # saved inputs ring
+        jax.tree_util.tree_map(pc, f32(stage_params)),
+        jax.tree_util.tree_map(pc, f32(head_params)),
+        pc(jnp.float32(0.0)),                         # loss accumulator
+    )
+
+    def tick(carry, t):
+        act_in, ct_in, saved, d_sp, d_hp, loss_acc = carry
+
+        m_f = (t - stage) // 2
+        fwd_work = ((t - stage) % 2 == 0) & (m_f >= 0) & (m_f < M)
+        m_b = (t - (2 * n - 1 - stage)) // 2
+        bwd_work = (((t - (2 * n - 1 - stage)) % 2 == 0)
+                    & (m_b >= 0) & (m_b < M))
+
+        # ---- forward unit (parity-s ticks) ----
+        def do_fwd(op):
+            act_in, saved, loss_acc = op
+            mi = jnp.clip(m_f, 0, M - 1)
+            x_in = jnp.where(stage == 0,
+                             lax.dynamic_index_in_dim(x_mb, mi, 0, False),
+                             act_in.astype(x.dtype))
+            t_in = lax.dynamic_index_in_dim(tgt_mb, mi, 0, False)
+            h, loss = g(sp_v, hp_v, x_in, t_in)
+            saved = lax.dynamic_update_index_in_dim(
+                saved, x_in, mi % n, 0)
+            return h, saved, loss_acc + loss / M
+
+        def skip_fwd(op):
+            act_in, saved, loss_acc = op
+            return act_in.astype(x.dtype), saved, loss_acc
+
+        act_out, saved, loss_acc = lax.cond(
+            fwd_work, do_fwd, skip_fwd, (act_in, saved, loss_acc))
+
+        # ---- backward unit (parity-(s+1) ticks) ----
+        def do_bwd(op):
+            ct_in, d_sp, d_hp = op
+            mi = jnp.clip(m_b, 0, M - 1)
+            x_in = lax.dynamic_index_in_dim(saved, mi % n, 0, False)
+            t_in = lax.dynamic_index_in_dim(tgt_mb, mi, 0, False)
+            _, pull = jax.vjp(g, sp_v, hp_v, x_in, t_in)
+            ct_h = jnp.where(is_last, jnp.zeros(act_shape, jnp.float32),
+                             ct_in).astype(x.dtype)
+            ct_loss = jnp.where(is_last, jnp.float32(1.0 / M),
+                                jnp.float32(0.0))
+            g_sp, g_hp, g_x, _ = pull((ct_h, ct_loss))
+            d_sp = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), d_sp, g_sp)
+            d_hp = jax.tree_util.tree_map(
+                lambda a, b: a + b.astype(jnp.float32), d_hp, g_hp)
+            return g_x.astype(jnp.float32), d_sp, d_hp
+
+        def skip_bwd(op):
+            ct_in, d_sp, d_hp = op
+            return ct_in, d_sp, d_hp
+
+        ct_out, d_sp, d_hp = lax.cond(
+            bwd_work, do_bwd, skip_bwd, (ct_in, d_sp, d_hp))
+
+        # both ring directions rotate every tick (collectives must stay
+        # outside the conds: every stage participates every tick)
+        act_next = lax.ppermute(act_out, pp_axis, fwd_perm)
+        ct_next = lax.ppermute(ct_out, pp_axis, bwd_perm)
+        return (act_next, ct_next, saved, d_sp, d_hp, loss_acc), None
+
+    ticks = jnp.arange(2 * (M + n) - 2)     # last: stage-0 bwd of M-1
+    (_, _, _, d_sp, d_hp, loss_acc), _ = lax.scan(tick, carry0, ticks)
+    loss = from_last_stage(loss_acc, pp_axis)
+    # head grads were produced on the last stage only; make pp-invariant
+    d_hp = jax.tree_util.tree_map(lambda v: lax.psum(v, pp_axis), d_hp)
+    return loss, d_sp, d_hp
+
+
+def cost_model(num_microbatches: int, pp: int,
+               schedule: str = "gpipe") -> dict:
+    """Pipeline schedule cost report — the bubble/memory arithmetic users
+    need to size num_microbatches.
+
+    schedule="gpipe" (forward pass of `pipeline_apply`; this
+    implementation computes on ring garbage during bubble ticks, so
+    `bubble_fraction` IS the wasted-compute fraction):
+      ticks            M + pp - 1 forward ticks
+      bubble_ticks     pp - 1
+      live_activations M per stage once differentiated (jax saves every
+                       forward carry for the backward)
+
+    schedule="1f1b" (`pipeline_train_1f1b`, fused fwd+bwd):
+      ticks            2*(M + pp) - 2 work units (fwd and bwd counted 1)
+      bubble_ticks     2*pp - 2 per stage
+      live_activations <= pp per stage — the whole point: the in-flight
+                       window is the ring depth, independent of M
     """
     if num_microbatches < 1 or pp < 1:
         raise ValueError((num_microbatches, pp))
-    ticks = num_microbatches + pp - 1
-    return {
-        "num_microbatches": num_microbatches,
-        "pp": pp,
-        "ticks": ticks,
-        "bubble_ticks": pp - 1,
-        "bubble_fraction": (pp - 1) / ticks,
-        "utilization": num_microbatches / ticks,
-    }
+    M = num_microbatches
+    if schedule == "gpipe":
+        ticks = M + pp - 1
+        return {
+            "schedule": "gpipe",
+            "num_microbatches": M,
+            "pp": pp,
+            "ticks": ticks,
+            "bubble_ticks": pp - 1,
+            "bubble_fraction": (pp - 1) / ticks,
+            "utilization": M / ticks,
+            "live_activations_per_stage": M,
+        }
+    if schedule == "1f1b":
+        ticks = 2 * (M + pp) - 2
+        return {
+            "schedule": "1f1b",
+            "num_microbatches": M,
+            "pp": pp,
+            "ticks": ticks,
+            "bubble_ticks": 2 * pp - 2,
+            "bubble_fraction": (2 * pp - 2) / ticks,
+            "utilization": 2 * M / ticks,
+            "live_activations_per_stage": min(M, pp),
+        }
+    raise ValueError(f"unknown schedule {schedule!r}")
 
 
 def from_last_stage(val: jax.Array, pp_axis: str) -> jax.Array:
