@@ -47,18 +47,22 @@ def main():
     if tpu_art:
         d = _load(tpu_art)
         rows.append((d, _rel(tpu_art)))
-    for rec in ("BENCH_r02.json", "BENCH_r01.json"):
-        p = os.path.join(ROOT, rec)
-        if os.path.exists(p):
-            d = _load(p).get("parsed") or {}
-            if d:
-                rows.append((d, _rel(p) + " (driver record)"))
-                break
+    # newest driver record wins (round number ascending in the name)
+    for p in sorted(glob.glob(os.path.join(ROOT, "BENCH_r*.json")),
+                    reverse=True):
+        d = _load(p).get("parsed") or {}
+        if d:
+            rows.append((d, _rel(p) + " (driver record)"))
+            break
     if rows:
-        L += ["| samples/s/chip | vs baseline | platform | degraded "
-              "| artifact |", "|---|---|---|---|---|"]
+        L += ["| samples/s/chip | vs baseline | TFLOP/s | MFU | platform "
+              "| degraded | artifact |", "|---|---|---|---|---|---|---|"]
         for d, src in rows:
+            mfu = d.get("mfu")
+            mfu_s = (f"{mfu} ({d.get('mfu_peak_ref', '')})" if mfu is not None
+                     else "—")
             L.append(f"| {d.get('value')} | {d.get('vs_baseline')} "
+                     f"| {d.get('tflops_per_chip', '—')} | {mfu_s} "
                      f"| {d.get('platform')} "
                      f"| {bool(d.get('degraded', False))} | `{src}` |")
     else:
