@@ -534,6 +534,86 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
     return loss + aux
 
 
+def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
+                           pp_axis: str, num_microbatches: int,
+                           tp_axis: Optional[str] = None,
+                           dp_axis: Optional[str] = None,
+                           remat: bool = False):
+    """`loss_fn_pp`'s loss AND gradients under the 1F1B schedule
+    (parallel.pipeline.pipeline_train_1f1b): O(pp) live activations per
+    stage instead of GPipe's O(num_microbatches), gradients produced by
+    the explicit fwd/bwd ring — no outer jax.grad.
+
+    Exact-parity construction: the head computes the per-microbatch token
+    NLL SUM; the scheduler returns the microbatch MEAN, so M * mean is
+    loss_fn_pp's local_sum, fed through the same `_weighted_loss` (and
+    its dp gradient-scale contract).  The scheduler seeds d(mean)=1, so
+    every gradient is rescaled by d loss/d mean = M * w, where w is
+    _weighted_loss's (token-count) linear coefficient.  The embedding is
+    differentiated OUTSIDE the schedule via the returned d_x.
+
+    tp composes: _block's tp psums sit inside stage-divergent schedule
+    conds, but every participant of a tp group shares one pp stage (and
+    therefore one branch), so the rendezvous is uniform — only pp-axis
+    collectives are forbidden inside stages.  Dense stacks only (MoE
+    rides the GPipe path).  Returns (loss, grads) with grads matching
+    the stack_params pytree; tp/pp-replicated leaves arrive correctly
+    psum'd (the scheduler transposes its own entry widening), dp-varying
+    leaves stay per-shard for the trainer's manual dp reduction.
+    """
+    from ..parallel import pipeline as pl
+
+    tokens, labels = batch
+    S = tokens.shape[1]
+    n_heads, n_kv = _shard_counts(cfg, tp_axis)
+    pos = _positions(S, None)
+    M = num_microbatches
+    valid = labels >= 0
+    safe = jnp.where(valid, labels, 0)
+
+    def block(lyr, x):
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, None, None)
+
+    def stage_fn(sp, hp, x_in, c_in):
+        def blk(lyr, h):
+            out, _ = block(lyr, h)
+            return out
+        return pl.scan_layers(blk, sp, x_in, remat=remat)
+
+    def loss_head_fn(hp, h, c_in):
+        safe_mb, valid_mb = c_in
+        h = _rmsnorm(h, hp["final_norm"], cfg.norm_eps)
+        logits = h @ hp["lm_head"]
+        nll = jnp.where(valid_mb, _token_nll(logits, safe_mb, tp_axis), 0.0)
+        return jnp.sum(nll)                 # SUM — weighting applied below
+
+    x, emb_vjp = jax.vjp(lambda e: e[tokens], params["tok_emb"])
+    head_params = {"final_norm": params["final_norm"],
+                   "lm_head": params["lm_head"]}
+    mean_nll_sum, d_layers, d_hp, d_x = pl.pipeline_train_1f1b(
+        stage_fn, loss_head_fn, params["layers"], head_params,
+        x, (safe, valid), M, pp_axis)
+
+    count = jnp.sum(valid)
+    local_sum = M * mean_nll_sum
+    loss = _weighted_loss(local_sum, count, (dp_axis,), dp_axis)
+    # d loss / d mean_nll_sum: _weighted_loss is linear in local_sum with
+    # coefficient 1/denom (times the n_dp gradient-scale when dp is on)
+    if dp_axis is not None:
+        denom = jnp.maximum(lax.psum(count, (dp_axis,)), 1).astype(
+            jnp.float32)
+        w = lax.axis_size(dp_axis) / denom
+    else:
+        w = 1.0 / jnp.maximum(count, 1).astype(jnp.float32)
+    scale = M * w
+    d_emb, = emb_vjp(d_x.astype(x.dtype))
+    grads = {"tok_emb": d_emb, "final_norm": d_hp["final_norm"],
+             "lm_head": d_hp["lm_head"], "layers": d_layers}
+    grads = jax.tree_util.tree_map(
+        lambda g2: g2.astype(jnp.float32) * scale, grads)
+    return loss, grads
+
+
 def num_params(cfg: LlamaConfig) -> int:
     D, Hd = cfg.dim, cfg.head_dim
     if cfg.moe is not None:

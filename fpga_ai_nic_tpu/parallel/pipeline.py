@@ -162,7 +162,7 @@ def pipeline_apply_aux(stage_fn: Callable, stage_params, x: jax.Array,
 
 def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
                         stage_params, head_params, x: jax.Array,
-                        tgt: jax.Array, num_microbatches: int,
+                        ctx, num_microbatches: int,
                         pp_axis: str):
     """One fused forward+backward pass under the 1F1B schedule — explicit
     per-tick scheduling of forwards, backwards, and both ring directions,
@@ -194,13 +194,26 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     of arbitrary vjp residuals.
 
     Contracts (call inside shard_map):
-      stage_fn(stage_params, mb) -> mb            this stage's layer slice
-      loss_head_fn(head_params, mb, tgt_mb) -> scalar mean loss (applied
-        on the LAST stage only; head_params replicated over pp)
-      x: [B, ...] tgt: [B, ...] replicated over pp, B % M == 0.
-    Returns (loss, d_stage_params, d_head_params): loss is the
-    microbatch-mean (pp-invariant); d_stage_params is stage-LOCAL
-    (sharded like stage_params); d_head_params is pp-invariant (psum).
+      stage_fn(stage_params, head_params, x_in, ctx_mb) -> x_out
+        this stage's layer slice on one microbatch (head_params carries
+        replicated leaves stages may need, e.g. stage 0's embedding —
+        gate stage-specific work on lax.axis_index(pp_axis), keeping any
+        collectives over OTHER axes, never over pp_axis)
+      loss_head_fn(head_params, x_out, ctx_mb) -> scalar per-microbatch
+        loss (applied on the LAST stage only)
+      x:   [B, ...] initial activations, replicated over pp, B % M == 0
+      ctx: pytree of [B, ...] arrays (tokens/labels/masks), microbatched
+        alongside x and handed to every stage + the head
+
+    Returns (loss, d_stage_params, d_head_params, d_x):
+      loss   microbatch-mean of the head losses (pp-invariant)
+      d_*    gradient trees matching the params; each leaf is psum'd over
+             EXACTLY the axes it was widened over on entry (an
+             already-varying leaf — dp-varying grads for a manual dp
+             reduce-scatter, tp-sharded weights — keeps its per-shard
+             cotangent, so this composes with any outer mesh)
+      d_x    [B, ...] cotangent of the initial activations (for an
+             embedding vjp outside), invariantized the same way
     Dense stacks only (no MoE aux routing on this schedule yet — use the
     GPipe path for MoE).
     """
@@ -210,44 +223,56 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     B = x.shape[0]
     assert B % M == 0, (B, M)
     mb = B // M
-    x_mb = x.reshape((M, mb) + x.shape[1:])
-    tgt_mb = tgt.reshape((M, mb) + tgt.shape[1:])
+    tmap = jax.tree_util.tree_map
+
+    def to_mb(v):
+        return v.reshape((M, mb) + v.shape[1:])
+
+    x_mb = to_mb(x)
+    ctx_mb = tmap(to_mb, ctx)
     fwd_perm = [(i, (i + 1) % n) for i in range(n)]
     bwd_perm = [(i, (i - 1) % n) for i in range(n)]
     is_last = stage == n - 1
     act_shape = (mb,) + x.shape[1:]
-    vma = _tree_vma(x, stage_params, head_params) | {pp_axis}
+    vma = _tree_vma(x, ctx, stage_params, head_params) | {pp_axis}
 
-    def pc_tree(t):
-        return jax.tree_util.tree_map(lambda v: _pcast_to(v, vma), t)
+    # Widen EVERY input to the full varying set BEFORE the schedule runs,
+    # RECORDING the widened axes per leaf.  The scheduling conds are
+    # stage-divergent, and jax.vjp transposes an invariant-used-in-
+    # varying-math widening into a psum — a collective inside a divergent
+    # branch deadlocks the whole mesh (observed as an XLA rendezvous
+    # abort: 3 devices in collective-permute, 1 in all-reduce).  With all
+    # inputs varying, every vjp inside the conds is collective-free;
+    # invariantization happens exactly once after the scan — each
+    # gradient leaf psum'd over precisely its recorded widened axes (the
+    # manual transpose of the entry pcast).
+    def widen(tree):
+        axes = tmap(lambda v: tuple(sorted(set(vma)
+                                           - set(jax.typeof(v).vma))), tree)
+        return tmap(lambda v: _pcast_to(v, vma), tree), axes
 
-    # Widen EVERY input to the full varying set BEFORE the schedule runs.
-    # The scheduling conds are stage-divergent, and jax.vjp transposes an
-    # invariant-used-in-varying-math widening into a psum — a collective
-    # inside a divergent branch deadlocks the whole mesh (observed as an
-    # XLA rendezvous abort: 3 devices in collective-permute, 1 in
-    # all-reduce).  With all inputs varying, every vjp inside the conds
-    # is collective-free; invariantization happens exactly once, in the
-    # post-scan psum of the head grads.
-    sp_v = pc_tree(stage_params)
-    hp_v = pc_tree(head_params)
-    x_mb = pc_tree(x_mb)
-    tgt_mb = pc_tree(tgt_mb)
+    def unwiden_grads(grads, axes):
+        return tmap(lambda d, a: lax.psum(d, a) if a else d, grads, axes)
 
-    def g(sp, hp, x_in, t_in):
+    sp_v, sp_axes = widen(stage_params)
+    hp_v, hp_axes = widen(head_params)
+    x_axes = tuple(sorted(set(vma) - set(jax.typeof(x).vma)))
+    x_mb = _pcast_to(x_mb, vma)
+    ctx_mb = tmap(lambda v: _pcast_to(v, vma), ctx_mb)
+
+    def g(sp, hp, x_in, c_in):
         """The per-stage primal: layer slice, then the loss head on the
         last stage.  The false branch derives its (varying) type from h
         with a zero-gradient sum, NOT a pcast — a pcast's transpose is a
         psum, which must not exist inside this divergent cond."""
-        h = stage_fn(sp, x_in)
+        h = stage_fn(sp, hp, x_in, c_in)
         loss = lax.cond(
             is_last,
-            lambda: loss_head_fn(hp, h, t_in).astype(jnp.float32),
+            lambda: loss_head_fn(hp, h, c_in).astype(jnp.float32),
             lambda: jnp.sum(h).astype(jnp.float32) * 0.0)
         return h, loss
 
-    f32 = functools.partial(jax.tree_util.tree_map,
-                            lambda p: jnp.zeros(p.shape, jnp.float32))
+    f32 = functools.partial(tmap, lambda p: jnp.zeros(p.shape, jnp.float32))
 
     def pc(v):
         return _pcast_to(v, vma)
@@ -256,13 +281,18 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
         pc(jnp.zeros(act_shape, x.dtype)),            # act in flight (down)
         pc(jnp.zeros(act_shape, jnp.float32)),        # ct in flight (up)
         pc(jnp.zeros((n,) + act_shape, x.dtype)),     # saved inputs ring
-        jax.tree_util.tree_map(pc, f32(stage_params)),
-        jax.tree_util.tree_map(pc, f32(head_params)),
+        tmap(pc, f32(stage_params)),
+        tmap(pc, f32(head_params)),
+        pc(jnp.zeros((M,) + act_shape, jnp.float32)),  # d_x per microbatch
         pc(jnp.float32(0.0)),                         # loss accumulator
     )
 
+    def ctx_at(mi):
+        return tmap(lambda v: lax.dynamic_index_in_dim(v, mi, 0, False),
+                    ctx_mb)
+
     def tick(carry, t):
-        act_in, ct_in, saved, d_sp, d_hp, loss_acc = carry
+        act_in, ct_in, saved, d_sp, d_hp, d_x, loss_acc = carry
 
         m_f = (t - stage) // 2
         fwd_work = ((t - stage) % 2 == 0) & (m_f >= 0) & (m_f < M)
@@ -277,8 +307,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
             x_in = jnp.where(stage == 0,
                              lax.dynamic_index_in_dim(x_mb, mi, 0, False),
                              act_in.astype(x.dtype))
-            t_in = lax.dynamic_index_in_dim(tgt_mb, mi, 0, False)
-            h, loss = g(sp_v, hp_v, x_in, t_in)
+            h, loss = g(sp_v, hp_v, x_in, ctx_at(mi))
             saved = lax.dynamic_update_index_in_dim(
                 saved, x_in, mi % n, 0)
             return h, saved, loss_acc + loss / M
@@ -292,41 +321,53 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
 
         # ---- backward unit (parity-(s+1) ticks) ----
         def do_bwd(op):
-            ct_in, d_sp, d_hp = op
+            ct_in, d_sp, d_hp, d_x = op
             mi = jnp.clip(m_b, 0, M - 1)
             x_in = lax.dynamic_index_in_dim(saved, mi % n, 0, False)
-            t_in = lax.dynamic_index_in_dim(tgt_mb, mi, 0, False)
-            _, pull = jax.vjp(g, sp_v, hp_v, x_in, t_in)
-            ct_h = jnp.where(is_last, jnp.zeros(act_shape, jnp.float32),
-                             ct_in).astype(x.dtype)
-            ct_loss = jnp.where(is_last, jnp.float32(1.0 / M),
-                                jnp.float32(0.0))
+            _, pull = jax.vjp(g, sp_v, hp_v, x_in, ctx_at(mi))
+            # seeds must carry g's full output vma type; the pcast here
+            # feeds a cotangent INTO pull (it is never itself transposed,
+            # so no psum materializes inside this divergent branch)
+            ct_h = pc(jnp.where(is_last,
+                                jnp.zeros(act_shape, jnp.float32),
+                                ct_in).astype(x.dtype))
+            ct_loss = pc(jnp.where(is_last, jnp.float32(1.0 / M),
+                                   jnp.float32(0.0)))
             g_sp, g_hp, g_x, _ = pull((ct_h, ct_loss))
-            d_sp = jax.tree_util.tree_map(
-                lambda a, b: a + b.astype(jnp.float32), d_sp, g_sp)
-            d_hp = jax.tree_util.tree_map(
-                lambda a, b: a + b.astype(jnp.float32), d_hp, g_hp)
-            return g_x.astype(jnp.float32), d_sp, d_hp
+            d_sp = tmap(lambda a, b: a + b.astype(jnp.float32), d_sp, g_sp)
+            d_hp = tmap(lambda a, b: a + b.astype(jnp.float32), d_hp, g_hp)
+            # d_x is meaningful on stage 0 only (its x_in came from x_mb,
+            # not the ring); other stages contribute zeros
+            d_x = lax.dynamic_update_index_in_dim(
+                d_x, jnp.where(stage == 0, g_x.astype(jnp.float32), 0.0),
+                mi, 0)
+            return g_x.astype(jnp.float32), d_sp, d_hp, d_x
 
         def skip_bwd(op):
-            ct_in, d_sp, d_hp = op
-            return ct_in, d_sp, d_hp
+            ct_in, d_sp, d_hp, d_x = op
+            return ct_in, d_sp, d_hp, d_x
 
-        ct_out, d_sp, d_hp = lax.cond(
-            bwd_work, do_bwd, skip_bwd, (ct_in, d_sp, d_hp))
+        ct_out, d_sp, d_hp, d_x = lax.cond(
+            bwd_work, do_bwd, skip_bwd, (ct_in, d_sp, d_hp, d_x))
 
         # both ring directions rotate every tick (collectives must stay
         # outside the conds: every stage participates every tick)
         act_next = lax.ppermute(act_out, pp_axis, fwd_perm)
         ct_next = lax.ppermute(ct_out, pp_axis, bwd_perm)
-        return (act_next, ct_next, saved, d_sp, d_hp, loss_acc), None
+        return (act_next, ct_next, saved, d_sp, d_hp, d_x, loss_acc), None
 
     ticks = jnp.arange(2 * (M + n) - 2)     # last: stage-0 bwd of M-1
-    (_, _, _, d_sp, d_hp, loss_acc), _ = lax.scan(tick, carry0, ticks)
+    (_, _, _, d_sp, d_hp, d_x, loss_acc), _ = lax.scan(tick, carry0, ticks)
     loss = from_last_stage(loss_acc, pp_axis)
-    # head grads were produced on the last stage only; make pp-invariant
-    d_hp = jax.tree_util.tree_map(lambda v: lax.psum(v, pp_axis), d_hp)
-    return loss, d_sp, d_hp
+    # transpose of the entry widening: psum each grad leaf over exactly
+    # the axes it was widened over (head/replicated leaves got per-stage
+    # partials; stage-sharded and dp-varying leaves stay per-shard)
+    d_sp = unwiden_grads(d_sp, sp_axes)
+    d_hp = unwiden_grads(d_hp, hp_axes)
+    # d_x: stage-0 rows + zeros elsewhere; pp-psum selects stage 0's and
+    # the recorded widening handles any other axes
+    d_x = lax.psum(d_x, tuple(sorted(set(x_axes) | {pp_axis})))
+    return loss, d_sp, d_hp, d_x.reshape(x.shape)
 
 
 def cost_model(num_microbatches: int, pp: int,

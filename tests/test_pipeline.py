@@ -109,25 +109,25 @@ def test_1f1b_matches_sequential_grads(rng, pp, n_mb):
     tgt = jnp.asarray(rng.standard_normal((8,)), jnp.float32)
 
     def run(stacked, hp, x, tgt):
-        def stage(sp_, h):
+        def stage(sp_, hp_, h, c):
             return pl.scan_layers(_toy_block, sp_, h)
 
         return pl.pipeline_train_1f1b(stage, _head, stacked, hp, x, tgt,
                                       n_mb, "pp")
 
-    loss, d_sp, d_hp = jax.jit(jax.shard_map(
+    loss, d_sp, d_hp, d_x = jax.jit(jax.shard_map(
         run, mesh=mesh, in_specs=(spec, P(), P(), P()),
-        out_specs=(P(), spec, P())))(stacked, hp, x, tgt)
+        out_specs=(P(), spec, P(), P())))(stacked, hp, x, tgt)
 
-    def ref_loss(stacked, hp):
+    def ref_loss(stacked, hp, x):
         xs = x.reshape(n_mb, -1, 16)
         ts = tgt.reshape(n_mb, -1)
         losses = [_head(hp, _seq(pl.unstack_layers(stacked), xs[i]), ts[i])
                   for i in range(n_mb)]
         return sum(losses) / n_mb
 
-    want_loss, (want_sp, want_hp) = jax.value_and_grad(
-        ref_loss, argnums=(0, 1))(stacked, hp)
+    want_loss, (want_sp, want_hp, want_x) = jax.value_and_grad(
+        ref_loss, argnums=(0, 1, 2))(stacked, hp, x)
     np.testing.assert_allclose(float(loss), float(want_loss),
                                rtol=1e-5, atol=1e-6)
     for a, b in zip(jax.tree_util.tree_leaves(d_sp),
@@ -138,6 +138,8 @@ def test_1f1b_matches_sequential_grads(rng, pp, n_mb):
                     jax.tree_util.tree_leaves(want_hp)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(d_x), np.asarray(want_x),
+                               rtol=1e-4, atol=1e-5)
 
 
 def test_1f1b_memory_independent_of_microbatches():
@@ -160,12 +162,15 @@ def test_1f1b_memory_independent_of_microbatches():
     def stage(sp_, h):
         return pl.scan_layers(_toy_block, sp_, h)
 
+    def stage4(sp_, hp_, h, c):
+        return stage(sp_, h)
+
     def temp_1f1b(M):
         fn = jax.jit(jax.shard_map(
             lambda sp_, hp_, xx, tt: pl.pipeline_train_1f1b(
-                stage, _head, sp_, hp_, xx, tt, M, "pp"),
+                stage4, _head, sp_, hp_, xx, tt, M, "pp"),
             mesh=mesh, in_specs=(spec, P(), P(), P()),
-            out_specs=(P(), spec, P())))
+            out_specs=(P(), spec, P(), P())))
         return fn.lower(stacked, hp, x, tgt).compile() \
                  .memory_analysis().temp_size_in_bytes
 
@@ -218,6 +223,73 @@ def test_llama_pp_loss_matches_plain(rng):
     got = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=(specs, P()),
                                 out_specs=P()))(stacked, (toks, labels))
     np.testing.assert_allclose(float(got), want, rtol=1e-5)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("axes", ["pp", "pp_tp", "pp_dp"])
+def test_llama_1f1b_matches_gpipe_grads(rng, axes):
+    """llama.loss_and_grads_pp_1f1b == jax.grad(loss_fn_pp) — same loss,
+    same gradients for every leaf (embedding via the returned d_x,
+    head/norm leaves via the scheduler's recorded-axes psums), across
+    pp-only, pp x tp (psums inside divergent schedule branches are
+    uniform per tp group), and pp x dp (grads stay dp-varying per shard,
+    masked-label weighting included)."""
+    import dataclasses
+    cfg = dataclasses.replace(CFG, n_layers=4)
+    toks, labels = _batch(rng)
+    if axes == "pp_dp":
+        labels = labels.at[:, : S // 4].set(-100)   # exercise weighting
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    stacked = llama.stack_params(params)
+    tp_axis = "tp" if axes == "pp_tp" else None
+    dp_axis = "dp" if axes == "pp_dp" else None
+    if axes == "pp":
+        mesh = Mesh(np.array(jax.devices()[:2]).reshape(2), ("pp",))
+    elif axes == "pp_tp":
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", "tp"))
+    else:
+        mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+    specs = llama.stacked_param_specs(cfg, pp_axis="pp", tp_axis=tp_axis)
+    b_spec = (P("dp"), P("dp")) if dp_axis else (P(), P())
+    M = 2 if dp_axis else 4
+
+    kw = dict(pp_axis="pp", num_microbatches=M, tp_axis=tp_axis,
+              dp_axis=dp_axis)
+
+    def clear(loss):
+        # numerically identity; clears the varying TYPE the same way the
+        # trainer does before returning an invariant loss
+        if tp_axis:
+            loss = jax.lax.pmean(loss, tp_axis)
+        if dp_axis:
+            loss = jax.lax.pmean(loss, dp_axis)
+        return loss
+
+    def ref(p, b):
+        return llama.loss_fn_pp(p, b, cfg, **kw)
+
+    def ref_wrapped(p, b):
+        loss, g = jax.value_and_grad(ref)(p, b)
+        return clear(loss), g
+
+    want_loss, want_g = jax.jit(jax.shard_map(
+        ref_wrapped, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    def got_fn(p, b):
+        loss, g = llama.loss_and_grads_pp_1f1b(p, b, cfg, **kw)
+        return clear(loss), g
+
+    got_loss, got_g = jax.jit(jax.shard_map(
+        got_fn, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5),
+        got_g, want_g)
 
 
 def test_llama_pp_moe_loss_matches_plain(rng):
