@@ -1,0 +1,138 @@
+"""The staged first-contact ladder's GATING logic (tools/first_contact.py)
+— pure-python, no hardware: a rare healthy tunnel window must convert into
+banked evidence in the right order, and a misbehaving kernel must never be
+driven at benchmark sizes.
+
+Rules under test (round-3 verdict item 1 + the review findings on the
+first draft):
+  - escalation past the canary requires a banked PASSING canary;
+  - a canary that raises (watchdog kill == deadlock) stops the ladder and
+    is NOT marked done (next window retries);
+  - a stage that executes but fails keeps its artifact and retries next
+    window (never marked done);
+  - a wedged probe mid-ladder stops gracefully, completed stages stay
+    banked and are skipped on the next window.
+"""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def fc(tmp_path, monkeypatch):
+    """A fresh first_contact module instance with state + git + artifacts
+    sandboxed to tmp_path."""
+    spec = importlib.util.spec_from_file_location(
+        "first_contact_under_test",
+        os.path.join(_REPO, "tools", "first_contact.py"))
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules[spec.name] = mod
+    spec.loader.exec_module(mod)
+    monkeypatch.setattr(mod, "STATE_PATH",
+                        str(tmp_path / "artifacts" / "state.json"))
+    monkeypatch.setattr(mod, "_git_commit", lambda msg: None)
+    saved = []
+    monkeypatch.setattr(mod, "save_artifact",
+                        lambda prefix, result: saved.append(prefix))
+    mod._test_saved = saved
+    return mod
+
+
+def _stages(mod, outcomes):
+    """Replace STAGES with stubs following `outcomes`: name -> result dict,
+    or an Exception instance to raise.  Records execution order."""
+    calls = []
+
+    def mk(name, out):
+        def run():
+            calls.append(name)
+            if isinstance(out, Exception):
+                raise out
+            return dict(out)
+        return run
+
+    mod.STAGES = [(name, mk(name, out), f"art_{name}")
+                  for name, out in outcomes]
+    return calls
+
+
+def test_healthy_window_runs_all_stages_in_order(fc, monkeypatch):
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    calls = _stages(fc, [("canary", {"ok": True}), ("loopback", {"ok": True}),
+                         ("bench", {"ok": True})])
+    assert fc.main() == 0
+    assert calls == ["canary", "loopback", "bench"]
+    assert sorted(fc._load_state()["done"]) == ["bench", "canary", "loopback"]
+    assert fc._test_saved == ["art_canary", "art_loopback", "art_bench"]
+
+
+def test_canary_deadlock_stops_ladder_and_is_retried(fc, monkeypatch):
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    calls = _stages(fc, [("canary", RuntimeError("watchdog kill")),
+                         ("loopback", {"ok": True})])
+    assert fc.main() == 1
+    assert calls == ["canary"]          # never escalated
+    assert fc._load_state()["done"] == {}   # not banked -> retried
+
+    # next window: canary now passes; ladder completes from the top
+    calls2 = _stages(fc, [("canary", {"ok": True}),
+                          ("loopback", {"ok": True})])
+    assert fc.main() == 0
+    assert calls2 == ["canary", "loopback"]
+
+
+def test_canary_executed_failure_banks_evidence_but_blocks(fc, monkeypatch):
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    calls = _stages(fc, [("canary", {"ok": False, "kernels": {}}),
+                         ("loopback", {"ok": True})])
+    assert fc.main() == 1
+    assert calls == ["canary"]
+    assert fc._test_saved == ["art_canary"]   # forensics banked
+    assert "canary" not in fc._load_state()["done"]   # but not done
+
+
+def test_wedge_midladder_keeps_banked_stages(fc, monkeypatch):
+    probes = iter([True, False])            # canary ok, loopback probe dies
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: next(probes))
+    calls = _stages(fc, [("canary", {"ok": True}),
+                         ("loopback", {"ok": True})])
+    assert fc.main() == 0                   # ran something; graceful stop
+    assert calls == ["canary"]
+
+    # next window: canary skipped (banked), loopback runs
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    calls2 = _stages(fc, [("canary", {"ok": True}),
+                          ("loopback", {"ok": True})])
+    assert fc.main() == 0
+    assert calls2 == ["loopback"]
+
+
+def test_failed_noncanary_stage_retries_next_window(fc, monkeypatch):
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    _stages(fc, [("canary", {"ok": True}),
+                 ("loopback", {"ok": False, "error": "x"}),
+                 ("bench", {"ok": True})])
+    assert fc.main() == 0
+    done = fc._load_state()["done"]
+    assert "loopback" not in done and "bench" in done
+
+    calls2 = _stages(fc, [("canary", {"ok": True}),
+                          ("loopback", {"ok": True}),
+                          ("bench", {"ok": True})])
+    assert fc.main() == 0
+    assert calls2 == ["loopback"]           # only the failed one reruns
+    assert "loopback" in fc._load_state()["done"]
+
+
+def test_state_is_json_on_disk(fc, monkeypatch):
+    monkeypatch.setattr(fc, "probe_tpu", lambda *a, **k: True)
+    _stages(fc, [("canary", {"ok": True})])
+    fc.main()
+    with open(fc.STATE_PATH) as f:
+        assert "canary" in json.load(f)["done"]
