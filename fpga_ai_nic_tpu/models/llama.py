@@ -537,6 +537,7 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
 def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
                            pp_axis: str, num_microbatches: int,
                            tp_axis: Optional[str] = None,
+                           sp_axis: Optional[str] = None,
                            dp_axis: Optional[str] = None,
                            remat: bool = False):
     """`loss_fn_pp`'s loss AND gradients under the 1F1B schedule
@@ -566,13 +567,14 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     tokens, labels = batch
     S = tokens.shape[1]
     n_heads, n_kv = _shard_counts(cfg, tp_axis)
-    pos = _positions(S, None)
+    pos = _positions(S, sp_axis)
     M = num_microbatches
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
 
     def block(lyr, x):
-        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, None, None)
+        return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
+                      None)
 
     def stage_fn(sp, hp, x_in, c_in):
         def blk(lyr, h):
@@ -596,17 +598,24 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
 
     count = jnp.sum(valid)
     local_sum = M * mean_nll_sum
-    loss = _weighted_loss(local_sum, count, (dp_axis,), dp_axis)
+    loss = _weighted_loss(local_sum, count, (sp_axis, dp_axis), dp_axis)
     # d loss / d mean_nll_sum: _weighted_loss is linear in local_sum with
     # coefficient 1/denom (times the n_dp gradient-scale when dp is on)
-    if dp_axis is not None:
-        denom = jnp.maximum(lax.psum(count, (dp_axis,)), 1).astype(
-            jnp.float32)
-        w = lax.axis_size(dp_axis) / denom
+    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+    if axes:
+        denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
+        w = (lax.axis_size(dp_axis) if dp_axis is not None else 1.0) / denom
     else:
         w = 1.0 / jnp.maximum(count, 1).astype(jnp.float32)
     scale = M * w
     d_emb, = emb_vjp(d_x.astype(x.dtype))
+    # tok_emb is replicated over axes its cotangent may still vary over
+    # (sp-sharded tokens feed a replicated table; GPipe's vma autodiff
+    # inserts this psum automatically, the explicit path does it here)
+    extra = tuple(sorted(set(jax.typeof(d_emb).vma)
+                         - set(jax.typeof(params["tok_emb"]).vma)))
+    if extra:
+        d_emb = lax.psum(d_emb, extra)
     grads = {"tok_emb": d_emb, "final_norm": d_hp["final_norm"],
              "lm_head": d_hp["lm_head"], "layers": d_layers}
     grads = jax.tree_util.tree_map(

@@ -71,8 +71,23 @@ class ShardedTrainer:
     def __init__(self, loss_fn: Callable, mesh: Mesh, cfg: TrainConfig,
                  param_specs, *, dp_axis: str = "dp", tp_axis: str = "tp",
                  sp_axis: str = "sp", pp_axis: Optional[str] = None,
-                 ep_axis: Optional[str] = None):
+                 ep_axis: Optional[str] = None,
+                 loss_and_grads_fn: Optional[Callable] = None):
+        """loss_and_grads_fn(params_local, batch_local) -> (loss, grads):
+        an explicit-gradient alternative to jax.grad(loss_fn) — the hook
+        for schedules that produce gradients themselves, e.g. the 1F1B
+        pipeline (llama.loss_and_grads_pp_1f1b).  The contract matches
+        what vma autodiff would produce: dp-varying per-shard grads (the
+        trainer's manual dp reduction follows), tp/pp-replicated leaves
+        already psum'd.  Mutually exclusive with accum_steps > 1 (1F1B
+        already microbatches inside the schedule)."""
         self.loss_fn = loss_fn
+        self.loss_and_grads_fn = loss_and_grads_fn
+        if loss_and_grads_fn is not None and cfg.accum_steps > 1:
+            raise ValueError(
+                "loss_and_grads_fn (explicit-gradient schedule) does not "
+                "compose with accum_steps > 1 — fold accumulation into "
+                "the schedule's num_microbatches instead")
         self.mesh = mesh
         self.cfg = cfg
         self.param_specs = param_specs
@@ -190,8 +205,11 @@ class ShardedTrainer:
             # sequence shards and tp-replicated params.
             params_v = jax.tree_util.tree_map(
                 lambda x: lax.pcast(x, dp, to="varying"), params)
-            loss, grads = accum.accumulated_value_and_grad(
-                self.loss_fn, self.cfg.accum_steps)(params_v, batch)
+            if self.loss_and_grads_fn is not None:
+                loss, grads = self.loss_and_grads_fn(params_v, batch)
+            else:
+                loss, grads = accum.accumulated_value_and_grad(
+                    self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n_dp)
             g_own = fused_update.reduce_scatter(flat_g, dp, coll) / self.n_dp
             if opt_cfg.clip_norm is not None:

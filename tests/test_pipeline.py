@@ -292,6 +292,60 @@ def test_llama_1f1b_matches_gpipe_grads(rng, axes):
         got_g, want_g)
 
 
+@pytest.mark.slow
+def test_sharded_trainer_1f1b_matches_gpipe_training(rng):
+    """The trainer knob: ShardedTrainer(loss_and_grads_fn=...) trains
+    llama on the 1F1B schedule through the full fused-update path
+    (flatten -> dp reduce-scatter -> sharded adamw -> gather) and must
+    track the GPipe trainer's loss trajectory step for step."""
+    import dataclasses
+    cfg_m = dataclasses.replace(CFG, n_layers=4)
+    toks, labels = _batch(rng)
+    params = llama.stack_params(llama.init(jax.random.PRNGKey(0), cfg_m))
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 1, 1, 2),
+                ("dp", "tp", "sp", "pp"))
+    specs = llama.stacked_param_specs(cfg_m, pp_axis="pp", tp_axis=None)
+    tcfg = TrainConfig(
+        iters=3, global_batch=B, mesh=MeshConfig(dp=2, pp=2),
+        collective=CollectiveConfig(impl="xla"),
+        optimizer=OptimizerConfig(kind="adamw", learning_rate=1e-3))
+
+    def losses(trainer):
+        st = trainer.init_state(jax.tree_util.tree_map(jnp.copy, params))
+        out = []
+        for _ in range(3):
+            st, loss = trainer.step(st, trainer.shard_batch((toks, labels)))
+            out.append(float(loss))
+        return out
+
+    gpipe = ShardedTrainer(
+        lambda p, b: llama.loss_fn_pp(p, b, cfg_m, pp_axis="pp",
+                                      num_microbatches=2, dp_axis="dp",
+                                      sp_axis="sp"),
+        mesh, tcfg, specs, pp_axis="pp")
+    onef1b = ShardedTrainer(
+        None, mesh, tcfg, specs, pp_axis="pp",
+        loss_and_grads_fn=lambda p, b: llama.loss_and_grads_pp_1f1b(
+            p, b, cfg_m, pp_axis="pp", num_microbatches=2, dp_axis="dp",
+            sp_axis="sp"))
+
+    a, b = losses(gpipe), losses(onef1b)
+    np.testing.assert_allclose(a, b, rtol=1e-4)
+    assert a[-1] < a[0]
+
+
+def test_trainer_rejects_1f1b_with_accum():
+    from fpga_ai_nic_tpu.parallel.sharded import ShardedTrainer as ST
+    import dataclasses
+    tcfg = TrainConfig(iters=1, global_batch=8,
+                       mesh=MeshConfig(dp=2, pp=2), accum_steps=2,
+                       collective=CollectiveConfig(impl="xla"),
+                       optimizer=OptimizerConfig(kind="sgd",
+                                                 learning_rate=0.1))
+    with pytest.raises(ValueError, match="loss_and_grads_fn"):
+        ST(None, _pp_mesh(2), tcfg, {}, loss_and_grads_fn=lambda p, b: None)
+
+
 def test_llama_pp_moe_loss_matches_plain(rng):
     """MoE layers on the pipelined path: with one microbatch the aux loss
     rides the scan over exactly the same routing as the unpipelined
