@@ -31,10 +31,13 @@ def test_bfp_m8_final_loss_within_5pct(model):
 
 def test_committed_artifact_gates():
     """The committed evaluation artifact (docs/bfp_convergence.json) must
-    itself satisfy the quality gates: canonical-width MEAN m8 ratio <=
-    1.05 across seeds (round-2's single-seed 20-step arm swung +/-20% and
-    could not support the gate), and the ZeRO-3 compressed-gather arm m8
-    within the same bound."""
+    itself satisfy the quality gates (round-3 verdict item 3): the
+    canonical arm is CRN-paired (identical init + batches per seed across
+    arms), >= 5 seeds, time-averaged endpoints; the gate binds on the
+    per-seed PAIRED m8 ratio — its mean <= 1.05 AND its sigma small
+    enough (< 5%) that the mean carries statistical meaning (the round-3
+    artifact's sigma was ~40% of the mean — a gate with no power).  The
+    artifact must carry provenance, since CI binds on it."""
     import json
     import os
     path = os.path.join(os.path.dirname(os.path.dirname(
@@ -42,14 +45,32 @@ def test_committed_artifact_gates():
     with open(path) as f:
         rep = json.load(f)
 
+    prov = rep.get("_provenance")
+    assert prov and prov.get("git_sha") and prov.get("timestamp_utc"), (
+        "gated artifact must carry _provenance")
+
     can = rep["mlp_canonical"]
-    assert "seeds" in can and len(can["seeds"]) >= 3, (
-        "canonical arm must be multi-seed")
+    assert "seeds" in can and len(can["seeds"]) >= 5, (
+        "canonical arm must have >= 5 CRN-paired seeds")
+    assert can.get("pairing") == "common-random-numbers", can.get("pairing")
     assert can["steps"] >= 200, can["steps"]
     m8 = can["bfp_m8"]
     assert m8["ratio_mean"] <= 1.05, m8
-    fsdp = rep["mlp_fsdp"]["bfp_m8"]
-    assert fsdp["final_loss_ratio"] <= 1.05, fsdp
+    assert m8["ratio_std"] < 0.05, (
+        "paired-ratio sigma too large for the mean to carry meaning", m8)
+    # the m4 arm is reported, not gated — but a lossy codec "improving"
+    # the paired final loss by a large margin would mean the arms are
+    # measuring noise again (the round-3 0.402 anomaly)
+    m4 = can.get("bfp_m4")
+    if m4 is not None:
+        assert m4["ratio_mean"] > 0.7, ("m4 paired ratio implausibly low "
+                                        "— endpoint noise is back", m4)
+    # ZeRO-3 compressed-gather arm: same paired multi-seed treatment (its
+    # gate previously bound on one seed's raw endpoint — no power)
+    fsdp = rep["mlp_fsdp"]
+    assert "seeds" in fsdp and len(fsdp["seeds"]) >= 5, (
+        "fsdp arm must have >= 5 CRN-paired seeds")
+    assert fsdp["bfp_m8"]["ratio_mean"] <= 1.05, fsdp["bfp_m8"]
 
 
 def test_codec_error_monotone_in_mantissa_bits():
