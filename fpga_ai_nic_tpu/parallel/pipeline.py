@@ -194,19 +194,30 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     of arbitrary vjp residuals.
 
     Contracts (call inside shard_map):
-      stage_fn(stage_params, head_params, x_in, ctx_mb) -> x_out
-        this stage's layer slice on one microbatch (head_params carries
+      stage_fn(stage_params, head_params, x_in, ctx_mb)
+          -> (x_out, stage_loss)
+        this stage's layer slice on one microbatch plus the stage's OWN
+        per-microbatch scalar loss contribution (MoE load-balance aux —
+        every stage's loss channel is seeded in its backward, not just
+        the last).  stage_loss must carry the same varying type as
+        x_out; plain stacks return the zero-gradient
+        ``jnp.sum(x_out) * 0.0``, NOT an invariant literal (mixing an
+        invariant scalar into the varying loss channel inserts a pvary
+        whose transpose is a psum inside the divergent cond).  head_params carries
         replicated leaves stages may need, e.g. stage 0's embedding —
         gate stage-specific work on lax.axis_index(pp_axis), keeping any
-        collectives over OTHER axes, never over pp_axis)
+        collectives over OTHER axes, never over pp_axis.
       loss_head_fn(head_params, x_out, ctx_mb) -> scalar per-microbatch
-        loss (applied on the LAST stage only)
+        loss (applied on the LAST stage only, ADDED to that stage's
+        contribution)
       x:   [B, ...] initial activations, replicated over pp, B % M == 0
       ctx: pytree of [B, ...] arrays (tokens/labels/masks), microbatched
         alongside x and handed to every stage + the head
 
     Returns (loss, d_stage_params, d_head_params, d_x):
-      loss   microbatch-mean of the head losses (pp-invariant)
+      loss   microbatch-mean of the summed per-stage contributions +
+             head losses (pp-invariant: psum over stages — identical to
+             the last stage's value for plain stacks)
       d_*    gradient trees matching the params; each leaf is psum'd over
              EXACTLY the axes it was widened over on entry (an
              already-varying leaf — dp-varying grads for a manual dp
@@ -214,8 +225,10 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
              cotangent, so this composes with any outer mesh)
       d_x    [B, ...] cotangent of the initial activations (for an
              embedding vjp outside), invariantized the same way
-    Dense stacks only (no MoE aux routing on this schedule yet — use the
-    GPipe path for MoE).
+    The per-stage loss channel makes the schedule MoE-ready (every
+    stage's aux differentiates locally); the llama wrapper currently
+    wires the dense path — MoE training rides GPipe
+    (llama.loss_fn_pp with_aux).
     """
     n = lax.axis_size(pp_axis)
     stage = lax.axis_index(pp_axis)
@@ -261,12 +274,13 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     ctx_mb = tmap(lambda v: _pcast_to(v, vma), ctx_mb)
 
     def g(sp, hp, x_in, c_in):
-        """The per-stage primal: layer slice, then the loss head on the
-        last stage.  The false branch derives its (varying) type from h
-        with a zero-gradient sum, NOT a pcast — a pcast's transpose is a
-        psum, which must not exist inside this divergent cond."""
-        h = stage_fn(sp, hp, x_in, c_in)
-        loss = lax.cond(
+        """The per-stage primal: layer slice (+ its own loss
+        contribution), then the loss head on the last stage.  The false
+        branch derives its (varying) type from h with a zero-gradient
+        sum, NOT a pcast — a pcast's transpose is a psum, which must not
+        exist inside this divergent cond."""
+        h, stage_loss = stage_fn(sp, hp, x_in, c_in)
+        loss = stage_loss.astype(jnp.float32) + lax.cond(
             is_last,
             lambda: loss_head_fn(hp, h, c_in).astype(jnp.float32),
             lambda: jnp.sum(h).astype(jnp.float32) * 0.0)
@@ -331,8 +345,10 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
             ct_h = pc(jnp.where(is_last,
                                 jnp.zeros(act_shape, jnp.float32),
                                 ct_in).astype(x.dtype))
-            ct_loss = pc(jnp.where(is_last, jnp.float32(1.0 / M),
-                                   jnp.float32(0.0)))
+            # EVERY stage seeds its loss channel (its own per-stage
+            # contribution differentiates locally; the head rides the
+            # last stage's channel)
+            ct_loss = pc(jnp.full((), 1.0 / M, jnp.float32))
             g_sp, g_hp, g_x, _ = pull((ct_h, ct_loss))
             d_sp = tmap(lambda a, b: a + b.astype(jnp.float32), d_sp, g_sp)
             d_hp = tmap(lambda a, b: a + b.astype(jnp.float32), d_hp, g_hp)
@@ -358,7 +374,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
 
     ticks = jnp.arange(2 * (M + n) - 2)     # last: stage-0 bwd of M-1
     (_, _, _, d_sp, d_hp, d_x, loss_acc), _ = lax.scan(tick, carry0, ticks)
-    loss = from_last_stage(loss_acc, pp_axis)
+    loss = lax.psum(loss_acc, pp_axis)      # per-stage contributions + head
     # transpose of the entry widening: psum each grad leaf over exactly
     # the axes it was widened over (head/replicated leaves got per-stage
     # partials; stage-sharded and dp-varying leaves stay per-shard)
