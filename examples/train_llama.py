@@ -49,6 +49,7 @@ def main(argv):
     from fpga_ai_nic_tpu.utils.config import coerce_value
     seq = 64
     n_mb = 1
+    pp_schedule = "gpipe"
     remat = False
     data_path = None
     save_dir = None
@@ -58,6 +59,11 @@ def main(argv):
             seq = int(a.partition("=")[2])
         elif a.startswith("--microbatches="):
             n_mb = int(a.partition("=")[2])
+        elif a.startswith("--pp_schedule="):
+            pp_schedule = a.partition("=")[2]
+            if pp_schedule not in ("gpipe", "1f1b"):
+                raise ValueError(f"--pp_schedule must be gpipe|1f1b, "
+                                 f"got {pp_schedule!r}")
         elif a.startswith("--remat="):
             remat = coerce_value(bool, a.partition("=")[2])
         elif a.startswith("--data="):
@@ -83,10 +89,23 @@ def main(argv):
     mesh = make_mesh(m)
     prof = Profiler()
 
+    loss_and_grads = None
     if pp_ax:
-        loss = lambda p, b: llama.loss_fn_pp(
-            p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb, tp_axis=tp_ax,
-            sp_axis=sp_ax, dp_axis="dp", ep_axis=ep_ax, remat=True)
+        if pp_schedule == "1f1b":
+            # explicit-gradient 1F1B: O(pp) live activations per stage
+            # (dense stacks; MoE rides gpipe)
+            if ep_ax:
+                raise ValueError("--pp_schedule=1f1b does not support MoE "
+                                 "(ep) yet — use gpipe")
+            loss = None
+            loss_and_grads = lambda p, b: llama.loss_and_grads_pp_1f1b(
+                p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb,
+                tp_axis=tp_ax, sp_axis="sp", dp_axis="dp", remat=True)
+        else:
+            loss = lambda p, b: llama.loss_fn_pp(
+                p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb,
+                tp_axis=tp_ax, sp_axis=sp_ax, dp_axis="dp", ep_axis=ep_ax,
+                remat=True)
         # tp_size enables kv-head replication when tp > n_kv_heads
         specs = llama.stacked_param_specs(mcfg, tp_axis=tp_ax,
                                           ep_axis=ep_ax, tp_size=m.tp)
@@ -100,7 +119,8 @@ def main(argv):
                                   tp_size=m.tp)
         init_params = llama.init(jax.random.PRNGKey(cfg.seed), mcfg)
 
-    tr = ShardedTrainer(loss, mesh, cfg, specs, pp_axis=pp_ax, ep_axis=ep_ax)
+    tr = ShardedTrainer(loss, mesh, cfg, specs, pp_axis=pp_ax, ep_axis=ep_ax,
+                        loss_and_grads_fn=loss_and_grads)
     with prof.bucket("init"):
         state = tr.init_state(init_params)
 
@@ -150,7 +170,8 @@ def main(argv):
     }
     if pp_ax:
         from fpga_ai_nic_tpu.parallel import pipeline
-        out["pipeline_cost"] = pipeline.cost_model(n_mb, m.pp)
+        out["pipeline_cost"] = pipeline.cost_model(
+            n_mb, m.pp, schedule=pp_schedule)
     if save_dir:
         from fpga_ai_nic_tpu.utils.checkpoint import Checkpointer
         out["checkpoint"] = Checkpointer(save_dir).save(cfg.iters, state)
