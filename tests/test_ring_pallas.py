@@ -225,6 +225,21 @@ class TestFlowControl:
             v, "dp", compression=CFG), n)(owned.reshape(-1))
         np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
 
+    def test_ag_streaming_segmented(self, rng, monkeypatch):
+        """Sequential segment kernels share one collective_id (barrier
+        semaphore) — the composition must hold under the REAL protocol,
+        not just the lockstep emulation."""
+        n = 4
+        C = SLICE * 4
+        monkeypatch.setattr(rp, "_AG_STREAM_MAX_CHUNK_ELEMS", SLICE * 2)
+        owned = jnp.asarray(rng.standard_normal((n, C)), jnp.float32)
+        got = _run(lambda v: rp.ring_all_gather_fused(
+            v, "dp", compression=CFG, slice_elems=SLICE, streaming=True,
+            interpret="threaded"), n)(owned.reshape(-1))
+        want = _run(lambda v: ring_ops.ring_all_gather(
+            v, "dp", compression=CFG), n)(owned.reshape(-1))
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
     @pytest.mark.parametrize("n,slices_per_chunk", [(4, 4), (4, 2), (3, 5)])
     def test_ag_streaming(self, rng, n, slices_per_chunk):
         """The credit window (n_slots = S+2) under real concurrency: the
