@@ -132,9 +132,16 @@ def main():
                      f" (gate: mean <= 1.05)")
         fsdp = d.get("mlp_fsdp")
         if fsdp and "bfp_m8" in fsdp:
-            L.append(f"- ZeRO-3 + compressed gather/reduce-scatter "
-                     f"(mlp_fsdp): m8 ratio "
-                     f"{fsdp['bfp_m8']['final_loss_ratio']:.3f}")
+            f8 = fsdp["bfp_m8"]
+            if "ratio_mean" in f8:      # multi-seed paired arm (round 4+)
+                L.append(f"- ZeRO-3 + compressed gather/reduce-scatter "
+                         f"(mlp_fsdp), {len(fsdp['seeds'])} seeds: m8 "
+                         f"ratio **{f8['ratio_mean']:.3f} +/- "
+                         f"{f8['ratio_std']:.3f}**")
+            else:
+                L.append(f"- ZeRO-3 + compressed gather/reduce-scatter "
+                         f"(mlp_fsdp): m8 ratio "
+                         f"{f8['final_loss_ratio']:.3f}")
         L.append("")
 
     # -- withdrawn claims ----------------------------------------------------
