@@ -556,9 +556,16 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     tp composes: _block's tp psums sit inside stage-divergent schedule
     conds, but every participant of a tp group shares one pp stage (and
     therefore one branch), so the rendezvous is uniform — only pp-axis
-    collectives are forbidden inside stages.  Dense stacks only (MoE
-    rides the GPipe path).  Returns (loss, grads) with grads matching
-    the stack_params pytree; tp/pp-replicated leaves arrive correctly
+    collectives are forbidden inside stages.  MoE composes the same way
+    (dp/sp routing-stat psums are uniform per stage): each stage's aux
+    differentiates through its own seeded loss channel with the
+    gradient-scale folded in (aux coefficient n_dp/(M*w), uniform
+    post-scale M*w — reproducing loss_fn_pp's ce and _grad_scale(aux)
+    gradients exactly), while the scheduler's non-differentiated report
+    channel carries the RAW nll and aux sums so the displayed loss is
+    reconstructed unscaled.  ep (expert-parallel) is not wired on this
+    schedule.  Returns (loss, grads) with grads matching the
+    stack_params pytree; tp/pp-replicated leaves arrive correctly
     psum'd (the scheduler transposes its own entry widening), dp-varying
     leaves stay per-shard for the trainer's manual dp reduction.
     """
@@ -572,15 +579,40 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     valid = labels >= 0
     safe = jnp.where(valid, labels, 0)
 
+    moe = cfg.moe is not None
+    batch_axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+
     def block(lyr, x):
         return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
-                      None)
+                      None, batch_axes if moe else ())
+
+    # d loss / d (scheduler mean): _weighted_loss is linear in local_sum
+    # with coefficient 1/denom (times the n_dp gradient-scale when dp is
+    # on); computed BEFORE the schedule so per-term gradient scales can
+    # fold into the differentiated loss channel
+    count = jnp.sum(valid)
+    axes = batch_axes
+    if axes:
+        denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
+        w = (lax.axis_size(dp_axis) if dp_axis is not None else 1.0) / denom
+    else:
+        w = 1.0 / jnp.maximum(count, 1).astype(jnp.float32)
+    scale = M * w
+    # aux's gradient contract: GPipe's aux path is
+    # _grad_scale(pmean_dp(psum_pp(sum_m aux)/M), n_dp) — the pmean's
+    # 1/n_dp and the grad-scale's n_dp cancel, leaving d total/d aux_sm
+    # = 1/M per shard; the uniform post-scale M*w then requires the
+    # fold c = 1/(M*w)
+    c_aux = 1.0 / jnp.maximum(scale, 1e-30)
 
     def stage_fn(sp, hp, x_in, c_in):
         def blk(lyr, h):
-            out, _ = block(lyr, h)
-            return out
-        h = pl.scan_layers(blk, sp, x_in, remat=remat)
+            return block(lyr, h)
+        h, aux = pl.scan_layers_aux(blk, sp, x_in, remat=remat)
+        if moe:
+            return (h, c_aux * aux.astype(jnp.float32),
+                    jnp.stack([jnp.sum(h).astype(jnp.float32) * 0.0,
+                               aux.astype(jnp.float32)]))
         return h, jnp.sum(h).astype(jnp.float32) * 0.0
 
     def loss_head_fn(hp, h, c_in):
@@ -588,27 +620,29 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
         h = _rmsnorm(h, hp["final_norm"], cfg.norm_eps)
         logits = h @ hp["lm_head"]
         nll = jnp.where(valid_mb, _token_nll(logits, safe_mb, tp_axis), 0.0)
-        return jnp.sum(nll)                 # SUM — weighting applied below
+        nll_sum = jnp.sum(nll)              # SUM — weighting applied below
+        if moe:
+            return nll_sum, jnp.stack([nll_sum,
+                                       nll_sum.astype(jnp.float32) * 0.0])
+        return nll_sum
 
     x, emb_vjp = jax.vjp(lambda e: e[tokens], params["tok_emb"])
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
-    mean_nll_sum, d_layers, d_hp, d_x = pl.pipeline_train_1f1b(
-        stage_fn, loss_head_fn, params["layers"], head_params,
-        x, (safe, valid), M, pp_axis)
-
-    count = jnp.sum(valid)
-    local_sum = M * mean_nll_sum
-    loss = _weighted_loss(local_sum, count, (sp_axis, dp_axis), dp_axis)
-    # d loss / d mean_nll_sum: _weighted_loss is linear in local_sum with
-    # coefficient 1/denom (times the n_dp gradient-scale when dp is on)
-    axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
-    if axes:
-        denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
-        w = (lax.axis_size(dp_axis) if dp_axis is not None else 1.0) / denom
+    if moe:
+        obj_mean, d_layers, d_hp, d_x, report = pl.pipeline_train_1f1b(
+            stage_fn, loss_head_fn, params["layers"], head_params,
+            x, (safe, valid), M, pp_axis, report_len=2)
+        # display from the RAW report: weighted ce + aux_total (value
+        # identity of _grad_scale; gradient already folded into obj)
+        loss = (_weighted_loss(report[0], count, batch_axes, dp_axis)
+                + report[1] / M)
     else:
-        w = 1.0 / jnp.maximum(count, 1).astype(jnp.float32)
-    scale = M * w
+        mean_nll_sum, d_layers, d_hp, d_x = pl.pipeline_train_1f1b(
+            stage_fn, loss_head_fn, params["layers"], head_params,
+            x, (safe, valid), M, pp_axis)
+        local_sum = M * mean_nll_sum
+        loss = _weighted_loss(local_sum, count, batch_axes, dp_axis)
     d_emb, = emb_vjp(d_x.astype(x.dtype))
     # tok_emb is replicated over axes its cotangent may still vary over
     # (sp-sharded tokens feed a replicated table; GPipe's vma autodiff

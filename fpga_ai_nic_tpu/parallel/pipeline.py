@@ -163,7 +163,7 @@ def pipeline_apply_aux(stage_fn: Callable, stage_params, x: jax.Array,
 def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
                         stage_params, head_params, x: jax.Array,
                         ctx, num_microbatches: int,
-                        pp_axis: str):
+                        pp_axis: str, report_len: int = 0):
     """One fused forward+backward pass under the 1F1B schedule — explicit
     per-tick scheduling of forwards, backwards, and both ring directions,
     returning gradients directly (no outer jax.grad).
@@ -214,7 +214,17 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
       ctx: pytree of [B, ...] arrays (tokens/labels/masks), microbatched
         alongside x and handed to every stage + the head
 
-    Returns (loss, d_stage_params, d_head_params, d_x):
+    report_len > 0 switches both callables to a three-output contract —
+    stage_fn -> (x_out, stage_loss, report [report_len]) and
+    loss_head_fn -> (loss, report [report_len]) — where `report` is a
+    NON-differentiated f32 vector accumulated across stages and
+    microbatches (summed, psum'd over pp, NOT divided by M) and returned
+    as a fifth output.  This is the display channel: a wrapper can fold
+    per-term gradient scales into the differentiated loss channel while
+    reconstructing exact unscaled values (e.g. raw token-NLL sum and raw
+    MoE aux) from the report.
+
+    Returns (loss, d_stage_params, d_head_params, d_x[, report]):
       loss   microbatch-mean of the summed per-stage contributions +
              head losses (pp-invariant: psum over stages — identical to
              the last stage's value for plain stacks)
@@ -225,10 +235,10 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
              cotangent, so this composes with any outer mesh)
       d_x    [B, ...] cotangent of the initial activations (for an
              embedding vjp outside), invariantized the same way
-    The per-stage loss channel makes the schedule MoE-ready (every
-    stage's aux differentiates locally); the llama wrapper currently
-    wires the dense path — MoE training rides GPipe
-    (llama.loss_fn_pp with_aux).
+    The per-stage loss channel + report channel carry MoE: every
+    stage's load-balance aux differentiates locally with its gradient
+    scale folded into the objective, and the raw values ride the report
+    for exact display (llama.loss_and_grads_pp_1f1b).
     """
     n = lax.axis_size(pp_axis)
     stage = lax.axis_index(pp_axis)
@@ -273,18 +283,34 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     x_mb = _pcast_to(x_mb, vma)
     ctx_mb = tmap(lambda v: _pcast_to(v, vma), ctx_mb)
 
+    R = report_len
+
     def g(sp, hp, x_in, c_in):
         """The per-stage primal: layer slice (+ its own loss
         contribution), then the loss head on the last stage.  The false
         branch derives its (varying) type from h with a zero-gradient
         sum, NOT a pcast — a pcast's transpose is a psum, which must not
-        exist inside this divergent cond."""
-        h, stage_loss = stage_fn(sp, hp, x_in, c_in)
-        loss = stage_loss.astype(jnp.float32) + lax.cond(
-            is_last,
-            lambda: loss_head_fn(hp, h, c_in).astype(jnp.float32),
-            lambda: jnp.sum(h).astype(jnp.float32) * 0.0)
-        return h, loss
+        exist inside this divergent cond.  The report channel rides
+        along stop-gradiented (display only, never differentiated)."""
+        if R:
+            h, stage_loss, rep_s = stage_fn(sp, hp, x_in, c_in)
+            head_loss, head_rep = lax.cond(
+                is_last,
+                lambda: [o.astype(jnp.float32) for o in
+                         loss_head_fn(hp, h, c_in)],
+                lambda: [jnp.sum(h).astype(jnp.float32) * 0.0,
+                         jnp.zeros((R,), jnp.float32)
+                         + jnp.sum(h).astype(jnp.float32) * 0.0])
+            rep = lax.stop_gradient(rep_s.astype(jnp.float32) + head_rep)
+        else:
+            h, stage_loss = stage_fn(sp, hp, x_in, c_in)
+            head_loss = lax.cond(
+                is_last,
+                lambda: loss_head_fn(hp, h, c_in).astype(jnp.float32),
+                lambda: jnp.sum(h).astype(jnp.float32) * 0.0)
+            rep = jnp.zeros((0,), jnp.float32)
+        loss = stage_loss.astype(jnp.float32) + head_loss
+        return h, (loss, rep)
 
     f32 = functools.partial(tmap, lambda p: jnp.zeros(p.shape, jnp.float32))
 
@@ -299,6 +325,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
         tmap(pc, f32(head_params)),
         pc(jnp.zeros((M,) + act_shape, jnp.float32)),  # d_x per microbatch
         pc(jnp.float32(0.0)),                         # loss accumulator
+        pc(jnp.zeros((report_len,), jnp.float32)),    # report accumulator
     )
 
     def ctx_at(mi):
@@ -306,7 +333,7 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
                     ctx_mb)
 
     def tick(carry, t):
-        act_in, ct_in, saved, d_sp, d_hp, d_x, loss_acc = carry
+        act_in, ct_in, saved, d_sp, d_hp, d_x, loss_acc, rep_acc = carry
 
         m_f = (t - stage) // 2
         fwd_work = ((t - stage) % 2 == 0) & (m_f >= 0) & (m_f < M)
@@ -316,22 +343,22 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
 
         # ---- forward unit (parity-s ticks) ----
         def do_fwd(op):
-            act_in, saved, loss_acc = op
+            act_in, saved, loss_acc, rep_acc = op
             mi = jnp.clip(m_f, 0, M - 1)
             x_in = jnp.where(stage == 0,
                              lax.dynamic_index_in_dim(x_mb, mi, 0, False),
                              act_in.astype(x.dtype))
-            h, loss = g(sp_v, hp_v, x_in, ctx_at(mi))
+            h, (loss, rep) = g(sp_v, hp_v, x_in, ctx_at(mi))
             saved = lax.dynamic_update_index_in_dim(
                 saved, x_in, mi % n, 0)
-            return h, saved, loss_acc + loss / M
+            return h, saved, loss_acc + loss / M, rep_acc + rep
 
         def skip_fwd(op):
-            act_in, saved, loss_acc = op
-            return act_in.astype(x.dtype), saved, loss_acc
+            act_in, saved, loss_acc, rep_acc = op
+            return act_in.astype(x.dtype), saved, loss_acc, rep_acc
 
-        act_out, saved, loss_acc = lax.cond(
-            fwd_work, do_fwd, skip_fwd, (act_in, saved, loss_acc))
+        act_out, saved, loss_acc, rep_acc = lax.cond(
+            fwd_work, do_fwd, skip_fwd, (act_in, saved, loss_acc, rep_acc))
 
         # ---- backward unit (parity-(s+1) ticks) ----
         def do_bwd(op):
@@ -349,7 +376,11 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
             # contribution differentiates locally; the head rides the
             # last stage's channel)
             ct_loss = pc(jnp.full((), 1.0 / M, jnp.float32))
-            g_sp, g_hp, g_x, _ = pull((ct_h, ct_loss))
+            # report: no grad; the R=0 dummy channel is an invariant
+            # empty array, so its seed must be too
+            ct_rep = (pc(jnp.zeros((R,), jnp.float32)) if R
+                      else jnp.zeros((0,), jnp.float32))
+            g_sp, g_hp, g_x, _ = pull((ct_h, (ct_loss, ct_rep)))
             d_sp = tmap(lambda a, b: a + b.astype(jnp.float32), d_sp, g_sp)
             d_hp = tmap(lambda a, b: a + b.astype(jnp.float32), d_hp, g_hp)
             # d_x is meaningful on stage 0 only (its x_in came from x_mb,
@@ -370,10 +401,12 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
         # outside the conds: every stage participates every tick)
         act_next = lax.ppermute(act_out, pp_axis, fwd_perm)
         ct_next = lax.ppermute(ct_out, pp_axis, bwd_perm)
-        return (act_next, ct_next, saved, d_sp, d_hp, d_x, loss_acc), None
+        return (act_next, ct_next, saved, d_sp, d_hp, d_x, loss_acc,
+                rep_acc), None
 
     ticks = jnp.arange(2 * (M + n) - 2)     # last: stage-0 bwd of M-1
-    (_, _, _, d_sp, d_hp, d_x, loss_acc), _ = lax.scan(tick, carry0, ticks)
+    (_, _, _, d_sp, d_hp, d_x, loss_acc, rep_acc), _ = lax.scan(
+        tick, carry0, ticks)
     loss = lax.psum(loss_acc, pp_axis)      # per-stage contributions + head
     # transpose of the entry widening: psum each grad leaf over exactly
     # the axes it was widened over (head/replicated leaves got per-stage
@@ -383,6 +416,9 @@ def pipeline_train_1f1b(stage_fn: Callable, loss_head_fn: Callable,
     # d_x: stage-0 rows + zeros elsewhere; pp-psum selects stage 0's and
     # the recorded widening handles any other axes
     d_x = lax.psum(d_x, tuple(sorted(set(x_axes) | {pp_axis})))
+    if report_len:
+        report = lax.psum(rep_acc, pp_axis)
+        return loss, d_sp, d_hp, d_x.reshape(x.shape), report
     return loss, d_sp, d_hp, d_x.reshape(x.shape)
 
 

@@ -336,6 +336,56 @@ def test_sharded_trainer_1f1b_matches_gpipe_training(rng):
     assert a[-1] < a[0]
 
 
+@pytest.mark.slow
+def test_llama_1f1b_moe_matches_gpipe_grads(rng):
+    """MoE on the 1F1B schedule: per-stage aux differentiates through the
+    stage's own seeded loss channel (gradient-scale folded, n_dp/(M*w)),
+    the display loss reconstructs from the raw report channel — loss AND
+    every gradient leaf must match jax.grad(loss_fn_pp) on a dp x pp
+    mesh."""
+    import dataclasses
+    cfg_m = dataclasses.replace(
+        llama.LlamaConfig.tiny(n_layers=4, ffn_dim=64),
+        moe_experts=4, moe_top_k=2, moe_capacity_factor=4.0)
+    toks, labels = _batch(rng)
+    labels = labels.at[:, : S // 4].set(-100)
+    params = llama.init(jax.random.PRNGKey(0), cfg_m)
+    stacked = llama.stack_params(params)
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("dp", "pp"))
+    specs = llama.stacked_param_specs(cfg_m, pp_axis="pp", tp_axis=None)
+    b_spec = (P("dp"), P("dp"))
+    M = 2
+    kw = dict(pp_axis="pp", num_microbatches=M, dp_axis="dp")
+
+    def clear(loss):
+        return jax.lax.pmean(loss, "dp")
+
+    def ref_wrapped(p, b):
+        loss, g = jax.value_and_grad(
+            lambda p2, b2: llama.loss_fn_pp(p2, b2, cfg_m, **kw))(p, b)
+        return clear(loss), g
+
+    want_loss, want_g = jax.jit(jax.shard_map(
+        ref_wrapped, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    def got_fn(p, b):
+        loss, g = llama.loss_and_grads_pp_1f1b(p, b, cfg_m, **kw,
+                                               sp_axis=None)
+        return clear(loss), g
+
+    got_loss, got_g = jax.jit(jax.shard_map(
+        got_fn, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5),
+        got_g, want_g)
+
+
 def test_trainer_rejects_1f1b_with_accum():
     from fpga_ai_nic_tpu.parallel.sharded import ShardedTrainer as ST
     import dataclasses
