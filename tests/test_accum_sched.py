@@ -102,6 +102,7 @@ def test_accumulation_matches_single_shot():
                                    rtol=2e-5, atol=2e-6)
 
 
+@pytest.mark.slow
 def test_accumulation_sharded_llama():
     """Accumulation composes with the multi-axis trainer (dp x tp)."""
     cfg_m = llama.LlamaConfig.tiny()

@@ -160,6 +160,7 @@ def test_ddp_matches_single_device_reference(rng, impl):
     np.testing.assert_allclose(float(loss), ref_loss, rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_ddp_bfp_ring_converges(rng):
     cfg = _cfg(
         iters=8,

@@ -17,6 +17,7 @@ from fpga_ai_nic_tpu.evals import bfp_convergence as ev
 STEPS = 60
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("model", ["mlp", "bert"])
 def test_bfp_m8_final_loss_within_5pct(model):
     rep = ev.run_comparison(model, STEPS, mantissa_sweep=(8,), batch=32)

@@ -69,6 +69,7 @@ def test_dp_clip_matches_optax_reference(rng, trainer_cls):
             rtol=2e-5, atol=1e-6), got, want)
 
 
+@pytest.mark.slow
 def test_sharded_tp_clip_matches_unsharded(rng):
     """dp x tp Llama with clipping == single-device clipped adamw step:
     tp-replicated leaves (norms) must not be double-counted in the norm."""

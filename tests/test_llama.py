@@ -93,6 +93,7 @@ def test_num_params_matches_init():
 
 @pytest.mark.parametrize("dp,tp,sp", [(2, 2, 2), (1, 4, 2), (4, 1, 2),
                                       (2, 2, 1)])
+@pytest.mark.slow
 def test_sharded_training_matches_unsharded(dp, tp, sp):
     """The framework's core contract: the same model trained on a
     dp x tp x sp mesh produces the same weights as one device."""
@@ -137,6 +138,7 @@ def test_sharded_training_matches_unsharded(dp, tp, sp):
             err_msg=str(path_want[0]))
 
 
+@pytest.mark.slow
 def test_kv_replicated_tp_matches_unsharded():
     """tp > n_kv_heads (tp=4, n_kv=2): wk/wv replicate over tp, each rank
     slices its query group's kv head, and the tied-replica gradient (vma
@@ -230,6 +232,7 @@ def test_rope_scaling_parity_and_bands(rng):
     assert np.all(np.isfinite(np.asarray(logits, np.float32)))
 
 
+@pytest.mark.slow
 def test_remat_grad_parity_and_memory(rng):
     """remat=True: identical gradients (it is the same math recomputed) and
     strictly smaller compiled temp memory for a deep config."""

@@ -92,6 +92,7 @@ def test_moe_ep_matches_single_device(rng):
     np.testing.assert_allclose(float(aux), float(aux_want), rtol=1e-5)
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,ep", [(2, 2), (1, 4), (2, 4)])
 def test_moe_llama_training_matches_unsharded(dp, ep):
     """dp x ep ZeRO-1 MoE training must reproduce the single-device update
@@ -136,6 +137,7 @@ def test_moe_llama_training_matches_unsharded(dp, ep):
             rtol=5e-4, atol=5e-5, err_msg=str(pw[0]))
 
 
+@pytest.mark.slow
 @pytest.mark.parametrize("dp,tp,ep", [(1, 4, 1), (2, 2, 2)])
 def test_moe_tp_training_matches_unsharded(dp, tp, ep):
     """MoE x tp (x ep): each expert's SwiGLU hidden Megatron-shards over tp
@@ -231,6 +233,7 @@ def test_expert_stats_sharded_matches_unsharded(rng):
         float(want["drop_frac"]), abs=1e-6)
 
 
+@pytest.mark.slow
 def test_moe_llama_converges(rng):
     """8 adamw steps on a fixed batch must reduce the loss (the convergence
     smoke the round-1 review flagged as missing)."""
