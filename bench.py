@@ -251,7 +251,7 @@ def main() -> None:
             result["failed_attempts"] = errors
         print(json.dumps(result), flush=True)
 
-    if probe_tpu(budget_s=min(40.0, remaining())):
+    if probe_tpu(budget_s=min(90.0, remaining())):
         for att in (TPU_FULL, TPU_SMALL):
             result = attempt(att)
             if result is not None:
@@ -264,9 +264,11 @@ def main() -> None:
     # every remaining second on spaced re-probes — a wedge that clears
     # mid-ladder still yields a real TPU record
     banked = attempt(CPU_RUNG)
-    while remaining() > TPU_SMALL["budget_s"] + 45.0:
-        time.sleep(min(20.0, max(remaining() - TPU_SMALL["budget_s"] - 40, 0)))
-        if not probe_tpu(budget_s=min(40.0, remaining())):
+    # reserve covers the worst-case probe (90 s) ahead of the attempt so a
+    # slow-but-healthy probe cannot eat the attempt's own budget
+    while remaining() > TPU_SMALL["budget_s"] + 95.0:
+        time.sleep(min(20.0, max(remaining() - TPU_SMALL["budget_s"] - 90, 0)))
+        if not probe_tpu(budget_s=min(90.0, remaining())):
             continue
         att = TPU_FULL if remaining() > TPU_FULL["budget_s"] + 5 else TPU_SMALL
         result = attempt(att)

@@ -96,13 +96,13 @@ d = jax.devices()
 print("[bench] phase=compute t=%.1fs" % (time.time()-t0), flush=True)
 import jax.numpy as jnp
 v = float(jax.jit(lambda a: (a @ a).sum())(jnp.ones((128, 128), jnp.bfloat16)))
-print(json.dumps({"ok": v == 128.0 * 128, "platform": d[0].platform,
+print(json.dumps({"ok": v == 128.0 ** 3, "platform": d[0].platform,
                   "n_devices": len(d), "t": round(time.time()-t0, 1)}),
       flush=True)
 """
 
 
-def probe_tpu(budget_s: float = 40.0, silence_s: float = 35.0) -> bool:
+def probe_tpu(budget_s: float = 90.0, silence_s: float = 60.0) -> bool:
     """Is the TPU tunnel healthy *right now*?  A subprocess imports jax,
     enumerates devices, and runs one tiny jitted matmul under an activity
     watchdog — the three places a wedged tunnel hangs (import / devices /
@@ -120,6 +120,24 @@ def probe_tpu(budget_s: float = 40.0, silence_s: float = 35.0) -> bool:
     except Exception as e:  # noqa: BLE001 — a failed probe is just "wedged"
         log(f"probe failed: {e}")
         return False
+
+
+def chain_kernel_calls(call, k: int = 8):
+    """jit(k chained invocations of a side-effecting kernel `call`) —
+    divide the elapsed time of one dispatch by k.  The adds serialize the
+    calls without copies, and pallas `has_side_effects=True` keeps the
+    identical invocations from being CSE'd.  This exists because the
+    axon tunnel costs ~16 ms per device dispatch (first contact measured
+    a FLAT 16-18 ms across 1-32 MiB payloads), which floors any
+    one-kernel-per-dispatch measurement."""
+    import jax
+
+    def chained(v):
+        acc = call(v)
+        for _ in range(k - 1):
+            acc = acc + call(v)
+        return acc
+    return jax.jit(chained)
 
 
 def git_sha(repo_dir=None) -> str:

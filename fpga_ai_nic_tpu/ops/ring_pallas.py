@@ -20,9 +20,11 @@ with its dual-clock FIFOs and valid/ready handshakes (hw/fifo.v,
 hw/bfp_adapter.sv:57-98).
 
 Wire format: one int8 frame per slice packing `R` mantissa rows followed
-by `R/B` shared-exponent rows (B = block_size) — byte-for-byte the rate of
-the reference's 17-flit frame (16 mantissa flits : 1 exponent flit,
-hw/bfp_adapter.sv:30,63-77), so one RDMA moves the whole compressed slice.
+by `R/B` shared-exponent rows (B = block_size) — the live rows carry the
+reference's exact 17-flit rate (16 mantissa flits : 1 exponent flit,
+hw/bfp_adapter.sv:30,63-77), and the RDMA'd frame rounds up to the int8
+8-row tile (_frame_rows; 72/68 of the live bytes at the default R=64
+plan).  One RDMA moves the whole compressed slice.
 
 Numerics are bit-identical to `ops.ring.ring_reduce_scatter` with
 codec="pallas" and the same slice_elems (same add order, same per-hop
@@ -78,6 +80,22 @@ def _decode_rows(mant, scale, block_size: int):
     se = scale.astype(jnp.int32)
     s = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
     return mant.astype(jnp.float32) * jnp.repeat(s, block_size, axis=0)
+
+
+_FRAME_ALIGN = 8     # int8 VMEM sublane tile: DMA slice row extents align
+
+
+def _frame_rows(R: int, block_size: int) -> int:
+    """Rows of one RDMA'd wire frame: R mantissa rows + R/B scale rows,
+    padded up to the int8 (8,128) sublane tile — the Mosaic compiler
+    rejects DMA slices whose row extent is not tile-aligned (first
+    hardware contact, v5e: "Slice shape along dimension 1 must be aligned
+    to tiling (8), but is 17").  Pad rows ride the wire but are never
+    written or decoded; at the default slice plan (R=64, B=16: 68 -> 72
+    rows) the overhead is 5.9%, and the live rows keep the reference's
+    exact 16:1 mantissa:exponent rate (hw/bfp_adapter.sv:30,63-77)."""
+    live = R + R // block_size
+    return -(-live // _FRAME_ALIGN) * _FRAME_ALIGN
 
 
 def _neighbor_barrier(left, right):
@@ -292,7 +310,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     chunk_rows = L_rows // n
     R = slice_elems // LANES
     S = chunk_rows // R
-    pkt_rows = R + R // block_size
+    pkt_rows = _frame_rows(R, block_size)
     ids = _ring_ids(axis_name)
     _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
@@ -522,7 +540,7 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
     chunk_rows = L_rows // n
     R = slice_elems // LANES
     S = chunk_rows // R
-    pkt_rows = R + R // block_size
+    pkt_rows = _frame_rows(R, block_size)
     ids = _ring_ids(axis_name)
     _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
@@ -655,7 +673,7 @@ def _ag_call(own2, axis_name: Optional[str], block_size: int,
              collective_id: int, loopback_n: Optional[int] = None):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     R = own2.shape[0]
-    pkt_rows = R + R // block_size
+    pkt_rows = _frame_rows(R, block_size)
     ids = _ring_ids(axis_name)
     _interp, _flow, _unrolled = _interp_args(interpret)
     kern = functools.partial(
@@ -777,12 +795,12 @@ def _ag_schedule(n: int, S: int, n_slots: int):
     return content, fwd_j, own_at, own_j, own_js, tail_own_js
 
 
-def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
-                      recv_pkt, ld_sem, own_wb_sem, wb_sem, send_sem,
-                      recv_sem, credit_sem, *, n: int, n_slices: int,
-                      n_slots: int, slice_rows: int, block_size: int,
-                      mantissa_bits: int, rounding: str, flow_control: bool,
-                      unrolled: bool):
+def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
+                      send_pkt, recv_pkt, ld_sem, own_wb_sem, wb_sem,
+                      send_sem, recv_sem, credit_sem, *, n: int,
+                      n_slices: int, n_slots: int, slice_rows: int,
+                      block_size: int, mantissa_bits: int, rounding: str,
+                      flow_control: bool, unrolled: bool, schedule: tuple):
     """HBM-streaming fused ring all-gather, interleaved emission order.
 
     Loop index m = arrival order (== upstream's emission order; wire slots
@@ -820,8 +838,13 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
     SB = R // block_size
     chunk_rows = S * R
     total = (n - 1) * S                 # arrivals == emissions
+    # the static schedule arrives twice: as python lists (compile-time —
+    # drives the unrolled interpreter schedule and the static tail-drain
+    # list) and as the sched_ref SMEM input (runtime — the rolled hardware
+    # schedule reads it; in-kernel jnp table constants are rejected by the
+    # Mosaic compiler: "kernel captures constants ... pass them as inputs")
     (content_t, fwd_j_t, own_at_t, own_j_t, own_js,
-     tail_own_js) = _ag_schedule(n, S, n_slots)
+     tail_own_js) = schedule
 
     def wslot(x):
         return x % n_slots
@@ -842,29 +865,24 @@ def _ag_stream_kernel(ids_ref, own_hbm, out_hbm, ld, own_st, st, send_pkt,
         def is_own_j(j):
             return j >= 0 and j in own_js
     else:
-        # static dispatch tables embedded as constants; one scalar gather
-        # per slice step (n, S are compile-time, so the tables are too)
-        CONTENT = jnp.asarray(content_t, jnp.int32)
-        FWDJ = jnp.asarray(fwd_j_t, jnp.int32)
-        OWNAT = jnp.asarray(own_at_t, jnp.int32)
-        OWNJ = jnp.asarray(own_j_t, jnp.int32)
-        OWNMASK = jnp.asarray([1 if j2 in own_js else 0
-                               for j2 in range(total)], jnp.int32)
+        # static dispatch tables, one scalar SMEM load per schedule
+        # decision (sched_ref rows: 0 content, 1 fwd_j, 2 own_at,
+        # 3 own-mask, 4 own_j — built in _ag_stream_call)
 
         def content(m):
-            return CONTENT[m]
+            return sched_ref[0, m]
 
         def fwd_j(m):
-            return FWDJ[m]
+            return sched_ref[1, m]
 
         def own_at(m):
-            return OWNAT[m]
+            return sched_ref[2, m]
 
         def own_j(k):
-            return OWNJ[k]
+            return sched_ref[4, k]
 
         def is_own_j(j):
-            return (j >= 0) & (OWNMASK[jnp.clip(j, 0, total - 1)] == 1)
+            return (j >= 0) & (sched_ref[3, jnp.clip(j, 0, total - 1)] == 1)
 
     def out_rdma(j, src):
         slot = wslot(j)
@@ -1022,22 +1040,37 @@ def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
     C_rows = own2.shape[0]
     R = slice_elems // LANES
     S = C_rows // R
-    pkt_rows = R + R // block_size
+    pkt_rows = _frame_rows(R, block_size)
     ids = _ring_ids(axis_name)
     # slot window sized to the slice plan: covers the own phase's maximum
     # emission lead (== S, _ag_schedule P2) with one slot of margin
     n_slots = min((n - 1) * S, S + 2)
     _interp, _flow, _unrolled = _interp_args(interpret)
+    schedule = _ag_schedule(n, S, n_slots)
+    content_t, fwd_j_t, own_at_t, own_j_t, own_js, _tails = schedule
+    total = (n - 1) * S
+    # SMEM copy of the schedule for the rolled (hardware) path; rows:
+    # content / fwd_j / own_at / own-mask / own_j (padded with -1)
+    import numpy as np
+    sched_np = np.full((5, total), -1, np.int32)
+    sched_np[0] = content_t
+    sched_np[1] = fwd_j_t
+    sched_np[2] = own_at_t
+    sched_np[3] = [1 if j in own_js else 0 for j in range(total)]
+    sched_np[4, :S] = own_j_t
+    sched = jnp.asarray(sched_np)
     kern = functools.partial(
         _ag_stream_kernel, n=n, n_slices=S, n_slots=n_slots, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled,
+        schedule=schedule)
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
         out_shape=jax.ShapeDtypeStruct((n * C_rows, LANES), jnp.float32,
                                        vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
         out_specs=pl.BlockSpec(memory_space=pl.ANY),
         scratch_shapes=[
@@ -1056,14 +1089,16 @@ def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
         compiler_params=pltpu.CompilerParams(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
-    )(ids, own2)
+    )(ids, sched, own2)
 
 
-# Frame VMEM for the streaming gather is ~2 * (S+2)/S * 17/16 bytes per
-# chunk f32 element (send + recv windows) regardless of the slice plan, so
-# the binding constraint is the CHUNK size; larger chunks are gathered in
-# sequential segments of at most this many elements (each segment is an
-# independent all-gather — BFP blocks never straddle a segment boundary).
+# Frame VMEM for the streaming gather is ~2 * (S+2)/S * (FR/(R*4)) bytes
+# per chunk f32 element (send + recv windows), where FR = _frame_rows(R, B)
+# includes the 8-row tile padding — 72/68 of the live 17/16 rate at the
+# default R=64 plan, but up to 24/17 (~1.4x) at R=16; the binding
+# constraint is the CHUNK size.  Larger chunks are gathered in sequential
+# segments of at most this many elements (each segment is an independent
+# all-gather — BFP blocks never straddle a segment boundary).
 _AG_STREAM_MAX_CHUNK_ELEMS = 2 << 20      # ~4.5 MiB frame VMEM per segment
 
 

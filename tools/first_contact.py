@@ -146,6 +146,7 @@ platform = d[0].platform
 print("[bench] phase=devices t=%.1fs platform=%s" % (time.time()-t0, platform),
       flush=True)
 from fpga_ai_nic_tpu.ops import ring_pallas as rp
+from bench_common import chain_kernel_calls
 
 _scalar = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
 def sync(t):
@@ -153,7 +154,11 @@ def sync(t):
 
 out = {"stage": "loopback", "platform": platform, "sweep": []}
 vn = 8
-for mib, slice_elems, streaming in ((1, 8192, False), (8, 8192, False),
+# resident rows cap at 4 MiB: the kernel holds input + acc copies in VMEM,
+# and 2 * 8 MiB + frames exceeds v5e's 16 MiB scoped-vmem limit (measured:
+# "Scoped allocation with size 16.04M and limit 16.00M") — the production
+# router (_VMEM_RESIDENT_MAX_BYTES) already enforces this bound
+for mib, slice_elems, streaming in ((1, 8192, False), (4, 8192, False),
                                     (8, 8192, True), (32, 8192, True)):
     L = mib * (1 << 20) // 4
     L -= L % (vn * slice_elems)
@@ -164,19 +169,22 @@ for mib, slice_elems, streaming in ((1, 8192, False), (8, 8192, False),
     if streaming:
         kw["streaming"] = True     # builds without the kwarg record the
     try:                           # TypeError in the sweep row honestly
-        run = jax.jit(lambda v: rp.loopback_microbench(v, vn, **kw))
+        k = 8
+        run = chain_kernel_calls(
+            lambda v: rp.loopback_microbench(v, vn, **kw), k)
         r = run(x); sync(r)                      # compile + warmup
-        t1 = time.perf_counter()
-        iters = 4
-        for _ in range(iters):
+        best = None
+        for _ in range(3):
+            t1 = time.perf_counter()
             r = run(x)
-        sync(r)
-        dt = (time.perf_counter() - t1) / iters
+            sync(r)
+            dt = (time.perf_counter() - t1) / k
+            best = dt if best is None else min(best, dt)
         hop_bytes = (vn - 1) * (L // vn) * 4     # f32 through the pipeline
         out["sweep"].append({
             "mib": mib, "streaming": streaming,
-            "pipeline_gbps": round(hop_bytes / dt / 1e9, 2),
-            "t_ms": round(dt * 1e3, 2)})
+            "pipeline_gbps": round(hop_bytes / best / 1e9, 2),
+            "t_ms": round(best * 1e3, 2), "inner_k": k})
         print(f"[bench] {mib}MiB stream={streaming}: "
               f"{out['sweep'][-1]['pipeline_gbps']} GB/s", flush=True)
     except Exception as e:
