@@ -70,7 +70,8 @@ def main():
     L.append("")
 
     # -- collective / codec --------------------------------------------------
-    col_art = (_newest("COLLECTIVE_r*.json")
+    col_art = (_newest("artifacts/collective_tpu_*.json")
+               or _newest("COLLECTIVE_r*.json")
                or _newest("artifacts/collective_2*.json"))
     if col_art:
         d = _load(col_art)
@@ -115,6 +116,26 @@ def main():
                          f"| {'yes' if v['bfp_wins'] else 'no'} "
                          f"| {v['required_codec_gbps_to_win']} |")
             L.append("")
+        if not (d.get("sweep") or d.get("mesh_sweep")):
+            # single-chip TPU artifact carries no multi-device sweep; cite
+            # the newest CPU-mesh record for the busbw table
+            cpu_art = (_newest("COLLECTIVE_r*.json")
+                       or _newest("artifacts/collective_2*.json"))
+            if cpu_art:
+                dc = _load(cpu_art)
+                sweep = dc.get("sweep")
+                if sweep:
+                    L += [f"Ring busbw sweep (`{_rel(cpu_art)}`, platform: "
+                          f"{dc.get('platform')} — the virtual CPU mesh is "
+                          "memory-bound, not ICI-representative):", "",
+                          "| size MiB | psum bf16 | ring f32 | ring BFP | "
+                          "BFP/f32 |", "|---|---|---|---|---|"]
+                    for r in sweep:
+                        L.append(
+                            f"| {r['size_mb']} | {r['psum_bf16_gbps']} "
+                            f"| {r['ring_f32_gbps']} | {r['ring_bfp_gbps']} "
+                            f"| {r['bfp_speedup_vs_ring_f32']}x |")
+                    L.append("")
 
     # -- convergence ---------------------------------------------------------
     conv = os.path.join(ROOT, "docs", "bfp_convergence.json")
@@ -152,7 +173,13 @@ def main():
           "substantiates them, and the driver's contemporaneous record "
           "(BENCH_r02.json) is a degraded CPU fallback — so they are "
           "withdrawn rather than repeated.  They return if and when a "
-          "committed artifact reproduces them.", ""]
+          "committed artifact reproduces them.  Round 4 UPDATE: the "
+          "first-contact ladder's committed TPU artifacts now reproduce "
+          "every one of those figures (502,223 samples/s/chip, 35.9x "
+          "baseline, 62% MFU, 99.96% DMA overlap, 12.0 GB/s codec "
+          "encode — see the headline and collective tables above), so "
+          "the round-2 numbers were plausibly real but unevidenced; the "
+          "withdrawal stands as a record of process, not of falsity.", ""]
 
     out = os.path.join(ROOT, "docs", "PERF.md")
     with open(out, "w") as f:
