@@ -527,8 +527,10 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
         aux = lax.pmean(aux, batch_axes)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
     local_sum = pl.from_last_stage(jnp.sum(nll), pp_axis)
-    loss = _weighted_loss(local_sum, jnp.sum(valid), (sp_axis, dp_axis),
-                          dp_axis)
+    # ep shards the batch alongside dp (ShardedTrainer._bspec), so the
+    # token-weighted reduction must span it too — matching loss_fn
+    loss = _weighted_loss(local_sum, jnp.sum(valid),
+                          (sp_axis, dp_axis, ep_axis), dp_axis)
     if dp_axis is not None:     # same /n_dp cancellation as the ce term
         aux = _grad_scale(aux, lax.axis_size(dp_axis))
     return loss + aux
@@ -539,6 +541,7 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
                            tp_axis: Optional[str] = None,
                            sp_axis: Optional[str] = None,
                            dp_axis: Optional[str] = None,
+                           ep_axis: Optional[str] = None,
                            remat: bool = False):
     """`loss_fn_pp`'s loss AND gradients under the 1F1B schedule
     (parallel.pipeline.pipeline_train_1f1b): O(pp) live activations per
@@ -559,12 +562,18 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     collectives are forbidden inside stages.  MoE composes the same way
     (dp/sp routing-stat psums are uniform per stage): each stage's aux
     differentiates through its own seeded loss channel with the
-    gradient-scale folded in (aux coefficient n_dp/(M*w), uniform
+    gradient-scale folded in (aux coefficient 1/(M*w*n_rep), uniform
     post-scale M*w — reproducing loss_fn_pp's ce and _grad_scale(aux)
-    gradients exactly), while the scheduler's non-differentiated report
-    channel carries the RAW nll and aux sums so the displayed loss is
-    reconstructed unscaled.  ep (expert-parallel) is not wired on this
-    schedule.  Returns (loss, grads) with grads matching the
+    gradients exactly; n_rep is the replication of the aux value over
+    the non-dp batch axes, whose pmean seed GPipe's autodiff applies),
+    while the scheduler's non-differentiated report channel carries the
+    RAW nll and aux sums so the displayed loss is reconstructed
+    unscaled.  ep composes like tp: the all_to_all expert exchange and
+    routing-stat psums sit inside stage-divergent schedule conds, but
+    every ep-group member shares one pp stage and therefore one branch;
+    expert leaves enter ep-varying (sharded) and keep per-shard
+    cotangents, ep-replicated leaves are widened on entry and psum'd
+    over ep on exit.  Returns (loss, grads) with grads matching the
     stack_params pytree; tp/pp-replicated leaves arrive correctly
     psum'd (the scheduler transposes its own entry widening), dp-varying
     leaves stay per-shard for the trainer's manual dp reduction.
@@ -580,11 +589,12 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     safe = jnp.where(valid, labels, 0)
 
     moe = cfg.moe is not None
-    batch_axes = tuple(a for a in (sp_axis, dp_axis) if a is not None)
+    batch_axes = tuple(a for a in (sp_axis, dp_axis, ep_axis)
+                       if a is not None)
 
     def block(lyr, x):
         return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
-                      None, batch_axes if moe else ())
+                      ep_axis, batch_axes if moe else ())
 
     # d loss / d (scheduler mean): _weighted_loss is linear in local_sum
     # with coefficient 1/denom (times the n_dp gradient-scale when dp is
@@ -599,11 +609,19 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
         w = 1.0 / jnp.maximum(count, 1).astype(jnp.float32)
     scale = M * w
     # aux's gradient contract: GPipe's aux path is
-    # _grad_scale(pmean_dp(psum_pp(sum_m aux)/M), n_dp) — the pmean's
-    # 1/n_dp and the grad-scale's n_dp cancel, leaving d total/d aux_sm
-    # = 1/M per shard; the uniform post-scale M*w then requires the
-    # fold c = 1/(M*w)
-    c_aux = 1.0 / jnp.maximum(scale, 1e-30)
+    # _grad_scale(pmean_batch(psum_pp(sum_m aux)/M), n_dp) — the pmean
+    # seeds each shard with 1/(n_dp * n_rep) where n_rep is the product
+    # of the NON-dp batch-axis sizes (sp, ep); the grad-scale's n_dp
+    # cancels the dp factor, leaving d total/d aux_sm = 1/(M * n_rep)
+    # per shard.  The uniform post-scale M*w then requires the fold
+    # c = 1/(M * w * n_rep).  (The exit psums over sp/ep for replicated
+    # router leaves are identical in both paths, so the SEEDS must
+    # match shard-for-shard.)
+    n_rep = 1
+    for a in batch_axes:
+        if a != dp_axis:
+            n_rep *= lax.axis_size(a)
+    c_aux = 1.0 / jnp.maximum(scale * n_rep, 1e-30)
 
     def stage_fn(sp, hp, x_in, c_in):
         def blk(lyr, h):

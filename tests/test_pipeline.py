@@ -498,3 +498,72 @@ def test_cost_model_bubble_arithmetic():
             < cm["bubble_fraction"])
     with pytest.raises(ValueError):
         pipeline.cost_model(0, 2)
+
+
+@pytest.mark.slow
+def test_llama_1f1b_moe_ep_matches_gpipe_and_unsharded(rng):
+    """ep on the 1F1B schedule — the last trainer-axis composition: on a
+    dp x pp x ep mesh the all_to_all expert exchange and routing-stat
+    psums execute inside stage-divergent schedule conds (uniform per ep
+    group, like tp), expert leaves keep per-shard cotangents, and the
+    token weighting spans ep (ep shards the batch alongside dp).
+
+    Three-way check with UNEQUAL valid-token counts across ep shards
+    (equal counts make mean-of-ratios == ratio-of-sums, hiding a missing
+    ep psum in the weighting): 1F1B loss+grads == jax.grad(loss_fn_pp)
+    leaf for leaf, and both losses == the unsharded single-device
+    loss_fn value (generous capacity so no tokens drop on either side).
+    """
+    import dataclasses
+    cfg_m = dataclasses.replace(
+        llama.LlamaConfig.tiny(n_layers=2, ffn_dim=64),
+        moe_experts=4, moe_top_k=2, moe_capacity_factor=16.0)
+    B2 = 8
+    toks = jnp.asarray(rng.integers(0, cfg_m.vocab, (B2, S)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg_m.vocab, (B2, S)), jnp.int32)
+    labels = labels.at[:3, : S // 2].set(-100)   # unequal counts per shard
+    params = llama.init(jax.random.PRNGKey(0), cfg_m)
+    stacked = llama.stack_params(params)
+
+    # unsharded ground truth (token-weighted global mean + aux)
+    want_unsharded = float(jax.jit(
+        lambda p, b: llama.loss_fn(p, b, cfg_m))(params, (toks, labels)))
+
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 2, 2),
+                ("dp", "pp", "ep"))
+    specs = llama.stacked_param_specs(cfg_m, pp_axis="pp", tp_axis=None,
+                                      ep_axis="ep")
+    b_spec = (P(("dp", "ep")), P(("dp", "ep")))
+    M = 2
+    kw = dict(pp_axis="pp", num_microbatches=M, dp_axis="dp", ep_axis="ep")
+
+    def clear(loss):
+        return jax.lax.pmean(jax.lax.pmean(loss, "dp"), "ep")
+
+    def ref_wrapped(p, b):
+        loss, g = jax.value_and_grad(
+            lambda p2, b2: llama.loss_fn_pp(p2, b2, cfg_m, **kw))(p, b)
+        return clear(loss), g
+
+    want_loss, want_g = jax.jit(jax.shard_map(
+        ref_wrapped, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    def got_fn(p, b):
+        loss, g = llama.loss_and_grads_pp_1f1b(p, b, cfg_m, **kw)
+        return clear(loss), g
+
+    got_loss, got_g = jax.jit(jax.shard_map(
+        got_fn, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    # sharded-vs-single-device fp reordering is ~1e-4 here; a missing ep
+    # psum in the weighting shows up at the percent level (the masked
+    # shards make the per-rank ratios genuinely unequal)
+    np.testing.assert_allclose(float(want_loss), want_unsharded, rtol=2e-4)
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5),
+        got_g, want_g)
