@@ -211,14 +211,16 @@ def child_main() -> None:
     enc_g = report.get("codec_encode_gbps", 0.0)
     dec_g = report.get("codec_decode_gbps", 0.0)
     rows = {}
-    for W in (45.0, 90.0, 180.0):                      # GB/s per direction
+    # 5: DCN-class multi-host link; 12.5: the reference's own 100GbE wire
+    # (hw/bfp_adapter.sv sat on a 100G Ethernet MAC); 45+: ICI classes
+    for W in (5.0, 12.5, 45.0, 90.0, 180.0):           # GB/s per direction
         # payload B f32 bytes; bf16 psum moves B/2 at rate W; BFP ring
         # moves B/r_fused at rate W overlapped with codec at enc/dec rates
         t_bf16 = 0.5 / W
         t_bfp = max(1.0 / enc_g if enc_g else 9e9,
                     1.0 / dec_g if dec_g else 9e9,
                     (1.0 / r_fused) / W)
-        rows[f"link_{int(W)}GBps"] = {
+        rows[f"link_{W:g}GBps"] = {
             "bfp_speedup_vs_bf16_psum": round(t_bf16 / t_bfp, 3),
             "bfp_wins": t_bfp < t_bf16,
             "required_codec_gbps_to_win": round(2 * W, 1),

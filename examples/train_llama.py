@@ -50,6 +50,7 @@ def main(argv):
     seq = 64
     n_mb = 1
     pp_schedule = "gpipe"
+    virtual_stages = None    # interleaved chunk count (default 2)
     remat = False
     data_path = None
     save_dir = None
@@ -61,9 +62,11 @@ def main(argv):
             n_mb = int(a.partition("=")[2])
         elif a.startswith("--pp_schedule="):
             pp_schedule = a.partition("=")[2]
-            if pp_schedule not in ("gpipe", "1f1b"):
-                raise ValueError(f"--pp_schedule must be gpipe|1f1b, "
-                                 f"got {pp_schedule!r}")
+            if pp_schedule not in ("gpipe", "1f1b", "1f1b-interleaved"):
+                raise ValueError(f"--pp_schedule must be gpipe|1f1b|"
+                                 f"1f1b-interleaved, got {pp_schedule!r}")
+        elif a.startswith("--virtual_stages="):
+            virtual_stages = int(a.partition("=")[2])
         elif a.startswith("--remat="):
             remat = coerce_value(bool, a.partition("=")[2])
         elif a.startswith("--data="):
@@ -91,16 +94,22 @@ def main(argv):
 
     loss_and_grads = None
     if pp_ax:
-        if pp_schedule == "1f1b":
-            # explicit-gradient 1F1B: O(pp) live activations per stage
-            # (dense stacks; MoE rides gpipe)
-            if ep_ax:
-                raise ValueError("--pp_schedule=1f1b does not support MoE "
-                                 "(ep) yet — use gpipe")
+        if pp_schedule.startswith("1f1b"):
+            # explicit-gradient 1F1B: O(pp) live activations per stage;
+            # "1f1b-interleaved" additionally splits each device's layers
+            # into --virtual_stages non-adjacent chunks (bubble / v)
+            if (virtual_stages is not None
+                    and pp_schedule != "1f1b-interleaved"):
+                raise ValueError(
+                    "--virtual_stages only applies to "
+                    "--pp_schedule=1f1b-interleaved")
+            v = ((virtual_stages or 2)
+                 if pp_schedule == "1f1b-interleaved" else 1)
             loss = None
             loss_and_grads = lambda p, b: llama.loss_and_grads_pp_1f1b(
                 p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb,
-                tp_axis=tp_ax, sp_axis="sp", dp_axis="dp", remat=True)
+                tp_axis=tp_ax, sp_axis="sp", dp_axis="dp", ep_axis=ep_ax,
+                virtual_stages=v, remat=True)
         else:
             loss = lambda p, b: llama.loss_fn_pp(
                 p, b, mcfg, pp_axis=pp_ax, num_microbatches=n_mb,
@@ -111,6 +120,15 @@ def main(argv):
                                           ep_axis=ep_ax, tp_size=m.tp)
         init_params = llama.stack_params(
             llama.init(jax.random.PRNGKey(cfg.seed), mcfg))
+        if pp_schedule == "1f1b-interleaved":
+            # the interleaved scheduler's layout contract: global stack in
+            # device-major chunk order (the whole training run — masters,
+            # checkpoints — lives in this order; deinterleave_layers maps
+            # back for export)
+            from fpga_ai_nic_tpu.parallel import pipeline as _pl
+            init_params = dict(init_params)
+            init_params["layers"] = _pl.interleave_layers(
+                init_params["layers"], m.pp, virtual_stages or 2)
     else:
         loss = lambda p, b: llama.loss_fn(p, b, mcfg, tp_axis=tp_ax,
                                           sp_axis=sp_ax, dp_axis="dp",
@@ -171,10 +189,23 @@ def main(argv):
     if pp_ax:
         from fpga_ai_nic_tpu.parallel import pipeline
         out["pipeline_cost"] = pipeline.cost_model(
-            n_mb, m.pp, schedule=pp_schedule)
+            n_mb, m.pp, schedule=pp_schedule,
+            virtual_stages=((virtual_stages or 2)
+                            if pp_schedule == "1f1b-interleaved" else 1))
     if save_dir:
         from fpga_ai_nic_tpu.utils.checkpoint import Checkpointer
         out["checkpoint"] = Checkpointer(save_dir).save(cfg.iters, state)
+        if pp_schedule == "1f1b-interleaved":
+            # the flat masters flatten the INTERLEAVED layer order; record
+            # it so a restore into a different pp/v/schedule cannot
+            # silently misinterpret the bytes
+            layout = {"layers_order": "interleaved-device-major",
+                      "pp": m.pp, "virtual_stages": virtual_stages or 2}
+            import os
+            with open(os.path.join(save_dir, "layer_layout.json"),
+                      "w") as f:
+                json.dump(layout, f)
+            out["checkpoint_layout"] = layout
     print(json.dumps(out))
 
 

@@ -542,6 +542,7 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
                            sp_axis: Optional[str] = None,
                            dp_axis: Optional[str] = None,
                            ep_axis: Optional[str] = None,
+                           virtual_stages: int = 1,
                            remat: bool = False):
     """`loss_fn_pp`'s loss AND gradients under the 1F1B schedule
     (parallel.pipeline.pipeline_train_1f1b): O(pp) live activations per
@@ -573,7 +574,16 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     every ep-group member shares one pp stage and therefore one branch;
     expert leaves enter ep-varying (sharded) and keep per-shard
     cotangents, ep-replicated leaves are widened on entry and psum'd
-    over ep on exit.  Returns (loss, grads) with grads matching the
+    over ep on exit.
+
+    virtual_stages > 1 selects the INTERLEAVED schedule
+    (pipeline.pipeline_train_1f1b_interleaved): each device runs v
+    non-adjacent layer chunks, cutting the bubble to 1/v of a full
+    stage per warm-up tick.  The stacked layer tree must then be in the
+    interleaved (device-major) order — permute it with
+    pipeline.interleave_layers before sharding, and map gradients back
+    with pipeline.deinterleave_layers; num_microbatches must be a
+    multiple of pp.  Returns (loss, grads) with grads matching the
     stack_params pytree; tp/pp-replicated leaves arrive correctly
     psum'd (the scheduler transposes its own entry widening), dp-varying
     leaves stay per-shard for the trainer's manual dp reduction.
@@ -647,20 +657,39 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     x, emb_vjp = jax.vjp(lambda e: e[tokens], params["tok_emb"])
     head_params = {"final_norm": params["final_norm"],
                    "lm_head": params["lm_head"]}
+    v = virtual_stages
+    if v > 1:
+        # interleaved layout: the local [L/pp] shard splits into v chunks,
+        # chunk c being global virtual stage c*pp + s — the GLOBAL stack
+        # must be permuted with pipeline.interleave_layers OUTSIDE the
+        # shard_map (gradients return in the same interleaved order)
+        layer_chunks = jax.tree_util.tree_map(
+            lambda a: a.reshape((v, a.shape[0] // v) + a.shape[1:]),
+            params["layers"])
+
+        def run_sched(*a, **kw2):
+            return pl.pipeline_train_1f1b_interleaved(
+                *a, virtual_stages=v, **kw2)
+    else:
+        layer_chunks = params["layers"]
+        run_sched = pl.pipeline_train_1f1b
     if moe:
-        obj_mean, d_layers, d_hp, d_x, report = pl.pipeline_train_1f1b(
-            stage_fn, loss_head_fn, params["layers"], head_params,
+        obj_mean, d_layers, d_hp, d_x, report = run_sched(
+            stage_fn, loss_head_fn, layer_chunks, head_params,
             x, (safe, valid), M, pp_axis, report_len=2)
         # display from the RAW report: weighted ce + aux_total (value
         # identity of _grad_scale; gradient already folded into obj)
         loss = (_weighted_loss(report[0], count, batch_axes, dp_axis)
                 + report[1] / M)
     else:
-        mean_nll_sum, d_layers, d_hp, d_x = pl.pipeline_train_1f1b(
-            stage_fn, loss_head_fn, params["layers"], head_params,
+        mean_nll_sum, d_layers, d_hp, d_x = run_sched(
+            stage_fn, loss_head_fn, layer_chunks, head_params,
             x, (safe, valid), M, pp_axis)
         local_sum = M * mean_nll_sum
         loss = _weighted_loss(local_sum, count, batch_axes, dp_axis)
+    if v > 1:
+        d_layers = jax.tree_util.tree_map(
+            lambda a: a.reshape((-1,) + a.shape[2:]), d_layers)
     d_emb, = emb_vjp(d_x.astype(x.dtype))
     # tok_emb is replicated over axes its cotangent may still vary over
     # (sp-sharded tokens feed a replicated table; GPipe's vma autodiff
