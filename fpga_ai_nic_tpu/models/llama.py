@@ -26,7 +26,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import moe as moe_ops
-from ..ops.ring_attention import full_attention, ring_attention
+from ..ops.ring_attention import (full_attention, gathered_attention,
+                                  ring_attention)
 
 
 @dataclass(frozen=True)
@@ -226,7 +227,7 @@ def _kv_rep_slice(lyr: Dict, cfg: LlamaConfig, tp_axis: str):
 def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
            n_heads: int, n_kv: int, tp_axis: Optional[str],
            sp_axis: Optional[str], ep_axis: Optional[str] = None,
-           batch_axes=()) -> "tuple[jax.Array, jax.Array]":
+           batch_axes=(), sp_attn: str = "ring") -> "tuple[jax.Array, jax.Array]":
     """One decoder layer (pre-norm attention + SwiGLU or MoE FFN) on local
     shards; n_heads/n_kv are the per-tp-shard head counts.  Returns
     (x, aux) — aux is the MoE load-balance loss (0 for dense layers)."""
@@ -248,7 +249,12 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
         k = jnp.repeat(k, rep, axis=1)
         v = jnp.repeat(v, rep, axis=1)
     if sp_axis is not None:
-        att = ring_attention(q, k, v, sp_axis, causal=True)
+        # "gather": KV all-gather variant — the only form sound inside the
+        # 1F1B schedulers' stage-divergent conds (ring's ppermute pairs
+        # span the whole mesh; see ops.ring_attention.gathered_attention)
+        att = (gathered_attention(q, k, v, sp_axis, causal=True)
+               if sp_attn == "gather"
+               else ring_attention(q, k, v, sp_axis, causal=True))
     else:
         att = full_attention(q, k, v, causal=True)
     att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)
@@ -465,6 +471,7 @@ def apply_pp(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
              ep_axis: Optional[str] = None,
              batch_axes=(),
              with_aux: bool = False,
+             sp_attn: str = "ring",
              remat: bool = False) -> jax.Array:
     """Pipelined forward; call inside shard_map with stack_params params
     sharded per ``stacked_param_specs``.  Returns logits valid on the LAST
@@ -479,7 +486,7 @@ def apply_pp(params: Dict, tokens: jax.Array, cfg: LlamaConfig, *,
 
     def block(lyr, x):
         return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
-                      ep_axis, batch_axes)
+                      ep_axis, batch_axes, sp_attn=sp_attn)
 
     def stage_fn(stacked, x):
         return pl.scan_layers_aux(block, stacked, x, remat=remat)
@@ -498,6 +505,7 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
                sp_axis: Optional[str] = None,
                dp_axis: Optional[str] = None,
                ep_axis: Optional[str] = None,
+               sp_attn: str = "ring",
                remat: bool = False) -> jax.Array:
     """Next-token cross-entropy through the pipeline.  Every pp stage
     computes the head on its own (mostly garbage) activations — unavoidable
@@ -515,7 +523,8 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
     logits, aux = apply_pp(params, tokens, cfg, pp_axis=pp_axis,
                            num_microbatches=num_microbatches, tp_axis=tp_axis,
                            sp_axis=sp_axis, ep_axis=ep_axis,
-                           batch_axes=batch_axes, with_aux=True, remat=remat)
+                           batch_axes=batch_axes, with_aux=True,
+                           sp_attn=sp_attn, remat=remat)
     if batch_axes:
         # Value-preserving: the per-rank aux copies are identical over the
         # batch axes (moe_ffn psums its statistics over them), but the
@@ -602,9 +611,16 @@ def loss_and_grads_pp_1f1b(params: Dict, batch, cfg: LlamaConfig, *,
     batch_axes = tuple(a for a in (sp_axis, dp_axis, ep_axis)
                        if a is not None)
 
+    # the explicit schedulers run stages inside stage-divergent lax.conds,
+    # where ring attention's sp ppermutes are unsound (whole-mesh
+    # collective-permute pairs); the KV-all-gather variant is the
+    # replica-grouped, cond-safe form
+    sp_attn = ("gather" if sp_axis is not None
+               and lax.axis_size(sp_axis) > 1 else "ring")
+
     def block(lyr, x):
         return _block(lyr, x, pos, cfg, n_heads, n_kv, tp_axis, sp_axis,
-                      ep_axis, batch_axes if moe else ())
+                      ep_axis, batch_axes if moe else (), sp_attn=sp_attn)
 
     # d loss / d (scheduler mean): _weighted_loss is linear in local_sum
     # with coefficient 1/denom (times the n_dp gradient-scale when dp is
