@@ -797,3 +797,75 @@ def test_llama_1f1b_sp_matches_gpipe(rng, moe):
         lambda a, b: np.testing.assert_allclose(
             np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5),
         got_g, want_g)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("axes", ["tp", "ep", "sp"])
+def test_llama_interleaved_1f1b_axis_matrix(rng, axes):
+    """Interleaved 1F1B x {tp, ep, sp}: every in-stage collective the
+    zoo uses (tp psum, ep all_to_all, sp KV all-gather) is replica-
+    grouped and therefore sound inside the schedule's conds; each must
+    reproduce GPipe leaf for leaf through the chunked virtual stages."""
+    import dataclasses
+    moe = axes == "ep"
+    if moe:
+        cfg = dataclasses.replace(
+            llama.LlamaConfig.tiny(n_layers=4, ffn_dim=64),
+            moe_experts=4, moe_top_k=2, moe_capacity_factor=16.0)
+    else:
+        cfg = dataclasses.replace(CFG, n_layers=4)
+    toks, labels = _batch(rng)
+    labels = labels.at[:, : S // 4].set(-100)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    stacked = llama.stack_params(params)
+    pp, v, M = 2, 2, 2
+    mesh = Mesh(np.array(jax.devices()[:4]).reshape(2, 2), ("pp", axes))
+    tp_axis = "tp" if axes == "tp" else None
+    specs = llama.stacked_param_specs(cfg, pp_axis="pp", tp_axis=tp_axis,
+                                      ep_axis="ep" if moe else None)
+    if axes == "sp":
+        b_spec = (P(None, "sp"), P(None, "sp"))
+    elif axes == "ep":
+        b_spec = (P("ep"), P("ep"))
+    else:
+        b_spec = (P(), P())
+    kw = dict(pp_axis="pp", num_microbatches=M, tp_axis=tp_axis,
+              sp_axis="sp" if axes == "sp" else None,
+              ep_axis="ep" if moe else None)
+    ref_kw = dict(kw)
+    if axes == "sp":
+        ref_kw["sp_attn"] = "gather"
+
+    def clear(loss):
+        return jax.lax.pmean(loss, axes)
+
+    def ref_wrapped(p, b):
+        loss, g = jax.value_and_grad(
+            lambda p2, b2: llama.loss_fn_pp(p2, b2, cfg, **ref_kw))(p, b)
+        return clear(loss), g
+
+    want_loss, want_g = jax.jit(jax.shard_map(
+        ref_wrapped, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(stacked, (toks, labels))
+
+    ilv = dict(stacked)
+    ilv["layers"] = pl.interleave_layers(stacked["layers"], pp, v)
+
+    def got_fn(p, b):
+        loss, g = llama.loss_and_grads_pp_1f1b(p, b, cfg, **kw,
+                                               virtual_stages=v)
+        return clear(loss), g
+
+    got_loss, got_g = jax.jit(jax.shard_map(
+        got_fn, mesh=mesh, in_specs=(specs, b_spec),
+        out_specs=(P(), specs)))(ilv, (toks, labels))
+
+    got_g = dict(got_g)
+    got_g["layers"] = pl.deinterleave_layers(got_g["layers"], pp, v)
+
+    np.testing.assert_allclose(float(got_loss), float(want_loss),
+                               rtol=1e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-4, atol=3e-5),
+        got_g, want_g)
