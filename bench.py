@@ -168,17 +168,10 @@ def child_main(layers: int, batch: int, iters: int) -> None:
     flops = mlp.flops_per_sample(mcfg) * per_chip
     out["tflops_per_chip"] = round(flops / 1e12, 3)
     if is_tpu_platform(platform):
-        # MFU denominator: bf16 peak of the tunneled chip generation
-        # (PALLAS_AXON_TPU_GEN env; the device API does not expose it).
-        # v5e ~197 TFLOP/s bf16, v5p ~459, v4 ~275.
-        peaks = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
-        gen = os.environ.get("PALLAS_AXON_TPU_GEN", "v5e")
-        known = gen in peaks
-        peak = peaks.get(gen, 197e12)
+        from bench_common import bf16_peak
+        peak, label = bf16_peak()
         out["mfu"] = round(flops / peak, 4)
-        out["mfu_peak_ref"] = (
-            f"{gen} bf16 {peak / 1e12:.0f} TFLOP/s" if known
-            else f"UNKNOWN gen {gen!r}: v5e fallback {peak / 1e12:.0f} TFLOP/s")
+        out["mfu_peak_ref"] = label
     # bank the measured number FIRST: the parent keeps the last parseable
     # JSON line, so if anything below wedges, this result still stands
     print(json.dumps(out), flush=True)

@@ -122,6 +122,22 @@ def probe_tpu(budget_s: float = 90.0, silence_s: float = 60.0) -> bool:
         return False
 
 
+def bf16_peak(default_gen: str = "v5e"):
+    """(peak_flops, label) for the tunneled chip generation — the MFU
+    denominator.  PALLAS_AXON_TPU_GEN is the only channel (the device API
+    does not expose the generation through the tunnel); unknown values
+    fall back to v5e with an explicit UNKNOWN label so a mislabeled MFU
+    can never pass silently."""
+    peaks = {"v4": 275e12, "v5e": 197e12, "v5p": 459e12, "v6e": 918e12}
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", default_gen)
+    known = gen in peaks
+    peak = peaks.get(gen, 197e12)
+    label = (f"{gen} bf16 {peak / 1e12:.0f} TFLOP/s" if known
+             else f"UNKNOWN gen {gen!r}: v5e fallback "
+                  f"{peak / 1e12:.0f} TFLOP/s")
+    return peak, label
+
+
 def chain_kernel_calls(call, k: int = 8):
     """jit(k chained invocations of a side-effecting kernel `call`) —
     divide the elapsed time of one dispatch by k.  The adds serialize the
