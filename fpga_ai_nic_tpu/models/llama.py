@@ -26,8 +26,8 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import moe as moe_ops
-from ..ops.ring_attention import (full_attention, gathered_attention,
-                                  ring_attention)
+from ..ops.ring_attention import (flash_attention, full_attention,
+                                  gathered_attention, ring_attention)
 
 
 @dataclass(frozen=True)
@@ -50,6 +50,11 @@ class LlamaConfig:
     rope_high_freq_factor: float = 4.0
     norm_eps: float = 1e-5
     dtype: str = "bfloat16"
+    # flash-blocked single-device attention (ops.ring_attention.
+    # flash_attention): score memory O(S * attn_block) instead of
+    # full_attention's O(S^2); None keeps the exact direct softmax.
+    # sp-sharded paths (ring/gathered) block independently of this knob.
+    attn_block: "Optional[int]" = None
     # MoE: when moe_experts > 0, every FFN becomes a top-k routed expert
     # layer (ops.moe); dense SwiGLU otherwise.  Not composable with the
     # pipelined path yet (apply_pp raises).
@@ -255,6 +260,17 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
         att = (gathered_attention(q, k, v, sp_axis, causal=True)
                if sp_attn == "gather"
                else ring_attention(q, k, v, sp_axis, causal=True))
+    elif cfg.attn_block is not None:
+        # flash-blocked single-device attention, attention-only remat:
+        # the k-block scan's per-block residuals would otherwise
+        # reconstitute the full O(S^2) score memory in the backward;
+        # checkpointing JUST the attention recomputes it once (the
+        # standard flash backward), saving q/k/v per layer instead —
+        # far cheaper than whole-block remat's ~1/3 extra model FLOPs
+        att = jax.checkpoint(
+            lambda q2, k2, v2: flash_attention(
+                q2, k2, v2, causal=True, k_block=cfg.attn_block)
+        )(q, k, v)
     else:
         att = full_attention(q, k, v, causal=True)
     att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)

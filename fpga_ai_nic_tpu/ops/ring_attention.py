@@ -30,6 +30,24 @@ from jax import lax
 _NEG = -1e30
 
 
+def _init_acc(B, H, S, dh, vma=()):
+    """Fresh online-softmax accumulators (running max / normalizer /
+    output), widened to `vma` when the caller sits inside shard_map (scan
+    carries must enter with the vma type the body produces)."""
+    accs = (jnp.full((B, H, S, 1), _NEG, jnp.float32),
+            jnp.zeros((B, H, S, 1), jnp.float32),
+            jnp.zeros((B, H, S, dh), jnp.float32))
+    vma = tuple(sorted(vma))
+    return tuple(lax.pcast(z, vma, to="varying") if vma else z
+                 for z in accs)
+
+
+def _finish(o, l, out_dtype):
+    """Normalize the accumulated output; rows with no visible keys keep a
+    zero output (cannot happen causally: a token always sees itself)."""
+    return (o / jnp.where(l == 0, 1.0, l)).astype(out_dtype)
+
+
 def _block_attend(q, k, v, q_pos, k_pos, m, l, o, sm_scale, causal):
     """One online-softmax accumulation step against a visiting K/V block.
 
@@ -121,10 +139,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     # accumulators start device-varying: the k-block scan in _attend_chunk
     # carries them, and a scan carry's variance type must match its output
     # (which is varying as soon as it touches q/k)
-    m0, l0, o0 = (lax.pcast(z, axis_name, to="varying") for z in (
-        jnp.full((B, H, S, 1), _NEG, jnp.float32),
-        jnp.zeros((B, H, S, 1), jnp.float32),
-        jnp.zeros((B, H, S, dh), jnp.float32)))
+    m0, l0, o0 = _init_acc(B, H, S, dh,
+                           {axis_name} | set(jax.typeof(qf).vma))
     m, l, o = _attend_chunk(qf, k, v, q_pos, idx * S, m0, l0, o0,
                             sm_scale, causal, k_block)
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -152,9 +168,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
         return m, l, o, kc, vc
 
     m, l, o, _, _ = lax.fori_loop(1, n, hop, (m, l, o, k, v), unroll=unroll)
-    # rows with no visible keys (can't happen causally: a token sees itself)
-    l = jnp.where(l == 0, 1.0, l)
-    return (o / l).astype(q.dtype)
+    return _finish(o, l, q.dtype)
 
 
 def full_attention(q, k, v, *, causal=True, sm_scale=None):
@@ -203,11 +217,31 @@ def gathered_attention(q, k, v, axis_name: str, *, causal=True,
     vf = lax.all_gather(v, axis_name, axis=2, tiled=True)
     qf = q.astype(jnp.float32)
     q_pos = idx * Sl + lax.broadcasted_iota(jnp.int32, (Sl, 1), 0)[:, 0]
-    m0, l0, o0 = (lax.pcast(z, axis_name, to="varying") for z in (
-        jnp.full((B, H, Sl, 1), _NEG, jnp.float32),
-        jnp.zeros((B, H, Sl, 1), jnp.float32),
-        jnp.zeros((B, H, Sl, dh), jnp.float32)))
+    m0, l0, o0 = _init_acc(B, H, Sl, dh,
+                           {axis_name} | set(jax.typeof(qf).vma))
     m, l, o = _attend_chunk(qf, kf, vf, q_pos, 0, m0, l0, o0,
                             sm_scale, causal, k_block)
-    l = jnp.where(l == 0, 1.0, l)
-    return (o / l).astype(q.dtype)
+    return _finish(o, l, q.dtype)
+
+
+def flash_attention(q, k, v, *, causal=True, sm_scale=None,
+                    k_block: Optional[int] = 512):
+    """Single-device flash-blocked exact attention: the same
+    `_attend_chunk` online-softmax accumulation the ring/gathered
+    variants use, with no collectives — peak score memory
+    O(S * k_block) instead of full_attention's O(S^2) f32 score matrix
+    (which XLA also saves for the backward, forcing remat on long
+    sequences).  Bit-differences vs full_attention are f32 summation
+    order only; both are exact softmax attention."""
+    if sm_scale is None:
+        sm_scale = q.shape[-1] ** -0.5
+    B, H, S, dh = q.shape
+    qf = q.astype(jnp.float32)
+    pos = lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
+    # q may be batch-sharded under an outer shard_map even though this
+    # attention itself is collective-free
+    vma = set(jax.typeof(qf).vma) | set(jax.typeof(k).vma)
+    m0, l0, o0 = _init_acc(B, H, S, dh, vma)
+    m, l, o = _attend_chunk(qf, k, v, pos, 0, m0, l0, o0,
+                            sm_scale, causal, k_block)
+    return _finish(o, l, q.dtype)

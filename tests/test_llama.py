@@ -256,3 +256,24 @@ def test_remat_grad_parity_and_memory(rng):
         return fn.lower(params).compile().memory_analysis().temp_size_in_bytes
 
     assert mem(True) < mem(False), (mem(True), mem(False))
+
+
+def test_attn_block_matches_full(rng):
+    """cfg.attn_block (flash-blocked single-device attention + attention-
+    only remat) must match the direct-softmax path — loss AND grads."""
+    import dataclasses
+    cfg = llama.LlamaConfig.tiny(n_layers=2)
+    cfg_b = dataclasses.replace(cfg, attn_block=8)
+    toks = jnp.asarray(rng.integers(0, cfg.vocab, (2, 32)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab, (2, 32)), jnp.int32)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    want_l, want_g = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, (toks, labels), cfg))(params)
+    got_l, got_g = jax.value_and_grad(
+        lambda p: llama.loss_fn(p, (toks, labels), cfg_b))(params)
+    np.testing.assert_allclose(float(got_l), float(want_l), rtol=2e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a, np.float32), np.asarray(b, np.float32),
+            rtol=2e-3, atol=2e-4),
+        got_g, want_g)

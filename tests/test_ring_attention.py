@@ -198,3 +198,18 @@ def test_gathered_grads_match_full(rng):
     for a, b in zip(got, want):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+@pytest.mark.parametrize("causal,k_block", [(True, 8), (False, 16)])
+def test_flash_matches_full(rng, causal, k_block):
+    """Single-device flash-blocked attention == full attention (same
+    online softmax as the sharded variants, no collectives)."""
+    B, H, S, dh = 2, 2, 32, 16
+    q = jnp.asarray(rng.standard_normal((B, H, S, dh)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((B, H, S, dh)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((B, H, S, dh)), jnp.float32)
+    want = ra.full_attention(q, k, v, causal=causal)
+    got = jax.jit(lambda a, b, c: ra.flash_attention(
+        a, b, c, causal=causal, k_block=k_block))(q, k, v)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-6)

@@ -106,6 +106,31 @@ def main():
         L.append("*(no committed throughput artifact yet)*")
     L.append("")
 
+    # -- model zoo (TPU single-chip) -----------------------------------------
+    zoo_art = _newest("artifacts/zoo_tpu_*.json")
+    if zoo_art:
+        d = _load(zoo_art)
+        ok_rows = [(k, v) for k, v in (d.get("configs") or {}).items()
+                   if v.get("ok")]
+        if ok_rows:
+            L += ["## Model zoo (TPU, single chip, device-resident "
+                  "batches)", "",
+                  f"Source: `{_rel(zoo_art)}`.  One jitted multi-step "
+                  "dispatch (the tunnel's per-dispatch cost scales with "
+                  "the state tree's buffer count and would otherwise "
+                  "dominate).", "",
+                  "| config | rate | TFLOP/s | MFU | params |",
+                  "|---|---|---|---|---|"]
+            for k, v in ok_rows:
+                rate = (f"{v['samples_per_sec']:,.0f} samples/s"
+                        if "samples_per_sec" in v
+                        else f"{v['tokens_per_sec']:,.0f} tok/s")
+                L.append(f"| {k} | {rate} "
+                         f"| {v.get('model_tflops_per_sec', '—')} "
+                         f"| {v.get('mfu', '—')} "
+                         f"| {v.get('params', 0):,} |")
+            L.append("")
+
     # -- collective / codec --------------------------------------------------
     col_art = (_newest("artifacts/collective_tpu_*.json")
                or _newest("COLLECTIVE_r*.json")
