@@ -240,7 +240,8 @@ def flash_attention(q, k, v, *, causal=True, sm_scale=None,
     pos = lax.broadcasted_iota(jnp.int32, (S, 1), 0)[:, 0]
     # q may be batch-sharded under an outer shard_map even though this
     # attention itself is collective-free
-    vma = set(jax.typeof(qf).vma) | set(jax.typeof(k).vma)
+    vma = (set(jax.typeof(qf).vma) | set(jax.typeof(k).vma)
+           | set(jax.typeof(v).vma))
     m0, l0, o0 = _init_acc(B, H, S, dh, vma)
     m, l, o = _attend_chunk(qf, k, v, pos, 0, m0, l0, o0,
                             sm_scale, causal, k_block)

@@ -122,9 +122,14 @@ def main():
                   "| config | rate | TFLOP/s | MFU | params |",
                   "|---|---|---|---|---|"]
             for k, v in ok_rows:
-                rate = (f"{v['samples_per_sec']:,.0f} samples/s"
-                        if "samples_per_sec" in v
-                        else f"{v['tokens_per_sec']:,.0f} tok/s")
+                if "samples_per_sec" in v:
+                    rate = f"{v['samples_per_sec']:,.0f} samples/s"
+                elif "tokens_per_sec" in v:
+                    rate = f"{v['tokens_per_sec']:,.0f} tok/s"
+                else:
+                    rate = (f"{v['decode_tokens_per_sec']:,.0f} tok/s "
+                            f"decode ({v['per_token_latency_ms']} "
+                            f"ms/token)")
                 L.append(f"| {k} | {rate} "
                          f"| {v.get('model_tflops_per_sec', '—')} "
                          f"| {v.get('mfu', '—')} "

@@ -33,7 +33,17 @@ from bench_common import (bf16_peak, is_tpu_platform, log,  # noqa: E402
 # the ~16 GB config runs FIRST: the terminal's HBM reclaim between child
 # processes lags, and following three smaller configs OOM'd it once
 CONFIG_NAMES = ("llama_7e8_dp1", "resnet50_dp1", "bert_base_dp1",
-                "llama_dp1")
+                "llama_dp1", "llama_decode_dp1")
+
+
+def _llama_dp1_cfg():
+    """The llama_dp1 model — ONE definition so the training row and the
+    decode row of the zoo table stay comparable."""
+    import dataclasses
+    from fpga_ai_nic_tpu.models import llama
+    return dataclasses.replace(
+        llama.LlamaConfig.tiny(), dim=512, n_layers=8, n_heads=8,
+        n_kv_heads=8, ffn_dim=1408, vocab=8192, dtype="bfloat16")
 ITERS = 16
 
 
@@ -54,6 +64,35 @@ def child_main(name: str) -> None:
     out = {"config": name, "platform": jax.default_backend(),
            "iters": ITERS,
            "method": "device-resident synthetic batch, reused per step"}
+
+    if name == "llama_decode_dp1":
+        # KV-cache incremental generation: the whole decode loop is ONE
+        # scanned device program (llama_decode.generate), so the tunnel
+        # pays one dispatch for n_new tokens
+        from fpga_ai_nic_tpu.models import llama, llama_decode
+        mcfg = _llama_dp1_cfg()   # same model as the llama_dp1 train row
+        B, n_new = 8, 256
+        out["iters"] = 1          # one timed dispatch, not the train ITERS
+        params = llama.init(jax.random.PRNGKey(0), mcfg)
+        prompt = jax.random.randint(key, (B, 32), 0, mcfg.vocab, jnp.int32)
+        run = jax.jit(lambda p, pr: llama_decode.generate(
+            p, pr, n_new, mcfg, temperature=0.0,
+            rng=jax.random.PRNGKey(1)))
+        out_toks = run(params, prompt)
+        _ = int(out_toks[0, -1])                 # sync: compile + warmup
+        t1 = time.perf_counter()
+        out_toks = run(params, prompt)
+        _ = int(out_toks[0, -1])
+        dt = time.perf_counter() - t1
+        out.update({
+            "params": llama.num_params(mcfg), "batch": B, "n_new": n_new,
+            "decode_tokens_per_sec": round(B * n_new / dt, 1),
+            "per_token_latency_ms": round(dt / n_new * 1e3, 3),
+            "wall_s": round(dt, 3), "method": "one scanned decode "
+            "program per dispatch (KV cache device-resident)",
+            "ok": True})
+        print(json.dumps(out), flush=True)
+        return
 
     if name == "resnet50_dp1":
         from fpga_ai_nic_tpu.models import resnet
@@ -108,9 +147,7 @@ def child_main(name: str) -> None:
             B, seq, opt = 2, 1024, OptimizerConfig(kind="momentum",
                                                    learning_rate=1e-2)
         else:
-            mcfg = dataclasses.replace(
-                llama.LlamaConfig.tiny(), dim=512, n_layers=8, n_heads=8,
-                n_kv_heads=8, ffn_dim=1408, vocab=8192, dtype="bfloat16")
+            mcfg = _llama_dp1_cfg()
             B, seq, opt = 8, 512, OptimizerConfig(kind="adamw",
                                                   learning_rate=1e-4)
         cfg = TrainConfig(iters=ITERS, global_batch=B, mesh=MeshConfig(),
