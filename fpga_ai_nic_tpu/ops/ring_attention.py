@@ -71,7 +71,8 @@ def _block_attend(q, k, v, q_pos, k_pos, m, l, o, sm_scale, causal):
 
 
 def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
-                  k_block: Optional[int]):
+                  k_block: Optional[int],
+                  remat_blocks: Optional[bool] = None):
     """Online-softmax accumulation against one visiting K/V chunk, scanning
     it in k-blocks so at most [B,H,Sq,k_block] scores materialize — the
     flash-attention blocking that keeps peak memory O(S*k_block) instead of
@@ -100,6 +101,18 @@ def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
                                 m, l, o, sm_scale, causal)
         return (m, l, o), None
 
+    # remat_blocks: recompute each block's scores in the backward (the
+    # flash-attention backward) — without it, differentiating the scan
+    # saves every block's [Sq, k_block] residuals SIMULTANEOUSLY, which
+    # at long S reconstitutes O(S^2/k_block * k_block) = O(S^2) memory
+    # (measured: 22 GB at S=16384 where the forward needs < 2 GB).
+    # None = auto: recompute only past a few blocks — at short S the
+    # residuals are small and the recompute is a pure slowdown (measured
+    # -2.5 MFU points on a S=1024 config with it always-on)
+    if remat_blocks is None:
+        remat_blocks = S // k_block > 4
+    if remat_blocks:
+        step = jax.checkpoint(step)
     (m, l, o), _ = lax.scan(step, (m, l, o), jnp.arange(S // k_block))
     return m, l, o
 

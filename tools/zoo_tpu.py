@@ -13,7 +13,9 @@ PCIe/DMA, which the tunnel does not represent).
 
 Each config runs as a probe-gated subprocess under a watchdog; all
 results bank into ONE artifacts/zoo_tpu_*.json with per-config status.
-Transformer TFLOP/s uses the 6*P*tokens/s dense approximation; ResNet's
+Transformer TFLOP/s uses the 6*P*tokens/s dense approximation — except
+llama_long_ctx_dp1, which adds the causal attention quadratic
+(6*L*D*S per token; ~2x the 6P term at S=16k).  ResNet's
 uses a per-sample FLOP constant (3x forward) at the run's image size.
 MFU is against the detected v5e bf16 peak, matching bench.py.
 """
@@ -33,7 +35,7 @@ from bench_common import (bf16_peak, is_tpu_platform, log,  # noqa: E402
 # the ~16 GB config runs FIRST: the terminal's HBM reclaim between child
 # processes lags, and following three smaller configs OOM'd it once
 CONFIG_NAMES = ("llama_7e8_dp1", "resnet50_dp1", "bert_base_dp1",
-                "llama_dp1", "llama_decode_dp1")
+                "llama_dp1", "llama_long_ctx_dp1", "llama_decode_dp1")
 
 
 def _llama_dp1_cfg():
@@ -130,6 +132,31 @@ def child_main(name: str) -> None:
         P = bert.num_params(mcfg)
         out["params"] = P
         unit, per_unit_flops = "tokens", 6.0 * P
+    elif name == "llama_long_ctx_dp1":
+        # long-context single-chip: S=16384 through the flash-blocked
+        # attention (attn_block=512; the O(S^2) direct softmax would need
+        # ~4 GB of scores per layer).  FLOP accounting includes the
+        # attention quadratic — at this S it exceeds the 6P matmul term:
+        # per token ~ 6P + 12*L*D*S*causal(0.5)
+        import dataclasses
+        from fpga_ai_nic_tpu.models import llama
+        mcfg = dataclasses.replace(_llama_dp1_cfg(), attn_block=512)
+        B, seq = 1, 16384   # 32768 faults the TPU worker — do not raise
+        cfg = TrainConfig(iters=ITERS, global_batch=B, mesh=MeshConfig(),
+                          collective=CollectiveConfig(impl="xla"),
+                          optimizer=OptimizerConfig(kind="adamw",
+                                                    learning_rate=1e-4))
+        loss_fn = lambda p, b: llama.loss_fn(p, b, mcfg)
+        init = llama.init(jax.random.PRNGKey(cfg.seed), mcfg)
+        kt, = jax.random.split(key, 1)
+        toks = jax.random.randint(kt, (B, seq + 1), 0, mcfg.vocab,
+                                  jnp.int32)
+        batch = (toks[:, :-1], toks[:, 1:])
+        P = llama.num_params(mcfg)
+        out["params"] = P
+        out["seq_len"] = seq
+        unit = "tokens"
+        per_unit_flops = 6.0 * P + 6.0 * mcfg.n_layers * mcfg.dim * seq
     elif name in ("llama_7e8_dp1", "llama_dp1"):
         import dataclasses
         from fpga_ai_nic_tpu.models import llama
