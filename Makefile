@@ -20,10 +20,10 @@ bench:
 # run the collective/codec benchmark and snapshot its newest artifact as
 # the round's committed record (the round-2 review's item 3: the
 # first-named BASELINE metric must land in a committed file every round)
-ROUND ?= r03
+ROUND ?= r04
 collective:
 	python bench_collective.py
-	@latest=$$(ls -t artifacts/collective_2*.json | head -1); \
+	@latest=$$(ls -t artifacts/collective_tpu_*.json artifacts/collective_2*.json 2>/dev/null | head -1); \
 	  cp $$latest COLLECTIVE_$(ROUND).json; \
 	  echo "saved $$latest -> COLLECTIVE_$(ROUND).json"
 
