@@ -99,7 +99,7 @@ def child_main(name: str) -> None:
     if name == "resnet50_dp1":
         from fpga_ai_nic_tpu.models import resnet
         mcfg = resnet.ResNetConfig.resnet50()
-        B, size = 64, 112
+        B, size = 64, 224
         cfg = TrainConfig(iters=ITERS, global_batch=B, mesh=MeshConfig(),
                           collective=CollectiveConfig(impl="xla"),
                           optimizer=OptimizerConfig(kind="momentum",
@@ -112,8 +112,8 @@ def child_main(name: str) -> None:
                  jax.random.randint(ky, (B,), 0, mcfg.num_classes,
                                     jnp.int32))
         out["params"] = resnet.num_params(mcfg)
-        # ~1.05 GFLOP fwd per sample at 112px (1/4 of the 4.1G @224), x3
-        unit, per_unit_flops = "samples", 3 * 4.1e9 / 4
+        # ~4.1 GFLOP fwd per sample at 224px, x3 for fwd+bwd
+        unit, per_unit_flops = "samples", 3 * 4.1e9
     elif name == "bert_base_dp1":
         from fpga_ai_nic_tpu.models import bert
         mcfg = bert.BertConfig.bert_base()
