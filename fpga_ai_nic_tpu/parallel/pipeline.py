@@ -461,6 +461,17 @@ def cost_model(num_microbatches: int, pp: int,
       bubble_ticks     2*pp - 2 per stage
       live_activations <= pp per stage — the whole point: the in-flight
                        window is the ring depth, independent of M
+
+    Design note — zero-bubble (ZB-H1) schedules: splitting the backward
+    into input-grad (B) and weight-grad (W) units lets W units fill
+    bubble ticks.  Considered and NOT implemented here: this module's
+    lockstep execution model (every device, one unit per tick, two
+    ppermutes per tick) synchronizes each tick on the SLOWEST unit, and
+    F/B/W have unequal costs (~1x/2x/1x of a forward), so the bubble
+    ticks ZB reclaims are largely returned as per-tick stalls.  Getting
+    ZB's real win needs per-edge asynchronous p2p sends, which the
+    shard_map + ppermute paradigm deliberately does not use (static
+    lockstep is what makes the schedules verifiable at trace time).
     """
     if num_microbatches < 1 or pp < 1:
         raise ValueError((num_microbatches, pp))
