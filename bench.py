@@ -160,6 +160,13 @@ def child_main(layers: int, batch: int, iters: int) -> None:
         "value": round(per_chip, 1),
         "unit": "samples/s/chip",
         "vs_baseline": round(per_chip / BASELINE_SAMPLES_PER_SEC_PER_NODE, 3),
+        # the denominator is a MODEL, not a measurement — the reference
+        # repo publishes no absolute numbers (BASELINE.md); this field
+        # rides every artifact so the ratio can never be read as
+        # measured-vs-measured (round-4 verdict, weak #7)
+        "baseline_model": ("estimated 14,000 samples/s/node: Xeon Platinum "
+                           "8280 libxsmm f32 @80% of 4.3 TFLOP/s over "
+                           "243.3 MFLOP/sample"),
         "platform": platform,
         "n_devices": n_dev,
         "loss": float(loss),

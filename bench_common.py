@@ -140,12 +140,15 @@ def bf16_peak(default_gen: str = "v5e"):
 
 def chain_kernel_calls(call, k: int = 8):
     """jit(k chained invocations of a side-effecting kernel `call`) —
-    divide the elapsed time of one dispatch by k.  The adds serialize the
-    calls without copies, and pallas `has_side_effects=True` keeps the
-    identical invocations from being CSE'd.  This exists because the
-    axon tunnel costs ~16 ms per device dispatch (first contact measured
-    a FLAT 16-18 ms across 1-32 MiB payloads), which floors any
-    one-kernel-per-dispatch measurement."""
+    divide the elapsed time of one dispatch by k.  The adds only order
+    *consumption* of the results; what keeps the k identical invocations
+    distinct and ordered is pallas `has_side_effects=True` (no CSE, no
+    reordering across side effects).  This exists because the axon tunnel
+    costs ~16 ms per device dispatch (first contact measured a FLAT
+    16-18 ms across 1-32 MiB payloads), which floors any
+    one-kernel-per-dispatch measurement.  For a *fixed-floor-free* rate
+    use `slope_timeit`, which differences two chain lengths so even the
+    residual in-dispatch constant cancels."""
     import jax
 
     def chained(v):
@@ -154,6 +157,40 @@ def chain_kernel_calls(call, k: int = 8):
             acc = acc + call(v)
         return acc
     return jax.jit(chained)
+
+
+def slope_timeit(make_chain, args, k, sync, reps: int = 3):
+    """Fixed-cost-free per-iteration time by slope: build chains of k and
+    2k data-dependent iterations (``make_chain(k)`` must return a jitted
+    callable), time each inside ONE dispatch, and difference:
+
+        t_iter = (t_2k - t_k) / k
+
+    Any per-dispatch constant — the ~16 ms axon tunnel floor, sync fetch,
+    loop setup — appears in both terms and cancels exactly.  This is the
+    round-5 replacement for the naive `t_k / k` quotient whose r04 codec
+    numbers were provably dispatch-floored (roundtrip measured ~2x the
+    harmonic sum of its own stages).  Returns (t_iter_seconds, diag dict);
+    t_iter <= 0 means noise swamped the slope — callers must treat the
+    measurement as invalid, not report a negative rate."""
+    def run(fn):
+        out = fn(*args)
+        sync(out)
+        best = 9e9
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            sync(out)
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    t_k = run(make_chain(k))
+    t_2k = run(make_chain(2 * k))
+    t_iter = (t_2k - t_k) / k
+    diag = {"k": k, "t_k_s": round(t_k, 4), "t_2k_s": round(t_2k, 4),
+            "naive_t_iter_s": round(t_k / k, 6),
+            "slope_t_iter_s": round(t_iter, 6)}
+    return t_iter, diag
 
 
 def git_sha(repo_dir=None) -> str:

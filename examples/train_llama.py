@@ -194,17 +194,16 @@ def main(argv):
                             if pp_schedule == "1f1b-interleaved" else 1))
     if save_dir:
         from fpga_ai_nic_tpu.utils.checkpoint import Checkpointer
-        out["checkpoint"] = Checkpointer(save_dir).save(cfg.iters, state)
+        # the flat masters flatten the INTERLEAVED layer order; the layout
+        # sidecar makes Checkpointer.restore refuse a mismatched
+        # pp/virtual_stages/schedule instead of silently permuting layers
+        layout = None
         if pp_schedule == "1f1b-interleaved":
-            # the flat masters flatten the INTERLEAVED layer order; record
-            # it so a restore into a different pp/v/schedule cannot
-            # silently misinterpret the bytes
             layout = {"layers_order": "interleaved-device-major",
                       "pp": m.pp, "virtual_stages": virtual_stages or 2}
-            import os
-            with open(os.path.join(save_dir, "layer_layout.json"),
-                      "w") as f:
-                json.dump(layout, f)
+        out["checkpoint"] = Checkpointer(save_dir).save(
+            cfg.iters, state, layout=layout)
+        if layout:
             out["checkpoint_layout"] = layout
     print(json.dumps(out))
 

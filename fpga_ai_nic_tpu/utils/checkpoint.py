@@ -12,6 +12,7 @@ Two layers:
 
 from __future__ import annotations
 
+import json
 import os
 import re
 from typing import Any, Dict, Optional
@@ -80,10 +81,58 @@ class Checkpointer:
         self._ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
                        if async_save else ocp.PyTreeCheckpointer())
 
+    _LAYOUT_FILE = "layer_layout.json"
+
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
 
-    def save(self, step: int, state) -> str:
+    def _layout_path(self) -> str:
+        return os.path.join(self.directory, self._LAYOUT_FILE)
+
+    def save_layout(self, layout: Dict[str, Any]) -> Dict[str, Any]:
+        """Record how the flat master bytes are ordered (e.g. the
+        interleaved-1F1B layer permutation: layers_order / pp /
+        virtual_stages).  A checkpoint that carries a layout sidecar can
+        only be restored by a caller that declares a MATCHING layout —
+        ``restore`` enforces it — so bytes can never be silently
+        reinterpreted under a different pp/v/schedule."""
+        with open(self._layout_path(), "w") as f:
+            json.dump(layout, f)
+        return layout
+
+    def saved_layout(self) -> Optional[Dict[str, Any]]:
+        if os.path.exists(self._layout_path()):
+            with open(self._layout_path()) as f:
+                return json.load(f)
+        return None
+
+    def _check_layout(self, expect: Optional[Dict[str, Any]]) -> None:
+        saved = self.saved_layout()
+        if saved is None and expect is None:
+            return
+        if saved is None:
+            raise ValueError(
+                f"restore declared layout {expect} but the checkpoint at "
+                f"{self.directory} has no {self._LAYOUT_FILE} sidecar — it "
+                "was saved in plain model order; drop expect_layout or "
+                "re-save with save_layout()")
+        if expect is None:
+            raise ValueError(
+                f"checkpoint at {self.directory} carries a layout sidecar "
+                f"{saved} (its flat masters are NOT in model order); pass "
+                "expect_layout= with the run's matching "
+                "pp/virtual_stages/schedule to restore()")
+        mismatched = {k: (saved.get(k), expect.get(k))
+                      for k in set(saved) | set(expect)
+                      if saved.get(k) != expect.get(k)}
+        if mismatched:
+            raise ValueError(
+                "checkpoint layout mismatch (saved vs requested): "
+                f"{mismatched} — restoring these bytes under the requested "
+                "pp/virtual_stages/schedule would silently permute layers")
+
+    def save(self, step: int, state,
+             layout: Optional[Dict[str, Any]] = None) -> str:
         """Persist a trainer state.  TRAINER STATES (NamedTuples) carrying
         a flat master copy (w_own / w_master) drop their working ``params``
         tree: every trainer's ``restore_state`` rematerializes params from
@@ -107,9 +156,19 @@ class Checkpointer:
                     for k, v in tree["opt_state"].items()}
         path = self._path(step)
         self._ckptr.save(path, tree, force=True)
+        if layout is not None:
+            self.save_layout(layout)
+        elif os.path.exists(self._layout_path()):
+            # a plain-order save must not inherit an earlier save's layout
+            # sidecar: restore() would then demand (and validate against)
+            # a layout these bytes are not in — the exact silent-permute
+            # hazard the sidecar exists to prevent
+            os.remove(self._layout_path())
         return path
 
-    def restore(self, step: int):
+    def restore(self, step: int,
+                expect_layout: Optional[Dict[str, Any]] = None):
+        self._check_layout(expect_layout)
         tree = self._ckptr.restore(self._path(step))
         if self.compress is not None:
             for key in ("w_own", "w_master"):

@@ -196,3 +196,48 @@ def test_ddp_trainer_checkpoint_roundtrip(tmp_path, rng):
         restored.params, params_saved)
     st2, loss = tr2.step(restored, tr2.shard_batch(batch))
     assert np.isfinite(float(loss))
+
+
+def test_layout_sidecar_enforced(tmp_path):
+    """A checkpoint whose flat masters are in a permuted (interleaved-1F1B)
+    layer order carries a layer_layout.json sidecar; restore() must refuse
+    to hand those bytes to a run that does not declare the MATCHING layout
+    (ADVICE r4: the sidecar used to be advisory — written on save, read by
+    nobody)."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    layout = {"layers_order": "interleaved-device-major",
+              "pp": 4, "virtual_stages": 2}
+    c.save(1, {"w": np.ones(4, np.float32)}, layout=layout)
+    assert c.saved_layout() == layout
+
+    # no declared layout -> refuse (the silent-misinterpretation case)
+    with pytest.raises(ValueError, match="sidecar"):
+        c.restore(1)
+    # wrong pp/virtual_stages -> refuse, naming the mismatched keys
+    with pytest.raises(ValueError, match="virtual_stages"):
+        c.restore(1, expect_layout=dict(layout, virtual_stages=4))
+    # matching layout -> restores
+    out = c.restore(1, expect_layout=dict(layout))
+    np.testing.assert_array_equal(out["w"], np.ones(4, np.float32))
+
+    # plain checkpoint + declared layout -> refuse too (bytes are in model
+    # order; deinterleaving them would equally permute layers)
+    c2 = ckpt.Checkpointer(str(tmp_path / "ck2"))
+    c2.save(1, {"w": np.ones(4, np.float32)})
+    with pytest.raises(ValueError, match="no .*sidecar|model order"):
+        c2.restore(1, expect_layout=layout)
+    assert c2.restore(1)["w"].shape == (4,)
+
+
+def test_layout_sidecar_cleared_by_plain_save(tmp_path):
+    """A later plain-order save into the same directory must remove the
+    earlier save's sidecar — otherwise restore() would demand (and
+    validate against) a layout the new bytes are not in."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    layout = {"layers_order": "interleaved-device-major",
+              "pp": 2, "virtual_stages": 2}
+    c.save(1, {"w": np.ones(2, np.float32)}, layout=layout)
+    c.save(2, {"w": np.zeros(2, np.float32)})       # plain model order
+    assert c.saved_layout() is None
+    np.testing.assert_array_equal(c.restore(2)["w"],
+                                  np.zeros(2, np.float32))

@@ -1,0 +1,116 @@
+#!/usr/bin/env python
+"""Slope-based codec rate probe (round-5 item 1 groundwork).
+
+Times K and 2K chained codec passes inside single dispatches and
+differences them, so any fixed per-dispatch cost (the ~16 ms axon tunnel
+floor that invalidated COLLECTIVE_r04's codec numbers) cancels exactly:
+
+    rate = K * bytes / (t_2K - t_K)
+
+Chains are serialized by real data dependence:
+  - roundtrip: v <- dec(enc(v))  (naturally dependent)
+  - decode:    scale vector rolled by the loop index (small-buffer op,
+               ~1/16 of the mantissa traffic)
+  - encode:    one element of the input perturbed in place from the
+               previous iteration's scale output (O(1) update on the
+               loop carry; XLA keeps it in place)
+"""
+
+import sys
+import time
+
+sys.path.insert(0, "/root/repo")
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    from bench_common import enable_compile_cache
+    enable_compile_cache(jax)
+    from fpga_ai_nic_tpu.ops import ring as ring_ops
+    from fpga_ai_nic_tpu.utils.config import BFPConfig
+
+    mb = int(sys.argv[1]) if len(sys.argv) > 1 else 64
+    K = int(sys.argv[2]) if len(sys.argv) > 2 else 64
+    n_elems = mb * (1 << 20) // 4
+    gb = n_elems * 4 / 1e9
+    cfg = BFPConfig(codec="auto")
+    enc_fn, dec_fn = ring_ops._codec(cfg, n_elems)
+
+    x = jax.random.normal(jax.random.PRNGKey(0), (n_elems,), jnp.float32)
+    mant0, se0 = jax.jit(enc_fn)(x)
+
+    # block_until_ready does not actually block through the axon tunnel;
+    # fetching a jitted scalar reduction is the honest sync (bench.py).
+    _scalar = jax.jit(lambda t: sum(
+        jnp.sum(jnp.asarray(l).astype(jnp.float32))
+        for l in jax.tree_util.tree_leaves(t)))
+
+    def timed(fn, *args):
+        out = fn(*args)
+        float(_scalar(out))
+        best = 9e9
+        for _ in range(3):
+            t0 = time.perf_counter()
+            out = fn(*args)
+            float(_scalar(out))
+            best = min(best, time.perf_counter() - t0)
+        return best
+
+    def make_rt(k):
+        @jax.jit
+        def chain(v):
+            def body(i, v):
+                m, s = enc_fn(v)
+                return dec_fn(m, s, v.dtype)
+            return lax.fori_loop(0, k, body, v)
+        return chain
+
+    # O(1) consumption is exact ONLY for the pallas codec (opaque custom
+    # call — DCE can't split it); the XLA codec needs full reductions
+    exact = ring_ops._use_pallas(cfg, n_elems)
+    print(f"[probe] exact_consume(pallas)={exact}", file=sys.stderr,
+          flush=True)
+
+    def make_dec(k):
+        @jax.jit
+        def chain(mant, se):
+            def body(i, acc):
+                out = dec_fn(mant, jnp.roll(se, i), jnp.float32)
+                return acc + (out[0] if exact else jnp.sum(out))
+            return lax.fori_loop(0, k, body, jnp.float32(0))
+        return chain
+
+    def make_enc(k):
+        @jax.jit
+        def chain(v):
+            def body(i, carry):
+                v, acc = carry
+                v = v.at[0].add(acc.astype(jnp.float32) * 1e-40)
+                m, s = enc_fn(v)
+                consumed = (s[0].astype(jnp.int32) if exact else
+                            jnp.sum(m.astype(jnp.int32))
+                            + jnp.sum(s.astype(jnp.int32)))
+                return v, consumed
+            return lax.fori_loop(0, k, body, (v, jnp.int32(0)))[1]
+        return chain
+
+    for name, mk, args in (("roundtrip", make_rt, (x,)),
+                           ("decode", make_dec, (mant0, se0)),
+                           ("encode", make_enc, (x,))):
+        print(f"[probe] {name} K={K}...", file=sys.stderr, flush=True)
+        tK = timed(mk(K), *args)
+        print(f"[probe] {name} tK={tK*1e3:.1f}ms; 2K...",
+              file=sys.stderr, flush=True)
+        t2K = timed(mk(2 * K), *args)
+        slope = (t2K - tK) / K
+        naive = tK / K
+        print(f"{name:10s} {mb}MiB K={K}: slope {gb/slope:8.2f} GB/s "
+              f"(naive {gb/naive:8.2f}; tK={tK*1e3:.1f}ms t2K={t2K*1e3:.1f}ms)",
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -38,14 +38,19 @@ def _reproduction_note() -> str:
     if not tpu_art:
         return ""
     d = _load(tpu_art)
-    bits = [f"{d.get('value'):,.0f} samples/s/chip",
-            f"{d.get('vs_baseline')}x baseline"]
+    bits = []
+    if d.get("value") is not None:       # partially-written artifacts may
+        bits.append(f"{d['value']:,.0f} samples/s/chip")   # miss either key
+    if d.get("vs_baseline") is not None:
+        bits.append(f"{d['vs_baseline']}x baseline")
     if d.get("mfu") is not None:
         bits.append(f"MFU {d['mfu']}")
     if col_art:
         dc = _load(col_art)
         if dc.get("codec_encode_gbps"):
             bits.append(f"codec encode {dc['codec_encode_gbps']} GB/s")
+    if not bits:
+        return ""
     return (" UPDATE: committed TPU artifacts now substantiate this class "
             "of figures (" + ", ".join(bits) + " — the headline and "
             "collective tables above cite them), so the round-2 numbers "
@@ -92,8 +97,9 @@ def main():
             rows.append((d, _rel(p) + " (driver record)"))
             break
     if rows:
-        L += ["| samples/s/chip | vs baseline | TFLOP/s | MFU | platform "
-              "| degraded | artifact |", "|---|---|---|---|---|---|---|"]
+        L += ["| samples/s/chip | vs baseline (modeled) | TFLOP/s | MFU "
+              "| platform | degraded | artifact |",
+              "|---|---|---|---|---|---|---|"]
         for d, src in rows:
             mfu = d.get("mfu")
             mfu_s = (f"{mfu} ({d.get('mfu_peak_ref', '')})" if mfu is not None
@@ -102,6 +108,12 @@ def main():
                      f"| {d.get('tflops_per_chip', '—')} | {mfu_s} "
                      f"| {d.get('platform')} "
                      f"| {bool(d.get('degraded', False))} | `{src}` |")
+        bm = next((d.get("baseline_model") for d, _ in rows
+                   if d.get("baseline_model")), None)
+        if bm:
+            L += ["", f"*vs-baseline denominator is modeled, not measured: "
+                      f"{bm} (the reference publishes no absolute "
+                      "numbers).*"]
     else:
         L.append("*(no committed throughput artifact yet)*")
     L.append("")
