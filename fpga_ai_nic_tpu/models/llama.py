@@ -26,7 +26,7 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 from ..ops import moe as moe_ops
-from ..ops.ring_attention import (flash_attention, full_attention,
+from ..ops.ring_attention import (flash_attention_remat, full_attention,
                                   gathered_attention, ring_attention)
 
 
@@ -55,6 +55,10 @@ class LlamaConfig:
     # full_attention's O(S^2); None keeps the exact direct softmax.
     # sp-sharded paths (ring/gathered) block independently of this knob.
     attn_block: "Optional[int]" = None
+    # which flash implementation backs attn_block: "auto" = the fused
+    # Pallas kernels on TPU (ops.flash_pallas, custom-vjp backward),
+    # XLA-blocked scan elsewhere; "pallas"/"xla" pin one for A/B runs
+    attn_impl: str = "auto"
     # MoE: when moe_experts > 0, every FFN becomes a top-k routed expert
     # layer (ops.moe); dense SwiGLU otherwise.  Not composable with the
     # pipelined path yet (apply_pp raises).
@@ -261,16 +265,12 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
                if sp_attn == "gather"
                else ring_attention(q, k, v, sp_axis, causal=True))
     elif cfg.attn_block is not None:
-        # flash-blocked single-device attention, attention-only remat:
-        # the k-block scan's per-block residuals would otherwise
-        # reconstitute the full O(S^2) score memory in the backward;
-        # checkpointing JUST the attention recomputes it once (the
-        # standard flash backward), saving q/k/v per layer instead —
-        # far cheaper than whole-block remat's ~1/3 extra model FLOPs
-        att = jax.checkpoint(
-            lambda q2, k2, v2: flash_attention(
-                q2, k2, v2, causal=True, k_block=cfg.attn_block)
-        )(q, k, v)
+        # memory-bounded single-device attention; the remat/backward
+        # choice (fused Pallas kernel vs checkpointed XLA scan) lives in
+        # ops.ring_attention.flash_attention_remat
+        att = flash_attention_remat(q, k, v, causal=True,
+                                    k_block=cfg.attn_block,
+                                    impl=cfg.attn_impl)
     else:
         att = full_attention(q, k, v, causal=True)
     att = att.transpose(0, 2, 1, 3).reshape(B, S, n_heads * Hd)

@@ -200,6 +200,42 @@ def full_attention(q, k, v, *, causal=True, sm_scale=None):
                       ).astype(q.dtype)
 
 
+def flash_attention_remat(q, k, v, *, causal=True, sm_scale=None,
+                          k_block: Optional[int] = 512, impl: str = "auto"):
+    """Memory-bounded exact attention for model code — picks the best
+    backward story available:
+
+    - ``pallas`` (auto on TPU when shapes tile): the fused
+      ops.flash_pallas kernels; the custom-vjp backward recomputes p
+      from the saved logsumexp, so no ``jax.checkpoint`` wrapper is
+      needed (wrapping one would only re-run the forward kernel).
+    - ``xla`` (auto off-TPU / odd shapes): the k-block-scanned
+      ``flash_attention`` under attention-only ``jax.checkpoint`` —
+      without it the scan's per-block residuals reconstitute O(S^2)
+      backward memory (measured 22 GB at S=16,384; models/llama.py
+      carried this wrapper before round 5 moved the choice here)."""
+    from . import flash_pallas
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"attn impl {impl!r}: want auto|pallas|xla")
+    if impl == "pallas" and not flash_pallas.supported(q.shape):
+        # a PINNED pallas that silently ran xla would invalidate every
+        # A/B comparison made with the knob
+        raise ValueError(
+            f"impl='pallas' pinned but q shape {q.shape} does not tile "
+            "(need S % 128 == 0, head_dim % 8 == 0, head_dim <= 256)")
+    want_pallas = impl == "pallas" or (impl == "auto"
+                                       and flash_pallas._is_tpu())
+    if want_pallas and flash_pallas.supported(q.shape):
+        b = k_block or flash_pallas._DEF_BLOCK
+        return flash_pallas.flash_attention(q, k, v, causal=causal,
+                                            sm_scale=sm_scale,
+                                            block_q=b, block_k=b)
+    return jax.checkpoint(
+        lambda q2, k2, v2: flash_attention(q2, k2, v2, causal=causal,
+                                           sm_scale=sm_scale,
+                                           k_block=k_block))(q, k, v)
+
+
 def gathered_attention(q, k, v, axis_name: str, *, causal=True,
                        sm_scale=None, k_block: Optional[int] = 512):
     """Sequence-parallel attention via KV all-gather: queries stay
