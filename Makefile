@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -30,3 +30,14 @@ collective:
 # regenerate docs/PERF.md strictly from committed artifacts
 perf:
 	python tools/gen_perf_md.py
+
+# multi-chip conversion kit: on any >= 2-real-chip surface this banks the
+# canary -> busbw (bf16 psum vs BFP rings) -> trace-attribution ladder
+# unattended (tools/multichip_bench.py docstring states the claims each
+# stage settles); the dryrun variant validates every code path on the
+# 8-device virtual CPU mesh, artifacts marked {"dryrun": true}
+multichip-bench:
+	python tools/multichip_bench.py
+
+multichip-dryrun:
+	python tools/multichip_bench.py --dryrun

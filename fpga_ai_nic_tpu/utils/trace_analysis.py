@@ -19,11 +19,16 @@ xprof dependency.
 from __future__ import annotations
 
 import os
+import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 _COLLECTIVE_MARKERS = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all", "collective-broadcast", "ragged-all-to-all",
+    # jax-level instruction names (XLA names HLO collectives after the
+    # primitive that built them, e.g. "psum.7" on the CPU thunk executor)
+    "psum", "ppermute", "all_gather", "all_to_all", "psum_scatter",
+    "reduce_scatter",
 )
 
 Interval = Tuple[float, float]          # (start_ns, end_ns)
@@ -90,6 +95,41 @@ def _is_collective(name: str) -> bool:
     return any(m in n for m in _COLLECTIVE_MARKERS)
 
 
+def _attribution_report(sync_ivs: List[Interval],
+                        async_evs: List[Tuple[str, Interval]],
+                        classify=None) -> Dict:
+    """Shared overlapped/exposed accounting for one device plane (TPU) or
+    one thunk mesh (CPU): async op wall time split into the part covered
+    by merged sync compute and the exposed remainder, ranked per op.
+    `classify(name)` returns the async bucket key (default: collective vs
+    dma by name)."""
+    if classify is None:
+        def classify(name):
+            return ("async_collective_s" if _is_collective(name)
+                    else "async_dma_s")
+    merged = merge_intervals(sync_ivs)
+    rep = {"sync_busy_s": total_len(merged) / 1e9,
+           "async_s": 0.0, "async_collective_s": 0.0,
+           "async_dma_s": 0.0, "overlapped_s": 0.0, "exposed_s": 0.0}
+    exposed_by_op: Dict[str, float] = {}
+    for name, iv in async_evs:
+        dur = (iv[1] - iv[0]) / 1e9
+        cov = overlap_len(iv, merged) / 1e9
+        rep["async_s"] += dur
+        rep[classify(name)] += dur
+        rep["overlapped_s"] += cov
+        exposed = dur - cov
+        rep["exposed_s"] += exposed
+        if exposed > 0:
+            exposed_by_op[name] = exposed_by_op.get(name, 0.0) + exposed
+    rep["overlap_frac"] = (rep["overlapped_s"] / rep["async_s"]
+                           if rep["async_s"] else 1.0)
+    rep["exposed_by_op"] = exposed_by_op
+    rep["top_exposed"] = sorted(exposed_by_op.items(),
+                                key=lambda kv: -kv[1])[:5]
+    return rep
+
+
 def analyze_trace(trace_dir: str, *,
                   plane_substr: str = "/device:") -> Dict:
     """Overlap/stall report for every device plane in the trace.
@@ -122,36 +162,95 @@ def analyze_trace(trace_dir: str, *,
                                        ev.start_ns + ev.duration_ns)))
         if not sync_ivs and not async_evs:
             continue
-        merged = merge_intervals(sync_ivs)
-        rep = {"sync_busy_s": total_len(merged) / 1e9,
-               "async_s": 0.0, "async_collective_s": 0.0,
-               "async_dma_s": 0.0, "overlapped_s": 0.0, "exposed_s": 0.0}
-        exposed_by_op: Dict[str, float] = {}
-        for name, iv in async_evs:
-            dur = (iv[1] - iv[0]) / 1e9
-            cov = overlap_len(iv, merged) / 1e9
-            rep["async_s"] += dur
-            key = ("async_collective_s" if _is_collective(name)
-                   else "async_dma_s")
-            rep[key] += dur
-            rep["overlapped_s"] += cov
-            exposed = dur - cov
-            rep["exposed_s"] += exposed
-            if exposed > 0:
-                exposed_by_op[name] = exposed_by_op.get(name, 0.0) + exposed
-        rep["overlap_frac"] = (rep["overlapped_s"] / rep["async_s"]
-                               if rep["async_s"] else 1.0)
-        # full map kept so cross-device aggregation never drops an op that
-        # is small per device but large fleet-wide; top_exposed is display
-        rep["exposed_by_op"] = exposed_by_op
-        rep["top_exposed"] = sorted(exposed_by_op.items(),
-                                    key=lambda kv: -kv[1])[:5]
-        devices[plane.name] = rep
+        # full exposed_by_op map kept so cross-device aggregation never
+        # drops an op that is small per device but large fleet-wide
+        devices[plane.name] = _attribution_report(sync_ivs, async_evs)
     if not devices:
         raise ValueError(
             f"{path} has no '{plane_substr}' plane with XLA Ops lines "
             "(CPU traces carry host thunk lines only; capture on TPU)")
     return {"devices": devices, "xplane": path}
+
+
+# thunks execute on the per-shard executor threads AND the shared Eigen
+# intra-op pool threads; both carry leaf op events
+_CPU_LINE_PREFIXES = ("tf_XLAPjRtCpuClient", "tf_XLAEigen")
+# leaf thunk events are bare HLO instruction names ("wrapped_tanh",
+# "psum.7", "broadcast_add_fusion"); executor infrastructure events mostly
+# carry spaces or "::" ("ThunkExecutor::Execute (...)", "end: X",
+# "Wait: pending_threads=2/8") — the bare-word exceptions are listed
+_CPU_OP_RE = re.compile(r"[\w.\-]+")
+_CPU_INFRA = frozenset({"Rendezvous"})   # collective-internal wait event,
+# already inside the enclosing psum/ppermute thunk interval
+# control-flow thunks ENCLOSE their body's thunk events — counting a
+# while-loop's full span as sync compute would blanket every collective
+# inside it
+_CPU_CONTAINER_RE = re.compile(r"(while|call|conditional)(\.\d+)?")
+
+
+def analyze_cpu_thunk_trace(trace_dir: str) -> Dict:
+    """Overlap attribution from a CPU thunk-executor trace — the virtual
+    8-device mesh's substitute for TPU device planes (which a CPU trace
+    does not carry; capture with ``ProfileOptions.host_tracer_level=3`` so
+    per-op thunk events appear).
+
+    Semantics differ from the device-plane analysis and are labeled in
+    the report: each ``tf_XLAPjRtCpuClient/*`` line is one shard's
+    executor thread; a collective thunk's interval INCLUDES its
+    rendezvous wait (the wire-time analogue), and its *overlapped* share
+    is the part hidden under compute thunks running concurrently on the
+    other shards' threads — the mesh-level "was anything useful happening
+    while shards sat in the collective" question the reference answers
+    with stall_eth counters (hw/all_reduce.sv:94-97).  Exposed = no shard
+    computed: true mesh-wide stall."""
+    from jax.profiler import ProfileData
+    path = find_xplane(trace_dir)
+    data = ProfileData.from_file(path)
+    sync_ivs: List[Interval] = []
+    async_evs: List[Tuple[str, Interval]] = []
+    n_lines = 0
+    for plane in data.planes:
+        if not plane.name.startswith("/host:"):
+            continue
+        for line in plane.lines:
+            if not line.name.startswith(_CPU_LINE_PREFIXES):
+                continue
+            n_lines += 1
+            for ev in line.events:
+                if (not _CPU_OP_RE.fullmatch(ev.name)
+                        or not ev.duration_ns
+                        or ev.name in _CPU_INFRA
+                        or _CPU_CONTAINER_RE.fullmatch(ev.name)):
+                    continue
+                iv = (ev.start_ns, ev.start_ns + ev.duration_ns)
+                base = ev.name.removeprefix("wrapped_")
+                if _is_collective(base):
+                    async_evs.append((ev.name, iv))
+                else:
+                    sync_ivs.append(iv)
+    if not async_evs and not sync_ivs:
+        raise ValueError(
+            f"{path} carries no leaf thunk events on "
+            f"{'/'.join(_CPU_LINE_PREFIXES)} lines — capture with "
+            "ProfileOptions.host_tracer_level=3")
+    # every async event here IS a collective (that's how it was classified)
+    rep = _attribution_report(sync_ivs, async_evs,
+                              classify=lambda name: "async_collective_s")
+    rep["mode"] = ("cpu-thunks: per-shard collective wall time (incl. "
+                   "rendezvous wait) vs compute concurrently live on any "
+                   "shard's executor thread")
+    rep["n_executor_lines"] = n_lines
+    return {"devices": {"cpu-thunk-mesh": rep}, "xplane": path}
+
+
+def analyze_any(trace_dir: str) -> Dict:
+    """Device-plane analysis when the trace has one (TPU), CPU thunk-mode
+    otherwise — so the same tooling attributes collectives on the real
+    chip and on the virtual mesh."""
+    try:
+        return analyze_trace(trace_dir)
+    except ValueError:
+        return analyze_cpu_thunk_trace(trace_dir)
 
 
 def summarize(report: Dict) -> Dict:
