@@ -226,6 +226,27 @@ def save_artifact(prefix: str, result: dict) -> str:
     return path
 
 
+def git_commit_artifacts(repo_dir: str, msg: str) -> None:
+    """Bank evidence under artifacts/ immediately (the first-contact
+    discipline: a wedge mid-ladder must cost the remaining stages, never
+    the committed ones); retries through index-lock races with an
+    interactive session — benign, evidence swept into either commit is
+    still committed evidence."""
+    import subprocess
+    for i in range(5):
+        try:
+            subprocess.run(["git", "add", "artifacts", "-f"], cwd=repo_dir,
+                           timeout=30, check=True)
+            r = subprocess.run(["git", "commit", "-m", msg], cwd=repo_dir,
+                               timeout=30, capture_output=True, text=True)
+            if r.returncode == 0 or "nothing to commit" in r.stdout:
+                return
+        except Exception as e:  # noqa: BLE001
+            log(f"git commit retry {i}: {e}")
+        time.sleep(3 + 2 * i)
+    log(f"git commit failed after retries: {msg!r}")
+
+
 def cpu_env(n_devices: int = 8) -> dict:
     """Env overrides forcing an n-device virtual CPU mesh (and disabling the
     eager TPU-tunnel registration)."""

@@ -150,7 +150,8 @@ def _when(cond, static: bool):
 def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
                recv_sem, credit_sem, *, n: int, n_slices: int,
                slice_rows: int, block_size: int, mantissa_bits: int,
-               rounding: str, flow_control: bool, unrolled: bool):
+               rounding: str, flow_control: bool, unrolled: bool,
+               ablate: Optional[str] = None):
     """The whole sliced ring reduce-scatter, one kernel invocation.
 
     ids_ref:   SMEM [3] int32 — (my index, right neighbor, left neighbor),
@@ -162,7 +163,21 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
     recv_pkt:  (2, R + R/B, 128) int8
     send/recv_sem: DMA (2,) — one per comm slot
     credit_sem: REGULAR — downstream-consumed-slot credits (flow control)
-    """
+
+    ablate (STAGE-ATTRIBUTION ONLY, compile-time): None runs the full
+    pipeline; "encode" / "rdma" / "decode" run exactly one stage of the
+    same schedule (the other stages compile away), so timing the four
+    variants answers which stage binds the pipelined hop — the per-stage
+    breakdown the round-4 verdict ordered for the loopback microbench
+    (the reference reads the same split from its stall counters,
+    hw/all_reduce.sv:94-97).  Ablated outputs are garbage by design:
+    "rdma" sends whatever is in the frames, "decode" decodes stale
+    frames — timing is data-independent on the VPU/DMA so rates are
+    unaffected.  Loopback/bench use only; never a collective."""
+    assert ablate in (None, "encode", "rdma", "decode"), ablate
+    do_enc = ablate in (None, "encode")
+    do_rdma = ablate in (None, "rdma")
+    do_dec = ablate in (None, "decode")
     idx = ids_ref[0]
     right = ids_ref[1]               # we send downstream (IKL ring order,
     left = ids_ref[2]                # sw/setup_route.sh:12-40)
@@ -195,12 +210,14 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
     # lockstep emulation cannot execute remote semaphore signals; the
     # threaded interpreter (interpret="threaded") and hardware both run
     # the barrier + credits for real (see _interp_args).
-    if flow_control:
+    if flow_control and do_rdma:
         _neighbor_barrier(left, right)
 
     # prologue: slice 0 has no in-flight RDMA to overlap with
-    encode_to_slot(0)
-    rdma(0).start()
+    if do_enc:
+        encode_to_slot(0)
+    if do_rdma:
+        rdma(0).start()
 
     def launch(q):
         # launch send q while RDMA q-1 is in flight — the encode/wire
@@ -208,28 +225,33 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
         # egress path
         @_when(q < total, unrolled)
         def _launch():
-            @_when(q >= 2, unrolled)
-            def _reuse():                 # slot q%2 was used by RDMA q-2:
-                rdma(q - 2).wait_send()   # source buffer must be drained
-            encode_to_slot(q)
+            if do_rdma:
+                @_when(q >= 2, unrolled)
+                def _reuse():               # slot q%2 was used by RDMA
+                    rdma(q - 2).wait_send()  # q-2: source must be drained
+            if do_enc:
+                encode_to_slot(q)
 
-            if flow_control:
+            if flow_control and do_rdma:
                 @_when(q >= 2, unrolled)
                 def _credit():            # destination slot safety: the
                     pltpu.semaphore_wait(credit_sem, 1)  # recvr freed q-2
-            rdma(q).start()
+            if do_rdma:
+                rdma(q).start()
 
     def consume(g):
         # decode slice g + accumulate into the chunk this hop owns
-        rdma(g).wait_recv()
-        s, k = g // S, g % S
-        slot = g % 2
-        chunk = (idx - s - 2) % n
-        off = chunk * chunk_rows + k * R
-        dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
-                           recv_pkt[slot, pl.ds(R, SB)], block_size)
-        acc[pl.ds(off, R)] = acc[pl.ds(off, R)] + dec
-        if flow_control:
+        if do_rdma:
+            rdma(g).wait_recv()
+        if do_dec:
+            s, k = g // S, g % S
+            slot = g % 2
+            chunk = (idx - s - 2) % n
+            off = chunk * chunk_rows + k * R
+            dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                               recv_pkt[slot, pl.ds(R, SB)], block_size)
+            acc[pl.ds(off, R)] = acc[pl.ds(off, R)] + dec
+        if flow_control and do_rdma:
             # free the slot for our upstream sender
             pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
@@ -264,11 +286,12 @@ def _rs_kernel(ids_ref, x_ref, out_ref, acc, send_pkt, recv_pkt, send_sem,
 
     # drain: the last two sends' source-buffer semaphores, and the two
     # residual credits our receiver signaled but no later send consumed
-    rdma(total - 1).wait_send()
-    if total >= 2:
-        rdma(total - 2).wait_send()
-    if flow_control:
-        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+    if do_rdma:
+        rdma(total - 1).wait_send()
+        if total >= 2:
+            rdma(total - 2).wait_send()
+        if flow_control:
+            pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
 
     out_ref[:] = acc[pl.ds(idx * chunk_rows, chunk_rows)]
 
@@ -300,11 +323,12 @@ def _ring_ids(axis_name: Optional[str]) -> jax.Array:
 
 @functools.partial(jax.jit, static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id", "loopback_n"))
+    "interpret", "collective_id", "loopback_n", "ablate"))
 def _rs_call(x2, axis_name: Optional[str], block_size: int,
              mantissa_bits: int, rounding: str, slice_elems: int,
              interpret: bool, collective_id: int,
-             loopback_n: Optional[int] = None):
+             loopback_n: Optional[int] = None,
+             ablate: Optional[str] = None):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -316,7 +340,8 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     kern = functools.partial(
         _rs_kernel, n=n, n_slices=S, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled,
+        ablate=ablate)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
@@ -1229,7 +1254,8 @@ def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
                         compression: Optional[BFPConfig] = None,
                         slice_elems: int = 8192,
                         streaming: bool = False,
-                        interpret: Optional[bool] = None) -> jax.Array:
+                        interpret: Optional[bool] = None,
+                        ablate: Optional[str] = None) -> jax.Array:
     """Single-chip exercise of the fused reduce-scatter pipeline: the same
     kernel with every RDMA addressed to this device (virtual ring of
     `virtual_n`); streaming=True runs the HBM-streaming variant.
@@ -1252,11 +1278,16 @@ def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
     if C % slice_elems or slice_elems % (cfg.block_size * LANES):
         raise ValueError((C, slice_elems, cfg.block_size * LANES))
     x2 = x.astype(jnp.float32).reshape(-1, LANES)
+    if ablate is not None and streaming:
+        raise ValueError("stage ablation instruments the VMEM-resident "
+                         "kernel only (the streaming variant adds "
+                         "load/store stages the split doesn't model)")
     call = _rs_stream_call if streaming else _rs_call
+    kw = {} if streaming else {"ablate": ablate}
     out = _loopback_shmap(
         lambda v: call(v, None, cfg.block_size, cfg.mantissa_bits,
                        cfg.rounding, slice_elems, interpret, 7,
-                       loopback_n=virtual_n), x2)
+                       loopback_n=virtual_n, **kw), x2)
     return out.reshape(C)
 
 

@@ -355,3 +355,24 @@ def test_loopback_gather_microbench_runs(rng, streaming):
     assert a.shape == (v_n * 2 * SLICE,)
     assert np.isfinite(a).all()
     np.testing.assert_array_equal(a, b)
+
+
+def test_loopback_stage_ablation(rng):
+    """Stage-ablated loopback variants (round-5 per-stage attribution):
+    each runs the same schedule with one stage compiled in.  encode/rdma
+    ablations never touch the accumulator, so the owned chunk comes back
+    untouched — a structural check that the ablation really removed the
+    decode+add stage rather than scrambling the schedule."""
+    vn, SL = 4, SLICE
+    x = jnp.asarray(rng.standard_normal(vn * 2 * SL), jnp.float32)
+    C = x.shape[0] // vn
+    for ab in ("encode", "rdma"):
+        out = rp.loopback_microbench(x, vn, slice_elems=SL, ablate=ab)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x[:C]))
+    out = rp.loopback_microbench(x, vn, slice_elems=SL, ablate="decode")
+    assert out.shape == (C,)               # decodes stale frames: values
+    full = rp.loopback_microbench(x, vn, slice_elems=SL)  # are garbage
+    assert full.shape == (C,) and np.isfinite(np.asarray(full)).all()
+    with pytest.raises(ValueError, match="resident"):
+        rp.loopback_microbench(x, vn, slice_elems=SL, streaming=True,
+                               ablate="encode")

@@ -45,7 +45,6 @@ dryrun; re-runs skip banked stages (--force redoes).
 
 import json
 import os
-import subprocess
 import sys
 import time
 
@@ -53,8 +52,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
-from bench_common import (cpu_env, log, probe_tpu, run_attempt,  # noqa: E402
-                          save_artifact)
+from bench_common import (cpu_env, git_commit_artifacts, log,  # noqa: E402
+                          probe_tpu, run_attempt, save_artifact)
 
 STATE_PATH = os.path.join(REPO, "artifacts", "multichip_state.json")
 SWEEP_MB = (16, 64)
@@ -73,21 +72,6 @@ def _save_state(state: dict) -> None:
     os.makedirs(os.path.dirname(STATE_PATH), exist_ok=True)
     with open(STATE_PATH, "w") as f:
         json.dump(state, f, indent=1)
-
-
-def _git_commit(msg: str) -> None:
-    for i in range(5):
-        try:
-            subprocess.run(["git", "add", "artifacts", "-f"], cwd=REPO,
-                           timeout=30, check=True)
-            r = subprocess.run(["git", "commit", "-m", msg], cwd=REPO,
-                               timeout=30, capture_output=True, text=True)
-            if r.returncode == 0 or "nothing to commit" in r.stdout:
-                return
-        except Exception as e:  # noqa: BLE001
-            log(f"git commit retry {i}: {e}")
-        time.sleep(3 + 2 * i)
-    log(f"git commit failed after retries: {msg!r}")
 
 
 # ---------------------------------------------------------------------------
@@ -241,21 +225,25 @@ def child_busbw() -> None:
     for mb in sizes:
         L = mb * (1 << 20) // 4
         L -= L % (n * cfg.block_size * 128)
-        print(f"[bench] phase=sweep_{mb}MiB t={time.time() - t0:.1f}s",
-              flush=True)
+        # slice plan derived from the actual per-device chunk — a
+        # hard-coded 8192 does not divide the chunk on non-power-of-two
+        # rings (the reference's own topology was THREE nodes)
+        sl = rp.pick_slice_elems(L // n, 8192, cfg.block_size)
+        print(f"[bench] phase=sweep_{mb}MiB t={time.time() - t0:.1f}s "
+              f"slice={sl}", flush=True)
         xs = jax.random.normal(jax.random.PRNGKey(1), (L,), jnp.float32)
         xb = xs.astype(jnp.bfloat16)
-        row = {"size_mb": mb}
+        row = {"size_mb": mb, "slice_elems": sl}
         impls = [
             ("psum_bf16", lambda v: lax.psum(v, "dp"), xb, L * 2),
             ("ring_f32", lambda v: ring_ops.ring_all_reduce(v, "dp"),
              xs, L * 4),
             ("ring_bfp", lambda v: ring_ops.ring_all_reduce(
-                v, "dp", compression=cfg, slice_elems=8192), xs, L * 4),
+                v, "dp", compression=cfg, slice_elems=sl), xs, L * 4),
         ]
         if on_tpu:
             impls.append(("fused_bfp", lambda v: rp.ring_all_reduce_fused(
-                v, "dp", compression=cfg), xs, L * 4))
+                v, "dp", compression=cfg, slice_elems=sl), xs, L * 4))
         for name, coll, x, nbytes in impls:
             try:
                 t_iter, diag = slope_timeit(make_chain(coll), (x,),
@@ -394,8 +382,11 @@ def main() -> int:
             done[name] = {"ok": True, "at": time.strftime(
                 "%Y-%m-%dT%H:%M:%SZ", time.gmtime())}
             _save_state(state)
-        _git_commit(f"Bank multichip evidence: stage '{name}'"
-                    + (" (dryrun)" if dryrun else ""))
+        else:
+            rc = 1          # executed-but-failed: artifact banked for
+            # forensics, exit nonzero so an unattended caller retries
+        git_commit_artifacts(REPO, f"Bank multichip evidence: stage "
+                             f"'{name}'" + (" (dryrun)" if dryrun else ""))
         if name == "canary" and not ok:
             log("canary FAILED — banked evidence; refusing to escalate")
             return 1
