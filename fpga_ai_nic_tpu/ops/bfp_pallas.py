@@ -34,8 +34,24 @@ def _is_tpu() -> bool:
     return jax.devices()[0].platform in ("tpu", "axon")
 
 
+def _bcast_blocks(small, block_size, broadcast):
+    """(T, 128) -> (T*B, 128) with each row repeated B times consecutively.
+
+    "repeat": jnp.repeat on sublanes.  "reshape": broadcast through a 3D
+    register view — (T,1,128) -> (T,B,128) -> (T*B,128); whether Mosaic
+    lowers one better than the other is an on-hardware question
+    (tools/codec_kernel_probe.py A/Bs them); both are bit-identical
+    (tests/test_bfp_pallas.py)."""
+    assert broadcast in ("repeat", "reshape"), broadcast
+    T = small.shape[0]
+    if broadcast == "reshape":
+        return jnp.broadcast_to(small[:, None, :], (T, block_size, LANES)
+                                ).reshape(T * block_size, LANES)
+    return jnp.repeat(small, block_size, axis=0)
+
+
 def _encode_kernel(x_ref, mant_ref, scale_ref, *, block_size, mantissa_bits,
-                   rounding):
+                   rounding, broadcast="repeat"):
     # refs are 2D (T*B, 128) so every operand/result sits in NATIVE tiles —
     # f32 (8,128), int8 (32,128); a 3D (T, B=16, 128) int8 block would leave
     # each row-group half a native int8 tile and force packed relayouts on
@@ -48,18 +64,19 @@ def _encode_kernel(x_ref, mant_ref, scale_ref, *, block_size, mantissa_bits,
     scale_e = jnp.clip(emax - 127 - (mantissa_bits - 2), -126, 126)
     inv = pltpu.bitcast(((127 - scale_e) << 23).astype(jnp.uint32),
                         jnp.float32)               # 2.0**-scale_e, exact
-    q = x * jnp.repeat(inv, block_size, axis=0)
+    q = x * _bcast_blocks(inv, block_size, broadcast)
     q = jnp.round(q) if rounding == "nearest" else jnp.trunc(q)
     lim = float(2 ** (mantissa_bits - 1) - 1)
     mant_ref[:] = jnp.clip(q, -lim, lim).astype(jnp.int8)
     scale_ref[:] = scale_e.astype(jnp.int8)
 
 
-def _decode_kernel(mant_ref, scale_ref, out_ref, *, block_size):
+def _decode_kernel(mant_ref, scale_ref, out_ref, *, block_size,
+                   broadcast="repeat"):
     m = mant_ref[:].astype(jnp.float32)            # (T*B, 128)
     se = scale_ref[:].astype(jnp.int32)            # (T, 128)
     scale = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
-    out_ref[:] = m * jnp.repeat(scale, block_size, axis=0)
+    out_ref[:] = m * _bcast_blocks(scale, block_size, broadcast)
 
 
 def _grid(n_tiles: int, block_size: int, tiles_per_step: int):
@@ -72,7 +89,8 @@ def _grid(n_tiles: int, block_size: int, tiles_per_step: int):
 def bfp_encode_inline(x: jax.Array, block_size: int = 16,
                       mantissa_bits: int = 8, rounding: str = "nearest",
                       interpret: Optional[bool] = None,
-                      tiles_per_step: int = _DEF_TILES
+                      tiles_per_step: int = _DEF_TILES,
+                      broadcast: str = "repeat"
                       ) -> Tuple[jax.Array, jax.Array]:
     """Flat f32/bf16 [N] (N % (block*128) == 0) -> (int8 [N], int8 [N/block])
     in the "sublane" layout (bit-identical to
@@ -89,7 +107,8 @@ def bfp_encode_inline(x: jax.Array, block_size: int = 16,
     n_tiles = x2.shape[0] // block_size
     t, steps = _grid(n_tiles, block_size, tiles_per_step)
     kern = functools.partial(_encode_kernel, block_size=block_size,
-                             mantissa_bits=mantissa_bits, rounding=rounding)
+                             mantissa_bits=mantissa_bits, rounding=rounding,
+                             broadcast=broadcast)
     mant, scale = pl.pallas_call(
         kern,
         grid=(steps,),
@@ -113,13 +132,14 @@ def bfp_encode_inline(x: jax.Array, block_size: int = 16,
 
 bfp_encode = functools.partial(jax.jit, static_argnames=(
     "block_size", "mantissa_bits", "rounding", "interpret",
-    "tiles_per_step"))(bfp_encode_inline)
+    "tiles_per_step", "broadcast"))(bfp_encode_inline)
 
 
 def bfp_decode_inline(mant: jax.Array, scale: jax.Array,
                       block_size: int = 16, dtype=jnp.float32,
                       interpret: Optional[bool] = None,
-                      tiles_per_step: int = _DEF_TILES) -> jax.Array:
+                      tiles_per_step: int = _DEF_TILES,
+                      broadcast: str = "repeat") -> jax.Array:
     if interpret is None:
         interpret = not _is_tpu()
     n = mant.shape[0]
@@ -127,7 +147,8 @@ def bfp_decode_inline(mant: jax.Array, scale: jax.Array,
     s2 = scale.reshape(-1, LANES)
     t, steps = _grid(s2.shape[0], block_size, tiles_per_step)
     out = pl.pallas_call(
-        functools.partial(_decode_kernel, block_size=block_size),
+        functools.partial(_decode_kernel, block_size=block_size,
+                          broadcast=broadcast),
         grid=(steps,),
         in_specs=[
             pl.BlockSpec((t * block_size, LANES), lambda i: (i, 0),
@@ -146,5 +167,5 @@ def bfp_decode_inline(mant: jax.Array, scale: jax.Array,
 
 
 bfp_decode = functools.partial(jax.jit, static_argnames=(
-    "block_size", "dtype", "interpret", "tiles_per_step"))(
+    "block_size", "dtype", "interpret", "tiles_per_step", "broadcast"))(
         bfp_decode_inline)

@@ -56,3 +56,23 @@ def test_4bit_mantissa(rng):
     gm, gs = bfp_golden.bfp_encode(x, 16, 4, layout="sublane")
     np.testing.assert_array_equal(gm, np.asarray(m))
     np.testing.assert_array_equal(gs, np.asarray(s))
+
+
+@pytest.mark.parametrize("broadcast", ["repeat", "reshape"])
+def test_broadcast_variants_match_golden(rng, broadcast):
+    """Both in-kernel block-broadcast strategies (sublane jnp.repeat and
+    3D-register reshape) must match the golden sublane spec bit for bit —
+    they exist only so tools/codec_kernel_probe.py can pick the faster
+    Mosaic lowering.  (Each variant is checked against bfp_golden, not
+    against the default path, so a regression in either lowering fails
+    its own case.)"""
+    x = jnp.asarray(rng.standard_normal(4 * 16 * 128), jnp.float32)
+    mant, se = bfp_pallas.bfp_encode(x, interpret=True, broadcast=broadcast)
+    mant_g, se_g = bfp_golden.bfp_encode(np.asarray(x), 16, 8, "nearest",
+                                         layout="sublane")
+    np.testing.assert_array_equal(np.asarray(mant), mant_g)
+    np.testing.assert_array_equal(np.asarray(se), se_g)
+    out = bfp_pallas.bfp_decode(mant, se, interpret=True,
+                                broadcast=broadcast)
+    out_g = bfp_golden.bfp_decode(mant_g, se_g, 16, layout="sublane")
+    np.testing.assert_array_equal(np.asarray(out), out_g)

@@ -183,14 +183,23 @@ class TestFlowControl:
     must STILL be bit-identical to the XLA-op ring.
 
     Rings are capped at n=4 here: the threaded interpreter needs a live
-    OS thread per emulated device and this container has ONE core — n=8
-    livelocks in kernel-entry allocation (observed: 7 threads thrashing
-    _allocate_buffer while device 0 waits at the barrier, >500s without
-    progress).  n=4 already exercises everything the protocol has:
-    multi-hop forwards, credit waits (j >= n_slots), wire-slot reuse
-    (total > n_slots), and the barrier; n=8 stays covered by the fast
-    discharge-interpreter sweep above and the hardware canary
-    (tools/first_contact.py)."""
+    OS thread per emulated device and this container has ONE core.  n=8
+    exceeds 500s before any kernel body runs.  Round-5 diagnosis
+    (faulthandler stack dump during the hang): device 0 is parked in
+    shared_memory.Semaphore.wait (the neighbor barrier — correct,
+    blocking, GIL-released) while the other SEVEN threads all sit inside
+    interpret_pallas_call._allocate_buffer's np.array(val) buffer-init
+    copies under the interpreter's shared-memory lock and race-detector
+    vector clocks — kernel-ENTRY allocation, serialized on one core, not
+    our credit protocol (no cycle: the barrier participants simply never
+    finish allocating).  Forcing sys.setswitchinterval(0.0005) does not
+    help, ruling out GIL unfairness: the allocation work itself is the
+    convoy.  An upstream report is not possible from this surface (zero
+    egress) — this docstring is the record.  n=4 already exercises
+    everything the protocol has: multi-hop forwards, credit waits
+    (j >= n_slots), wire-slot reuse (total > n_slots), and the barrier;
+    n=8 stays covered by the fast discharge-interpreter sweep above and
+    the hardware canary (tools/first_contact.py)."""
 
     @pytest.mark.parametrize("n,slices_per_chunk", [(4, 2), (3, 1), (2, 2)])
     def test_rs_resident(self, rng, n, slices_per_chunk):
