@@ -261,9 +261,11 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
         # "gather": KV all-gather variant — the only form sound inside the
         # 1F1B schedulers' stage-divergent conds (ring's ppermute pairs
         # span the whole mesh; see ops.ring_attention.gathered_attention)
-        att = (gathered_attention(q, k, v, sp_axis, causal=True)
+        att = (gathered_attention(q, k, v, sp_axis, causal=True,
+                                  impl=cfg.attn_impl)
                if sp_attn == "gather"
-               else ring_attention(q, k, v, sp_axis, causal=True))
+               else ring_attention(q, k, v, sp_axis, causal=True,
+                                   impl=cfg.attn_impl))
     elif cfg.attn_block is not None:
         # memory-bounded single-device attention; the remat/backward
         # choice (fused Pallas kernel vs checkpointed XLA scan) lives in

@@ -1,2 +1,3 @@
-from . import (bfp, bfp_golden, bfp_pallas, bucketed, fused_update, moe,
-               ring, ring_attention, ring_golden, ring_pallas)  # noqa: F401
+from . import (bfp, bfp_golden, bfp_pallas, bucketed, flash_pallas,
+               fused_update, moe, ring, ring_attention, ring_golden,
+               ring_pallas)  # noqa: F401

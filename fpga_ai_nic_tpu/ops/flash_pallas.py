@@ -38,6 +38,8 @@ from __future__ import annotations
 import functools
 from typing import Optional
 
+import numpy as _np
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -74,9 +76,15 @@ def _vma(*arrs):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
-                *, sm_scale, causal, block_q, block_k, nk):
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                acc_scr, *, sm_scale, causal, block_q, block_k, nk):
+    # off_ref: SMEM [2] int32 — (q_offset, k_offset) GLOBAL positions of
+    # this call's first q row / k row.  (0, 0) for whole-sequence
+    # attention; nonzero when the caller attends a local q shard against
+    # a visiting K/V chunk (ring / gathered sequence parallelism) and
+    # causality must follow global token positions.
     iq, ik = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0], off_ref[1]
 
     @pl.when(ik == 0)
     def _init():
@@ -92,9 +100,9 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            qpos = (iq * block_q
+            qpos = (q0 + iq * block_q
                     + lax.broadcasted_iota(jnp.int32, s.shape, 0))
-            kpos = (ik * block_k
+            kpos = (k0 + ik * block_k
                     + lax.broadcasted_iota(jnp.int32, s.shape, 1))
             s = jnp.where(kpos > qpos, _NEG, s)
         m_prev = m_scr[:, :1]                          # (bq, 1)
@@ -113,7 +121,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
     if causal:
         # blocks strictly above the diagonal see only masked scores: skip
         # (the diagonal block itself still computes, with the mask above)
-        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
+        pl.when(k0 + ik * block_k
+                <= q0 + iq * block_q + block_q - 1)(_compute)
     else:
         _compute()
 
@@ -122,21 +131,28 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr,
         l = l_scr[:, :1]
         safe = jnp.where(l == 0, 1.0, l)
         o_ref[0] = (acc_scr[:] / safe).astype(o_ref.dtype)
+        # fully-masked rows (possible when a causal chunk sits entirely in
+        # the future) keep lse = _NEG + 0: exp(lse - anything) underflows
+        # to 0, so logsumexp-merging such a chunk is a no-op — exactly
+        # the semantics the ring hop needs
         lse = m_scr[:, :1] + jnp.log(safe)             # (bq, 1)
         lse_ref[0] = lse[:, 0]                         # (bq,)
 
 
-def _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
-    """q3,k3,v3: (BH, S, dh) -> (out (BH,S,dh), lse (BH,S) f32)."""
-    BH, S, dh = q3.shape
-    nq, nk = S // block_q, S // block_k
-    vma = _vma(q3, k3, v3)
+def _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
+    """q3: (BH, Sq, dh), k3/v3: (BH, Sk, dh), off: (2,) i32 ->
+    (out (BH,Sq,dh), lse (BH,Sq) f32)."""
+    BH, Sq, dh = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = Sq // block_q, Sk // block_k
+    vma = _vma(q3, k3, v3, off)
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
                              block_q=block_q, block_k=block_k, nk=nk)
     out, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
@@ -146,8 +162,8 @@ def _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, dh), q3.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, S), jnp.float32, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sq, dh), q3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sq), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
@@ -157,7 +173,7 @@ def _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3)
+    )(off, q3, k3, v3)
     return out, lse
 
 
@@ -165,9 +181,10 @@ def _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
 # backward
 # ---------------------------------------------------------------------------
 
-def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
-               dq_scr, *, sm_scale, causal, block_q, block_k, nk):
+def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, nk):
     iq, ik = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0], off_ref[1]
 
     @pl.when(ik == 0)
     def _init():
@@ -180,9 +197,9 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         lse_col = lse_ref[0].reshape(block_q, 1)       # (bq, 1)
         p = jnp.exp(s - lse_col)
         if causal:
-            qpos = (iq * block_q
+            qpos = (q0 + iq * block_q
                     + lax.broadcasted_iota(jnp.int32, s.shape, 0))
-            kpos = (ik * block_k
+            kpos = (k0 + ik * block_k
                     + lax.broadcasted_iota(jnp.int32, s.shape, 1))
             p = jnp.where(kpos > qpos, 0.0, p)
         dp = lax.dot_general(do, v, (((1,), (1,)), ((), ())),
@@ -193,7 +210,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
             ds, k.astype(jnp.float32), preferred_element_type=jnp.float32)
 
     if causal:
-        pl.when(ik * block_k <= iq * block_q + block_q - 1)(_compute)
+        pl.when(k0 + ik * block_k
+                <= q0 + iq * block_q + block_q - 1)(_compute)
     else:
         _compute()
 
@@ -202,10 +220,11 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         dq_ref[0] = dq_scr[:].astype(dq_ref.dtype)
 
 
-def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 dk_ref, dv_ref, dk_scr, dv_scr,
                 *, sm_scale, causal, block_q, block_k, nq):
     ik, iq = pl.program_id(1), pl.program_id(2)
+    q0, k0 = off_ref[0], off_ref[1]
 
     @pl.when(iq == 0)
     def _init():
@@ -221,9 +240,9 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         lse_row = lse_ref[0].reshape(1, block_q)       # (1, bq)
         p_t = jnp.exp(s_t - lse_row)                   # (bk, bq)
         if causal:
-            kpos = (ik * block_k
+            kpos = (k0 + ik * block_k
                     + lax.broadcasted_iota(jnp.int32, s_t.shape, 0))
-            qpos = (iq * block_q
+            qpos = (q0 + iq * block_q
                     + lax.broadcasted_iota(jnp.int32, s_t.shape, 1))
             p_t = jnp.where(kpos > qpos, 0.0, p_t)
         dv_scr[:] = dv_scr[:] + lax.dot(
@@ -237,7 +256,8 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
     if causal:
         # skip q blocks entirely BEFORE this k block (no key visible)
-        pl.when(iq * block_q + block_q - 1 >= ik * block_k)(_compute)
+        pl.when(q0 + iq * block_q + block_q - 1
+                >= k0 + ik * block_k)(_compute)
     else:
         _compute()
 
@@ -247,20 +267,28 @@ def _dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, out, lse, do, sm_scale, causal, block_q, block_k,
-         interpret):
-    BH, S, dh = q3.shape
-    nq, nk = S // block_q, S // block_k
-    # D = rowsum(dO * O): one fused elementwise+reduce, f32
+def _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale, causal, block_q,
+         block_k, interpret):
+    BH, Sq, dh = q3.shape
+    Sk = k3.shape[1]
+    nq, nk = Sq // block_q, Sk // block_k
+    # D = rowsum(dO * O) - d_lse: the standard flash delta, minus the
+    # lse-output cotangent.  With z the scaled scores and p = exp(z-lse),
+    # dL/dz = p*(dp - D) from the out path PLUS d_lse*p from the lse
+    # path (d lse/dz = p), so the whole lse gradient folds into the
+    # kernels' delta operand — this is what makes the per-hop kernels
+    # exactly differentiable under the sequence-parallel logsumexp merge
+    # (ring_flash_attention), where the merge weights depend on lse.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
-                    axis=-1)                           # (BH, S)
-    vma = _vma(q3, k3, v3, do)
+                    axis=-1) - d_lse                   # (BH, Sq)
+    vma = _vma(q3, k3, v3, do, off)
 
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nk=nk),
         grid=(BH, nq, nk),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
@@ -269,18 +297,19 @@ def _bwd(q3, k3, v3, out, lse, do, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, S, dh), q3.dtype, vma=vma),
+        out_shape=jax.ShapeDtypeStruct((BH, Sq, dh), q3.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do, lse, delta)
+    )(off, q3, k3, v3, do, lse, delta)
 
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq),
         grid=(BH, nk, nq),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
@@ -293,38 +322,45 @@ def _bwd(q3, k3, v3, out, lse, do, sm_scale, causal, block_q, block_k,
             pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, S, dh), k3.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, S, dh), v3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sk, dh), k3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BH, Sk, dh), v3.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
                         pltpu.VMEM((block_k, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(q3, k3, v3, do, lse, delta)
+    )(off, q3, k3, v3, do, lse, delta)
     return dq, dk, dv
 
 
 # ---------------------------------------------------------------------------
-# public entry (custom_vjp over q, k, v)
+# public entry (custom_vjp over q, k, v; `off` is a traced i32 operand
+# with a symbolic-zero cotangent)
 # ---------------------------------------------------------------------------
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
-def _flash(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
-    out, _ = _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret)
-    return out
+# (out, lse) both come out of the vjp'd function so sequence-parallel
+# callers can logsumexp-merge per-hop results and still differentiate
+@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
+def _flash(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
+    return _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
+                interpret)
 
 
-def _flash_fwd(q3, k3, v3, sm_scale, causal, block_q, block_k, interpret):
-    out, lse = _fwd(q3, k3, v3, sm_scale, causal, block_q, block_k,
+def _flash_fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
+               interpret):
+    out, lse = _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
                     interpret)
-    return out, (q3, k3, v3, out, lse)
+    return (out, lse), (q3, k3, v3, off, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, do):
-    q3, k3, v3, out, lse = res
-    return _bwd(q3, k3, v3, out, lse, do, sm_scale, causal,
-                block_q, block_k, interpret)
+def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
+    q3, k3, v3, off, out, lse = res
+    do, d_lse = cts
+    dq, dk, dv = _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale,
+                      causal, block_q, block_k, interpret)
+    d_off = _np.zeros((2,), jax.dtypes.float0)    # integer operand
+    return dq, dk, dv, d_off
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -339,26 +375,119 @@ def supported(q_shape, dtype=None) -> bool:
     return S % LANES == 0 and dh % 8 == 0 and dh <= 256
 
 
+def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
+            block_k, interpret, with_lse=False):
+    """[B,H,Sq,dh] x [B,H,Sk,dh] entry shared by the public wrappers."""
+    B, H, Sq, dh = q.shape
+    Sk = k.shape[2]
+    if sm_scale is None:
+        sm_scale = dh ** -0.5
+    bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
+    off = jnp.stack([jnp.asarray(q_offset, jnp.int32),
+                     jnp.asarray(k_offset, jnp.int32)])
+    out, lse = _flash(q.reshape(B * H, Sq, dh), k.reshape(B * H, Sk, dh),
+                      v.reshape(B * H, Sk, dh), off, float(sm_scale),
+                      bool(causal), bq, bk, bool(interpret))
+    out = out.reshape(B, H, Sq, dh)
+    if with_lse:
+        return out, lse.reshape(B, H, Sq)
+    return out
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = _DEF_BLOCK, block_k: int = _DEF_BLOCK,
+                    q_offset=0, k_offset=0,
                     interpret: Optional[bool] = None) -> jax.Array:
-    """Fused-kernel exact attention, q/k/v: [B, H, S, dh] -> [B, H, S, dh].
+    """Fused-kernel exact attention, q: [B, H, Sq, dh], k/v: [B, H, Sk,
+    dh] -> [B, H, Sq, dh].
 
     Differentiable (custom_vjp; the backward is the flash recompute from
-    the saved lse — residual memory is O(B*H*S*(dh+1)), never O(S^2)).
-    `interpret=None` auto-selects the Mosaic emulator off-TPU so parity
-    tests run everywhere."""
+    the saved lse — residual memory is O(B*H*Sq*(dh+1)), never O(S^2)).
+    `q_offset`/`k_offset` (traced i32 ok) give the GLOBAL position of the
+    first q/k row, so a sequence-sharded caller attending a visiting K/V
+    chunk gets causality over global token positions.  `interpret=None`
+    auto-selects the Mosaic emulator off-TPU so parity tests run
+    everywhere."""
     if interpret is None:
         interpret = not _is_tpu()
-    B, H, S, dh = q.shape
+    assert supported(q.shape), (q.shape,)
+    return _flash4(q, k, v, q_offset, k_offset, sm_scale, causal,
+                   block_q, block_k, interpret)
+
+
+def ring_flash_attention(q, k, v, axis_name: str, *, causal: bool = True,
+                         sm_scale: Optional[float] = None,
+                         block_q: int = _DEF_BLOCK,
+                         block_k: int = _DEF_BLOCK,
+                         interpret: Optional[bool] = None) -> jax.Array:
+    """Sequence-parallel exact attention on the fused kernels: K/V chunks
+    rotate the unidirectional device ring (the reference's
+    stream-combine-forward dataflow, hw/all_reduce.sv REDUCE/FORWARD)
+    while every hop's local attention runs the Pallas flash kernel;
+    per-hop (out, lse) pairs combine by logsumexp merge — associative
+    and order-independent up to f32 rounding, so the result matches
+    ops.ring_attention.ring_attention up to reassociation.
+
+    Differentiates by autodiff THROUGH the hop scan: each hop's kernel
+    call carries its own custom flash vjp (recompute from that hop's
+    lse), and ppermute transposes to the reverse rotation — no O(S^2)
+    residual ever materializes; per-hop residuals total O(n * Sl) = O(S)
+    rows per device, the same order as the gathered-KV path's forward
+    buffers.
+
+    Inside shard_map with `axis_name` a mesh axis; shards contiguous
+    (device i holds global positions [i*Sl, (i+1)*Sl))."""
+    if interpret is None:
+        interpret = not _is_tpu()
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, H, Sl, dh = q.shape
     assert supported(q.shape), (q.shape,)
     if sm_scale is None:
         sm_scale = dh ** -0.5
-    bq, bk = _pick_block(S, block_q), _pick_block(S, block_k)
-    q3 = q.reshape(B * H, S, dh)
-    k3 = k.reshape(B * H, S, dh)
-    v3 = v.reshape(B * H, S, dh)
-    out = _flash(q3, k3, v3, float(sm_scale), bool(causal), bq, bk,
-                 bool(interpret))
-    return out.reshape(B, H, S, dh)
+    q0 = idx * Sl
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def hop_attend(kc, vc, src):
+        return _flash4(q, kc, vc, q0, src * Sl, sm_scale, causal,
+                       block_q, block_k, interpret, with_lse=True)
+
+    # hop 0: the local chunk (always causally visible to itself).  The
+    # running output stays f32 across the whole scan — requantizing to a
+    # bf16 carry every hop would accumulate ~n roundings where the XLA
+    # ring (f32 accumulators, one cast in _finish) has one.
+    out, lse = hop_attend(k, v, idx)
+    out = out.astype(jnp.float32)
+
+    def merge(out, lse, o_h, lse_h):
+        # logsumexp merge of two normalized partial attentions; a fully
+        # masked hop arrives as (0, -1e30) and merges as a no-op
+        lse_n = jnp.logaddexp(lse, lse_h)              # (B,H,Sl)
+        w, w_h = jnp.exp(lse - lse_n), jnp.exp(lse_h - lse_n)
+        return (out * w[..., None]
+                + o_h.astype(jnp.float32) * w_h[..., None]), lse_n
+
+    def hop(carry, s_i):
+        out, lse, kc, vc = carry
+        kc = lax.ppermute(kc, axis_name, perm)
+        vc = lax.ppermute(vc, axis_name, perm)
+        src = (idx - s_i) % n                 # whose K/V we hold this hop
+
+        def attend(args):
+            out, lse = args
+            o_h, lse_h = hop_attend(kc, vc, src)
+            return merge(out, lse, o_h, lse_h)
+
+        if causal:
+            # chunks entirely in the future are fully masked: skip the
+            # kernel, keep the rotation (same dead-beat elision as
+            # ring_attention)
+            out, lse = lax.cond(src > idx, lambda a: a, attend, (out, lse))
+        else:
+            out, lse = attend((out, lse))
+        return (out, lse, kc, vc), None
+
+    (out, lse, _, _), _ = lax.scan(hop, (out, lse, k, v),
+                                   jnp.arange(1, n))
+    return out.astype(q.dtype)

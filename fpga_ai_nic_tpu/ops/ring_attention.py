@@ -117,11 +117,27 @@ def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
     return m, l, o
 
 
+def _pallas_route(impl: str, q) -> bool:
+    """Shared sp-path dispatch: the fused kernels when pinned or (auto)
+    on TPU with tiling shapes; pinned-but-unsupported raises (a silent
+    xla fallback would invalidate A/B runs — same contract as
+    flash_attention_remat)."""
+    from . import flash_pallas
+    if impl not in ("auto", "pallas", "xla"):
+        raise ValueError(f"attn impl {impl!r}: want auto|pallas|xla")
+    if impl == "pallas" and not flash_pallas.supported(q.shape):
+        raise ValueError(
+            f"impl='pallas' pinned but q shape {q.shape} does not tile "
+            "(need S % 128 == 0, head_dim % 8 == 0, head_dim <= 256)")
+    return (impl == "pallas" or (impl == "auto" and flash_pallas._is_tpu()
+                                 and flash_pallas.supported(q.shape)))
+
+
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
                    *, causal: bool = True,
                    sm_scale: Optional[float] = None,
                    k_block: Optional[int] = 512,
-                   unroll: bool = False) -> jax.Array:
+                   unroll: bool = False, impl: str = "auto") -> jax.Array:
     """Sequence-parallel exact attention inside ``shard_map``.
 
     q, k, v: [B, H, S_local, dh] — the local sequence shard; shards are
@@ -137,7 +153,26 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
     as ``CollectiveConfig.unroll_hops`` (marginally better codegen at tiny
     n, O(n) compile-time blowup at pod scale; the rolled ``fori_loop`` is
     the default for the same reason as in ops.ring).
+
+    impl: "auto" routes each hop's local attention through the fused
+    Pallas kernels on TPU (ops.flash_pallas.ring_flash_attention — same
+    K/V rotation, logsumexp hop merge, per-hop flash vjp); "xla"/"pallas"
+    pin a backend.  The unroll and k_block=None knobs are XLA-path
+    schedules: in auto mode requesting either keeps the XLA path (an
+    explicitly-set knob must never be silently ignored); pinned "pallas"
+    rejects them.
     """
+    xla_only_knobs = unroll or k_block is None
+    if impl == "pallas" and xla_only_knobs:
+        raise ValueError(
+            "impl='pallas' cannot honor unroll=True / k_block=None — "
+            "the fused ring is a rolled scan of blocked kernels; drop "
+            "the knob or use impl='xla'")
+    if not xla_only_knobs and _pallas_route(impl, q):
+        from . import flash_pallas
+        return flash_pallas.ring_flash_attention(
+            q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
+            block_q=k_block, block_k=k_block)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, H, S, dh = q.shape
@@ -215,17 +250,7 @@ def flash_attention_remat(q, k, v, *, causal=True, sm_scale=None,
       backward memory (measured 22 GB at S=16,384; models/llama.py
       carried this wrapper before round 5 moved the choice here)."""
     from . import flash_pallas
-    if impl not in ("auto", "pallas", "xla"):
-        raise ValueError(f"attn impl {impl!r}: want auto|pallas|xla")
-    if impl == "pallas" and not flash_pallas.supported(q.shape):
-        # a PINNED pallas that silently ran xla would invalidate every
-        # A/B comparison made with the knob
-        raise ValueError(
-            f"impl='pallas' pinned but q shape {q.shape} does not tile "
-            "(need S % 128 == 0, head_dim % 8 == 0, head_dim <= 256)")
-    want_pallas = impl == "pallas" or (impl == "auto"
-                                       and flash_pallas._is_tpu())
-    if want_pallas and flash_pallas.supported(q.shape):
+    if _pallas_route(impl, q):
         b = k_block or flash_pallas._DEF_BLOCK
         return flash_pallas.flash_attention(q, k, v, causal=causal,
                                             sm_scale=sm_scale,
@@ -237,7 +262,8 @@ def flash_attention_remat(q, k, v, *, causal=True, sm_scale=None,
 
 
 def gathered_attention(q, k, v, axis_name: str, *, causal=True,
-                       sm_scale=None, k_block: Optional[int] = 512):
+                       sm_scale=None, k_block: Optional[int] = 512,
+                       impl: str = "auto"):
     """Sequence-parallel attention via KV all-gather: queries stay
     sequence-sharded, keys/values gather once over `axis_name`, and the
     local attention runs the same flash-style k-blocked online softmax as
@@ -256,6 +282,11 @@ def gathered_attention(q, k, v, axis_name: str, *, causal=True,
     accumulation to ring_attention up to f32 summation order (both are
     exact attention).  Reference analogue: none — the reference has no
     attention; this is the standard all-gather sequence-parallel form.
+
+    impl: "auto" keeps the (replica-grouped, cond-safe) all_gather and
+    runs the LOCAL attention through the fused Pallas kernel with
+    q_offset = idx*S_local (global-position causality); "xla"/"pallas"
+    pin a backend.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -264,6 +295,12 @@ def gathered_attention(q, k, v, axis_name: str, *, causal=True,
         sm_scale = dh ** -0.5
     kf = lax.all_gather(k, axis_name, axis=2, tiled=True)
     vf = lax.all_gather(v, axis_name, axis=2, tiled=True)
+    if _pallas_route(impl, q):
+        from . import flash_pallas
+        b = k_block or flash_pallas._DEF_BLOCK
+        return flash_pallas.flash_attention(
+            q, kf, vf, causal=causal, sm_scale=sm_scale,
+            q_offset=idx * Sl, block_q=b, block_k=b)
     qf = q.astype(jnp.float32)
     q_pos = idx * Sl + lax.broadcasted_iota(jnp.int32, (Sl, 1), 0)[:, 0]
     m0, l0, o0 = _init_acc(B, H, Sl, dh,
