@@ -25,8 +25,25 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # bench -> collective -> trace; each stage banks + git-commits its
     # artifact before the next runs (round-3 verdict item 1)
     flock "$LOCK" -c "python tools/first_contact.py" >/tmp/harvest_contact.out 2>&1
-    echo "[harvest] ladder exited rc=$? at $(date -u +%FT%TZ); artifacts:"
-    ls -la artifacts/ 2>/dev/null
+    echo "[harvest] ladder exited rc=$? at $(date -u +%FT%TZ)"
+    # round-5 evidence chain, each piece banked+committed on its own so a
+    # mid-chain wedge never costs completed pieces (probe-gated inside):
+    # model zoo (flash-kernel MFU rows, bf16 resnet A/B, S=32k retry)
+    flock "$LOCK" -c "python tools/zoo_tpu.py" >/tmp/harvest_zoo.out 2>&1
+    echo "[harvest] zoo exited rc=$? at $(date -u +%FT%TZ)"
+    flock "$LOCK" -c "git add artifacts && git commit -m 'Bank TPU evidence: model zoo'" >/dev/null 2>&1
+    # codec kernel variant A/B (broadcast x tiles, slope-based)
+    flock "$LOCK" -c "python tools/codec_kernel_probe.py" >/tmp/harvest_codecprobe.out 2>&1
+    echo "[harvest] codec probe exited rc=$? at $(date -u +%FT%TZ)"
+    flock "$LOCK" -c "git add artifacts && git commit -m 'Bank TPU evidence: codec kernel variant A/B'" >/dev/null 2>&1
+    # snapshot the round's collective record when a TPU artifact landed
+    latest=$(ls -t artifacts/collective_tpu_*.json 2>/dev/null | head -1)
+    if [ -n "$latest" ] && [ "$latest" -nt COLLECTIVE_r04.json ]; then
+      cp "$latest" COLLECTIVE_r05.json
+      git add COLLECTIVE_r05.json && git commit -m "COLLECTIVE_r05: slope-based codec record ($latest)" >/dev/null 2>&1
+      echo "[harvest] COLLECTIVE_r05.json <- $latest"
+    fi
+    ls -la artifacts/ 2>/dev/null | tail -20
   fi
   if have_artifacts; then sleep "$LONG_PERIOD"; else sleep "$PERIOD"; fi
 done
