@@ -76,13 +76,20 @@ def _vma(*arrs):
 # forward
 # ---------------------------------------------------------------------------
 
-def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                acc_scr, *, sm_scale, causal, block_q, block_k, nk):
+def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
+                block_q, block_k, nk, has_bias):
     # off_ref: SMEM [2] int32 — (q_offset, k_offset) GLOBAL positions of
     # this call's first q row / k row.  (0, 0) for whole-sequence
     # attention; nonzero when the caller attends a local q shard against
     # a visiting K/V chunk (ring / gathered sequence parallelism) and
     # causality must follow global token positions.
+    # has_bias compiles in an additive per-key bias row (B, Sk) — the
+    # padding-mask path (models/bert.py key_bias); absent, the operand
+    # and its load/add cost do not exist.
+    if has_bias:
+        bias_ref, o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
+    else:
+        o_ref, lse_ref, m_scr, l_scr, acc_scr = rest
     iq, ik = pl.program_id(1), pl.program_id(2)
     q0, k0 = off_ref[0], off_ref[1]
 
@@ -99,6 +106,8 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         # exact, accumulation f32 (same math as casting inputs to f32)
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
+        if has_bias:
+            s = s + bias_ref[:]                        # (1, bk) broadcast
         if causal:
             qpos = (q0 + iq * block_q
                     + lax.broadcasted_iota(jnp.int32, s.shape, 0))
@@ -139,24 +148,35 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         lse_ref[0] = lse[:, 0]                         # (bq,)
 
 
-def _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
-    """q3: (BH, Sq, dh), k3/v3: (BH, Sk, dh), off: (2,) i32 ->
+def _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
+         block_k, interpret):
+    """q3: (BH, Sq, dh), k3/v3: (BH, Sk, dh), off: (2,) i32,
+    bias: None | (B, Sk) f32 (B = BH/n_heads) ->
     (out (BH,Sq,dh), lse (BH,Sq) f32)."""
     BH, Sq, dh = q3.shape
     Sk = k3.shape[1]
     nq, nk = Sq // block_q, Sk // block_k
-    vma = _vma(q3, k3, v3, off)
+    has_bias = bias is not None
+    vma = _vma(q3, k3, v3, off, *([bias] if has_bias else []))
     kern = functools.partial(_fwd_kernel, sm_scale=sm_scale, causal=causal,
-                             block_q=block_q, block_k=block_k, nk=nk)
+                             block_q=block_q, block_k=block_k, nk=nk,
+                             has_bias=has_bias)
+    H = n_heads
+    in_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+    ]
+    args = [off, q3, k3, v3]
+    if has_bias:
+        in_specs.append(pl.BlockSpec((1, block_k),
+                                     lambda b, i, j: (b // H, j)))
+        args.append(bias)
     out, lse = pl.pallas_call(
         kern,
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=[
             pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -173,7 +193,7 @@ def _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(off, q3, k3, v3)
+    )(*args)
     return out, lse
 
 
@@ -182,7 +202,11 @@ def _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
 # ---------------------------------------------------------------------------
 
 def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-               dq_ref, dq_scr, *, sm_scale, causal, block_q, block_k, nk):
+               *rest, sm_scale, causal, block_q, block_k, nk, has_bias):
+    if has_bias:
+        bias_ref, dq_ref, dq_scr = rest
+    else:
+        dq_ref, dq_scr = rest
     iq, ik = pl.program_id(1), pl.program_id(2)
     q0, k0 = off_ref[0], off_ref[1]
 
@@ -194,6 +218,8 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
         s = lax.dot_general(q, k, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32) * sm_scale
+        if has_bias:
+            s = s + bias_ref[:]
         lse_col = lse_ref[0].reshape(block_q, 1)       # (bq, 1)
         p = jnp.exp(s - lse_col)
         if causal:
@@ -221,8 +247,11 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, dk_scr, dv_scr,
-                *, sm_scale, causal, block_q, block_k, nq):
+                *rest, sm_scale, causal, block_q, block_k, nq, has_bias):
+    if has_bias:
+        bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
+    else:
+        dk_ref, dv_ref, dk_scr, dv_scr = rest
     ik, iq = pl.program_id(1), pl.program_id(2)
     q0, k0 = off_ref[0], off_ref[1]
 
@@ -237,6 +266,8 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # lse/delta broadcast along lanes with no relayout
         s_t = lax.dot_general(k, q, (((1,), (1,)), ((), ())),
                               preferred_element_type=jnp.float32) * sm_scale
+        if has_bias:
+            s_t = s_t + bias_ref[:].reshape(block_k, 1)
         lse_row = lse_ref[0].reshape(1, block_q)       # (1, bq)
         p_t = jnp.exp(s_t - lse_row)                   # (bk, bq)
         if causal:
@@ -267,11 +298,13 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
 
 
-def _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale, causal, block_q,
-         block_k, interpret):
+def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
+         causal, block_q, block_k, interpret):
     BH, Sq, dh = q3.shape
     Sk = k3.shape[1]
     nq, nk = Sq // block_q, Sk // block_k
+    has_bias = bias is not None
+    H = n_heads
     # D = rowsum(dO * O) - d_lse: the standard flash delta, minus the
     # lse-output cotangent.  With z the scaled scores and p = exp(z-lse),
     # dL/dz = p*(dp - D) from the out path PLUS d_lse*p from the lse
@@ -281,42 +314,56 @@ def _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale, causal, block_q,
     # (ring_flash_attention), where the merge weights depend on lse.
     delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32),
                     axis=-1) - d_lse                   # (BH, Sq)
-    vma = _vma(q3, k3, v3, do, off)
+    vma = _vma(q3, k3, v3, do, off, *([bias] if has_bias else []))
 
+    dq_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+        pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
+    ]
+    dq_args = [off, q3, k3, v3, do, lse, delta]
+    if has_bias:
+        dq_specs.append(pl.BlockSpec((1, block_k),
+                                     lambda b, i, j: (b // H, j)))
+        dq_args.append(bias)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nk=nk),
+                          block_q=block_q, block_k=block_k, nk=nk,
+                          has_bias=has_bias),
         grid=(BH, nq, nk),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
-        ],
+        in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
         out_shape=jax.ShapeDtypeStruct((BH, Sq, dh), q3.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(off, q3, k3, v3, do, lse, delta)
+    )(*dq_args)
 
+    dkv_specs = [
+        pl.BlockSpec(memory_space=pltpu.SMEM),
+        pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
+        pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+    ]
+    dkv_args = [off, q3, k3, v3, do, lse, delta]
+    if has_bias:
+        dkv_specs.append(pl.BlockSpec((1, block_k),
+                                      lambda b, j, i: (b // H, j)))
+        dkv_args.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          has_bias=has_bias),
         grid=(BH, nk, nq),
-        in_specs=[
-            pl.BlockSpec(memory_space=pltpu.SMEM),
-            pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-            pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-        ],
+        in_specs=dkv_specs,
         out_specs=[
             pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
             pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
@@ -330,7 +377,7 @@ def _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale, causal, block_q,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
-    )(off, q3, k3, v3, do, lse, delta)
+    )(*dkv_args)
     return dq, dk, dv
 
 
@@ -340,27 +387,33 @@ def _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale, causal, block_q,
 # ---------------------------------------------------------------------------
 
 # (out, lse) both come out of the vjp'd function so sequence-parallel
-# callers can logsumexp-merge per-hop results and still differentiate
-@functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6, 7, 8))
-def _flash(q3, k3, v3, off, sm_scale, causal, block_q, block_k, interpret):
-    return _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
-                interpret)
+# callers can logsumexp-merge per-hop results and still differentiate.
+# `bias` is a PRIMAL but deliberately gets a ZERO cotangent: the public
+# wrappers stop_gradient it (it is the padding-mask channel, not a
+# learned-bias channel — a learned attention bias needs the XLA path).
+@functools.partial(jax.custom_vjp, nondiff_argnums=(5, 6, 7, 8, 9, 10))
+def _flash(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
+           block_k, interpret):
+    return _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
+                block_k, interpret)
 
 
-def _flash_fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
-               interpret):
-    out, lse = _fwd(q3, k3, v3, off, sm_scale, causal, block_q, block_k,
-                    interpret)
-    return (out, lse), (q3, k3, v3, off, out, lse)
+def _flash_fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
+               block_k, interpret):
+    out, lse = _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal,
+                    block_q, block_k, interpret)
+    return (out, lse), (q3, k3, v3, off, bias, out, lse)
 
 
-def _flash_bwd(sm_scale, causal, block_q, block_k, interpret, res, cts):
-    q3, k3, v3, off, out, lse = res
+def _flash_bwd(n_heads, sm_scale, causal, block_q, block_k, interpret,
+               res, cts):
+    q3, k3, v3, off, bias, out, lse = res
     do, d_lse = cts
-    dq, dk, dv = _bwd(q3, k3, v3, off, out, lse, do, d_lse, sm_scale,
-                      causal, block_q, block_k, interpret)
+    dq, dk, dv = _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse,
+                      sm_scale, causal, block_q, block_k, interpret)
     d_off = _np.zeros((2,), jax.dtypes.float0)    # integer operand
-    return dq, dk, dv, d_off
+    d_bias = None if bias is None else jnp.zeros_like(bias)
+    return dq, dk, dv, d_off, d_bias
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -376,7 +429,7 @@ def supported(q_shape, dtype=None) -> bool:
 
 
 def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
-            block_k, interpret, with_lse=False):
+            block_k, interpret, with_lse=False, key_bias=None):
     """[B,H,Sq,dh] x [B,H,Sk,dh] entry shared by the public wrappers."""
     B, H, Sq, dh = q.shape
     Sk = k.shape[2]
@@ -385,9 +438,16 @@ def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
     off = jnp.stack([jnp.asarray(q_offset, jnp.int32),
                      jnp.asarray(k_offset, jnp.int32)])
+    if key_bias is not None:
+        assert key_bias.shape == (B, Sk), (key_bias.shape, (B, Sk))
+        # the fused kernels carry no d_bias path (see _flash docstring):
+        # the bias channel is for padding masks, whose gradient is
+        # discarded by construction
+        key_bias = lax.stop_gradient(key_bias.astype(jnp.float32))
     out, lse = _flash(q.reshape(B * H, Sq, dh), k.reshape(B * H, Sk, dh),
-                      v.reshape(B * H, Sk, dh), off, float(sm_scale),
-                      bool(causal), bq, bk, bool(interpret))
+                      v.reshape(B * H, Sk, dh), off, key_bias, H,
+                      float(sm_scale), bool(causal), bq, bk,
+                      bool(interpret))
     out = out.reshape(B, H, Sq, dh)
     if with_lse:
         return out, lse.reshape(B, H, Sq)
@@ -398,6 +458,7 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     sm_scale: Optional[float] = None,
                     block_q: int = _DEF_BLOCK, block_k: int = _DEF_BLOCK,
                     q_offset=0, k_offset=0,
+                    key_bias: Optional[jax.Array] = None,
                     interpret: Optional[bool] = None) -> jax.Array:
     """Fused-kernel exact attention, q: [B, H, Sq, dh], k/v: [B, H, Sk,
     dh] -> [B, H, Sq, dh].
@@ -406,14 +467,17 @@ def flash_attention(q, k, v, *, causal: bool = True,
     the saved lse — residual memory is O(B*H*Sq*(dh+1)), never O(S^2)).
     `q_offset`/`k_offset` (traced i32 ok) give the GLOBAL position of the
     first q/k row, so a sequence-sharded caller attending a visiting K/V
-    chunk gets causality over global token positions.  `interpret=None`
-    auto-selects the Mosaic emulator off-TPU so parity tests run
-    everywhere."""
+    chunk gets causality over global token positions.  `key_bias`
+    ([B, Sk] f32, added to every query row's scores) is the padding-mask
+    channel (0 / -1e30) — NON-differentiable by contract
+    (stop_gradient'd; learned biases need the XLA path).
+    `interpret=None` auto-selects the Mosaic emulator off-TPU so parity
+    tests run everywhere."""
     if interpret is None:
         interpret = not _is_tpu()
     assert supported(q.shape), (q.shape,)
     return _flash4(q, k, v, q_offset, k_offset, sm_scale, causal,
-                   block_q, block_k, interpret)
+                   block_q, block_k, interpret, key_bias=key_bias)
 
 
 def ring_flash_attention(q, k, v, axis_name: str, *, causal: bool = True,

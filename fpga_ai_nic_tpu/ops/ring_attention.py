@@ -117,20 +117,21 @@ def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
     return m, l, o
 
 
-def _pallas_route(impl: str, q) -> bool:
-    """Shared sp-path dispatch: the fused kernels when pinned or (auto)
-    on TPU with tiling shapes; pinned-but-unsupported raises (a silent
-    xla fallback would invalidate A/B runs — same contract as
-    flash_attention_remat)."""
+def pallas_route(impl: str, q_shape) -> bool:
+    """Shared attention-backend dispatch: the fused kernels when pinned
+    or (auto) on TPU with tiling shapes; pinned-but-unsupported raises (a
+    silent xla fallback would invalidate A/B runs).  ``q_shape`` is the
+    [B, H, S, dh] tuple (or an array with that .shape)."""
     from . import flash_pallas
+    q_shape = getattr(q_shape, "shape", q_shape)
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"attn impl {impl!r}: want auto|pallas|xla")
-    if impl == "pallas" and not flash_pallas.supported(q.shape):
+    if impl == "pallas" and not flash_pallas.supported(q_shape):
         raise ValueError(
-            f"impl='pallas' pinned but q shape {q.shape} does not tile "
+            f"impl='pallas' pinned but q shape {q_shape} does not tile "
             "(need S % 128 == 0, head_dim % 8 == 0, head_dim <= 256)")
     return (impl == "pallas" or (impl == "auto" and flash_pallas._is_tpu()
-                                 and flash_pallas.supported(q.shape)))
+                                 and flash_pallas.supported(q_shape)))
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
@@ -168,7 +169,7 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
             "impl='pallas' cannot honor unroll=True / k_block=None — "
             "the fused ring is a rolled scan of blocked kernels; drop "
             "the knob or use impl='xla'")
-    if not xla_only_knobs and _pallas_route(impl, q):
+    if not xla_only_knobs and pallas_route(impl, q):
         from . import flash_pallas
         return flash_pallas.ring_flash_attention(
             q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
@@ -250,7 +251,7 @@ def flash_attention_remat(q, k, v, *, causal=True, sm_scale=None,
       backward memory (measured 22 GB at S=16,384; models/llama.py
       carried this wrapper before round 5 moved the choice here)."""
     from . import flash_pallas
-    if _pallas_route(impl, q):
+    if pallas_route(impl, q):
         b = k_block or flash_pallas._DEF_BLOCK
         return flash_pallas.flash_attention(q, k, v, causal=causal,
                                             sm_scale=sm_scale,
@@ -295,7 +296,7 @@ def gathered_attention(q, k, v, axis_name: str, *, causal=True,
         sm_scale = dh ** -0.5
     kf = lax.all_gather(k, axis_name, axis=2, tiled=True)
     vf = lax.all_gather(v, axis_name, axis=2, tiled=True)
-    if _pallas_route(impl, q):
+    if pallas_route(impl, q):
         from . import flash_pallas
         b = k_block or flash_pallas._DEF_BLOCK
         return flash_pallas.flash_attention(
