@@ -55,6 +55,18 @@ def _is_tpu() -> bool:
     return jax.devices()[0].platform in ("tpu", "axon")
 
 
+def _bias_spec(H: int, block_k: int, k_grid_dim: int):
+    """BlockSpec for the (B, Sk) key-bias operand: batch row b // H of the
+    collapsed BH grid axis, k-block from grid dim `k_grid_dim` — the ONE
+    definition all three kernels share (the fwd/dq grids put the k axis
+    at dim 2, the transposed dkv grid at dim 1; hand-copying the lambda
+    between them is exactly the wrong-dimension trap this helper
+    removes)."""
+    def index_map(b, *grid):
+        return (b // H, grid[k_grid_dim - 1])
+    return pl.BlockSpec((1, block_k), index_map)
+
+
 def _pick_block(S: int, want: int) -> int:
     """Largest divisor of S that is <= want (and a lane multiple when
     possible) — smaller blocks cost grid steps, never correctness."""
@@ -170,8 +182,7 @@ def _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
     ]
     args = [off, q3, k3, v3]
     if has_bias:
-        in_specs.append(pl.BlockSpec((1, block_k),
-                                     lambda b, i, j: (b // H, j)))
+        in_specs.append(_bias_spec(H, block_k, k_grid_dim=2))
         args.append(bias)
     out, lse = pl.pallas_call(
         kern,
@@ -327,8 +338,7 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
     ]
     dq_args = [off, q3, k3, v3, do, lse, delta]
     if has_bias:
-        dq_specs.append(pl.BlockSpec((1, block_k),
-                                     lambda b, i, j: (b // H, j)))
+        dq_specs.append(_bias_spec(H, block_k, k_grid_dim=2))
         dq_args.append(bias)
     dq = pl.pallas_call(
         functools.partial(_dq_kernel, sm_scale=sm_scale, causal=causal,
@@ -355,8 +365,7 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
     ]
     dkv_args = [off, q3, k3, v3, do, lse, delta]
     if has_bias:
-        dkv_specs.append(pl.BlockSpec((1, block_k),
-                                      lambda b, j, i: (b // H, j)))
+        dkv_specs.append(_bias_spec(H, block_k, k_grid_dim=1))
         dkv_args.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
@@ -470,7 +479,14 @@ def flash_attention(q, k, v, *, causal: bool = True,
     chunk gets causality over global token positions.  `key_bias`
     ([B, Sk] f32, added to every query row's scores) is the padding-mask
     channel (0 / -1e30) — NON-differentiable by contract
-    (stop_gradient'd; learned biases need the XLA path).
+    (stop_gradient'd; learned biases need the XLA path), and every query
+    row must see >= 1 unmasked key: an all-masked row's FORWARD matches
+    the XLA softmax (both degenerate to a uniform average), but the
+    backward recompute p = exp(s - lse) evaluates to 1 per key instead
+    of 1/Sk there, inflating that row's gradients ~Sk-fold.  Real masks
+    satisfy this (a sequence with zero valid tokens carries no loss);
+    the precondition is documented rather than paid for with a
+    renormalization in every backward block.
     `interpret=None` auto-selects the Mosaic emulator off-TPU so parity
     tests run everywhere."""
     if interpret is None:

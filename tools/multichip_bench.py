@@ -340,16 +340,34 @@ def main() -> int:
         return 0
     dryrun = "--dryrun" in sys.argv
     force = "--force" in sys.argv
+    known = {s[0] for s in STAGES}
+    only = None
+    for a in sys.argv[1:]:
+        if a.startswith("--stages="):       # e.g. --stages=canary,busbw
+            only = {s for s in a.split("=", 1)[1].split(",") if s}
+            bad = only - known
+            if bad or not only:
+                # an unattended run that silently matched zero stages
+                # would log 'complete' having done nothing
+                log(f"--stages: unknown/empty {sorted(bad) or '(empty)'}; "
+                    f"valid: {sorted(known)}")
+                return 2
     key = "dryrun" if dryrun else "real"
     state = _load_state()
     done = state.setdefault(key, {})
     if force:
-        done.clear()
+        # clear only what this invocation will re-run: a filtered --force
+        # must not wipe banked evidence (incl. the canary gate) for
+        # stages it is not going to redo
+        for name in (only or known):
+            done.pop(name, None)
     env = cpu_env(8) if dryrun else dict(os.environ)
     env["MULTICHIP_DRYRUN"] = "1" if dryrun else "0"
     here = os.path.abspath(__file__)
     rc = 0
     for name, budget, silence in STAGES:
+        if only is not None and name not in only:
+            continue
         if name in done:
             log(f"stage {name} [{key}]: already banked — skipping")
             continue
