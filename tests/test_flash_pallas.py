@@ -290,3 +290,27 @@ def test_bert_attn_impl_parity(rng):
         return float(bert.loss_fn(params, (toks, labels), c))
 
     np.testing.assert_allclose(loss("pallas"), loss("xla"), rtol=1e-5)
+
+
+def test_ring_flash_bf16_close_to_xla_ring(rng):
+    """bf16 activations, n=4 ring: the f32 running output across the hop
+    scan must keep the fused ring within bf16 noise of the XLA ring's
+    single-final-cast result (the per-hop-requantize regression case)."""
+    from fpga_ai_nic_tpu.ops.ring_attention import ring_attention
+    from jax.sharding import Mesh, PartitionSpec as P
+    n, Sl, dh = 4, 128, 64
+    q, k, v = _qkv(rng, S=n * Sl, dh=dh, dtype=jnp.bfloat16)
+    mesh = Mesh(np.array(jax.devices()[:n]), ("sp",))
+
+    def run(fn):
+        f = jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=P(None, None, "sp", None),
+            out_specs=P(None, None, "sp", None), check_vma=False))
+        return np.asarray(f(q, k, v), np.float32)
+
+    got = run(lambda q, k, v: flash_pallas.ring_flash_attention(
+        q, k, v, "sp", causal=True, block_q=128, block_k=128,
+        interpret=True))
+    want = run(lambda q, k, v: ring_attention(q, k, v, "sp", causal=True,
+                                              impl="xla"))
+    np.testing.assert_allclose(got, want, atol=2e-2, rtol=2e-2)
