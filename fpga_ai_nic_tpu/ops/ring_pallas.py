@@ -422,7 +422,8 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
                       ld_sem, st_ld_sem, wb_sem, send_sem, recv_sem,
                       credit_sem, *, n: int, n_slices: int, slice_rows: int,
                       block_size: int, mantissa_bits: int, rounding: str,
-                      flow_control: bool, unrolled: bool):
+                      flow_control: bool, unrolled: bool,
+                      ablate: Optional[str] = None):
     """HBM-streaming variant of _rs_kernel: the vector stays in HBM (acc
     aliases the input buffer) and only two slices of working f32 plus the
     int8 frames live in VMEM — the reference's exact memory shape, which
@@ -436,6 +437,18 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
     del x_hbm: the aliased acc ref IS the input buffer.
     """
     del x_hbm
+    # Stage ablation (loopback attribution only — see _rs_kernel): each
+    # variant keeps exactly one pipeline resource class of the SAME
+    # schedule: "hbm" = slice load + store-load + writeback streaming,
+    # "encode" = load + codec-in, "rdma" = the wire chain alone,
+    # "decode" = store-load + codec-out+add + writeback.
+    assert ablate in (None, "encode", "rdma", "decode", "hbm"), ablate
+    do_ld = ablate in (None, "encode", "hbm")
+    do_enc = ablate in (None, "encode")
+    do_rdma = ablate in (None, "rdma")
+    do_stld = ablate in (None, "hbm", "decode")
+    do_dec = ablate in (None, "decode")
+    do_wb = ablate in (None, "hbm", "decode")
     idx = ids_ref[0]
     right = ids_ref[1]
     left = ids_ref[2]
@@ -480,41 +493,54 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
         send_pkt[slot, pl.ds(0, R)] = mant
         send_pkt[slot, pl.ds(R, SB)] = scale
 
-    if flow_control:
+    if flow_control and do_rdma:
         _neighbor_barrier(left, right)
 
-    ld_dma(0).start()
-    ld_dma(0).wait()
-    encode_from_ld(0)
-    rdma(0).start()
+    if do_ld:
+        ld_dma(0).start()
+        ld_dma(0).wait()
+    if do_enc:
+        encode_from_ld(0)
+    if do_rdma:
+        rdma(0).start()
 
     def launch(q):
         @_when(q < total, unrolled)
         def _launch():
-            ld_dma(q).start()
-            @_when(q >= 2, unrolled)
-            def _reuse():
-                rdma(q - 2).wait_send()    # frame slot q%2 drained
-            ld_dma(q).wait()
-            encode_from_ld(q)
-            if flow_control:
+            if do_ld:
+                ld_dma(q).start()
+            if do_rdma:
+                @_when(q >= 2, unrolled)
+                def _reuse():
+                    rdma(q - 2).wait_send()    # frame slot q%2 drained
+            if do_ld:
+                ld_dma(q).wait()
+            if do_enc:
+                encode_from_ld(q)
+            if flow_control and do_rdma:
                 @_when(q >= 2, unrolled)
                 def _credit():
                     pltpu.semaphore_wait(credit_sem, 1)
-            rdma(q).start()
+            if do_rdma:
+                rdma(q).start()
 
     def consume(g):
-        stld_dma(g).start()                # overlap load with the wire
-        rdma(g).wait_recv()
-        stld_dma(g).wait()
-        slot = g % 2
-        dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
-                           recv_pkt[slot, pl.ds(R, SB)], block_size)
-        st[slot] = st[slot] + dec
-        if flow_control:
+        if do_stld:
+            stld_dma(g).start()            # overlap load with the wire
+        if do_rdma:
+            rdma(g).wait_recv()
+        if do_stld:
+            stld_dma(g).wait()
+        if do_dec:
+            slot = g % 2
+            dec = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                               recv_pkt[slot, pl.ds(R, SB)], block_size)
+            st[slot] = st[slot] + dec
+        if flow_control and do_rdma:
             pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-        wb_dma(g).start()
+        if do_wb:
+            wb_dma(g).start()
 
     # Writeback discipline: each wb_dma is waited EXACTLY ONCE, at a point
     # that dominates both of its consumers — the send-side RAW (launch q
@@ -525,13 +551,15 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
     if S == 1:
         def step(g):                       # RAW is immediate at S=1: the
             consume(g)                     # next send reads THIS writeback
-            wb_dma(g).wait()
+            if do_wb:
+                wb_dma(g).wait()
             launch(g + 1)
     else:
         def step(g):
-            @_when(g >= 1, unrolled)
-            def _wb_prev():                # single wait, 1-iteration lag:
-                wb_dma(g - 1).wait()       # every wb <= g-1 complete here,
+            if do_wb:
+                @_when(g >= 1, unrolled)
+                def _wb_prev():            # single wait, 1-iteration lag:
+                    wb_dma(g - 1).wait()   # every wb <= g-1 complete here,
             launch(g + 1)                  # dominating RAW (q-S <= g-1 for
             consume(g)                     # S >= 2) and slot reuse (g-2)
 
@@ -544,22 +572,24 @@ def _rs_stream_kernel(ids_ref, x_hbm, acc, ld, st, send_pkt, recv_pkt,
             return 0
         lax.fori_loop(0, total, body, 0)
 
-    if S >= 2:
+    if do_wb and S >= 2:
         wb_dma(total - 1).wait()           # S=1 waits each wb in-loop
-    rdma(total - 1).wait_send()
-    if total >= 2:
-        rdma(total - 2).wait_send()
-    if flow_control:
-        pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
+    if do_rdma:
+        rdma(total - 1).wait_send()
+        if total >= 2:
+            rdma(total - 2).wait_send()
+        if flow_control:
+            pltpu.semaphore_wait(credit_sem, 2 if total >= 2 else 1)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id", "loopback_n"))
+    "interpret", "collective_id", "loopback_n", "ablate"))
 def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
                     mantissa_bits: int, rounding: str, slice_elems: int,
                     interpret: bool, collective_id: int,
-                    loopback_n: Optional[int] = None):
+                    loopback_n: Optional[int] = None,
+                    ablate: Optional[str] = None):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -571,7 +601,8 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
     kern = functools.partial(
         _rs_stream_kernel, n=n, n_slices=S, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
-        rounding=rounding, flow_control=_flow, unrolled=_unrolled)
+        rounding=rounding, flow_control=_flow, unrolled=_unrolled,
+        ablate=ablate)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     acc = pl.pallas_call(
         kern,
@@ -1278,16 +1309,14 @@ def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
     if C % slice_elems or slice_elems % (cfg.block_size * LANES):
         raise ValueError((C, slice_elems, cfg.block_size * LANES))
     x2 = x.astype(jnp.float32).reshape(-1, LANES)
-    if ablate is not None and streaming:
-        raise ValueError("stage ablation instruments the VMEM-resident "
-                         "kernel only (the streaming variant adds "
-                         "load/store stages the split doesn't model)")
+    if ablate == "hbm" and not streaming:
+        raise ValueError("'hbm' ablates the streaming kernel's slice "
+                         "load/store stages; the resident kernel has none")
     call = _rs_stream_call if streaming else _rs_call
-    kw = {} if streaming else {"ablate": ablate}
     out = _loopback_shmap(
         lambda v: call(v, None, cfg.block_size, cfg.mantissa_bits,
                        cfg.rounding, slice_elems, interpret, 7,
-                       loopback_n=virtual_n, **kw), x2)
+                       loopback_n=virtual_n, ablate=ablate), x2)
     return out.reshape(C)
 
 

@@ -368,10 +368,11 @@ def test_loopback_gather_microbench_runs(rng, streaming):
 
 def test_loopback_stage_ablation(rng):
     """Stage-ablated loopback variants (round-5 per-stage attribution):
-    each runs the same schedule with one stage compiled in.  encode/rdma
-    ablations never touch the accumulator, so the owned chunk comes back
-    untouched — a structural check that the ablation really removed the
-    decode+add stage rather than scrambling the schedule."""
+    each runs the same schedule with one stage compiled in.  Ablations
+    that exclude decode+add (and whose writeback, if any, stores back
+    unchanged content) never modify the accumulator, so the owned chunk
+    comes back untouched — a structural check that the ablation really
+    removed the stage rather than scrambling the schedule."""
     vn, SL = 4, SLICE
     x = jnp.asarray(rng.standard_normal(vn * 2 * SL), jnp.float32)
     C = x.shape[0] // vn
@@ -382,6 +383,25 @@ def test_loopback_stage_ablation(rng):
     assert out.shape == (C,)               # decodes stale frames: values
     full = rp.loopback_microbench(x, vn, slice_elems=SL)  # are garbage
     assert full.shape == (C,) and np.isfinite(np.asarray(full)).all()
-    with pytest.raises(ValueError, match="resident"):
-        rp.loopback_microbench(x, vn, slice_elems=SL, streaming=True,
-                               ablate="encode")
+    # the resident kernel has no HBM slice-streaming stage to ablate
+    with pytest.raises(ValueError, match="hbm"):
+        rp.loopback_microbench(x, vn, slice_elems=SL, ablate="hbm")
+
+
+def test_loopback_stage_ablation_streaming(rng):
+    """Streaming-kernel ablations: encode/rdma touch nothing; 'hbm'
+    loads and writes back UNCHANGED slice content (pure memory
+    streaming), so the accumulator is also untouched; decode mutates."""
+    vn, SL = 4, SLICE
+    x = jnp.asarray(rng.standard_normal(vn * 2 * SL), jnp.float32)
+    C = x.shape[0] // vn
+    for ab in ("encode", "rdma", "hbm"):
+        out = rp.loopback_microbench(x, vn, slice_elems=SL,
+                                     streaming=True, ablate=ab)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x[:C]),
+                                      err_msg=ab)
+    out = rp.loopback_microbench(x, vn, slice_elems=SL, streaming=True,
+                                 ablate="decode")
+    assert out.shape == (C,)
+    full = rp.loopback_microbench(x, vn, slice_elems=SL, streaming=True)
+    assert full.shape == (C,) and np.isfinite(np.asarray(full)).all()

@@ -181,11 +181,15 @@ for mib, slice_elems, streaming in ((1, 8192, False), (4, 8192, False),
         row["timing"] = diag
         print(f"[bench] {mib}MiB stream={streaming}: "
               f"{row.get('pipeline_gbps')} GB/s", flush=True)
-        if not streaming and mib == 4 and t_full > 0:
-            # per-stage attribution on the headline resident row (round-4
-            # verdict item 3: say which stage binds, then fix it)
+        # per-stage attribution on the headline rows (round-4 verdict
+        # item 3: say which stage binds, then fix it): the 4 MiB
+        # resident row and the 32 MiB streaming row (which adds the
+        # HBM slice load/store stage the resident kernel doesn't have)
+        want_stages = (("encode", "rdma", "decode") if not streaming
+                       else ("encode", "rdma", "decode", "hbm"))
+        if mib in (4, 32) and t_full > 0:
             stages = {}
-            for ab in ("encode", "rdma", "decode"):
+            for ab in want_stages:
                 print(f"[bench] phase=stage_{ab} t={time.time()-t0:.1f}s",
                       flush=True)
                 t_s, _ = measure(ab)
@@ -220,10 +224,12 @@ def _stage_canary() -> dict:
 
 
 def _stage_loopback() -> dict:
-    # budget covers the stage-ablation compiles (4 variants x K/2K chains
-    # on the 4 MiB row; the persistent compile cache amortizes re-windows)
+    # budget covers the stage-ablation compiles: 3 resident variants on
+    # the 4 MiB row + 4 streaming variants on the 32 MiB row, each a
+    # K/2K chain pair (~14 extra compiles worst case; the persistent
+    # compile cache amortizes re-windows)
     return run_attempt("loopback", [sys.executable, "-u", "-c", LOOPBACK_SRC],
-                       budget_s=600.0, silence_s=240.0, cwd=REPO)
+                       budget_s=780.0, silence_s=300.0, cwd=REPO)
 
 
 def _stage_bench() -> dict:
