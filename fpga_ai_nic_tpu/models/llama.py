@@ -748,3 +748,17 @@ def num_params(cfg: LlamaConfig) -> int:
     per_layer = (2 * D + D * cfg.n_heads * Hd + 2 * D * cfg.n_kv_heads * Hd
                  + cfg.n_heads * Hd * D + ffn)
     return cfg.vocab * D * 2 + D + cfg.n_layers * per_layer
+
+
+def active_params(cfg: LlamaConfig) -> int:
+    """Parameters a TOKEN's matmuls actually touch: for MoE, only the
+    top_k routed experts' FFN weights count (plus the router), so the
+    6*P*tokens/s FLOP model stays honest — num_params would overstate
+    MoE FLOPs by num_experts/top_k on the FFN term.  Equal to num_params
+    for dense configs."""
+    if cfg.moe is None:
+        return num_params(cfg)
+    D = cfg.dim
+    all_ffn = 3 * cfg.moe_experts * D * cfg.ffn_dim
+    active_ffn = 3 * cfg.moe_top_k * D * cfg.ffn_dim
+    return num_params(cfg) - cfg.n_layers * (all_ffn - active_ffn)

@@ -268,3 +268,20 @@ def test_moe_ffn_with_stats_matches_standalone(rng):
     want = moe.expert_stats(params, x, MCFG)
     np.testing.assert_allclose(np.asarray(stats["load_frac"]),
                                np.asarray(want["load_frac"]), atol=1e-6)
+
+
+def test_active_params_accounting():
+    """active_params = router + top_k experts per token (the 6*P FLOP
+    model's P for MoE); dense configs are unchanged."""
+    dense = llama.LlamaConfig.tiny()
+    assert llama.active_params(dense) == llama.num_params(dense)
+    moe = dataclasses.replace(dense, moe_experts=8, moe_top_k=2)
+    total, active = llama.num_params(moe), llama.active_params(moe)
+    D, F, L = moe.dim, moe.ffn_dim, moe.n_layers
+    assert total - active == L * 3 * (8 - 2) * D * F
+    # the single-device forward must actually run this config
+    p = llama.init(jax.random.PRNGKey(0), moe)
+    toks = jnp.asarray(np.random.default_rng(0).integers(
+        0, moe.vocab, (2, 17)), jnp.int32)
+    loss = llama.loss_fn(p, (toks[:, :-1], toks[:, 1:]), moe)
+    assert np.isfinite(float(loss))

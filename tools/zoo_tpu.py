@@ -36,6 +36,7 @@ from bench_common import (bf16_peak, is_tpu_platform, log,  # noqa: E402
 # processes lags, and following three smaller configs OOM'd it once
 CONFIG_NAMES = ("llama_7e8_dp1", "resnet50_dp1", "bert_base_dp1",
                 "llama_dp1", "llama_long_ctx_dp1", "llama_decode_dp1",
+                "llama_moe_dp1",
                 # diagnostics last — and the 32k fault-retry VERY last: a
                 # row that may wedge the tunnel must cost nothing after it
                 "resnet50_f32_dp1", "llama_long_ctx32k_dp1")
@@ -175,6 +176,31 @@ def child_main(name: str) -> None:
         out["seq_len"] = seq
         unit = "tokens"
         per_unit_flops = 6.0 * P + 6.0 * mcfg.n_layers * mcfg.dim * seq
+    elif name == "llama_moe_dp1":
+        # MoE on one chip (routing + all experts local; the ep all_to_all
+        # axis is validated on the CPU mesh / dryrun): the llama_dp1
+        # backbone with every FFN an 8-expert top-2 routed layer.  FLOP
+        # accounting uses ACTIVE params (router + top_k experts per
+        # token) — 6*num_params would overstate the FFN term 4x.
+        import dataclasses
+        from fpga_ai_nic_tpu.models import llama
+        mcfg = dataclasses.replace(_llama_dp1_cfg(), moe_experts=8,
+                                   moe_top_k=2)
+        B, seq = 8, 512
+        cfg = TrainConfig(iters=ITERS, global_batch=B, mesh=MeshConfig(),
+                          collective=CollectiveConfig(impl="xla"),
+                          optimizer=OptimizerConfig(kind="adamw",
+                                                    learning_rate=1e-4))
+        loss_fn = lambda p, b: llama.loss_fn(p, b, mcfg)
+        init = llama.init(jax.random.PRNGKey(cfg.seed), mcfg)
+        kt, = jax.random.split(key, 1)
+        toks = jax.random.randint(kt, (B, seq + 1), 0, mcfg.vocab,
+                                  jnp.int32)
+        batch = (toks[:, :-1], toks[:, 1:])
+        active = llama.active_params(mcfg)
+        out["params"] = llama.num_params(mcfg)
+        out["active_params"] = active
+        unit, per_unit_flops = "tokens", 6.0 * active
     elif name in ("llama_7e8_dp1", "llama_dp1"):
         import dataclasses
         from fpga_ai_nic_tpu.models import llama
