@@ -147,6 +147,23 @@ def main():
                          f"| {v.get('mfu', '—')} "
                          f"| {v.get('params', 0):,} |")
             L.append("")
+            rows_d = dict(ok_rows)
+            bf, f32 = rows_d.get("resnet50_dp1"), rows_d.get(
+                "resnet50_f32_dp1")
+            if (bf and f32 and bf.get("mfu") and f32.get("mfu")
+                    and bf.get("compute_dtype") == "bfloat16"):
+                r04_mfu = 0.131        # zoo_tpu_20260731T092506Z.json,
+                # the r04 record this A/B was built to explain
+                L.append(
+                    f"ResNet-50 attribution (same batch, same model): "
+                    f"bf16 convs reach MFU {bf['mfu']}, f32 convs "
+                    f"{f32['mfu']} — a {bf['mfu'] / f32['mfu']:.2f}x "
+                    f"dtype factor; the r04 row's 0.131 ran the f32 "
+                    f"factory default at batch 64, so the r04 gap "
+                    f"decomposes into the dtype factor above times a "
+                    f"{f32['mfu'] / r04_mfu:.2f}x batch/layout factor "
+                    f"(64 -> 256 fills the late-stage 7x7 maps).")
+                L.append("")
             traced = [(k, v["trace"]) for k, v in ok_rows if v.get("trace")]
             if traced:
                 L += ["Trace attribution (one traced multi-step pass per "
