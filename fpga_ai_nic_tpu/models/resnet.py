@@ -40,8 +40,8 @@ class ResNetConfig:
     bn_momentum: float = 0.9
 
     @staticmethod
-    def resnet50() -> "ResNetConfig":
-        return ResNetConfig()
+    def resnet50(dtype: str = "bfloat16") -> "ResNetConfig":
+        return ResNetConfig(dtype=dtype)
 
     @staticmethod
     def tiny(stage_sizes=(1, 1), width=8, num_classes=10,

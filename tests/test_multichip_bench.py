@@ -92,3 +92,20 @@ def test_filtered_force_preserves_other_stages(monkeypatch, tmp_path):
     assert m.main() == 0
     assert calls == ["busbw"]                     # canary stayed banked
     assert m._load_state()["dryrun"]["canary"]["ok"]
+
+
+@pytest.mark.slow
+def test_zoo_configs_validate_on_cpu():
+    """Every zoo config must trace cleanly off-hardware (zoo --validate):
+    a config bug discovered on the TPU burns a healthy tunnel window —
+    this caught a real one in round 5 (resnet50(dtype=...) didn't exist)."""
+    from bench_common import cpu_env
+    p = subprocess.run(
+        [sys.executable, "-u", os.path.join(REPO, "tools", "zoo_tpu.py"),
+         "--validate"],
+        env=cpu_env(1), cwd=REPO, capture_output=True, text=True,
+        timeout=900)
+    assert p.returncode == 0, p.stdout[-2000:] + p.stderr[-2000:]
+    line = [l for l in p.stdout.splitlines() if '"validated"' in l][-1]
+    res = json.loads(line)
+    assert res["failed"] == [], res

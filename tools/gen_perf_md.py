@@ -157,12 +157,14 @@ def main():
                 L.append(
                     f"ResNet-50 attribution (same batch, same model): "
                     f"bf16 convs reach MFU {bf['mfu']}, f32 convs "
-                    f"{f32['mfu']} — a {bf['mfu'] / f32['mfu']:.2f}x "
-                    f"dtype factor; the r04 row's 0.131 ran the f32 "
-                    f"factory default at batch 64, so the r04 gap "
-                    f"decomposes into the dtype factor above times a "
-                    f"{f32['mfu'] / r04_mfu:.2f}x batch/layout factor "
-                    f"(64 -> 256 fills the late-stage 7x7 maps).")
+                    f"{f32['mfu']} — a measured "
+                    f"{bf['mfu'] / f32['mfu']:.2f}x dtype factor; the "
+                    f"r04 row's 0.131 was ALREADY bf16 (at batch 64), "
+                    f"so its gap to the bf16 row here is a "
+                    f"{bf['mfu'] / r04_mfu:.2f}x batch/layout effect "
+                    f"(64 -> 256 fills the late-stage 7x7 maps) — see "
+                    f"the traced row's overlap attribution for what "
+                    f"remains.")
                 L.append("")
             traced = [(k, v["trace"]) for k, v in ok_rows if v.get("trace")]
             if traced:

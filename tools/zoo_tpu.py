@@ -53,7 +53,7 @@ def _llama_dp1_cfg():
 ITERS = 16
 
 
-def child_main(name: str) -> None:
+def child_main(name: str, validate: bool = False) -> None:
     t0 = time.time()
     print(f"[bench] phase=import t=0.0s", flush=True)
     import jax
@@ -61,7 +61,8 @@ def child_main(name: str) -> None:
     from bench_common import enable_compile_cache
     enable_compile_cache(jax)
     print(f"[bench] phase=devices t={time.time()-t0:.1f}s", flush=True)
-    assert is_tpu_platform(jax.devices()[0].platform), jax.devices()
+    if not validate:
+        assert is_tpu_platform(jax.devices()[0].platform), jax.devices()
     from fpga_ai_nic_tpu.parallel import DPTrainer, make_mesh
     from fpga_ai_nic_tpu.utils.config import (CollectiveConfig, MeshConfig,
                                               OptimizerConfig, TrainConfig)
@@ -84,6 +85,12 @@ def child_main(name: str) -> None:
         run = jax.jit(lambda p, pr: llama_decode.generate(
             p, pr, n_new, mcfg, temperature=0.0,
             rng=jax.random.PRNGKey(1)))
+        if validate:
+            shape = jax.eval_shape(run, params, prompt)
+            assert shape.shape == (B, 32 + n_new), shape
+            print(json.dumps({"config": name, "validated": True}),
+                  flush=True)
+            return
         out_toks = run(params, prompt)
         _ = int(out_toks[0, -1])                 # sync: compile + warmup
         t1 = time.perf_counter()
@@ -101,12 +108,13 @@ def child_main(name: str) -> None:
         return
 
     if name in ("resnet50_dp1", "resnet50_f32_dp1"):
-        # canonical row: bf16 convs (the MXU-native rate; the r04 row ran
-        # the resnet50() factory's f32 default at MFU 0.131 — conv compute
-        # dtype was the first suspect) at batch 256 (late stages' 7x7
-        # spatial maps underfill the MXU at 64).  resnet50_f32_dp1 is the
-        # committed A/B: same batch, f32 convs — its MFU delta attributes
-        # the dtype share of the r04 gap.
+        # canonical row: bf16 convs at batch 256.  (The r04 row at MFU
+        # 0.131 ALREADY ran bf16 — the round-5 dtype hypothesis was
+        # wrong, caught by --validate — so the levers under test are
+        # batch 64 -> 256, which fills the late-stage 7x7 maps, and the
+        # ZOO_TRACE attribution.)  resnet50_f32_dp1 is the committed
+        # same-batch f32 A/B: it quantifies the dtype factor rather than
+        # assuming it.
         from fpga_ai_nic_tpu.models import resnet
         f32 = name == "resnet50_f32_dp1"
         mcfg = resnet.ResNetConfig.resnet50(
@@ -238,6 +246,24 @@ def child_main(name: str) -> None:
 
     units_per_step = (cfg.global_batch if unit == "samples"
                       else cfg.global_batch * batch[0].shape[1])
+    if validate:
+        # wiring check without hardware: tracing the loss catches config,
+        # shape, and kwarg bugs — precisely what must NOT burn a healthy
+        # tunnel window (the TPU rungs are this round's scarcest
+        # resource).  Traced inside a 1-device "dp" shard_map because
+        # that is the context DPTrainer runs it in (sync-BN pmean etc.
+        # need the axis bound).
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        mesh1 = Mesh(np.array(jax.devices()[:1]), ("dp",))
+        f = jax.shard_map(loss_fn, mesh=mesh1, in_specs=(P(), P()),
+                          out_specs=P(), check_vma=False)
+        shape = jax.eval_shape(f, init, batch)
+        assert shape.shape == (), shape
+        print(json.dumps({"config": name, "validated": True,
+                          "per_unit_flops": per_unit_flops,
+                          "units_per_step": units_per_step}), flush=True)
+        return
     mesh = make_mesh(cfg.mesh)
     tr = DPTrainer(loss_fn, mesh, cfg)
     print(f"[bench] phase=init t={time.time()-t0:.1f}s", flush=True)
@@ -338,5 +364,28 @@ def main() -> int:
 if __name__ == "__main__":
     if len(sys.argv) >= 3 and sys.argv[1] == "--child":
         child_main(sys.argv[2])
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--validate":
+        # CPU wiring check of every config (no hardware, no timing):
+        # traces each loss/generate abstractly so a config bug can never
+        # burn a real tunnel window.  MUST itself never touch the
+        # tunnel: the axon plugin registers eagerly at `import jax`, so
+        # re-exec under cpu_env() before anything imports jax (mutating
+        # the env after registration is too late — tests/conftest.py).
+        if os.environ.get("JAX_PLATFORMS") != "cpu":
+            from bench_common import cpu_env
+            os.execve(sys.executable,
+                      [sys.executable, "-u"] + sys.argv, cpu_env(1))
+        failed = []
+        for _name in CONFIG_NAMES:
+            try:
+                child_main(_name, validate=True)
+            # SystemExit included: an unknown-config branch raises it,
+            # and the sweep must still report the full failed list
+            except (Exception, SystemExit) as e:  # noqa: BLE001
+                failed.append((_name, repr(e)[:200]))
+                log(f"validate {_name}: FAILED {e!r}")
+        print(json.dumps({"validated": len(CONFIG_NAMES) - len(failed),
+                          "failed": failed}), flush=True)
+        sys.exit(1 if failed else 0)
     else:
         sys.exit(main())
