@@ -41,3 +41,8 @@ multichip-bench:
 
 multichip-dryrun:
 	python tools/multichip_bench.py --dryrun
+
+# trace every zoo config abstractly on CPU (no hardware): config bugs
+# must never burn a healthy tunnel window
+zoo-validate:
+	python tools/zoo_tpu.py --validate
