@@ -27,7 +27,8 @@ from jax.sharding import PartitionSpec as P
 
 from ..ops import moe as moe_ops
 from ..ops.ring_attention import (flash_attention_remat, full_attention,
-                                  gathered_attention, ring_attention)
+                                  gathered_attention, pallas_route,
+                                  ring_attention)
 
 
 @dataclass(frozen=True)
@@ -253,10 +254,21 @@ def _block(lyr: Dict, x: jax.Array, pos: jax.Array, cfg: LlamaConfig,
     v = (h @ wv).reshape(B, S, n_kv, Hd).transpose(0, 2, 1, 3)
     q = _rope(q, pos, cfg)
     k = _rope(k, pos, cfg)
-    if n_kv != n_heads:                             # GQA: expand kv heads
-        rep = n_heads // n_kv
-        k = jnp.repeat(k, rep, axis=1)
-        v = jnp.repeat(v, rep, axis=1)
+    if n_kv != n_heads:
+        # GQA: the fused Pallas kernels take grouped K/V natively (each
+        # KV head read once per group — 1/G the KV traffic/memory and,
+        # on the sp ring, 1/G the rotated bytes); the XLA paths' einsum
+        # math needs the repeat-expanded copy.  Grouped form is only
+        # reachable through branches that can route pallas (sp, or
+        # attn_block-flash) — full_attention has no kernel path — and
+        # the route decision is the same pallas_route(impl, q_shape) the
+        # ops make, so the two can't diverge.
+        kernel_branch = sp_axis is not None or cfg.attn_block is not None
+        if not (kernel_branch
+                and pallas_route(cfg.attn_impl, (B, n_heads, S, Hd))):
+            rep = n_heads // n_kv
+            k = jnp.repeat(k, rep, axis=1)
+            v = jnp.repeat(v, rep, axis=1)
     if sp_axis is not None:
         # "gather": KV all-gather variant — the only form sound inside the
         # 1F1B schedulers' stage-divergent conds (ring's ppermute pairs

@@ -162,11 +162,16 @@ def _fwd_kernel(off_ref, q_ref, k_ref, v_ref, *rest, sm_scale, causal,
 
 def _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
          block_k, interpret):
-    """q3: (BH, Sq, dh), k3/v3: (BH, Sk, dh), off: (2,) i32,
-    bias: None | (B, Sk) f32 (B = BH/n_heads) ->
-    (out (BH,Sq,dh), lse (BH,Sq) f32)."""
+    """q3: (BH, Sq, dh), k3/v3: (BH/G, Sk, dh) for GQA group size G
+    (G = 1 = multi-head), off: (2,) i32, bias: None | (B, Sk) f32
+    (B = BH/n_heads) -> (out (BH,Sq,dh), lse (BH,Sq) f32).
+
+    GQA rides the index maps alone: grid step b (a query head) reads KV
+    row b // G, so grouped K/V are never materialized per query head —
+    1/G the KV HBM traffic and memory of the repeat-then-attend form."""
     BH, Sq, dh = q3.shape
     Sk = k3.shape[1]
+    G = BH // k3.shape[0]
     nq, nk = Sq // block_q, Sk // block_k
     has_bias = bias is not None
     vma = _vma(q3, k3, v3, off, *([bias] if has_bias else []))
@@ -177,8 +182,8 @@ def _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
     in_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b // G, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b // G, j, 0)),
     ]
     args = [off, q3, k3, v3]
     if has_bias:
@@ -258,15 +263,21 @@ def _dq_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 
 def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                *rest, sm_scale, causal, block_q, block_k, nq, has_bias):
+                *rest, sm_scale, causal, block_q, block_k, nq, n_steps,
+                has_bias):
+    # grid (B*Hkv, nk, n_steps) with n_steps = G*nq: the sequential axis
+    # enumerates (query head of the group, q block); dk/dv accumulate in
+    # scratch across ALL of them — the GQA sum over the group's query
+    # heads happens here, not as a post-kernel reshape-reduce
     if has_bias:
         bias_ref, dk_ref, dv_ref, dk_scr, dv_scr = rest
     else:
         dk_ref, dv_ref, dk_scr, dv_scr = rest
-    ik, iq = pl.program_id(1), pl.program_id(2)
+    ik, g = pl.program_id(1), pl.program_id(2)
+    iq = g % nq                        # q block within the current head
     q0, k0 = off_ref[0], off_ref[1]
 
-    @pl.when(iq == 0)
+    @pl.when(g == 0)
     def _init():
         dk_scr[:] = jnp.zeros(dk_scr.shape, jnp.float32)
         dv_scr[:] = jnp.zeros(dv_scr.shape, jnp.float32)
@@ -303,7 +314,7 @@ def _dkv_kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     else:
         _compute()
 
-    @pl.when(iq == nq - 1)
+    @pl.when(g == n_steps - 1)
     def _finish():
         dk_ref[0] = dk_scr[:].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[:].astype(dv_ref.dtype)
@@ -313,6 +324,7 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
          causal, block_q, block_k, interpret):
     BH, Sq, dh = q3.shape
     Sk = k3.shape[1]
+    G = BH // k3.shape[0]
     nq, nk = Sq // block_q, Sk // block_k
     has_bias = bias is not None
     H = n_heads
@@ -330,8 +342,8 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
     dq_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
         pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b // G, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, i, j: (b // G, j, 0)),
         pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
         pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
@@ -354,32 +366,44 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
         interpret=interpret,
     )(*dq_args)
 
+    # dkv grid: leading dim is the KV head; the sequential axis g
+    # enumerates (group member h = g // nq, q block i = g % nq) so the
+    # scratch sums each group's contributions before the single write
+    BHkv = BH // G
+    n_steps = G * nq
+
+    def qmap(b, j, g):                  # ONE definition of the group
+        return (b * G + g // nq, g % nq)   # enumeration (same trap-
+    # avoidance as _bias_spec): head g//nq of KV head b's group, q
+    # block g % nq
+
     dkv_specs = [
         pl.BlockSpec(memory_space=pltpu.SMEM),
-        pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
-        pl.BlockSpec((1, block_q, dh), lambda b, j, i: (b, i, 0)),
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
-        pl.BlockSpec((1, block_q), lambda b, j, i: (b, i)),
+        pl.BlockSpec((1, block_q, dh), lambda b, j, g: (*qmap(b, j, g), 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, j, g: (b, j, 0)),
+        pl.BlockSpec((1, block_k, dh), lambda b, j, g: (b, j, 0)),
+        pl.BlockSpec((1, block_q, dh), lambda b, j, g: (*qmap(b, j, g), 0)),
+        pl.BlockSpec((1, block_q), qmap),
+        pl.BlockSpec((1, block_q), qmap),
     ]
     dkv_args = [off, q3, k3, v3, do, lse, delta]
     if has_bias:
-        dkv_specs.append(_bias_spec(H, block_k, k_grid_dim=1))
+        # leading grid dim is the KV head here: batch = b // (H/G)
+        dkv_specs.append(_bias_spec(H // G, block_k, k_grid_dim=1))
         dkv_args.append(bias)
     dk, dv = pl.pallas_call(
         functools.partial(_dkv_kernel, sm_scale=sm_scale, causal=causal,
                           block_q=block_q, block_k=block_k, nq=nq,
-                          has_bias=has_bias),
-        grid=(BH, nk, nq),
+                          n_steps=n_steps, has_bias=has_bias),
+        grid=(BHkv, nk, n_steps),
         in_specs=dkv_specs,
         out_specs=[
-            pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
-            pl.BlockSpec((1, block_k, dh), lambda b, j, i: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, j, g: (b, j, 0)),
+            pl.BlockSpec((1, block_k, dh), lambda b, j, g: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sk, dh), k3.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, Sk, dh), v3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BHkv, Sk, dh), k3.dtype, vma=vma),
+            jax.ShapeDtypeStruct((BHkv, Sk, dh), v3.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
                         pltpu.VMEM((block_k, dh), jnp.float32)],
@@ -439,9 +463,12 @@ def supported(q_shape, dtype=None) -> bool:
 
 def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
             block_k, interpret, with_lse=False, key_bias=None):
-    """[B,H,Sq,dh] x [B,H,Sk,dh] entry shared by the public wrappers."""
+    """q [B,H,Sq,dh] x k/v [B,Hkv,Sk,dh] entry shared by the public
+    wrappers; Hkv may divide H (GQA — the kernels read each KV head once
+    per group instead of attending a repeat-expanded copy)."""
     B, H, Sq, dh = q.shape
-    Sk = k.shape[2]
+    Hkv, Sk = k.shape[1], k.shape[2]
+    assert H % Hkv == 0, (H, Hkv)
     if sm_scale is None:
         sm_scale = dh ** -0.5
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)
@@ -453,8 +480,8 @@ def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
         # the bias channel is for padding masks, whose gradient is
         # discarded by construction
         key_bias = lax.stop_gradient(key_bias.astype(jnp.float32))
-    out, lse = _flash(q.reshape(B * H, Sq, dh), k.reshape(B * H, Sk, dh),
-                      v.reshape(B * H, Sk, dh), off, key_bias, H,
+    out, lse = _flash(q.reshape(B * H, Sq, dh), k.reshape(B * Hkv, Sk, dh),
+                      v.reshape(B * Hkv, Sk, dh), off, key_bias, H,
                       float(sm_scale), bool(causal), bq, bk,
                       bool(interpret))
     out = out.reshape(B, H, Sq, dh)
