@@ -27,13 +27,15 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     flock "$LOCK" -c "python tools/first_contact.py" >/tmp/harvest_contact.out 2>&1
     echo "[harvest] ladder exited rc=$? at $(date -u +%FT%TZ)"
     # round-5 evidence chain, each piece banked+committed on its own so a
-    # mid-chain wedge never costs completed pieces (probe-gated inside):
+    # mid-chain wedge never costs completed pieces (probe-gated inside;
+    # outer timeouts localize a mid-piece wedge to that piece — the codec
+    # probe has no internal watchdog of its own)
     # model zoo (flash-kernel MFU rows, bf16 resnet A/B, S=32k retry)
-    flock "$LOCK" -c "python tools/zoo_tpu.py" >/tmp/harvest_zoo.out 2>&1
+    flock "$LOCK" -c "timeout 5400 python tools/zoo_tpu.py" >/tmp/harvest_zoo.out 2>&1
     echo "[harvest] zoo exited rc=$? at $(date -u +%FT%TZ)"
     flock "$LOCK" -c "git add artifacts && git commit -m 'Bank TPU evidence: model zoo'" >/dev/null 2>&1
     # codec kernel variant A/B (broadcast x tiles, slope-based)
-    flock "$LOCK" -c "python tools/codec_kernel_probe.py" >/tmp/harvest_codecprobe.out 2>&1
+    flock "$LOCK" -c "timeout 1200 python tools/codec_kernel_probe.py" >/tmp/harvest_codecprobe.out 2>&1
     echo "[harvest] codec probe exited rc=$? at $(date -u +%FT%TZ)"
     flock "$LOCK" -c "git add artifacts && git commit -m 'Bank TPU evidence: codec kernel variant A/B'" >/dev/null 2>&1
     # snapshot the round's collective record when a TPU artifact landed
