@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -46,3 +46,9 @@ multichip-dryrun:
 # must never burn a healthy tunnel window
 zoo-validate:
 	python tools/zoo_tpu.py --validate
+
+# the chaos fault matrix: every fault class x injection site x wire
+# format, each cell a real supervised run that must recover (or absorb)
+# on the 8-device virtual CPU mesh — docs/CHAOS.md
+chaos-bench:
+	python tools/chaos_bench.py --fast

@@ -1,0 +1,149 @@
+"""JAX version compatibility — one place where API drift is absorbed.
+
+The codebase is written against the current JAX surface (``jax.shard_map``
+with varying-manual-axes checking, ``lax.pcast``, ``lax.axis_size``,
+``jax.typeof``).  Containers in the fleet pin older jaxlibs (the tunnel
+plugin lags upstream), where the same capabilities exist under older names
+(``jax.experimental.shard_map.shard_map`` with ``check_rep``) or not at all
+(the vma type system).  ``install()`` polyfills the missing names onto the
+``jax``/``jax.lax`` modules so the rest of the repo — and its tests and
+tools — run unchanged on both:
+
+- ``jax.shard_map(f, mesh=, in_specs=, out_specs=, check_vma=)`` →
+  ``jax.experimental.shard_map.shard_map(..., check_rep=False)``.
+  check_rep stays OFF on old JAX: its replication checker predates the
+  pbroadcast/pvary autodiff rules and rejects valid grad-inside-shard_map
+  programs (the vma checker that replaced it is a new-JAX concept).  The
+  numerics do not depend on the checker; the parity tests
+  (tests/test_train.py golden comparisons) hold under either.
+- ``lax.pcast(x, axis, to=...)`` → identity.  pcast only adjusts the vma
+  *type*; without the vma system there is nothing to adjust.
+- ``lax.axis_size(name)`` → ``lax.psum(1, name)``, which JAX evaluates
+  statically to a python int inside shard_map.
+- ``jax.typeof(x)`` → the concrete aval wrapped with an empty ``.vma``.
+
+On a JAX that already provides a name, that name is left untouched —
+install() is a strict no-op there, so new-JAX behavior (including real vma
+checking) is preserved.  Helpers that cannot be expressed as module
+attributes (``ShapeDtypeStruct(..., vma=)``, Pallas ``CompilerParams``)
+are exposed as functions for the kernel files to call directly.
+
+Caveat, on purpose: on an old jaxlib install() mutates the global
+``jax``/``jax.lax`` namespaces (it runs from the package __init__, so the
+whole repo and its tests see one consistent surface).  A co-resident
+library that feature-detects ``hasattr(jax, "shard_map")`` in the same
+process will see the polyfill — whose check_rep stays False — rather than
+a missing attribute.  If that ever bites, the alternative is routing
+every call site through compat helpers like the two above; until then the
+single-switch patch is what keeps the diff against upstream JAX usage
+zero.
+"""
+
+from __future__ import annotations
+
+import functools
+from types import SimpleNamespace
+from typing import Any, Optional
+
+import jax
+from jax import lax
+
+# True when this JAX has the varying-manual-axes type system (and therefore
+# the real shard_map/pcast/typeof); False when the polyfills are active.
+HAS_VMA = hasattr(lax, "pcast")
+
+
+def _shard_map_compat(f=None, *, mesh, in_specs, out_specs,
+                      check_vma: Optional[bool] = None, **kw):
+    from jax.experimental.shard_map import shard_map as _sm
+    if f is None:                     # decorator style: jax.shard_map(mesh=...)
+        return functools.partial(_shard_map_compat, mesh=mesh,
+                                 in_specs=in_specs, out_specs=out_specs,
+                                 check_vma=check_vma, **kw)
+    return _sm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               check_rep=False, **kw)
+
+
+def _pcast_compat(x, axis_name, *, to=None):
+    del axis_name, to
+    return x
+
+
+def _axis_size_compat(axis_name) -> int:
+    return lax.psum(1, axis_name)
+
+
+def _typeof_compat(x):
+    aval = jax.core.get_aval(x)
+    return SimpleNamespace(shape=getattr(aval, "shape", ()),
+                           dtype=getattr(aval, "dtype", None),
+                           vma=frozenset())
+
+
+_installed = False
+
+
+def install() -> None:
+    """Idempotently polyfill missing new-JAX names onto jax/jax.lax."""
+    global _installed
+    if _installed:
+        return
+    _installed = True
+    # hasattr probes go through jax's deprecation __getattr__, which raises
+    # AttributeError for unknown names — exactly the signal we want
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast_compat
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_compat
+    if not hasattr(jax, "typeof"):
+        jax.typeof = _typeof_compat
+
+
+def shape_dtype_struct(shape, dtype, vma=None) -> jax.ShapeDtypeStruct:
+    """ShapeDtypeStruct carrying vma only where the constructor takes it."""
+    if HAS_VMA and vma is not None:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
+def mesh_axis_sizes() -> dict:
+    """{axis_name: size} of the ambient manual mesh at trace time —
+    ``jax.sharding.get_abstract_mesh()`` where it exists, the tracing axis
+    env on older JAX (shard_map pushes its mesh axes there)."""
+    try:
+        from jax.sharding import get_abstract_mesh
+        return dict(get_abstract_mesh().shape)
+    except ImportError:
+        from jax._src.core import get_axis_env
+        return dict(get_axis_env().axis_sizes)
+
+
+# params safe to drop when the installed CompilerParams predates them:
+# pure scheduling hints whose absence cannot change results (the kernels
+# that pass has_side_effects always have their outputs consumed, so
+# dropping it cannot DCE them).  Correctness-bearing params — collective_id
+# (cross-chip DMA/barrier matching), dimension_semantics — are NOT here:
+# silently dropping those would compile a kernel that hangs or reduces
+# wrongly on a real mesh with nothing pointing at compat.
+_DROPPABLE_COMPILER_PARAMS = frozenset({"has_side_effects"})
+
+
+def tpu_compiler_params(**kwargs) -> Any:
+    """pltpu.CompilerParams across its rename (TPUCompilerParams before).
+
+    Hint-only fields the older dataclass lacks are dropped; a missing
+    correctness-bearing field raises instead of silently miscompiling."""
+    import dataclasses
+    from jax.experimental.pallas import tpu as pltpu
+    cls = getattr(pltpu, "CompilerParams", None) or pltpu.TPUCompilerParams
+    known = {f.name for f in dataclasses.fields(cls)}
+    missing = sorted(set(kwargs) - known - _DROPPABLE_COMPILER_PARAMS)
+    if missing:
+        raise NotImplementedError(
+            f"this jaxlib's {cls.__name__} has no {missing} — these "
+            "affect kernel correctness (collective matching / grid "
+            "semantics), so the fused kernels cannot run here; use "
+            "the non-fused paths or a newer jaxlib")
+    return cls(**{k: v for k, v in kwargs.items() if k in known})

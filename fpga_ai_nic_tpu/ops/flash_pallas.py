@@ -46,6 +46,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import compat
+
 LANES = 128
 _NEG = -1e30
 _DEF_BLOCK = 512
@@ -198,15 +200,15 @@ def _fwd(q3, k3, v3, off, bias, n_heads, sm_scale, causal, block_q,
             pl.BlockSpec((1, block_q), lambda b, i, j: (b, i)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Sq, dh), q3.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BH, Sq), jnp.float32, vma=vma),
+            compat.shape_dtype_struct((BH, Sq, dh), q3.dtype, vma=vma),
+            compat.shape_dtype_struct((BH, Sq), jnp.float32, vma=vma),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, LANES), jnp.float32),   # running max
             pltpu.VMEM((block_q, LANES), jnp.float32),   # normalizer
             pltpu.VMEM((block_q, dh), jnp.float32),      # output acc
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*args)
@@ -359,9 +361,9 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
         grid=(BH, nq, nk),
         in_specs=dq_specs,
         out_specs=pl.BlockSpec((1, block_q, dh), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Sq, dh), q3.dtype, vma=vma),
+        out_shape=compat.shape_dtype_struct((BH, Sq, dh), q3.dtype, vma=vma),
         scratch_shapes=[pltpu.VMEM((block_q, dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dq_args)
@@ -402,12 +404,12 @@ def _bwd(q3, k3, v3, off, bias, n_heads, out, lse, do, d_lse, sm_scale,
             pl.BlockSpec((1, block_k, dh), lambda b, j, g: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BHkv, Sk, dh), k3.dtype, vma=vma),
-            jax.ShapeDtypeStruct((BHkv, Sk, dh), v3.dtype, vma=vma),
+            compat.shape_dtype_struct((BHkv, Sk, dh), k3.dtype, vma=vma),
+            compat.shape_dtype_struct((BHkv, Sk, dh), v3.dtype, vma=vma),
         ],
         scratch_shapes=[pltpu.VMEM((block_k, dh), jnp.float32),
                         pltpu.VMEM((block_k, dh), jnp.float32)],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             dimension_semantics=("parallel", "parallel", "arbitrary")),
         interpret=interpret,
     )(*dkv_args)
@@ -452,12 +454,16 @@ def _flash_bwd(n_heads, sm_scale, causal, block_q, block_k, interpret,
 _flash.defvjp(_flash_fwd, _flash_bwd)
 
 
-def supported(q_shape, dtype=None) -> bool:
+def supported(q_shape, dtype=None, kv_seq_len=None) -> bool:
     """Can the fused kernel take this attention?  [B,H,S,dh] with S a
-    lane multiple (blocks divide S exactly) and a lane-friendly head dim."""
+    lane multiple (blocks divide S exactly) and a lane-friendly head dim.
+    ``kv_seq_len`` (Sk, when it differs from Sq) must be a lane multiple
+    too — the k/v blocks tile Sk the same way the q blocks tile Sq."""
     if len(q_shape) != 4:
         return False
     S, dh = q_shape[2], q_shape[3]
+    if kv_seq_len is not None and kv_seq_len % LANES != 0:
+        return False
     return S % LANES == 0 and dh % 8 == 0 and dh <= 256
 
 
@@ -469,6 +475,17 @@ def _flash4(q, k, v, q_offset, k_offset, sm_scale, causal, block_q,
     B, H, Sq, dh = q.shape
     Hkv, Sk = k.shape[1], k.shape[2]
     assert H % Hkv == 0, (H, Hkv)
+    if Sk % LANES != 0:
+        # fail here with a real error: _pick_block would fall back to a
+        # non-lane-multiple block (b == S admits any Sk), which only
+        # detonates later as an opaque Mosaic layout error on real
+        # hardware (ring/gathered callers keep Sk = Sl lane-tileable;
+        # the public API has to enforce it for everyone else)
+        raise ValueError(
+            f"flash kernels need the K/V sequence length to be a multiple "
+            f"of {LANES} lanes, got Sk={Sk} (k/v shape {k.shape}); pad the "
+            "keys (with key_bias masking the padding) or use the XLA "
+            "attention path")
     if sm_scale is None:
         sm_scale = dh ** -0.5
     bq, bk = _pick_block(Sq, block_q), _pick_block(Sk, block_k)

@@ -52,6 +52,29 @@ def _next_neighbor_perm(n: int):
     return [(i, (i + 1) % n) for i in range(n)]
 
 
+# -- chaos tap (runtime.chaos) ----------------------------------------------
+# When installed (fault-injection runs only), both explicit-ring
+# collectives (reduce-scatter and all-gather; ring_all_reduce composes
+# them) route their payload through the tap at trace time, so the chaos
+# harness can straggle or corrupt the wire INSIDE the compiled step — the
+# boundary the reference's bfp_adapter sits on.  None (the default) is
+# zero-cost: the collectives are traced exactly as before.
+
+_FAULT_TAP = None
+
+
+def set_fault_tap(tap) -> None:
+    """Install/remove (None) the trace-time payload tap.  Must be set
+    before the consuming step function is first traced; installed taps are
+    compiled into the program."""
+    global _FAULT_TAP
+    _FAULT_TAP = tap
+
+
+def _tap(x: jax.Array, point: str) -> jax.Array:
+    return x if _FAULT_TAP is None else _FAULT_TAP(x, point)
+
+
 def _use_pallas(cfg: BFPConfig, n_elems: int) -> bool:
     return cfg.codec == "pallas" or (
         cfg.codec == "auto" and _bfp_pl._is_tpu()
@@ -150,6 +173,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
         raise ValueError(f"need flat length divisible by {n}, got {x.shape}")
     if n == 1:
         return x
+    x = _tap(x, "ring.reduce_scatter")
     chunks = x.reshape(n, -1)
 
     def hop(s, ch):
@@ -176,6 +200,7 @@ def ring_all_gather(owned: jax.Array, axis_name: str, *,
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    owned = _tap(owned, "ring.all_gather")
     if n == 1:
         # still quantize: replicas must see wire-identical bytes at any n,
         # and the golden model quantizes the owned chunk unconditionally

@@ -117,21 +117,27 @@ def _attend_chunk(qf, k, v, q_pos, k_pos0, m, l, o, sm_scale, causal,
     return m, l, o
 
 
-def pallas_route(impl: str, q_shape) -> bool:
+def pallas_route(impl: str, q_shape, kv_seq_len: Optional[int] = None
+                 ) -> bool:
     """Shared attention-backend dispatch: the fused kernels when pinned
     or (auto) on TPU with tiling shapes; pinned-but-unsupported raises (a
     silent xla fallback would invalidate A/B runs).  ``q_shape`` is the
-    [B, H, S, dh] tuple (or an array with that .shape)."""
+    [B, H, S, dh] tuple (or an array with that .shape); pass
+    ``kv_seq_len`` for cross-attention (Sk != Sq) so auto can route a
+    non-lane-tileable Sk to the xla path instead of raising downstream."""
     from . import flash_pallas
     q_shape = getattr(q_shape, "shape", q_shape)
     if impl not in ("auto", "pallas", "xla"):
         raise ValueError(f"attn impl {impl!r}: want auto|pallas|xla")
-    if impl == "pallas" and not flash_pallas.supported(q_shape):
+    if impl == "pallas" and not flash_pallas.supported(
+            q_shape, kv_seq_len=kv_seq_len):
         raise ValueError(
-            f"impl='pallas' pinned but q shape {q_shape} does not tile "
-            "(need S % 128 == 0, head_dim % 8 == 0, head_dim <= 256)")
+            f"impl='pallas' pinned but q shape {q_shape} / kv_seq_len="
+            f"{kv_seq_len} does not tile (need S % 128 == 0, "
+            "head_dim % 8 == 0, head_dim <= 256, Sk % 128 == 0)")
     return (impl == "pallas" or (impl == "auto" and flash_pallas._is_tpu()
-                                 and flash_pallas.supported(q_shape)))
+                                 and flash_pallas.supported(
+                                     q_shape, kv_seq_len=kv_seq_len)))
 
 
 def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
@@ -169,7 +175,8 @@ def ring_attention(q: jax.Array, k: jax.Array, v: jax.Array, axis_name: str,
             "impl='pallas' cannot honor unroll=True / k_block=None — "
             "the fused ring is a rolled scan of blocked kernels; drop "
             "the knob or use impl='xla'")
-    if not xla_only_knobs and pallas_route(impl, q):
+    if not xla_only_knobs and pallas_route(impl, q,
+                                           kv_seq_len=k.shape[2]):
         from . import flash_pallas
         return flash_pallas.ring_flash_attention(
             q, k, v, axis_name, causal=causal, sm_scale=sm_scale,
@@ -251,7 +258,7 @@ def flash_attention_remat(q, k, v, *, causal=True, sm_scale=None,
       backward memory (measured 22 GB at S=16,384; models/llama.py
       carried this wrapper before round 5 moved the choice here)."""
     from . import flash_pallas
-    if pallas_route(impl, q):
+    if pallas_route(impl, q, kv_seq_len=k.shape[2]):
         b = k_block or flash_pallas._DEF_BLOCK
         return flash_pallas.flash_attention(q, k, v, causal=causal,
                                             sm_scale=sm_scale,
@@ -296,7 +303,7 @@ def gathered_attention(q, k, v, axis_name: str, *, causal=True,
         sm_scale = dh ** -0.5
     kf = lax.all_gather(k, axis_name, axis=2, tiled=True)
     vf = lax.all_gather(v, axis_name, axis=2, tiled=True)
-    if pallas_route(impl, q):
+    if pallas_route(impl, q, kv_seq_len=kf.shape[2]):
         from . import flash_pallas
         b = k_block or flash_pallas._DEF_BLOCK
         return flash_pallas.flash_attention(

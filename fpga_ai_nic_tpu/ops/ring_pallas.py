@@ -52,6 +52,8 @@ from jax import lax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+from .. import compat
+
 from .bfp_pallas import LANES, _is_tpu
 from ..utils.config import BFPConfig
 
@@ -81,6 +83,15 @@ def _decode_rows(mant, scale, block_size: int):
     s = pltpu.bitcast(((se + 127) << 23).astype(jnp.uint32), jnp.float32)
     return mant.astype(jnp.float32) * jnp.repeat(s, block_size, axis=0)
 
+
+# the threaded per-device TPU interpreter (blocking semaphores, race
+# detection) arrived after this container's jaxlib — under its original
+# TPUInterpretParams name on older releases that do ship it; the
+# flow-control battery skips without it (the discharge interpreter
+# still runs)
+_InterpretParams = getattr(pltpu, "InterpretParams",
+                           getattr(pltpu, "TPUInterpretParams", None))
+HAS_THREADED_INTERPRET = _InterpretParams is not None
 
 _FRAME_ALIGN = 8     # int8 VMEM sublane tile: DMA slice row extents align
 
@@ -129,7 +140,14 @@ def _interp_args(interpret):
                 admits (tests/test_ring_pallas.py::TestFlowControl).
     """
     if interpret == "threaded":
-        return pltpu.InterpretParams(detect_races=True), True, True
+        if not HAS_THREADED_INTERPRET:
+            raise NotImplementedError(
+                "interpret='threaded' needs pltpu.InterpretParams (or the "
+                "older TPUInterpretParams — the threaded TPU interpreter), "
+                "which this jaxlib does not ship — run the flow-control "
+                "battery on a newer JAX, or use interpret=True for the "
+                "discharge interpreter")
+        return _InterpretParams(detect_races=True), True, True
     return bool(interpret), not interpret, bool(interpret)
 
 
@@ -306,8 +324,7 @@ def _ring_ids(axis_name: Optional[str]) -> jax.Array:
     time: a silent mismatch would RDMA to the wrong chip."""
     if axis_name is None:
         return jnp.zeros((3,), jnp.int32)
-    from jax.sharding import get_abstract_mesh
-    sizes = dict(get_abstract_mesh().shape)
+    sizes = compat.mesh_axis_sizes()
     other = {a: s for a, s in sizes.items()
              if a != axis_name and s != 1}
     if other:
@@ -345,7 +362,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((chunk_rows, LANES), jnp.float32,
+        out_shape=compat.shape_dtype_struct((chunk_rows, LANES), jnp.float32,
                                        vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM)],
@@ -358,7 +375,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
     )(ids, x2)
@@ -606,7 +623,7 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     acc = pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((L_rows, LANES), jnp.float32,
+        out_shape=compat.shape_dtype_struct((L_rows, LANES), jnp.float32,
                                        vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pl.ANY)],
@@ -624,7 +641,7 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
             pltpu.SemaphoreType.DMA((2,)),                 # rdma recv
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
     )(ids, x2)
@@ -739,7 +756,7 @@ def _ag_call(own2, axis_name: Optional[str], block_size: int,
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((n * R, LANES), jnp.float32,
+        out_shape=compat.shape_dtype_struct((n * R, LANES), jnp.float32,
                                        vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.VMEM)],
@@ -751,7 +768,7 @@ def _ag_call(own2, axis_name: Optional[str], block_size: int,
             pltpu.SemaphoreType.DMA((2,)),
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
     )(ids, own2)
@@ -1123,7 +1140,7 @@ def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,
-        out_shape=jax.ShapeDtypeStruct((n * C_rows, LANES), jnp.float32,
+        out_shape=compat.shape_dtype_struct((n * C_rows, LANES), jnp.float32,
                                        vma=vma),
         in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM),
@@ -1142,7 +1159,7 @@ def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
             pltpu.SemaphoreType.DMA((n_slots,)),           # rdma recv
             pltpu.SemaphoreType.REGULAR,
         ],
-        compiler_params=pltpu.CompilerParams(
+        compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
     )(ids, sched, own2)

@@ -1,5 +1,6 @@
 from . import multihost, pipeline
 from .ddp import DDPState, DDPTrainer
+from .elastic import ElasticConfig, ElasticTrainer, RecoveryExhausted
 from .fsdp import FSDPState, FSDPTrainer
 from .mesh import make_mesh
 from .queued import QueuedDDPTrainer
@@ -9,4 +10,5 @@ from .train import DPTrainer, TrainState
 __all__ = ["make_mesh", "DPTrainer", "TrainState",
            "ShardedTrainer", "ShardedState",
            "DDPTrainer", "DDPState", "QueuedDDPTrainer",
-           "FSDPTrainer", "FSDPState", "pipeline", "multihost"]
+           "FSDPTrainer", "FSDPState", "pipeline", "multihost",
+           "ElasticTrainer", "ElasticConfig", "RecoveryExhausted"]

@@ -1,0 +1,328 @@
+"""Elastic recovery loop — the supervised step driver the reference lacks.
+
+The reference's failure story ends at detection zero: an OPAE read that
+never completes hangs the training loop forever (hw/README:3-5), the
+`kill_syn_e0` kill CSR is declared but never wired (hw/all_reduce.sv:83),
+and the documented remedy is a human running a full shell reset
+(sw/mlp_mpi_example_f32.cpp:54-57).  ``runtime.watchdog`` ships detection
+primitives and ``utils.checkpoint`` ships restore; this module composes
+them — plus ``parallel.multihost`` control-plane re-init and the
+``runtime.chaos`` integrity guards — into one supervised loop that turns
+every detected fault into a bounded recovery instead of a lost job:
+
+    ElasticTrainer.run:
+        for each step:
+            plan.begin_step(step)                  # chaos only: arm faults
+            watchdog.run(                          # hang -> DeviceHangError
+                queue.issue(state, batch)          # host issue boundary
+                queue.wait(ticket))                # host wait boundary
+            check_step_diag(metrics)               # wire corruption -> raise
+            drift_guard(loss / grad_norm)          # garbage-in -> raise
+            heartbeat.beat(); maybe checkpoint
+        on failure:
+            classify -> record fault (observability.RecoveryStats)
+            preemption: multihost re-init
+            restore last-good checkpoint -> retry with backoff
+
+Detection layers and what each catches:
+
+  watchdog timeout      the reference's infinite hang (a wedged dispatch,
+                        a straggler that never returns)
+  IntegrityError        collective corruption (chaos.collective_integrity
+                        inside the jitted step — NaN/inf or checksum
+                        drift on the reduce-scatter; the update was
+                        already gated out in-graph, so master weights
+                        stay clean)
+  NormDriftGuard        host-visible garbage: non-finite or exploding
+                        loss / gradient norm, e.g. a corrupted batch or
+                        host-side payload damage the wire checks cannot
+                        see
+  InjectedPreemption /  transient driver or control-plane loss; the
+  other exceptions      preemption path re-runs multihost.initialize
+                        before restoring
+
+Because the fused trainers jit their step with ``donate_argnums=(0,)``, a
+failed attempt may have consumed the input state's buffers — retrying from
+the in-memory pytree is not generally possible.  The loop therefore
+checkpoints every ``ckpt_every`` steps (plus once before the first step)
+and recovers by restoring the last-good checkpoint, replaying the steps
+since: the loop is keyed on ``int(state.step)``, so a rewind re-requests
+the same batches from ``batch_fn`` and re-arms nothing (a FaultPlan fires
+each spec at most once — injected faults are transient by construction,
+like the hang they model).
+
+Every event lands in ``Profiler.recovery`` (utils.observability), so the
+stats dump carries fault counts, restore counts and MTTR next to the
+collective counters — the observable proof that the gap vs the reference
+is closed, not merely argued.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Optional, Tuple, Union
+
+import jax
+import numpy as np
+
+from . import multihost
+from ..runtime import chaos as chaos_lib
+from ..runtime.queue import CollectiveQueue
+from ..runtime.watchdog import DeviceHangError, Heartbeat, Watchdog
+from ..utils.checkpoint import Checkpointer
+from ..utils.observability import Profiler
+
+__all__ = ["ElasticConfig", "ElasticTrainer", "RecoveryExhausted"]
+
+
+class RecoveryExhausted(RuntimeError):
+    """A step kept failing after max_retries recoveries — the fault is not
+    transient (or the recovery path itself is broken); escalate instead of
+    looping forever the way the reference's wait() poll does."""
+
+
+@dataclass(frozen=True)
+class ElasticConfig:
+    """Knobs for the supervised loop.  Defaults suit production cadence;
+    tests and the chaos bench shrink the timeouts to sub-second."""
+
+    step_timeout_s: float = 300.0     # watchdog limit per step attempt
+    stall_after_s: float = 600.0      # heartbeat staleness for monitors
+    max_retries: int = 3              # recoveries per step before giving up
+    backoff_s: float = 0.05           # exponential backoff base
+    ckpt_every: int = 1               # checkpoint cadence (steps)
+    drift_factor: float = 1e3         # NormDriftGuard trip factor
+    drift_warmup: int = 3             # clean samples before drift arms
+    # master-shard guard: validate what the checkpoint will persist
+    # (w_own + opt_state) for finiteness and norm drift BEFORE a step's
+    # state is accepted.  Catches host-side payload corruption the loss
+    # cannot see until the NEXT step — by which time the poisoned state
+    # would already be the restore target.  Costs a device->host pull of
+    # the master shard per step, so: None = on only when a FaultPlan is
+    # armed (chaos runs), True/False = forced.
+    master_guard: Optional[bool] = None
+
+
+class ElasticTrainer:
+    """Supervised elastic wrapper around a fused trainer (``DPTrainer`` or
+    API-compatible: ``step_fn``, ``restore_state``, ``cfg.collective``).
+
+    ``plan`` (a ``runtime.chaos.FaultPlan``) is optional and only for
+    fault-injection runs: the loop arms it per step and routes the step
+    dispatch through a ``CollectiveQueue`` carrying the plan, so the
+    queue.issue / queue.wait host boundaries fire; the collective site
+    fires via the ring tap (``chaos.install_collective_tap``) compiled
+    into the step, and the staging site via ``stage_fn`` (a host batch
+    pass, e.g. a ``runtime.staging.Stager`` roundtrip).
+
+    The loop itself is chaos-agnostic: with ``plan=None`` it is a plain
+    production supervisor — watchdog, integrity/drift checks, heartbeat,
+    checkpoint cadence, restore-on-failure.
+    """
+
+    def __init__(self, trainer, ckpt_dir: str,
+                 cfg: Optional[ElasticConfig] = None, *,
+                 plan: Optional[chaos_lib.FaultPlan] = None,
+                 stage_fn: Optional[Callable[[Any], Any]] = None,
+                 profiler: Optional[Profiler] = None):
+        self.trainer = trainer
+        self.cfg = cfg or ElasticConfig()
+        self.plan = plan
+        self.stage_fn = stage_fn
+        self.profiler = profiler or Profiler()
+        self.watchdog = Watchdog(self.cfg.step_timeout_s)
+        self.heartbeat = Heartbeat(stall_after_s=self.cfg.stall_after_s)
+        self.ckpt = Checkpointer(ckpt_dir)
+        self.loss_guard = chaos_lib.NormDriftGuard(
+            factor=self.cfg.drift_factor, warmup=self.cfg.drift_warmup)
+        self.gnorm_guard = chaos_lib.NormDriftGuard(
+            factor=self.cfg.drift_factor, warmup=self.cfg.drift_warmup)
+        self.wnorm_guard = chaos_lib.NormDriftGuard(
+            factor=self.cfg.drift_factor, warmup=self.cfg.drift_warmup)
+        self._guard_state = (self.cfg.master_guard if self.cfg.master_guard
+                             is not None else plan is not None)
+        # one dispatch in flight at a time; the queue exists for its
+        # issue/wait boundaries (stall attribution + chaos hooks), the
+        # same two host-visible points the reference ABI exposes
+        self.queue = CollectiveQueue(
+            lambda state, batch: self.trainer.step_fn(state, batch),
+            trainer.cfg.collective, self.profiler, chaos=plan)
+
+    # -- one attempt (runs inside the watchdog worker thread) ---------------
+
+    def _attempt(self, state, batch):
+        if self.stage_fn is not None:
+            batch = self.stage_fn(batch)
+        ticket = self.queue.issue(state, batch)
+        return self.queue.wait(ticket)
+
+    # -- detection ----------------------------------------------------------
+
+    def _check(self, metrics, step: int) -> Dict:
+        """Host verdict on a completed step's outputs; raises
+        IntegrityError on any tripped guard.  Returns metrics as a dict."""
+        if not isinstance(metrics, dict):
+            metrics = {"loss": metrics}
+        chaos_lib.check_step_diag(metrics, step)
+        self.loss_guard.check(float(metrics["loss"]), "loss")
+        if "grad_norm" in metrics:
+            self.gnorm_guard.check(float(metrics["grad_norm"]), "grad_norm")
+        return metrics
+
+    def _check_state(self, state, step: int) -> None:
+        """Validate exactly what a checkpoint would persist (the master
+        shard + optimizer state): non-finite values or a norm jump mean
+        the state must not become the restore target.  The working params
+        are NOT checked — checkpoints drop them and restore rematerializes
+        from the masters, so params damage is covered by the next step's
+        loss guard against a still-clean checkpoint."""
+        if not self._guard_state:
+            return
+        total = 0.0
+        for name in ("w_own", "w_master"):
+            leaf = getattr(state, name, None)
+            if leaf is None:
+                continue
+            host = np.asarray(jax.device_get(leaf), np.float32)
+            bad = int(np.size(host) - np.isfinite(host).sum())
+            if bad:
+                raise chaos_lib.IntegrityError(
+                    f"master shard '{name}' holds {bad} non-finite "
+                    f"values after step {step} — refusing to accept "
+                    "(a checkpoint of this state would poison recovery)")
+            total += float(np.sum(host * host, dtype=np.float64))
+        if total:
+            self.wnorm_guard.check(np.sqrt(total), "master_norm")
+        for k, v in (getattr(state, "opt_state", None) or {}).items():
+            host = np.asarray(jax.device_get(v))
+            if np.issubdtype(host.dtype, np.floating) and \
+                    not np.isfinite(host).all():
+                raise chaos_lib.IntegrityError(
+                    f"optimizer state '{k}' went non-finite at step {step}")
+
+    # -- recovery -----------------------------------------------------------
+
+    @staticmethod
+    def _classify(err: BaseException) -> str:
+        if isinstance(err, chaos_lib.InjectedPreemption):
+            return "preemption"
+        if isinstance(err, DeviceHangError):
+            return "hang"
+        if isinstance(err, chaos_lib.IntegrityError):
+            return "corruption"
+        if isinstance(err, chaos_lib.InjectedFault):
+            return err.kind
+        return "error"
+
+    def _restore(self):
+        """Last-good state from the checkpoint directory.  The loop saved
+        one before the first step, so this always has a target."""
+        step = self.ckpt.latest_step()
+        if step is None:
+            raise RuntimeError(
+                f"no checkpoint under {self.ckpt.directory} to restore "
+                "from (run() saves step 0 before the loop; direct step() "
+                "callers must checkpoint() first)")
+        return self.trainer.restore_state(self.ckpt.restore(step))
+
+    def checkpoint(self, state) -> str:
+        return self.ckpt.save(int(state.step), state)
+
+    # -- the supervised step ------------------------------------------------
+
+    def step(self, state, batch,
+             batch_fn: Optional[Callable[[int], Any]] = None
+             ) -> Tuple[Any, Dict]:
+        """One training step that survives detected faults: attempt ->
+        detect -> (record, re-init if preempted, restore, backoff) ->
+        retry, up to cfg.max_retries recoveries.
+
+        ``batch_fn`` (step -> batch) lets a restore that rewinds to an
+        EARLIER step (ckpt_every > 1) re-fetch that step's batch; without
+        it the retry can only reuse ``batch``, which is wrong data for a
+        rewound step — run() always passes it."""
+        step_i = int(state.step)
+        if self.plan is not None:
+            self.plan.begin_step(step_i)
+        t_fault = None
+        event = None
+        restored = False
+        for attempt in range(self.cfg.max_retries + 1):
+            try:
+                new_state, metrics = self.watchdog.run(
+                    self._attempt, state, batch,
+                    timeout_s=self.cfg.step_timeout_s)
+                metrics = self._check(metrics, step_i)
+                self._check_state(new_state, step_i)
+            except Exception as err:  # noqa: BLE001 — the recovery boundary
+                kind = self._classify(err)
+                now = time.monotonic()
+                t_fault = t_fault if t_fault is not None else now
+                ev = self.profiler.recovery.record_fault(
+                    kind, step_i, site=getattr(err, "site", ""),
+                    error=repr(err))
+                event = event or ev
+                # a failed attempt's ticket may be un-waitable (a wedged
+                # dispatch): drop the window or stale tickets eventually
+                # wedge issue() itself
+                self.queue.abandon()
+                if attempt >= self.cfg.max_retries:
+                    self.profiler.recovery.failed_recoveries += 1
+                    raise RecoveryExhausted(
+                        f"step {step_i} failed {attempt + 1} times "
+                        f"(last: {kind}); giving up after max_retries="
+                        f"{self.cfg.max_retries}") from err
+                if kind == "preemption":
+                    # the process 'lost its slice': control-plane re-init
+                    # before touching devices again (idempotent; a no-op
+                    # single-process, the real thing on a pod restart)
+                    multihost.initialize()
+                state = self._restore()
+                restored = True
+                if int(state.step) != step_i:
+                    # the restore rewound past this step (ckpt_every > 1):
+                    # the retry now trains the REWOUND step, so it needs
+                    # that step's batch and fault arming, not this one's
+                    step_i = int(state.step)
+                    if batch_fn is not None:
+                        batch = batch_fn(step_i)
+                    if self.plan is not None:
+                        self.plan.begin_step(step_i)
+                time.sleep(self.cfg.backoff_s * (2 ** attempt))
+            else:
+                if t_fault is not None:
+                    self.profiler.recovery.record_recovery(
+                        time.monotonic() - t_fault, restored=restored,
+                        event=event)
+                self.heartbeat.beat()
+                return new_state, metrics
+        raise AssertionError("unreachable")
+
+    # -- the supervised loop ------------------------------------------------
+
+    def run(self, state, batch_fn: Union[Callable[[int], Any], list],
+            n_steps: int) -> Tuple[Any, Dict]:
+        """Drive training to ``state.step == n_steps`` under supervision.
+
+        ``batch_fn(step) -> sharded batch`` (a list works too); it is
+        re-invoked for replayed steps after a checkpoint restore, so it
+        must be deterministic per step for exact replay (the loaders'
+        seeded-shuffle contract already guarantees this).
+        """
+        if callable(batch_fn):
+            get_batch = batch_fn
+        else:
+            batches = list(batch_fn)
+            get_batch = lambda i: batches[i]  # noqa: E731
+        if self.ckpt.latest_step() is None:
+            self.checkpoint(state)           # a restore target always exists
+        metrics: Dict = {}
+        while int(state.step) < n_steps:
+            step_i = int(state.step)
+            state, metrics = self.step(state, get_batch(step_i),
+                                       batch_fn=get_batch)
+            if (int(state.step) % self.cfg.ckpt_every == 0
+                    or int(state.step) >= n_steps):
+                self.checkpoint(state)
+        return state, metrics

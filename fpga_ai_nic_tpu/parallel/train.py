@@ -37,6 +37,7 @@ from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..ops import fused_update
+from ..runtime import chaos
 from ..utils.config import TrainConfig
 
 
@@ -115,11 +116,32 @@ class DPTrainer:
             loss, grads = accum.accumulated_value_and_grad(
                 self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
-            g_own = fused_update.reduce_scatter(flat_g, ax, coll) / self.n
+            diag = {}
+            if coll.integrity_check:
+                expect, l1 = chaos.chunk_checksums(flat_g, ax, self.n)
+            g_red = fused_update.reduce_scatter(flat_g, ax, coll)
+            if coll.integrity_check:
+                tol = (coll.integrity_tol if coll.integrity_tol is not None
+                       else chaos.integrity_tol(coll, self.n))
+                diag = chaos.collective_integrity(expect, l1, g_red, ax,
+                                                  self.n, tol)
+            g_own = g_red / self.n
+            if coll.integrity_check:
+                diag["grad_norm"] = jnp.sqrt(
+                    lax.psum(jnp.sum(g_own.astype(jnp.float32) ** 2), ax))
             g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
-            return w_new, opt_state2, lax.pmean(loss, ax)
+            if coll.integrity_check:
+                # gate the update: a corrupted reduce-scatter must not
+                # reach the master weights — the step becomes a no-op and
+                # the host decides (retry / restore) from the diag verdict
+                ok = diag["integrity_ok"]
+                w_new = jnp.where(ok, w_new, w_own)
+                opt_state2 = jax.tree_util.tree_map(
+                    lambda new, old: jnp.where(ok, new, old),
+                    opt_state2, opt_state)
+            return w_new, opt_state2, lax.pmean(loss, ax), diag
 
         # Phase 2 (no autodiff): all-gather updated weights -> replicated
         # working params (the reference's host write-back of w_new,
@@ -129,15 +151,21 @@ class DPTrainer:
             return fused_update.unflatten_tree(flat_w, meta)
 
         def _step(state: TrainState, batch):
-            w_own, opt_state, loss = jax.shard_map(
+            w_own, opt_state, loss, diag = jax.shard_map(
                 shard_update, mesh=self.mesh,
                 in_specs=(P(), P(ax), P(ax), P(), P(ax)),
-                out_specs=(P(ax), P(ax), P()),
+                out_specs=(P(ax), P(ax), P(), P()),
             )(state.params, state.w_own, state.opt_state, state.step, batch)
             new_params = jax.shard_map(
                 shard_gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
                 check_vma=False)(w_own)
-            return TrainState(new_params, w_own, opt_state, state.step + 1), loss
+            new_state = TrainState(new_params, w_own, opt_state,
+                                   state.step + 1)
+            if coll.integrity_check:
+                # metrics dict instead of the bare loss: the elastic loop
+                # (parallel.elastic) reads the integrity verdict from here
+                return new_state, dict(diag, loss=loss)
+            return new_state, loss
 
         return jax.jit(_step, donate_argnums=(0,))
 

@@ -1,6 +1,6 @@
-from . import native, staging  # noqa: F401
+from . import chaos, native, staging  # noqa: F401
 from .queue import CollectiveQueue, Ticket
 from .watchdog import DeviceHangError, Heartbeat, Watchdog, run_with_recovery
 
 __all__ = ["CollectiveQueue", "Ticket", "native", "staging", "Watchdog",
-           "Heartbeat", "DeviceHangError", "run_with_recovery"]
+           "Heartbeat", "DeviceHangError", "run_with_recovery", "chaos"]

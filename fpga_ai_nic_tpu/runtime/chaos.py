@@ -1,0 +1,433 @@
+"""Deterministic fault injection + collective integrity checking.
+
+The reference's defining failure mode is a nondeterministic infinite hang
+with no recovery path: OPAE reads/writes to on-board memory never complete
+(hw/README:3-5), the `kill_syn_e0` kill CSR is declared but never wired
+(hw/all_reduce.sv:83), and the only remedy is a full shell reset
+(sw/mlp_mpi_example_f32.cpp:54-57).  `runtime.watchdog` ships the
+*detection* half; this module ships the half that makes detection
+testable: a seeded, deterministic fault plan that can provoke every
+failure class on demand, at the three device-touching boundaries —
+
+  - ``queue.issue`` / ``queue.wait``  (runtime/queue.py host issue loop)
+  - ``staging``                       (runtime/staging.py host batch gather)
+  - ``collective``                    (the explicit-ring reduce-scatter AND
+                                       all-gather in ops/ring.py, via a
+                                       pure_callback tap that executes
+                                       INSIDE the jitted program; the
+                                       TPU-only fused ring_pallas kernel
+                                       path is NOT tapped — off-TPU it
+                                       falls back onto the tapped ring)
+
+plus the collective-integrity layer the compressed wire path needs:
+per-chunk checksums across the all-reduce (input contribution sums vs the
+reduced output), a NaN/inf guard, and a host-side gradient-norm drift
+guard — BFP quantization is *bounded* error, so anything outside the bound
+is corruption, caught before the optimizer consumes it.
+
+Fault classes (``FAULT_KINDS``):
+
+  hang        sleep far past the watchdog limit — the reference's OPAE
+              poll-forever, provoked on purpose.
+  slowdown    sleep below the limit — a straggler hop/host; must be
+              survived WITHOUT recovery.
+  exception   raise InjectedFault — a transient driver/tunnel error.
+  corruption  silently damage the payload (NaN / high-bit flip / scale) —
+              the failure a compressed wire adds and checksums must catch.
+  preemption  raise InjectedPreemption — the process lost its device slice
+              (TPU preemption); recovery must re-init + restore.
+
+Sites are host boundaries except ``collective``, whose faults run inside
+the compiled step via `jax.pure_callback` (sleep or corrupt only — raising
+inside an XLA callback aborts the runtime rather than unwinding the step,
+so transient-exception faults belong to the host sites).
+
+Everything is deterministic under a fixed seed: the plan's spec list, the
+corrupted indices, and the flipped bits all derive from
+``numpy.random.default_rng(seed)`` — a failing chaos run replays exactly.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field, replace
+from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+__all__ = [
+    "FAULT_KINDS", "SITES", "CORRUPTION_MODES",
+    "InjectedFault", "InjectedPreemption", "IntegrityError",
+    "FaultSpec", "FaultPlan", "NormDriftGuard",
+    "chunk_checksums", "collective_integrity", "integrity_tol",
+    "check_step_diag", "install_collective_tap", "uninstall_collective_tap",
+    "activate",
+]
+
+FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
+SITES = ("queue.issue", "queue.wait", "staging", "collective")
+CORRUPTION_MODES = ("nan", "bitflip", "scale")
+
+# faults that can run inside an XLA callback (no raising in there)
+_CALLBACK_KINDS = ("hang", "slowdown", "corruption")
+
+
+class InjectedFault(RuntimeError):
+    """A fault raised on purpose by a FaultPlan (transient by contract)."""
+
+    def __init__(self, spec: "FaultSpec"):
+        super().__init__(f"injected {spec.kind} at {spec.site} "
+                         f"(step {spec.step})")
+        self.spec = spec
+        self.kind = spec.kind
+        self.site = spec.site
+
+
+class InjectedPreemption(InjectedFault):
+    """The process 'lost its device slice' — recovery requires control-plane
+    re-init + checkpoint restore, not a plain retry."""
+
+
+class IntegrityError(RuntimeError):
+    """A collective/loss integrity guard tripped: the step's numbers cannot
+    be trusted and must not reach (or have been gated out of) the
+    optimizer."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    """One planned fault: fire ``kind`` at ``site`` on trainer step
+    ``step``.  ``duration_s`` is the sleep for hang/slowdown (a hang is a
+    sleep chosen to exceed the watchdog limit; the daemon worker thread
+    absorbs it).  ``mode``/``fraction`` shape corruption."""
+
+    kind: str
+    site: str
+    step: int
+    duration_s: float = 0.25
+    mode: str = "nan"             # corruption: "nan" | "bitflip" | "scale"
+    fraction: float = 0.01        # corrupted element fraction (>= 1 elem)
+
+    def __post_init__(self):
+        assert self.kind in FAULT_KINDS, self.kind
+        assert self.site in SITES, self.site
+        assert self.mode in CORRUPTION_MODES, self.mode
+        if self.site == "collective" and self.kind not in _CALLBACK_KINDS:
+            raise ValueError(
+                f"{self.kind!r} cannot fire at the 'collective' site: it "
+                "executes inside an XLA callback, where raising aborts the "
+                "runtime instead of unwinding the step — plan it at a host "
+                "site (queue.*/staging) instead")
+
+
+class FaultPlan:
+    """A deterministic schedule of FaultSpecs plus the machinery that fires
+    them.  Thread-safe: host hooks and the in-program collective tap may
+    run concurrently (queue issue thread vs XLA callback threads).
+
+    Protocol with the hook sites::
+
+        plan.begin_step(i)          # trainer loop, before dispatching step i
+        plan.fire(site)             # host boundary: may sleep or raise
+        x = plan.corrupt(site, x)   # host boundary carrying a payload
+        y = plan.collective_payload(y)   # inside jit, via the ring tap
+
+    Each spec fires at most once (``fired``) so a recovery retry of the
+    same step re-runs clean — the injected fault is transient by
+    construction, like the reference's nondeterministic hang."""
+
+    def __init__(self, faults: Iterable[FaultSpec] = (), seed: int = 0):
+        self.faults: Tuple[FaultSpec, ...] = tuple(faults)
+        self.seed = seed
+        self.fired: List[FaultSpec] = []
+        self._step = -1
+        self._lock = threading.RLock()
+
+    # -- construction -------------------------------------------------------
+
+    @classmethod
+    def random(cls, seed: int, n_steps: int, *, rate: float = 0.25,
+               kinds: Sequence[str] = FAULT_KINDS,
+               sites: Sequence[str] = SITES,
+               duration_s: float = 0.25) -> "FaultPlan":
+        """Seeded random plan: each step draws one fault with probability
+        ``rate``; kind/site/mode are drawn uniformly from the legal
+        combinations.  Same seed -> identical plan, always."""
+        rng = np.random.default_rng(seed)
+        specs = []
+        for step in range(n_steps):
+            if rng.random() >= rate:
+                continue
+            site = str(rng.choice(list(sites)))
+            legal = [k for k in kinds
+                     if site != "collective" or k in _CALLBACK_KINDS]
+            if not legal:
+                continue
+            kind = str(rng.choice(legal))
+            specs.append(FaultSpec(
+                kind=kind, site=site, step=step, duration_s=duration_s,
+                mode=str(rng.choice(list(CORRUPTION_MODES)))))
+        return cls(specs, seed=seed)
+
+    # -- stepping -----------------------------------------------------------
+
+    def begin_step(self, step: int) -> None:
+        with self._lock:
+            self._step = int(step)
+
+    @property
+    def step(self) -> int:
+        return self._step
+
+    def _take(self, site: str, kinds: Sequence[str],
+              limit: Optional[int] = None) -> List[FaultSpec]:
+        """Pop (mark fired) the unfired specs matching (site, current step,
+        kinds).  Fired-ness is per spec INSTANCE (identity, not dataclass
+        equality): a plan may deliberately schedule several equal specs —
+        e.g. one per expected retry — and each must fire exactly once.
+        ``limit`` caps how many are popped per call: raising hooks take one
+        at a time, so sibling specs stay armed for the retry."""
+        with self._lock:
+            fired_ids = {id(f) for f in self.fired}
+            out = [s for s in self.faults
+                   if s.site == site and s.step == self._step
+                   and s.kind in kinds and id(s) not in fired_ids]
+            if limit is not None:
+                out = out[:limit]
+            self.fired.extend(out)
+            return out
+
+    # -- host-side firing ---------------------------------------------------
+
+    def fire(self, site: str) -> None:
+        """Host boundary hook: sleeps for hang/slowdown, raises for
+        exception/preemption.  Corruption specs are left for corrupt()."""
+        for spec in self._take(site, ("hang", "slowdown")):
+            time.sleep(spec.duration_s)
+        for spec in self._take(site, ("preemption",), limit=1):
+            raise InjectedPreemption(spec)
+        for spec in self._take(site, ("exception",), limit=1):
+            raise InjectedFault(spec)
+
+    def corrupt(self, site: str, tree: Any) -> Any:
+        """Apply any pending corruption specs at ``site`` to a pytree of
+        arrays; returns the tree unchanged (same objects, zero copies) when
+        nothing fires."""
+        specs = self._take(site, ("corruption",))
+        if not specs:
+            return tree
+        import jax
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        for spec in specs:
+            # corrupt the largest float leaf: for a batch that is the
+            # payload (not e.g. int labels); for a (state, batch) tree —
+            # the queue.issue boundary — whichever of the master shard
+            # and the batch is bigger, so the guard layer that catches
+            # it depends on model-vs-batch size (both layers are pinned
+            # down by the dedicated queue.wait / staging cells)
+            fl = [i for i, l in enumerate(leaves)
+                  if np.issubdtype(np.asarray(l).dtype, np.floating)]
+            if not fl:
+                continue
+            i = max(fl, key=lambda j: np.asarray(leaves[j]).size)
+            leaves[i] = self._corrupt_array(np.array(leaves[i]), spec)
+        return jax.tree_util.tree_unflatten(treedef, leaves)
+
+    def _corrupt_array(self, arr: np.ndarray, spec: FaultSpec) -> np.ndarray:
+        """Deterministic damage: indices and bits derive from
+        (plan seed, spec step) only."""
+        rng = np.random.default_rng((self.seed, spec.step, 0xC0FFEE))
+        flat = arr.reshape(-1)
+        k = max(1, int(flat.size * spec.fraction))
+        idx = rng.choice(flat.size, size=min(k, flat.size), replace=False)
+        if spec.mode == "nan":
+            flat[idx] = np.nan
+        elif spec.mode == "scale":
+            flat[idx] = flat[idx] * np.float32(1e8) + np.float32(1e8)
+        else:                                   # bitflip: exponent-high bit
+            f32 = flat.astype(np.float32, copy=True)
+            bits = f32.view(np.uint32)
+            bits[idx] ^= np.uint32(1 << 30)
+            flat[:] = f32.astype(flat.dtype)
+        return arr
+
+    def stage(self, batch: Any) -> Any:
+        """The host staging boundary as one call (fire, then corrupt):
+        what ``runtime.staging.Stager`` does internally when constructed
+        with ``chaos=plan``, for callers staging batches without the
+        native gather library (the elastic loop's ``stage_fn``)."""
+        self.fire("staging")
+        return self.corrupt("staging", batch)
+
+    # -- in-program (collective) path --------------------------------------
+
+    def collective_payload(self, arr: np.ndarray) -> np.ndarray:
+        """The host half of the collective tap: called from inside the
+        compiled step (one call per shard).  Sleeps for a pending
+        hang/slowdown ON THE FIRST SHARD TO ARRIVE (a straggler device);
+        corrupts the first arriving shard's payload for corruption specs."""
+        for spec in self._take("collective", ("hang", "slowdown")):
+            time.sleep(spec.duration_s)
+        for spec in self._take("collective", ("corruption",)):
+            arr = self._corrupt_array(np.array(arr), spec)
+        return arr
+
+
+# ---------------------------------------------------------------------------
+# the collective tap (ops.ring / ops.ring_pallas boundary)
+# ---------------------------------------------------------------------------
+
+_ACTIVE_PLAN: Optional[FaultPlan] = None
+
+
+def _tap_fn(x, point: str):
+    """Trace-time tap body installed into ops.ring: routes the payload
+    through the ACTIVE plan on the host.  The callback executes on every
+    step of the compiled program; with no active plan (or no pending spec)
+    it is an identity copy."""
+    import jax
+
+    def host(v):
+        plan = _ACTIVE_PLAN
+        a = np.asarray(v)
+        if plan is None:
+            return a
+        return np.asarray(plan.collective_payload(a), dtype=a.dtype)
+
+    return jax.pure_callback(host, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+
+def install_collective_tap() -> None:
+    """Install the chaos tap into the explicit-ring collectives.  Must run
+    BEFORE the trainer's step is first traced (the tap is compiled into the
+    program); per-run plans are then switched via activate()."""
+    from ..ops import ring
+    ring.set_fault_tap(_tap_fn)
+
+
+def uninstall_collective_tap() -> None:
+    from ..ops import ring
+    ring.set_fault_tap(None)
+
+
+class activate:
+    """Context manager binding a plan as the ambient target of the
+    collective tap (and a convenience holder for host hooks).
+
+    Dispatch is async: the tap's callback reads the ambient plan from XLA
+    callback threads while the program runs, so any step that should see
+    the plan must COMPLETE (``jax.block_until_ready`` on its outputs, or a
+    blocking ``queue.wait``) before this context exits — the elastic loop
+    already blocks per step inside ``_check``."""
+
+    def __init__(self, plan: Optional[FaultPlan]):
+        self.plan = plan
+
+    def __enter__(self):
+        global _ACTIVE_PLAN
+        self._prev = _ACTIVE_PLAN
+        _ACTIVE_PLAN = self.plan
+        return self.plan
+
+    def __exit__(self, *exc):
+        global _ACTIVE_PLAN
+        _ACTIVE_PLAN = self._prev
+        return False
+
+
+# ---------------------------------------------------------------------------
+# collective integrity (pure JAX — runs inside shard_map)
+# ---------------------------------------------------------------------------
+
+def integrity_tol(coll, n: int) -> float:
+    """Checksum tolerance for an n-way all-reduce under the configured wire
+    format.  Uncompressed rings/psum differ from the input sums only by
+    f32 reassociation; BFP adds a bounded per-hop quantization error
+    (<= 2^(1-mantissa_bits) of the block max per element per hop), so the
+    chunk-sum discrepancy is bounded by ~(n-1) * 2^(1-m) * (blockmax/mean)
+    of the chunk L1.  The tolerance is a GROSS-corruption tripwire (NaN,
+    flipped exponent bits, runaway scale), not a bit-exactness check —
+    in-bound quantization noise must pass."""
+    comp = getattr(coll, "compression", None)
+    if comp is None:
+        return 1e-3
+    return min(0.5, (n - 1) * (2.0 ** (1 - comp.mantissa_bits)) * 8.0)
+
+
+def chunk_checksums(flat: "Any", axis_name: str, n: int):
+    """Inside shard_map: per-chunk input checksums of a local flat [L]
+    contribution, reduced across the axis.  Returns (expect[n], l1[n]):
+    expect[b] is the true sum of reduced chunk b; l1[b] the matching scale
+    for a relative comparison."""
+    import jax.numpy as jnp
+    from jax import lax
+    sums = flat.reshape(n, -1).sum(axis=1)
+    l1 = jnp.abs(flat).reshape(n, -1).sum(axis=1)
+    return lax.psum(sums, axis_name), lax.psum(l1, axis_name)
+
+
+def collective_integrity(expect, l1, g_red, axis_name: str, n: int,
+                         tol: float) -> Dict[str, Any]:
+    """Inside shard_map, after ``g_red = reduce_scatter(flat)`` (pre-mean):
+    compares this device's reduced-chunk sum against the input checksum
+    and counts non-finites.  Returns replicated scalar diagnostics::
+
+        integrity_ok   bool  — all chunks within tol AND fully finite
+        integrity_err  f32   — worst relative chunk-sum discrepancy
+        nonfinite      i32   — NaN/inf count across the reduced vector
+
+    ``integrity_ok`` is safe to gate the optimizer with (NaN comparisons
+    come out False, so a poisoned checksum fails closed)."""
+    import jax.numpy as jnp
+    from jax import lax
+    idx = lax.axis_index(axis_name)
+    mine = jnp.sum(g_red.astype(jnp.float32))
+    onehot = (jnp.arange(n) == idx).astype(jnp.float32)
+    # psum of masked per-device sums -> replicated [n] vector of the
+    # actual reduced-chunk sums (all-gather without relying on tiling)
+    got = lax.psum(onehot * mine, axis_name)
+    nonfinite = lax.psum(jnp.sum(~jnp.isfinite(g_red)), axis_name)
+    err = jnp.max(jnp.abs(expect - got) / (l1 + 1e-20))
+    ok = (nonfinite == 0) & (err <= tol)
+    return {"integrity_ok": ok, "integrity_err": err,
+            "nonfinite": nonfinite}
+
+
+def check_step_diag(diag: Dict[str, Any], step: int) -> None:
+    """Host-side verdict on a step's integrity diagnostics (raises
+    IntegrityError).  Call AFTER the step's outputs are materialized."""
+    nonfinite = int(diag.get("nonfinite", 0))
+    ok = bool(diag.get("integrity_ok", True))
+    if nonfinite or not ok:
+        raise IntegrityError(
+            f"collective integrity tripped at step {step}: "
+            f"nonfinite={nonfinite}, "
+            f"rel_err={float(diag.get('integrity_err', float('nan'))):.3g} "
+            "(update was gated out before the optimizer)")
+
+
+@dataclass
+class NormDriftGuard:
+    """Cheap host-side drift guard over a scalar series (gradient norm or
+    loss): trips when the value is non-finite, or after ``warmup`` clean
+    samples jumps ``factor``x above the running median."""
+
+    factor: float = 1e3
+    warmup: int = 3
+    window: int = 32
+    history: List[float] = field(default_factory=list)
+
+    def check(self, value: float, what: str = "grad_norm") -> None:
+        v = float(value)
+        if not np.isfinite(v):
+            raise IntegrityError(f"{what} is non-finite ({v})")
+        h = self.history
+        if len(h) >= self.warmup:
+            med = float(np.median(h[-self.window:]))
+            if med > 0 and v > self.factor * med:
+                raise IntegrityError(
+                    f"{what} drift: {v:.3g} is {v / med:.1f}x the running "
+                    f"median {med:.3g} (factor limit {self.factor:g})")
+        h.append(v)
+        del h[:-self.window]

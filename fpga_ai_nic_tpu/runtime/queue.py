@@ -19,6 +19,7 @@ accounting that XLA does not expose.
 
 from __future__ import annotations
 
+import threading
 import time
 from collections import deque
 from dataclasses import dataclass, field
@@ -39,6 +40,7 @@ class Ticket:
     issued_at: float
     waited: bool = False
     ready_at: Optional[float] = None
+    abandoned: bool = False          # dropped by recovery; never consumed
 
 
 class CollectiveQueue:
@@ -52,22 +54,62 @@ class CollectiveQueue:
     """
 
     def __init__(self, fn: Callable, coll: CollectiveConfig,
-                 profiler: Optional[Profiler] = None):
+                 profiler: Optional[Profiler] = None, chaos=None):
         self.fn = fn
         self.coll = coll
         self.profiler = profiler or Profiler()
+        # fault-injection hook (runtime.chaos.FaultPlan or None): fires at
+        # the issue/wait boundaries — the reference ABI's two host-visible
+        # points, where its real hang lived (the wait() spin,
+        # sw/mlp_mpi_example_f32.cpp:157-180)
+        self.chaos = chaos
         self._inflight: Deque[Ticket] = deque()
         self._uid = 0
+        # bumped by abandon(): an issue() that straddles a recovery (its
+        # worker thread outlived a watchdog timeout) sees the epoch moved
+        # and marks its own ticket abandoned instead of enqueueing it.
+        # _lock serializes the epoch/window/ticket-flag handshake between
+        # recovery and zombie watchdog workers — the unsynchronized check
+        # would let a zombie append a stale ticket right after abandon()
+        # cleared the window, recreating the permanent wedge
+        self._epoch = 0
+        self._lock = threading.Lock()
 
     # -- reference ABI ------------------------------------------------------
 
     def issue(self, *args, raw_bytes: int = 0, wire_bytes: int = 0) -> Ticket:
-        if len(self._inflight) >= self.coll.max_inflight:
-            self.wait(self._inflight[0])
+        with self._lock:
+            epoch = self._epoch
+        while True:
+            with self._lock:
+                if (epoch != self._epoch
+                        or len(self._inflight) < self.coll.max_inflight):
+                    break
+                head = self._inflight[0]
+            self.wait(head)                       # may stall (full window)
+        if self.chaos is not None and epoch == self._epoch:
+            self.chaos.fire("queue.issue")        # may stall (hang spec)
+        with self._lock:
+            alive = epoch == self._epoch
+        if not alive:
+            # recovery abandoned the window while this thread was stalled
+            # above (a timed-out watchdog worker resuming): the attempt is
+            # dead — dispatch nothing, consume no corruption specs, and
+            # hand back a ticket wait() treats as already dropped
+            self.profiler.collectives.abandoned += 1
+            return Ticket(0, None, time.perf_counter(), abandoned=True)
+        if self.chaos is not None:
+            args = self.chaos.corrupt("queue.issue", args)
         result = self.fn(*args)          # async dispatch
-        self._uid += 1
-        t = Ticket(self._uid, result, time.perf_counter())
-        self._inflight.append(t)
+        t = Ticket(0, result, time.perf_counter())
+        with self._lock:
+            if epoch != self._epoch:     # abandoned during the dispatch
+                t.abandoned = True
+                self.profiler.collectives.abandoned += 1
+                return t
+            self._uid += 1
+            t.uid = self._uid
+            self._inflight.append(t)
         st = self.profiler.collectives
         st.issued += 1
         st.raw_bytes += raw_bytes
@@ -77,15 +119,37 @@ class CollectiveQueue:
     def wait(self, ticket: Ticket) -> Any:
         if ticket.waited:
             return ticket.result
+        if ticket.abandoned:
+            # a dead attempt's ticket (see issue()/abandon()): consume no
+            # chaos specs, record no stats — the result is discarded
+            ticket.waited = True
+            return ticket.result
+        if self.chaos is not None:
+            self.chaos.fire("queue.wait")
         t0 = time.perf_counter()
         jax.block_until_ready(ticket.result)
+        with self._lock:
+            if ticket.abandoned:
+                # recovery dropped this ticket while we were blocked (the
+                # watchdog's worker thread outlives its timeout): the
+                # result is never consumed — record nothing, fire nothing,
+                # or the zombie would consume the live run's chaos specs
+                # and inflate completed/stall in the very stats recovery
+                # reports through
+                ticket.waited = True
+                return ticket.result
+            # claim the ticket: from here abandon() can no longer flag it
+            try:
+                self._inflight.remove(ticket)
+            except ValueError:
+                pass
+        if self.chaos is not None:
+            # wire-corruption surface: the materialized result is what the
+            # optimizer will consume
+            ticket.result = self.chaos.corrupt("queue.wait", ticket.result)
         now = time.perf_counter()
         ticket.waited = True
         ticket.ready_at = now
-        try:
-            self._inflight.remove(ticket)
-        except ValueError:
-            pass
         st = self.profiler.collectives
         st.completed += 1
         st.record_latency(now - ticket.issued_at)
@@ -94,8 +158,28 @@ class CollectiveQueue:
         return ticket.result
 
     def wait_all(self):
-        while self._inflight:
-            self.wait(self._inflight[0])
+        while True:
+            with self._lock:
+                if not self._inflight:
+                    return
+                head = self._inflight[0]
+            self.wait(head)
+
+    def abandon(self) -> int:
+        """Drop every inflight ticket WITHOUT waiting.  The recovery path
+        after a detected hang: a wedged dispatch's ticket can never be
+        waited out, and left in the window it wedges issue() itself once
+        max_inflight stale tickets pile up (issue would block forever in
+        wait() on a dead result — the reference's spin, one level up).
+        The dropped results are simply never consumed; returns the count."""
+        with self._lock:
+            self._epoch += 1         # a stalled issue() sees this on resume
+            n = len(self._inflight)
+            for t in self._inflight:
+                t.abandoned = True   # a blocked wait() sees this on resume
+            self._inflight.clear()
+        self.profiler.collectives.abandoned += n
+        return n
 
     @property
     def outstanding(self) -> int:

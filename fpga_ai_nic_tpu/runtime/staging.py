@@ -63,16 +63,20 @@ class Stager:
     or `Stager.sized([b0, b1, ...])` for per-slot capacities (submits claim
     the smallest FREE slot that fits)."""
 
-    def __init__(self, n_slots: int, slot_bytes: int):
-        self._init([slot_bytes] * n_slots)
+    def __init__(self, n_slots: int, slot_bytes: int, chaos=None):
+        self._init([slot_bytes] * n_slots, chaos)
 
     @classmethod
-    def sized(cls, slot_bytes_list) -> "Stager":
+    def sized(cls, slot_bytes_list, chaos=None) -> "Stager":
         self = cls.__new__(cls)
-        self._init(list(slot_bytes_list))
+        self._init(list(slot_bytes_list), chaos)
         return self
 
-    def _init(self, sizes):
+    def _init(self, sizes, chaos=None):
+        # fault-injection hook (runtime.chaos.FaultPlan or None): the host
+        # staging boundary — where a wedged gather worker or a bad DMA
+        # would surface in the reference's C++ driver
+        self.chaos = chaos
         l = lib()
         assert l is not None, "native staging unavailable (csrc build failed)"
         self._l = l
@@ -95,6 +99,8 @@ class Stager:
         the native wait would deadlock — with heterogeneous slot sizes the
         guard must consider capacities, not just counts (a free-but-small
         slot cannot satisfy a large job)."""
+        if self.chaos is not None:
+            self.chaos.fire("staging")
         src = np.ascontiguousarray(src)
         idx = np.ascontiguousarray(idx, np.int64)
         if idx.size and (idx.min() < 0 or idx.max() >= src.shape[0]):
@@ -134,7 +140,12 @@ class Stager:
         self._waited.add(slot)
         n = int(np.prod(shape, dtype=np.int64))
         buf = (ctypes.c_char * (n * dtype.itemsize)).from_address(ptr)
-        return np.frombuffer(buf, dtype=dtype).reshape(shape)
+        out = np.frombuffer(buf, dtype=dtype).reshape(shape)
+        if self.chaos is not None:
+            # corrupt() copies only when a spec fires — the healthy path
+            # keeps the zero-copy view contract
+            out = self.chaos.corrupt("staging", out)
+        return out
 
     def release(self, slot: int) -> None:
         """Return a slot to the pool.  Waits for the gather first if the

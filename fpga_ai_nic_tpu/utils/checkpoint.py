@@ -78,6 +78,7 @@ class Checkpointer:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.compress = compress
+        self._async = async_save
         self._ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
                        if async_save else ocp.PyTreeCheckpointer())
 
@@ -86,41 +87,144 @@ class Checkpointer:
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
 
-    def _layout_path(self) -> str:
+    def _layout_path(self, step: int) -> str:
+        # INSIDE the step directory: the sidecar describes that step's
+        # bytes and travels (and dies) with them.  A directory-scoped
+        # sidecar lets a later plain-order save clear the layout an
+        # earlier step's restore still depends on — restore(earlier)
+        # would then silently permute layers.
+        return os.path.join(self._path(step), self._LAYOUT_FILE)
+
+    def _legacy_layout_path(self) -> str:
+        # directory-scoped sidecar location used by older revisions; read
+        # as a fallback and migrated into the step dirs on the next save
         return os.path.join(self.directory, self._LAYOUT_FILE)
 
-    def save_layout(self, layout: Dict[str, Any]) -> Dict[str, Any]:
-        """Record how the flat master bytes are ordered (e.g. the
-        interleaved-1F1B layer permutation: layers_order / pp /
+    def _migrate_legacy_layout(self) -> None:
+        """Copy a directory-scoped sidecar (older revisions wrote one per
+        DIRECTORY) into every existing step dir that lacks its own, then
+        remove it — after which the per-step rules apply uniformly and a
+        plain-order save can no longer strand older steps layout-less."""
+        legacy = self._legacy_layout_path()
+        if not os.path.exists(legacy):
+            return
+        with open(legacy) as f:
+            layout = json.load(f)
+        for d in os.listdir(self.directory):
+            if re.fullmatch(r"step_\d+", d):
+                p = os.path.join(self.directory, d, self._LAYOUT_FILE)
+                if not os.path.exists(p):
+                    with open(p, "w") as f:
+                        json.dump(layout, f)
+        os.remove(legacy)
+
+    def _apply_sidecar(self, step: int,
+                       layout: Optional[Dict[str, Any]]) -> None:
+        """Write (or, for ``None``, remove) step's sidecar on disk."""
+        if layout is not None:
+            os.makedirs(self._path(step), exist_ok=True)
+            with open(self._layout_path(step), "w") as f:
+                json.dump(layout, f)
+        else:
+            try:
+                os.remove(self._layout_path(step))
+            except FileNotFoundError:
+                pass
+
+    # -- async-save sidecar staging -----------------------------------------
+    # The sidecar must live INSIDE the step dir, but an async save only
+    # materializes that dir when the background write commits (orbax
+    # writes a tmp dir and renames).  So save() stages the layout in a
+    # DURABLE pending file next to the step dir — not in memory — and any
+    # sync point moves it in.  A crash between commit and flush leaves
+    # checkpoint + pending file on disk, and saved_layout()/restore()
+    # honor the pending file, so the layout is never silently lost (the
+    # silent-permute hazard the sidecar exists to prevent).
+
+    def _pending_path(self, step: int) -> str:
+        return os.path.join(self.directory,
+                            f"step_{step:08d}.layout-pending.json")
+
+    def _stage_sidecar(self, step: int,
+                       layout: Optional[Dict[str, Any]]) -> None:
+        tmp = self._pending_path(step) + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump({"layout": layout}, f)
+        os.replace(tmp, self._pending_path(step))
+
+    def _read_pending(self, step: int) -> Optional[Dict[str, Any]]:
+        """The staged {'layout': ...} dict, or None if nothing is staged."""
+        try:
+            with open(self._pending_path(step)) as f:
+                return json.load(f)
+        except FileNotFoundError:
+            return None
+
+    def _flush_pending_sidecars(self, skip_step: Optional[int] = None
+                                ) -> None:
+        """Move staged sidecars into their (now committed) step dirs."""
+        for fname in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)\.layout-pending\.json", fname)
+            if not m:
+                continue
+            step = int(m.group(1))
+            if step == skip_step or not os.path.isdir(self._path(step)):
+                continue                 # not committed yet: stays staged
+            pending = self._read_pending(step)
+            if pending is not None:
+                self._apply_sidecar(step, pending["layout"])
+            os.remove(self._pending_path(step))
+
+    def save_layout(self, layout: Dict[str, Any],
+                    step: int) -> Dict[str, Any]:
+        """Record how step ``step``'s flat master bytes are ordered (e.g.
+        the interleaved-1F1B layer permutation: layers_order / pp /
         virtual_stages).  A checkpoint that carries a layout sidecar can
         only be restored by a caller that declares a MATCHING layout —
         ``restore`` enforces it — so bytes can never be silently
-        reinterpreted under a different pp/v/schedule."""
-        with open(self._layout_path(), "w") as f:
-            json.dump(layout, f)
+        reinterpreted under a different pp/v/schedule.  (Standalone use:
+        waits out any in-flight async save first; ``save(layout=...)``
+        defers instead and never blocks.)"""
+        self.wait_until_finished()
+        self._apply_sidecar(step, layout)
         return layout
 
-    def saved_layout(self) -> Optional[Dict[str, Any]]:
-        if os.path.exists(self._layout_path()):
-            with open(self._layout_path()) as f:
+    def saved_layout(self, step: Optional[int] = None
+                     ) -> Optional[Dict[str, Any]]:
+        if step is None:
+            step = self.latest_step()
+        if step is None:
+            return None
+        pending = self._read_pending(step)     # async save not yet flushed
+        if pending is not None:
+            return pending["layout"]
+        if os.path.exists(self._layout_path(step)):
+            with open(self._layout_path(step)) as f:
+                return json.load(f)
+        # pre-migration checkpoint: a directory-scoped sidecar governs
+        # every step that has no per-step sidecar of its own
+        legacy = self._legacy_layout_path()
+        if os.path.isdir(self._path(step)) and os.path.exists(legacy):
+            with open(legacy) as f:
                 return json.load(f)
         return None
 
-    def _check_layout(self, expect: Optional[Dict[str, Any]]) -> None:
-        saved = self.saved_layout()
+    def _check_layout(self, step: int,
+                      expect: Optional[Dict[str, Any]]) -> None:
+        saved = self.saved_layout(step)
         if saved is None and expect is None:
             return
         if saved is None:
             raise ValueError(
                 f"restore declared layout {expect} but the checkpoint at "
-                f"{self.directory} has no {self._LAYOUT_FILE} sidecar — it "
-                "was saved in plain model order; drop expect_layout or "
+                f"{self._path(step)} has no {self._LAYOUT_FILE} sidecar — "
+                "it was saved in plain model order; drop expect_layout or "
                 "re-save with save_layout()")
         if expect is None:
             raise ValueError(
-                f"checkpoint at {self.directory} carries a layout sidecar "
-                f"{saved} (its flat masters are NOT in model order); pass "
-                "expect_layout= with the run's matching "
+                f"checkpoint at {self._path(step)} carries a layout "
+                f"sidecar {saved} (its flat masters are NOT in model "
+                "order); pass expect_layout= with the run's matching "
                 "pp/virtual_stages/schedule to restore()")
         mismatched = {k: (saved.get(k), expect.get(k))
                       for k in set(saved) | set(expect)
@@ -154,21 +258,30 @@ class Checkpointer:
                 tree["opt_state"] = {
                     k: compress_array(v, self.compress)
                     for k, v in tree["opt_state"].items()}
+        self._migrate_legacy_layout()
         path = self._path(step)
+        # layout=None on a force=True re-save of the SAME step must clear
+        # that step's earlier sidecar (plain-order bytes must never
+        # validate against a stale layout); other steps' sidecars are
+        # theirs and stay untouched
+        if self._async:
+            # stage the sidecar durably BEFORE the background write: a
+            # crash between the commit and the next sync point must leave
+            # the layout recoverable next to the committed bytes
+            self._stage_sidecar(step, layout)
         self._ckptr.save(path, tree, force=True)
-        if layout is not None:
-            self.save_layout(layout)
-        elif os.path.exists(self._layout_path()):
-            # a plain-order save must not inherit an earlier save's layout
-            # sidecar: restore() would then demand (and validate against)
-            # a layout these bytes are not in — the exact silent-permute
-            # hazard the sidecar exists to prevent
-            os.remove(self._layout_path())
+        if self._async:
+            # orbax serialized any EARLIER async save before starting this
+            # one, so earlier staged sidecars are committed — flush them
+            self._flush_pending_sidecars(skip_step=step)
+        else:
+            self._apply_sidecar(step, layout)
         return path
 
     def restore(self, step: int,
                 expect_layout: Optional[Dict[str, Any]] = None):
-        self._check_layout(expect_layout)
+        self.wait_until_finished()       # commit in-flight saves + sidecars
+        self._check_layout(step, expect_layout)
         tree = self._ckptr.restore(self._path(step))
         if self.compress is not None:
             for key in ("w_own", "w_master"):
@@ -181,9 +294,11 @@ class Checkpointer:
         return tree
 
     def wait_until_finished(self) -> None:
-        """Block until any in-flight async save has committed to disk."""
+        """Block until any in-flight async save has committed to disk,
+        then flush the committed steps' staged layout sidecars."""
         if hasattr(self._ckptr, "wait_until_finished"):
             self._ckptr.wait_until_finished()
+        self._flush_pending_sidecars()
 
     def latest_step(self) -> Optional[int]:
         # ignore orbax atomic-write temp dirs (step_N.orbax-checkpoint-tmp-*)

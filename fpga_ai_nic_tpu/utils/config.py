@@ -109,6 +109,18 @@ class CollectiveConfig:
     # call per bwd layer, sw/mlp_mpi_example_f32.cpp:753); 4M f32 = 16 MiB
     # amortizes per-collective latency while keeping backward overlap.
     bucket_elems: int = 4 * 1024 * 1024
+    # collective integrity guard (runtime.chaos): per-chunk checksums
+    # across the gradient reduce-scatter plus a NaN/inf count, computed
+    # inside the jitted step; a tripped guard GATES the optimizer update
+    # (weights/optimizer state keep their pre-step values) and surfaces
+    # the verdict in the step's metrics dict for the elastic loop to act
+    # on.  Catches the silent-corruption surface a compressed wire adds
+    # (BFP codec faults, flipped exponent bits) before they poison the
+    # master weights.  integrity_tol=None derives the tolerance from the
+    # wire format (chaos.integrity_tol): reassociation-only for f32,
+    # quantization-bounded for BFP.
+    integrity_check: bool = False
+    integrity_tol: Optional[float] = None
 
     def __post_init__(self):
         assert self.impl in ("xla", "ring")

@@ -22,14 +22,26 @@ import os
 import re
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
+# hyphenated HLO collective op names — the device-plane classifier matches
+# these only.  The short jax-primitive names ("psum", ...) must NOT live
+# here: _is_collective substring-matches, and on real-chip traces any
+# fusion merely NAMED after a psum consumer (e.g. "psum_invariant_fusion")
+# would be banked as async collective time, skewing overlap attribution.
 _COLLECTIVE_MARKERS = (
     "all-reduce", "all-gather", "reduce-scatter", "collective-permute",
     "all-to-all", "collective-broadcast", "ragged-all-to-all",
-    # jax-level instruction names (XLA names HLO collectives after the
-    # primitive that built them, e.g. "psum.7" on the CPU thunk executor)
-    "psum", "ppermute", "all_gather", "all_to_all", "psum_scatter",
-    "reduce_scatter",
 )
+
+# jax-level instruction names, CPU thunk executor only (XLA names HLO
+# collectives there after the primitive that built them, e.g. "psum.7").
+# Matched as the WHOLE base name plus an optional ".uid" suffix — never as
+# a substring — so "psum.7" classifies but "my_psum_like_fusion" does not.
+_CPU_PRIMITIVE_MARKERS = (
+    "psum", "ppermute", "all_gather", "all_to_all", "psum_scatter",
+    "reduce_scatter", "pmax", "pmin",
+)
+_CPU_PRIMITIVE_RE = re.compile(
+    r"(?:%s)(?:\.\d+)?" % "|".join(_CPU_PRIMITIVE_MARKERS))
 
 Interval = Tuple[float, float]          # (start_ns, end_ns)
 
@@ -91,8 +103,16 @@ def find_xplane(trace_dir: str) -> str:
 
 
 def _is_collective(name: str) -> bool:
+    """Device-plane classifier: hyphenated HLO collective names only."""
     n = name.lower()
     return any(m in n for m in _COLLECTIVE_MARKERS)
+
+
+def _is_cpu_collective(base: str) -> bool:
+    """CPU thunk classifier: HLO collective names, plus bare jax-primitive
+    instruction names ("psum.7") matched on the full base name."""
+    return (_is_collective(base)
+            or _CPU_PRIMITIVE_RE.fullmatch(base.lower()) is not None)
 
 
 def _attribution_report(sync_ivs: List[Interval],
@@ -224,7 +244,7 @@ def analyze_cpu_thunk_trace(trace_dir: str) -> Dict:
                     continue
                 iv = (ev.start_ns, ev.start_ns + ev.duration_ns)
                 base = ev.name.removeprefix("wrapped_")
-                if _is_collective(base):
+                if _is_cpu_collective(base):
                     async_evs.append((ev.name, iv))
                 else:
                     sync_ivs.append(iv)

@@ -229,15 +229,96 @@ def test_layout_sidecar_enforced(tmp_path):
     assert c2.restore(1)["w"].shape == (4,)
 
 
+def test_legacy_directory_sidecar_honored_and_migrated(tmp_path):
+    """Checkpoints written by older revisions carry ONE directory-scoped
+    layer_layout.json.  It must still govern restores of every step that
+    lacks a per-step sidecar (silently treating permuted bytes as plain
+    model order is the exact hazard the sidecar exists for), and the next
+    save must migrate it into the step dirs so the per-step rules apply."""
+    import json as _json
+    import os as _os
+    layout = {"layers_order": "interleaved-device-major",
+              "pp": 2, "virtual_stages": 2}
+    d = str(tmp_path / "ck")
+    c = ckpt.Checkpointer(d)
+    c.save(1, {"w": np.ones(2, np.float32)})
+    # simulate the old revision: directory-scoped sidecar, none per step
+    with open(_os.path.join(d, "layer_layout.json"), "w") as f:
+        _json.dump(layout, f)
+
+    c2 = ckpt.Checkpointer(d)
+    assert c2.saved_layout(1) == layout             # legacy fallback read
+    with pytest.raises(ValueError, match="sidecar"):
+        c2.restore(1)                               # still enforced
+    np.testing.assert_array_equal(
+        c2.restore(1, expect_layout=dict(layout))["w"],
+        np.ones(2, np.float32))
+
+    # the next save migrates: per-step sidecar appears, legacy file goes,
+    # and a plain-order save of ANOTHER step cannot strand step 1
+    c2.save(2, {"w": np.zeros(2, np.float32)})
+    assert not _os.path.exists(_os.path.join(d, "layer_layout.json"))
+    assert c2.saved_layout(1) == layout
+    assert c2.saved_layout(2) is None
+
+
+def test_async_save_defers_layout_sidecar(tmp_path):
+    """async_save must not block on the sidecar write: the layout is
+    applied at the next sync point (wait_until_finished / restore) and is
+    visible through saved_layout() in the meantime."""
+    layout = {"layers_order": "interleaved-device-major",
+              "pp": 2, "virtual_stages": 2}
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), async_save=True)
+    c.save(1, {"w": np.ones(2, np.float32)}, layout=layout)
+    assert c.saved_layout(1) == layout              # pending, pre-commit
+    c.wait_until_finished()
+    assert c.saved_layout(1) == layout              # now on disk
+    with pytest.raises(ValueError, match="sidecar"):
+        c.restore(1)
+    np.testing.assert_array_equal(
+        c.restore(1, expect_layout=dict(layout))["w"],
+        np.ones(2, np.float32))
+    # plain async re-save of the same step clears the sidecar on sync
+    c.save(1, {"w": np.zeros(2, np.float32)})
+    c.wait_until_finished()
+    assert c.saved_layout(1) is None
+
+    # crash window: a committed step dir with a still-staged pending file
+    # (the process died between commit and flush) — a fresh Checkpointer
+    # must honor and enforce the staged layout, not silently drop it
+    c._stage_sidecar(1, layout)
+    c2 = ckpt.Checkpointer(str(tmp_path / "ck"), async_save=True)
+    assert c2.saved_layout(1) == layout
+    with pytest.raises(ValueError, match="sidecar"):
+        c2.restore(1)
+    np.testing.assert_array_equal(
+        c2.restore(1, expect_layout=dict(layout))["w"],
+        np.zeros(2, np.float32))
+
+
 def test_layout_sidecar_cleared_by_plain_save(tmp_path):
-    """A later plain-order save into the same directory must remove the
-    earlier save's sidecar — otherwise restore() would demand (and
-    validate against) a layout the new bytes are not in."""
+    """The sidecar is per-step: a later plain-order save must neither
+    inherit an earlier step's layout (restore(2) would demand a layout
+    its bytes are not in) nor DELETE it (restore(1) still depends on it —
+    the ADVICE r5 hazard of the old directory-scoped sidecar)."""
     c = ckpt.Checkpointer(str(tmp_path / "ck"))
     layout = {"layers_order": "interleaved-device-major",
               "pp": 2, "virtual_stages": 2}
     c.save(1, {"w": np.ones(2, np.float32)}, layout=layout)
     c.save(2, {"w": np.zeros(2, np.float32)})       # plain model order
-    assert c.saved_layout() is None
+    assert c.saved_layout(2) is None
+    assert c.saved_layout() is None                 # default: latest step
     np.testing.assert_array_equal(c.restore(2)["w"],
                                   np.zeros(2, np.float32))
+    # the earlier step's sidecar survived the later plain save: restore(1)
+    # still enforces — and accepts — its own layout
+    assert c.saved_layout(1) == layout
+    with pytest.raises(ValueError, match="sidecar"):
+        c.restore(1)
+    np.testing.assert_array_equal(c.restore(1, expect_layout=dict(layout))["w"],
+                                  np.ones(2, np.float32))
+    # re-saving the SAME step in plain order does clear that step's sidecar
+    c.save(1, {"w": np.full(2, 3.0, np.float32)})
+    assert c.saved_layout(1) is None
+    np.testing.assert_array_equal(c.restore(1)["w"],
+                                  np.full(2, 3.0, np.float32))

@@ -105,6 +105,19 @@ def test_supported_predicate():
     assert not flash_pallas.supported((2, 4, 100, 64))    # S not lane-mult
     assert not flash_pallas.supported((2, 4, 256, 300))   # dh too large
     assert not flash_pallas.supported((2, 256, 64))       # rank
+    # Sk is part of the contract too (cross-attention / visiting chunks)
+    assert flash_pallas.supported((2, 4, 256, 64), kv_seq_len=128)
+    assert not flash_pallas.supported((2, 4, 256, 64), kv_seq_len=100)
+
+
+def test_bad_kv_seq_len_raises_before_mosaic(rng):
+    """ADVICE r5: a non-lane-tileable Sk used to pass supported() (which
+    only sees q) and die later inside the Mosaic compile; the public entry
+    must reject it with a real error."""
+    q = jnp.asarray(rng.standard_normal((1, 2, 256, 64)), jnp.float32)
+    kv = jnp.asarray(rng.standard_normal((1, 2, 100, 64)), jnp.float32)
+    with pytest.raises(ValueError, match="K/V sequence length"):
+        flash_pallas.flash_attention(q, kv, kv, interpret=True)
 
 
 def test_llama_attn_impl_parity(rng):

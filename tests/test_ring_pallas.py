@@ -171,6 +171,9 @@ def test_fused_all_reduce_matches_xla_op_ring_bitexact(rng):
 
 
 @pytest.mark.slow
+@pytest.mark.skipif(not rp.HAS_THREADED_INTERPRET,
+                    reason="this jaxlib ships no threaded TPU interpreter "
+                           "(pltpu.InterpretParams)")
 class TestFlowControl:
     """The REAL flow-control protocol — neighbor barrier, credit-window
     semaphores, blocking waits — executed end-to-end under the threaded

@@ -35,6 +35,28 @@ def test_collective_classification():
     assert not ta._is_collective("%slice-start")
 
 
+def test_device_plane_ignores_primitive_named_fusions():
+    """ADVICE r5: jax-primitive substrings must not leak into the
+    device-plane classifier — a fusion merely named after a psum consumer
+    is sync compute, not collective wire time."""
+    assert not ta._is_collective("%psum_invariant_fusion.3")
+    assert not ta._is_collective("%loop_reduce_scatter_like_fusion")
+    assert not ta._is_collective("psum.7")      # CPU-only name
+
+
+def test_cpu_thunk_classification_is_word_scoped():
+    # bare primitive instruction names (with XLA's .uid) classify
+    assert ta._is_cpu_collective("psum.7")
+    assert ta._is_cpu_collective("ppermute")
+    assert ta._is_cpu_collective("all_gather.12")
+    # hyphenated HLO names still classify on the CPU path too
+    assert ta._is_cpu_collective("all-reduce-start.1")
+    # but a name that merely CONTAINS a primitive does not
+    assert not ta._is_cpu_collective("psum_invariant_fusion.3")
+    assert not ta._is_cpu_collective("my_psum")
+    assert not ta._is_cpu_collective("broadcast_add_fusion")
+
+
 def test_summarize_aggregates_planes():
     rep = {"devices": {
         "/device:TPU:0": {"sync_busy_s": 1.0, "async_s": 0.5,
@@ -72,6 +94,9 @@ def test_cpu_thunk_trace_attributes_collectives(tmp_path):
     from jax import lax
     from jax.sharding import Mesh, PartitionSpec as P
 
+    if not hasattr(jax.profiler, "ProfileOptions"):
+        pytest.skip("this jaxlib has no jax.profiler.ProfileOptions "
+                    "(host_tracer_level is not settable)")
     mesh = Mesh(np.array(jax.devices()), ("dp",))
     f = jax.jit(jax.shard_map(
         lambda v: lax.psum(jnp.tanh(lax.pcast(v, "dp", to="varying")), "dp"),

@@ -264,9 +264,19 @@ def child_busbw() -> None:
                 row["ring_bfp_gbps"] / row["psum_bf16_gbps"], 3)
         out["sweep"].append(row)
     out["ok"] = any(any(k.endswith("_gbps") for k in r) for r in out["sweep"])
-    if out["ok"]:
-        out["value"] = max(r.get("ring_bfp_gbps", 0) for r in out["sweep"])
+    bfp_rows = [r["ring_bfp_gbps"] for r in out["sweep"]
+                if "ring_bfp_gbps" in r]
+    if bfp_rows:
+        out["value"] = max(bfp_rows)
         out["unit"] = "GB/s"
+    elif out["ok"]:
+        # other impls measured but the BFP ring produced no number on any
+        # row: an explicit invalid marker, never a fake 0.0 GB/s headline
+        # (same convention as bench_collective's fused_ring_loopback_error)
+        out["ring_bfp_error"] = next(
+            (r["ring_bfp_error"] for r in out["sweep"]
+             if "ring_bfp_error" in r),
+            "no sweep row produced ring_bfp_gbps")
     print(json.dumps(out), flush=True)
 
 
