@@ -138,6 +138,22 @@ def bf16_peak(default_gen: str = "v5e"):
     return peak, label
 
 
+def hbm_peak(default_gen: str = "v5e"):
+    """(peak_bytes_per_s, label) for the tunneled chip generation — the
+    denominator of decode's HBM-roofline accounting (decode is
+    bandwidth-bound: every generated token re-reads the weights and the
+    KV cache, so bytes/token over HBM peak is its MFU analogue).  Same
+    env channel and explicit-UNKNOWN discipline as bf16_peak."""
+    peaks = {"v4": 1228e9, "v5e": 819e9, "v5p": 2765e9, "v6e": 1640e9}
+    gen = os.environ.get("PALLAS_AXON_TPU_GEN", default_gen)
+    known = gen in peaks
+    peak = peaks.get(gen, 819e9)
+    label = (f"{gen} HBM {peak / 1e9:.0f} GB/s" if known
+             else f"UNKNOWN gen {gen!r}: v5e fallback "
+                  f"{peak / 1e9:.0f} GB/s")
+    return peak, label
+
+
 def chain_kernel_calls(call, k: int = 8):
     """jit(k chained invocations of a side-effecting kernel `call`) —
     divide the elapsed time of one dispatch by k.  The adds only order

@@ -1,3 +1,3 @@
 from . import (bfp, bfp_golden, bfp_pallas, bucketed, flash_pallas,
-               fused_update, moe, ring, ring_attention, ring_golden,
-               ring_pallas)  # noqa: F401
+               fused_update, moe, ring, ring_attention, ring_cost,
+               ring_golden, ring_pallas)  # noqa: F401

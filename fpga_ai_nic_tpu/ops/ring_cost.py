@@ -1,0 +1,245 @@
+"""Per-stage cost model for the fused ring pipeline (ops.ring_pallas).
+
+A pipelined hop runs at the rate of its slowest RESOURCE, not the sum of
+its stages — the reference reads exactly this split from its RTL stall
+counters (hw/all_reduce.sv:94-97) to prove the 256b datapath stays busy
+every beat.  Our instrument is the `ablate=` machinery: each variant runs
+the SAME slice schedule with exactly one stage compiled in, so its
+slope-measured time is that stage's schedule time with the loop/semaphore
+skeleton included.  This module combines those timings into a predicted
+pipeline time and a `pipeline_efficiency`, which bench_collective.py and
+tools/first_contact.py report per loopback row.
+
+Resource model (why the terms combine the way they do):
+
+  VPU   encode and decode+accumulate execute in ONE instruction stream —
+        they can never overlap each other, so they add.  Each ablated run
+        carries the control skeleton once (measured by ablate="skeleton"),
+        so the sum subtracts it once:  t_vpu = t_enc + t_dec - t_skel.
+  RDMA  the wire chain is its own engine; fully overlappable with the
+        VPU:  t_rdma as measured.
+  HBM   the streaming kernel's slice load / store-load / writeback DMAs
+        (ablate="hbm"); a third engine, overlappable with both.
+
+  t_model             = max(t_vpu, t_rdma, t_hbm)
+  pipeline_efficiency = t_model / t_full      (1.0 = perfectly hidden;
+                        below ~0.8 the schedule is leaving overlap on
+                        the table — the round-5 verdict's 10x gap)
+  binding stage       = argmax of the terms
+
+The same serial-VPU insight fixes the break-even model: the old table
+used max(1/enc, 1/dec, wire) per byte, which assumed encode and decode
+overlap — they share the VPU, so the compute bound is their SUM
+(equivalently the harmonic combination of the rates).  That is why the
+r04 numbers could never have been self-consistent: a roundtrip measured
+at ~2x the harmonic sum of its own stages is impossible for a
+compute-bound pipeline (bench_collective's consistency gate).
+"""
+
+from __future__ import annotations
+
+from typing import Mapping, Optional, Sequence
+
+# stage names understood by ring_pallas's ablate= (skeleton = bare
+# schedule: loop + slot bookkeeping, no stage work)
+STAGES_RESIDENT = ("skeleton", "encode", "rdma", "decode")
+STAGES_STREAMING = ("skeleton", "encode", "rdma", "decode", "hbm")
+
+
+def stages_for(streaming: bool) -> Sequence[str]:
+    return STAGES_STREAMING if streaming else STAGES_RESIDENT
+
+
+def model_pipeline(stage_s: Mapping[str, float],
+                   full_s: Optional[float] = None) -> dict:
+    """Combine per-stage schedule times (seconds) into the predicted
+    pipeline time.
+
+    stage_s maps ablate names -> slope-measured seconds for the ablated
+    schedule; non-positive or missing entries are treated as unmeasured
+    (a non-positive slope means noise swamped the chain difference — the
+    caller must not fabricate a rate from it).  full_s is the full
+    pipeline's measured time; when given, pipeline_efficiency and the
+    modeled-vs-measured error are included.
+
+    Returns a dict with:
+      modeled_s             predicted pipeline time (max over resources)
+      binding_stage         "vpu" / "rdma" / "hbm" — the resource that
+                            bounds the hop (vpu = encode+decode serial)
+      terms_s               per-resource predicted times
+      pipeline_efficiency   modeled_s / full_s (when full_s > 0)
+      model_rel_err         (full_s - modeled_s) / modeled_s — how much
+                            slower the real schedule runs than a
+                            perfectly-overlapped one
+      valid                 False when the VPU term could not be formed
+    """
+    def get(name):
+        t = stage_s.get(name)
+        return float(t) if t is not None and t > 0 else None
+
+    skel, enc, dec = get("skeleton"), get("encode"), get("decode")
+    terms = {}
+    vpu_partial = False
+    if enc is not None and dec is not None:
+        # each ablated run includes the skeleton once; the serial VPU sum
+        # must count it once, not twice
+        terms["vpu"] = enc + dec - (skel or 0.0)
+    elif enc is not None or dec is not None:
+        # half the VPU cost is unmeasured: keep the term as a FLOOR for
+        # the display, but the model is not valid — a confident
+        # modeled_t_ms from half the serial chain would be exactly the
+        # fabricated-rate failure this module exists to prevent
+        terms["vpu"] = enc if enc is not None else dec
+        vpu_partial = True
+    rdma, hbm = get("rdma"), get("hbm")
+    if rdma is not None:
+        terms["rdma"] = rdma
+    if hbm is not None:
+        terms["hbm"] = hbm
+    # a resource can never run the schedule faster than the bare skeleton
+    if skel is not None:
+        terms = {k: max(v, skel) for k, v in terms.items()}
+
+    out = {"stage_s": {k: v for k, v in stage_s.items()},
+           "terms_s": terms,
+           "valid": bool(terms) and ("vpu" in terms) and not vpu_partial}
+    if vpu_partial:
+        out["vpu_partial"] = True     # one codec stage's slope drowned
+    if terms:
+        binding = max(terms, key=lambda k: terms[k])
+        out["binding_stage"] = binding
+        # a confident modeled time / efficiency from an incomplete term
+        # set would be a fabricated rate — emit them only when valid
+        if out["valid"]:
+            out["modeled_s"] = terms[binding]
+            if full_s is not None and full_s > 0:
+                out["full_s"] = float(full_s)
+                out["pipeline_efficiency"] = terms[binding] / full_s
+                out["model_rel_err"] = ((full_s - terms[binding])
+                                        / terms[binding])
+    return out
+
+
+def codec_rates(stages: Mapping[str, Mapping[str, float]],
+                payload_bytes: int):
+    """(encode_gbps, decode_gbps) for break_even from a decomposition
+    row's `stages` — SKELETON-CORRECTED: each ablated schedule time
+    includes the bare control loop once, and break_even's serial model
+    adds the two stage costs, so feeding it raw ablated rates would
+    count the skeleton twice (understating the combined codec rate and
+    biasing the verdict against BFP).  Per-byte the asymptotic stage
+    cost is (t_stage - t_skeleton) / bytes.  Returns (0, 0) when either
+    stage is missing or the subtraction is non-positive (skeleton-bound
+    measurement: no honest asymptotic rate exists)."""
+    skel = (stages.get("skeleton") or {}).get("t_ms", 0.0)
+    rates = []
+    for name in ("encode", "decode"):
+        t = (stages.get(name) or {}).get("t_ms")
+        if t is None or t - skel <= 0:
+            return 0.0, 0.0
+        rates.append(payload_bytes / ((t - skel) * 1e-3) / 1e9)
+    return rates[0], rates[1]
+
+
+# candidate per-direction link rates (GB/s): DCN-class multi-host, the
+# reference's own 100GbE wire (hw/bfp_adapter.sv sat on a 100G MAC), and
+# the ICI classes
+DEFAULT_LINK_RATES = (5.0, 12.5, 45.0, 90.0, 180.0)
+
+
+def break_even(encode_gbps: float, decode_gbps: float,
+               wire_ratio_fused: float, wire_ratio_xla: float,
+               link_rates: Sequence[float] = DEFAULT_LINK_RATES,
+               source: str = "") -> dict:
+    """Per-link-rate verdict: does the BFP wire path beat a bf16 psum?
+
+    Per f32 payload byte and hop: the BFP ring pays the wire
+    (1/r_fused)/W AND the serial VPU codec 1/enc + 1/dec (encode and
+    decode share the VPU — see module docstring; this replaces the old
+    max(1/enc, 1/dec) model, whose self-inconsistency round 4 proved);
+    whichever is larger binds, because the fused kernel overlaps codec
+    and wire.  The bf16 psum moves half the f32 bytes at the link rate:
+    0.5/W.  To win at all the codec must sustain the harmonic-combined
+    rate 1/(1/enc + 1/dec) > 2*W; the max speedup is r_fused/2.
+    """
+    rows = {}
+    t_vpu = ((1.0 / encode_gbps if encode_gbps else 9e9)
+             + (1.0 / decode_gbps if decode_gbps else 9e9))
+    for W in link_rates:
+        t_bf16 = 0.5 / W
+        t_bfp = max((1.0 / wire_ratio_fused) / W, t_vpu)
+        rows[f"link_{W:g}GBps"] = {
+            "bfp_speedup_vs_bf16_psum": round(t_bf16 / t_bfp, 3),
+            "bfp_wins": t_bfp < t_bf16,
+            "required_codec_gbps_to_win": round(2 * W, 1),
+        }
+    combined = (1.0 / t_vpu) if t_vpu < 9e8 else 0.0
+    return {
+        "model": ("hop time per f32 byte = max(1/(r_fused*W), "
+                  "1/encode + 1/decode) vs bf16 psum's 1/(2*W); encode "
+                  "and decode SHARE the VPU so their costs add (the "
+                  "harmonic-combined codec rate must exceed 2*W to win "
+                  "at all), and the max speedup is r_fused/2 (fused wire "
+                  "ratio includes the 8-row RDMA tile padding; the XLA "
+                  "ring's unpadded ratio is wire_ratio_vs_f32)"),
+        "codec_rates_source": source,
+        "encode_gbps": round(encode_gbps, 2),
+        "decode_gbps": round(decode_gbps, 2),
+        "combined_codec_gbps": round(combined, 2),
+        "wire_ratio_vs_f32": round(wire_ratio_xla, 3),
+        "wire_ratio_fused_vs_f32": round(wire_ratio_fused, 3),
+        "per_link_rate": rows,
+    }
+
+
+def decompose(measure, streaming: bool, payload_bytes: int) -> dict:
+    """Run the full per-stage decomposition of one loopback row.
+
+    measure(ablate_or_None) -> seconds (slope-based; <= 0 means the
+    measurement drowned in noise and is dropped).  Returns the
+    model_pipeline dict extended with per-stage {t_ms, gbps} rows ready
+    for the artifact, or {"valid": False, ...} when the full-pipeline
+    measurement itself failed."""
+    full_s = measure(None)
+    stage_s, stage_errors = {}, {}
+    for name in stages_for(streaming):
+        # a stage variant that crashes (fresh compile path on a scarce
+        # tunnel window) must not cost the already-measured full rate —
+        # partial evidence is evidence
+        try:
+            t = measure(name)
+        except Exception as e:  # noqa: BLE001 — per-stage best-effort
+            stage_errors[name] = repr(e)[:200]
+            continue
+        if t is not None and t > 0:
+            stage_s[name] = t
+    out = model_pipeline(stage_s, full_s if full_s and full_s > 0 else None)
+    out["stages"] = {
+        k: {"t_ms": round(v * 1e3, 3),
+            "gbps": round(payload_bytes / v / 1e9, 2)}
+        for k, v in stage_s.items()}
+    if stage_errors:
+        out["stage_errors"] = stage_errors
+        out["valid"] = False
+        # a missing resource term could have been the binding one — no
+        # confident model claims from an incomplete decomposition
+        for k in ("modeled_s", "pipeline_efficiency", "model_rel_err",
+                  "full_s"):
+            out.pop(k, None)
+    out["payload_bytes"] = payload_bytes
+    del out["stage_s"]
+    if full_s is not None and full_s > 0:
+        out["t_ms"] = round(full_s * 1e3, 3)
+        out["pipeline_gbps"] = round(payload_bytes / full_s / 1e9, 2)
+    else:
+        out["valid"] = False
+        out["error"] = ("non-positive slope on the full pipeline "
+                        "(noise swamped the chain-length difference)")
+    if "modeled_s" in out:
+        out["modeled_t_ms"] = round(out.pop("modeled_s") * 1e3, 3)
+    if "pipeline_efficiency" in out:
+        out["pipeline_efficiency"] = round(out["pipeline_efficiency"], 3)
+    if "model_rel_err" in out:
+        out["model_rel_err"] = round(out["model_rel_err"], 3)
+    out.pop("full_s", None)
+    return out

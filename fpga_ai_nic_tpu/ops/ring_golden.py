@@ -30,29 +30,38 @@ from . import bfp_golden
 from ..utils.config import BFPConfig
 
 
-def _compress(x: np.ndarray, cfg: BFPConfig) -> Tuple[np.ndarray, np.ndarray]:
+def _compress(x: np.ndarray, cfg: BFPConfig,
+              layout: str = "flat16") -> Tuple[np.ndarray, np.ndarray]:
     return bfp_golden.bfp_encode(x, cfg.block_size, cfg.mantissa_bits,
-                                 cfg.rounding)
+                                 cfg.rounding, layout=layout)
 
 
-def _roundtrip(x: np.ndarray, cfg: Optional[BFPConfig]) -> np.ndarray:
+def _roundtrip(x: np.ndarray, cfg: Optional[BFPConfig],
+               layout: str = "flat16") -> np.ndarray:
     if cfg is None:
         return x
-    mant, se = _compress(x, cfg)
-    return bfp_golden.bfp_decode(mant, se, cfg.block_size)
+    mant, se = _compress(x, cfg, layout)
+    return bfp_golden.bfp_decode(mant, se, cfg.block_size, layout=layout)
 
 
 def ring_reduce_scatter(shards: np.ndarray,
-                        compression: Optional[BFPConfig] = None) -> np.ndarray:
+                        compression: Optional[BFPConfig] = None,
+                        layout: str = "flat16") -> np.ndarray:
     """shards: [n, L] per-device input vectors (L divisible by n).
 
     Returns [n, L//n]: device i's fully-reduced chunk i.
-    """
+
+    layout picks the BFP block membership (bfp_golden): "flat16" is the
+    reference's consecutive-element grouping (the XLA codec); "sublane"
+    is the TPU lane layout the Pallas wire kernels quantize in — with it
+    this golden model is the DIRECT bit spec of ops.ring_pallas's fused
+    reduce-scatter (block-aligned slicing never changes block
+    membership, so per-slice and whole-chunk quantization agree)."""
     n, L = shards.shape
     assert L % n == 0
     chunks = shards.reshape(n, n, L // n).astype(np.float32).copy()
     for s in range(n - 1):
-        sends = [_roundtrip(chunks[i, (i - s - 1) % n], compression)
+        sends = [_roundtrip(chunks[i, (i - s - 1) % n], compression, layout)
                  for i in range(n)]
         for i in range(n):
             chunks[i, (i - s - 2) % n] += sends[(i - 1) % n]

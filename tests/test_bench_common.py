@@ -132,3 +132,19 @@ def test_probe_tpu_requires_tpu_platform(monkeypatch):
         bench_common, "run_attempt",
         lambda *a, **k: {"ok": True, "platform": "axon", "n_devices": 1})
     assert bench_common.probe_tpu() is True
+
+
+def test_hbm_peak_env_channel(monkeypatch):
+    """hbm_peak mirrors bf16_peak's discipline: known generations map to
+    their HBM bandwidth, unknown ones fall back with an explicit UNKNOWN
+    label so a mislabeled roofline can never pass silently."""
+    import bench_common
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v5p")
+    peak, label = bench_common.hbm_peak()
+    assert peak == 2765e9 and "v5p" in label and "UNKNOWN" not in label
+    monkeypatch.setenv("PALLAS_AXON_TPU_GEN", "v99")
+    peak, label = bench_common.hbm_peak()
+    assert peak == 819e9 and "UNKNOWN" in label
+    monkeypatch.delenv("PALLAS_AXON_TPU_GEN")
+    peak, label = bench_common.hbm_peak()
+    assert peak == 819e9 and "UNKNOWN" not in label

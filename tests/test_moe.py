@@ -285,3 +285,82 @@ def test_active_params_accounting():
         0, moe.vocab, (2, 17)), jnp.int32)
     loss = llama.loss_fn(p, (toks[:, :-1], toks[:, 1:]), moe)
     assert np.isfinite(float(loss))
+
+
+# -- capacity-binding behavior (round-5 verdict weak #6: the by-design -------
+# caveat in ops/moe.py becomes a tested contract) ----------------------------
+
+def test_capacity_binding_deterministic_and_token_major(rng):
+    """capacity_factor < 1: the drop set is DETERMINISTIC (two runs agree
+    bit-for-bit) and follows token-major priority — with identical tokens
+    (identical routing), exactly the first C assignments per expert keep
+    their slots and every later one falls back to the zero residual."""
+    params = _params(rng)
+    cfg = moe.MoEConfig(num_experts=E, top_k=1, capacity_factor=0.5)
+    T = 8
+    x0 = jnp.asarray(rng.standard_normal((1, 1, D)), jnp.float32)
+    x = jnp.tile(x0, (1, T, 1))              # T identical tokens
+    Cap = cfg.capacity(T)
+    assert Cap < T                            # capacity actually binds
+    y, _ = moe.moe_ffn(params, x, cfg)
+    y2, _ = moe.moe_ffn(params, x, cfg)
+    np.testing.assert_array_equal(np.asarray(y), np.asarray(y2))
+    y_one, _ = moe.moe_ffn(params, x0, moe.MoEConfig(
+        num_experts=E, top_k=1, capacity_factor=float(E)))
+    for t in range(T):                        # first Cap kept, rest dropped
+        if t < Cap:
+            np.testing.assert_allclose(np.asarray(y[0, t]),
+                                       np.asarray(y_one[0, 0]), rtol=1e-5)
+        else:
+            np.testing.assert_allclose(np.asarray(y[0, t]), 0.0, atol=1e-6)
+    stats = moe.expert_stats(params, x, cfg)
+    assert float(stats["drop_frac"]) == pytest.approx((T - Cap) / T)
+
+
+def test_capacity_binding_sharded_divergence_bounded(rng):
+    """Once capacity binds, ep-sharded and unsharded runs drop DIFFERENT
+    tokens (rank-local capacity — the documented divergence).  The
+    contract pinned here: the divergence is confined to dropped tokens —
+    every token kept by BOTH runs matches exactly, and the number of
+    differing tokens is bounded by the two runs' combined drop counts."""
+    params = _params(rng)
+    cfg = moe.MoEConfig(num_experts=E, top_k=1, capacity_factor=0.75)
+    B, S = 8, 4                               # T=32 tokens, ep shards by 4
+    x = jnp.asarray(rng.standard_normal((B, S, D)), jnp.float32)
+    T = B * S
+
+    y_ref, _ = moe.moe_ffn(params, x, cfg)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("ep",))
+    y_sh, _ = jax.jit(jax.shard_map(
+        lambda p, xx: moe.moe_ffn(p, xx, cfg, ep_axis="ep",
+                                  batch_axes=("ep",)),
+        mesh=mesh, in_specs=(moe.param_specs(cfg, "ep"), P("ep")),
+        out_specs=(P("ep"), P())))(params, x)
+
+    ref = np.asarray(y_ref).reshape(T, D)
+    sh = np.asarray(y_sh).reshape(T, D)
+    differs = ~np.all(np.isclose(ref, sh, rtol=2e-4, atol=2e-5), axis=1)
+
+    # drop counts of each run (global stats = psum'd rank-local stats)
+    st_ref = moe.expert_stats(params, x, cfg)
+    st_sh = jax.jit(jax.shard_map(
+        lambda p, xx: moe.expert_stats(p, xx, cfg, batch_axes=("ep",)),
+        mesh=mesh, in_specs=(P(), P("ep")),
+        out_specs=jax.tree_util.tree_map(lambda _: P(), st_ref),
+        check_vma=False))(params, x)
+    dropped = (float(st_ref["drop_frac"]) + float(st_sh["drop_frac"])) * T
+    assert float(st_sh["drop_frac"]) > 0.0    # capacity really binds
+    assert differs.sum() <= dropped + 0.5, (differs.sum(), dropped)
+    # a differing token is kept by one run and dropped (residual-zero)
+    # by the other — with top_k=1 its gap is exactly the kept run's
+    # expert output, so PER TOKEN the divergence is bounded by the
+    # larger of the two rows (a genuinely amplifying path would exceed
+    # this row-wise bound; the old whole-array triangle bound could not
+    # fail)
+    if differs.any():
+        gap = np.abs(ref[differs] - sh[differs]).max(axis=1)
+        row_bound = np.maximum(np.abs(ref[differs]).max(axis=1),
+                               np.abs(sh[differs]).max(axis=1))
+        assert (gap <= row_bound * (1 + 1e-5) + 1e-6).all(), (
+            gap, row_bound)

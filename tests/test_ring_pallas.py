@@ -379,9 +379,10 @@ def test_loopback_stage_ablation(rng):
     vn, SL = 4, SLICE
     x = jnp.asarray(rng.standard_normal(vn * 2 * SL), jnp.float32)
     C = x.shape[0] // vn
-    for ab in ("encode", "rdma"):
+    for ab in ("encode", "rdma", "skeleton"):
         out = rp.loopback_microbench(x, vn, slice_elems=SL, ablate=ab)
-        np.testing.assert_array_equal(np.asarray(out), np.asarray(x[:C]))
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(x[:C]),
+                                      err_msg=ab)
     out = rp.loopback_microbench(x, vn, slice_elems=SL, ablate="decode")
     assert out.shape == (C,)               # decodes stale frames: values
     full = rp.loopback_microbench(x, vn, slice_elems=SL)  # are garbage
@@ -392,13 +393,13 @@ def test_loopback_stage_ablation(rng):
 
 
 def test_loopback_stage_ablation_streaming(rng):
-    """Streaming-kernel ablations: encode/rdma touch nothing; 'hbm'
-    loads and writes back UNCHANGED slice content (pure memory
+    """Streaming-kernel ablations: encode/rdma/skeleton touch nothing;
+    'hbm' loads and writes back UNCHANGED slice content (pure memory
     streaming), so the accumulator is also untouched; decode mutates."""
     vn, SL = 4, SLICE
     x = jnp.asarray(rng.standard_normal(vn * 2 * SL), jnp.float32)
     C = x.shape[0] // vn
-    for ab in ("encode", "rdma", "hbm"):
+    for ab in ("encode", "rdma", "hbm", "skeleton"):
         out = rp.loopback_microbench(x, vn, slice_elems=SL,
                                      streaming=True, ablate=ab)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(x[:C]),
@@ -408,3 +409,162 @@ def test_loopback_stage_ablation_streaming(rng):
     assert out.shape == (C,)
     full = rp.loopback_microbench(x, vn, slice_elems=SL, streaming=True)
     assert full.shape == (C,) and np.isfinite(np.asarray(full)).all()
+
+
+@pytest.mark.parametrize("n,slices_per_chunk", [(4, 2), (8, 1), (2, 3)])
+def test_fused_matches_numpy_golden_direct(rng, n, slices_per_chunk):
+    """DIRECT golden compare (not just transitively through the XLA-op
+    ring): the fused reduce-scatter's bits equal the numpy golden model
+    running the identical sublane block layout — the 3-instance
+    testbench + golden discipline (readme.pdf §3.2-3.3) applied to the
+    deep-pipelined kernel itself."""
+    from fpga_ai_nic_tpu.ops import ring_golden
+    C = SLICE * slices_per_chunk
+    shards = rng.standard_normal((n, n * C)).astype(np.float32)
+    want = ring_golden.ring_reduce_scatter(shards, CFG, layout="sublane")
+    for streaming in (False, True):
+        got = _run(lambda v: rp.ring_reduce_scatter_fused(
+            v, "dp", compression=CFG, slice_elems=SLICE,
+            streaming=streaming), n)(jnp.asarray(shards).reshape(-1))
+        np.testing.assert_array_equal(
+            np.asarray(got).reshape(n, C), want,
+            err_msg=f"streaming={streaming}")
+
+
+# -- deep-pipelined schedule (PR: close the fused-ring 10x gap) ---------------
+
+@pytest.mark.parametrize("depth", [1, 2, 3, 4])
+@pytest.mark.parametrize("n,slices_per_chunk", [(8, 2), (4, 4), (2, 3)])
+def test_pipeline_depth_bitexact(rng, n, slices_per_chunk, depth):
+    """Every pipeline depth is a SCHEDULE choice, never a numerics
+    choice: the depth-D kernels (resident and streaming) stay
+    bit-identical to the separate-op XLA ring across the depth sweep —
+    including depths the plan caps (depth > S falls back to S) and
+    depth=1, which reproduces the old two-slot lockstep exactly."""
+    C = SLICE * slices_per_chunk
+    x = jnp.asarray(rng.standard_normal((n, n * C)), jnp.float32)
+    want = _run(lambda v: ring_ops.ring_reduce_scatter(
+        v, "dp", compression=CFG, slice_elems=SLICE), n)(x.reshape(-1))
+    for streaming in (False, True):
+        got = _run(lambda v: rp.ring_reduce_scatter_fused(
+            v, "dp", compression=CFG, slice_elems=SLICE,
+            streaming=streaming, pipeline_depth=depth), n)(x.reshape(-1))
+        np.testing.assert_array_equal(
+            np.asarray(got), np.asarray(want),
+            err_msg=f"depth={depth} streaming={streaming}")
+
+
+@pytest.mark.parametrize("streaming", [False, True])
+def test_rolled_schedule_matches_unrolled(rng, monkeypatch, streaming):
+    """The ROLLED schedule (lax.fori_loop + pl.when + SMEM schedule-table
+    loads — the code hardware actually compiles) executed under the
+    discharge interpreter, bit-compared against the unrolled static
+    schedule.  The old kernels never ran this path off-hardware; the
+    deep pipeline's traced-counter guards (q >= n_slots, clamped table
+    loads) make the coverage load-bearing.  jit caches key on static
+    args only, so caches are cleared around the monkeypatched variant."""
+    vn, SL = 4, SLICE
+    x = jnp.asarray(rng.standard_normal(vn * 4 * SL), jnp.float32)
+    refs = {}
+    for depth in (1, 2, 3):
+        refs[depth] = np.asarray(rp.loopback_microbench(
+            x, vn, slice_elems=SL, streaming=streaming,
+            pipeline_depth=depth))
+    jax.clear_caches()
+    monkeypatch.setattr(rp, "_interp_args",
+                        lambda interpret: (True, False, False))
+    try:
+        for depth in (1, 2, 3):
+            rolled = np.asarray(rp.loopback_microbench(
+                x, vn, slice_elems=SL, streaming=streaming,
+                pipeline_depth=depth))
+            np.testing.assert_array_equal(rolled, refs[depth],
+                                          err_msg=f"depth={depth}")
+    finally:
+        jax.clear_caches()       # drop rolled-schedule entries keyed on
+        # the same static args before other tests reuse them
+
+
+def test_rs_plan_invariants():
+    """The plan's three invariants (RAW / SLOT / CAP — _rs_plan
+    docstring) hold over the whole production regime."""
+    for n in (2, 3, 4, 8, 16):
+        for S in (1, 2, 3, 4, 8):
+            for depth in (None, 1, 2, 3, 8):
+                D, n_slots, launch_first = rp._rs_plan(n, S, depth)
+                total = (n - 1) * S
+                assert 1 <= D <= min(S, total)
+                assert n_slots == min(total, D + 1)
+                assert n_slots <= D + 1            # SLOT: window > depth
+                if launch_first:
+                    assert D <= S - 1              # RAW before consume
+                else:
+                    assert D <= S                  # RAW after consume
+    # depth=1 must reproduce the pre-deep-pipeline schedule shape
+    assert rp._rs_plan(4, 2, 1) == (1, 2, True)
+    assert rp._rs_plan(2, 1, 1) == (1, 1, False)
+
+
+def test_sub_rows_block_aligned():
+    """Sub-slice chunks divide the slice and never straddle a BFP block
+    (a straddle would change the shared exponents — the bits)."""
+    for R in (16, 64, 128, 256, 512, 48):
+        sub = rp._sub_rows(R, 16)
+        assert R % sub == 0 and sub % 16 == 0 and sub <= max(rp._SUB_ROWS, R)
+    assert rp._sub_rows(64, 16) == 64       # small slices stay whole
+    assert rp._sub_rows(512, 16) == 128     # big slices split
+
+
+# -- credit-protocol race check at n=8 (round-5 verdict missing #5) -----------
+
+@pytest.mark.parametrize("n", [2, 3, 4, 8])
+def test_rs_protocol_simulation(n):
+    """The credit protocol executed at MODEL level under randomized
+    interleavings with truly asynchronous transfers: every (S, depth)
+    plan at ring sizes up to n=8 completes without deadlock, slot
+    overwrite, or ordering corruption (simulate_rs_protocol's failure
+    modes).  This runs the 8-ring wait-for graph this container's
+    jaxlib cannot (no threaded interpreter) — the real-kernel check is
+    TestFlowControl + test_flow_control_selftest_n8 on newer jaxlibs."""
+    for S in (1, 2, 4):
+        for depth in (1, 2, 3, None):
+            for seed in (0, 1, 2):
+                ev = rp.simulate_rs_protocol(n, S, depth, seed)
+                assert ev > 0
+
+
+def test_rs_protocol_simulation_catches_bad_window(monkeypatch):
+    """The simulator is not a rubber stamp: shrinking the comm window
+    below depth+1 (violating the SLOT invariant) must be caught as a
+    recv-slot overwrite or deadlock within a few seeds."""
+    real_stream = rp._rs_op_stream
+
+    def bad_stream(n, S, depth):
+        ops, n_slots = real_stream(n, S, depth)
+        assert n_slots >= 2, "need a window to shrink"
+        # drop every wait/credit tied to the last slot: emissions reuse
+        # slots one step too early
+        return [op for op in ops
+                if op[0] not in ("credit_wait",)][:len(ops)], n_slots - 1
+
+    monkeypatch.setattr(rp, "_rs_op_stream", bad_stream)
+    with pytest.raises(AssertionError, match="overwrite|deadlock"):
+        for seed in range(8):
+            rp.simulate_rs_protocol(4, 2, 2, seed)
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(not rp.HAS_THREADED_INTERPRET,
+                    reason="this jaxlib ships no threaded TPU interpreter "
+                           "(pltpu.InterpretParams)")
+@pytest.mark.parametrize("streaming", [False, True])
+def test_flow_control_selftest_n8(streaming):
+    """The REAL credit protocol at n=8 under the threaded interpreter —
+    the run the round-5 ledger could not land: ablate='rdma' compiles
+    the codec away (tiny buffers, so the 1-core allocation convoy that
+    parked the full kernels for 500+ s never forms) while the barrier,
+    credit window, and remote copies execute end to end with race
+    detection on.  Deadlock hangs the test (CI timeout), a race is
+    reported by the interpreter, and the untouched-accumulator output
+    is checked exactly."""
+    rp.flow_control_selftest(8, streaming=streaming)

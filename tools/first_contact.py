@@ -140,14 +140,18 @@ _scalar = jax.jit(lambda t: jnp.sum(t.astype(jnp.float32)))
 def sync(t):
     return float(_scalar(t))
 
+from fpga_ai_nic_tpu.ops import ring_cost
+
 out = {"stage": "loopback", "platform": platform, "sweep": [],
        "method": ("slope over K/2K side-effect-ordered kernel chains in "
                   "one dispatch (r05: per-dispatch constants cancel; the "
                   "r04 rows carried ~2ms/call of overhead); stage rows "
                   "time the SAME schedule with exactly one stage compiled "
-                  "in (ring_pallas ablate=) — a pipelined hop is bound by "
-                  "its slowest stage, so efficiency = t_slowest_stage / "
-                  "t_full, 1.0 = perfectly hidden")}
+                  "in (ring_pallas ablate=, incl. the bare 'skeleton' "
+                  "control floor), combined by ops.ring_cost into a "
+                  "modeled pipeline time — encode+decode share the VPU "
+                  "so they add — and pipeline_efficiency = modeled / "
+                  "measured, 1.0 = perfectly hidden")}
 vn = 8
 K = 8
 # resident rows cap at 4 MiB: the kernel holds input + acc copies in VMEM,
@@ -168,44 +172,34 @@ for mib, slice_elems, streaming in ((1, 8192, False), (4, 8192, False),
             kw["streaming"] = True
         if ablate:
             kw["ablate"] = ablate
+        print(f"[bench] phase=stage_{ablate or 'full'}_{mib}MiB "
+              f"t={time.time()-t0:.1f}s", flush=True)
         def mk(k):
             return chain_kernel_calls(
                 lambda v: rp.loopback_microbench(v, vn, **kw), k)
-        return slope_timeit(mk, (x,), K, sync)
+        t_iter, _ = slope_timeit(mk, (x,), K, sync)
+        return t_iter
     row = {"mib": mib, "streaming": streaming, "inner_k": K}
     try:
-        t_full, diag = measure()
-        if t_full > 0:
-            row["pipeline_gbps"] = round(hop_bytes / t_full / 1e9, 2)
-            row["t_ms"] = round(t_full * 1e3, 3)
-        row["timing"] = diag
-        print(f"[bench] {mib}MiB stream={streaming}: "
-              f"{row.get('pipeline_gbps')} GB/s", flush=True)
         # per-stage attribution on the headline rows (round-4 verdict
         # item 3: say which stage binds, then fix it): the 4 MiB
         # resident row and the 32 MiB streaming row (which adds the
         # HBM slice load/store stage the resident kernel doesn't have)
-        want_stages = (("encode", "rdma", "decode") if not streaming
-                       else ("encode", "rdma", "decode", "hbm"))
-        if mib in (4, 32) and t_full > 0:
-            stages = {}
-            for ab in want_stages:
-                print(f"[bench] phase=stage_{ab} t={time.time()-t0:.1f}s",
-                      flush=True)
-                t_s, _ = measure(ab)
-                if t_s > 0:
-                    stages[ab] = {"t_ms": round(t_s * 1e3, 3),
-                                  "gbps": round(hop_bytes / t_s / 1e9, 2)}
-            if stages:
-                row["stages"] = stages
-                binding = max(stages, key=lambda k: stages[k]["t_ms"])
-                row["binding_stage"] = binding
-                row["pipeline_efficiency"] = round(
-                    stages[binding]["t_ms"] / row["t_ms"], 3)
-                print(f"[bench] stages: " + ", ".join(
-                    f"{k}={v['t_ms']}ms" for k, v in stages.items())
-                    + f" full={row['t_ms']}ms -> binding={binding}",
-                    flush=True)
+        if mib in (4, 32):
+            row.update(ring_cost.decompose(measure, streaming, hop_bytes))
+            if row.get("stages"):
+                print("[bench] stages: " + ", ".join(
+                    f"{k}={v['t_ms']}ms" for k, v in row["stages"].items())
+                    + f" full={row.get('t_ms')}ms -> binding="
+                    f"{row.get('binding_stage')} efficiency="
+                    f"{row.get('pipeline_efficiency')}", flush=True)
+        else:
+            t_full = measure()
+            if t_full > 0:
+                row["pipeline_gbps"] = round(hop_bytes / t_full / 1e9, 2)
+                row["t_ms"] = round(t_full * 1e3, 3)
+        print(f"[bench] {mib}MiB stream={streaming}: "
+              f"{row.get('pipeline_gbps')} GB/s", flush=True)
     except Exception as e:
         row["error"] = repr(e)[:200]
         print(f"[bench] sweep failed: {e!r}", flush=True)
@@ -224,12 +218,12 @@ def _stage_canary() -> dict:
 
 
 def _stage_loopback() -> dict:
-    # budget covers the stage-ablation compiles: 3 resident variants on
-    # the 4 MiB row + 4 streaming variants on the 32 MiB row, each a
-    # K/2K chain pair (~14 extra compiles worst case; the persistent
-    # compile cache amortizes re-windows)
+    # budget covers the stage-ablation compiles: 4 resident variants on
+    # the 4 MiB row + 5 streaming variants on the 32 MiB row (skeleton
+    # included), each a K/2K chain pair (~18 extra compiles worst case;
+    # the persistent compile cache amortizes re-windows)
     return run_attempt("loopback", [sys.executable, "-u", "-c", LOOPBACK_SRC],
-                       budget_s=780.0, silence_s=300.0, cwd=REPO)
+                       budget_s=960.0, silence_s=300.0, cwd=REPO)
 
 
 def _stage_bench() -> dict:
@@ -239,10 +233,12 @@ def _stage_bench() -> dict:
 
 
 def _stage_collective() -> dict:
+    # budget covers bench_collective's own 780 s tpu attempt (the
+    # loopback stage decomposition) plus the cpu_mesh rung
     return run_attempt("collective",
                        [sys.executable, "-u",
                         os.path.join(REPO, "bench_collective.py")],
-                       budget_s=420.0, silence_s=200.0, cwd=REPO)
+                       budget_s=1260.0, silence_s=330.0, cwd=REPO)
 
 
 def _stage_trace() -> dict:

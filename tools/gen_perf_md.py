@@ -10,6 +10,7 @@ without an artifact simply does not appear.  Run via `make perf`.
 import glob
 import json
 import os
+import subprocess
 import sys
 
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -27,6 +28,77 @@ def _rel(path):
 def _newest(pattern):
     paths = sorted(glob.glob(os.path.join(ROOT, pattern)))
     return paths[-1] if paths else None
+
+
+# -- provenance stamping / staleness badges ----------------------------------
+# Every artifact carries the git sha that produced it (_provenance, written
+# by bench_common.save_artifact).  Each rendered row is stamped with that
+# sha and BADGED when the code that produced the number has changed since
+# the measurement — the round-5 verdict's item 10: the zoo table described
+# pre-flash-kernel code with no marker.  The watch lists name the code
+# whose behavior the number measures (driver + kernels), not the docs
+# around it.
+
+_WATCH = {
+    "bench": ["bench.py", "bench_common.py", "fpga_ai_nic_tpu/models/",
+              "fpga_ai_nic_tpu/ops/", "fpga_ai_nic_tpu/parallel/"],
+    "zoo": ["tools/zoo_tpu.py", "bench_common.py",
+            "fpga_ai_nic_tpu/models/", "fpga_ai_nic_tpu/ops/",
+            "fpga_ai_nic_tpu/parallel/"],
+    "collective": ["bench_collective.py", "bench_common.py",
+                   "fpga_ai_nic_tpu/ops/"],
+    "loopback": ["tools/first_contact.py", "bench_common.py",
+                 "fpga_ai_nic_tpu/ops/ring_pallas.py",
+                 "fpga_ai_nic_tpu/ops/ring_cost.py",
+                 "fpga_ai_nic_tpu/ops/bfp_pallas.py"],
+    "convergence": ["fpga_ai_nic_tpu/evals/", "fpga_ai_nic_tpu/ops/"],
+}
+
+
+def _git_lines(*args):
+    try:
+        r = subprocess.run(["git"] + list(args), capture_output=True,
+                           text=True, cwd=ROOT, timeout=15)
+        if r.returncode != 0:
+            return None
+        return [l for l in r.stdout.splitlines() if l.strip()]
+    except Exception:  # noqa: BLE001 — badge gracefully degrades
+        return None
+
+
+def _artifact_sha(d):
+    sha = (d or {}).get("_provenance", {}).get("git_sha")
+    return sha if sha and sha != "unknown" else None
+
+
+def _code_changed(sha, kind):
+    """True/False when determinable; None when not (missing sha, shallow
+    clone, git unavailable) — None renders as an explicit unknown, never
+    as silently-current."""
+    if sha is None or _git_lines("cat-file", "-e", f"{sha}^{{commit}}") is None:
+        return None
+    # sha-vs-WORKTREE diff (no second commit-ish): `make perf` run with
+    # uncommitted edits to watched code must badge STALE too — a
+    # commit-to-HEAD diff would render modified-on-disk producers as
+    # "(current)", the exact silent-currency hole the badge closes
+    changed = _git_lines("diff", "--name-only", sha, "--",
+                         *_WATCH.get(kind, []))
+    return None if changed is None else bool(changed)
+
+
+def _badge(d, kind):
+    """' @ `sha` ...' provenance suffix for a rendered row."""
+    sha = _artifact_sha(d)
+    if sha is None:
+        return " @ sha unknown (pre-stamping artifact)"
+    changed = _code_changed(sha, kind)
+    short = sha[:10]
+    if changed is None:
+        return f" @ `{short}` (staleness undeterminable)"
+    if changed:
+        return (f" @ `{short}` **[STALE: producing code changed since "
+                f"measurement]**")
+    return f" @ `{short}` (current)"
 
 
 def _reproduction_note() -> str:
@@ -79,7 +151,10 @@ def main():
          "hand-written.  Artifacts carry timestamp + git sha + platform in",
          "`_provenance` (bench drivers write them on every TPU",
          "measurement; `tools/harvest_tpu.sh` banks healthy tunnel",
-         "windows).",
+         "windows).  Each source citation is stamped with the sha that",
+         "produced it and badged **STALE** when the producing code has",
+         "changed since the measurement (`git diff` against the watch",
+         "list in `tools/gen_perf_md.py`).",
          ""]
 
     # -- headline training throughput ---------------------------------------
@@ -107,7 +182,8 @@ def main():
             L.append(f"| {d.get('value')} | {d.get('vs_baseline')} "
                      f"| {d.get('tflops_per_chip', '—')} | {mfu_s} "
                      f"| {d.get('platform')} "
-                     f"| {bool(d.get('degraded', False))} | `{src}` |")
+                     f"| {bool(d.get('degraded', False))} "
+                     f"| `{src}`{_badge(d, 'bench')} |")
         bm = next((d.get("baseline_model") for d, _ in rows
                    if d.get("baseline_model")), None)
         if bm:
@@ -127,7 +203,8 @@ def main():
         if ok_rows:
             L += ["## Model zoo (TPU, single chip, device-resident "
                   "batches)", "",
-                  f"Source: `{_rel(zoo_art)}`.  One jitted multi-step "
+                  f"Source: `{_rel(zoo_art)}`{_badge(d, 'zoo')}.  One "
+                  "jitted multi-step "
                   "dispatch (the tunnel's per-dispatch cost scales with "
                   "the state tree's buffer count and would otherwise "
                   "dominate).", "",
@@ -147,6 +224,23 @@ def main():
                          f"| {v.get('mfu', '—')} "
                          f"| {v.get('params', 0):,} |")
             L.append("")
+            dec = next((v for _, v in ok_rows if "decode_roofline" in v),
+                       None)
+            if dec:
+                rf = dec["decode_roofline"]
+                frac = rf.get("hbm_bound_frac")
+                L += [f"Decode roofline context ({rf.get('hbm_peak_ref')}): "
+                      f"{rf.get('bytes_per_token', 0):,} bytes/token "
+                      f"(weights + full-static-cache KV reads), floor "
+                      f"{rf.get('min_step_ms_at_roofline')} ms/step at "
+                      f"HBM peak"
+                      + (f" -> measured **{frac:.1%} of the byte "
+                         f"roofline** (gate: >= "
+                         f"{rf.get('gate_min_frac', 0):.0%}"
+                         f"{', FAILING' if not rf.get('gate_ok', True) else ''})"
+                         if frac is not None else
+                         " (no measured fraction in this artifact)")
+                      + ".", ""]
             rows_d = dict(ok_rows)
             bf, f32 = rows_d.get("resnet50_dp1"), rows_d.get(
                 "resnet50_f32_dp1")
@@ -188,7 +282,8 @@ def main():
         d = _load(col_art)
         src = _rel(col_art)
         L += ["## Collective / wire path", "",
-              f"Source: `{src}` (platform: {d.get('platform')}, "
+              f"Source: `{src}`{_badge(d, 'collective')} "
+              f"(platform: {d.get('platform')}, "
               f"{d.get('n_devices')} device(s))", ""]
         pairs = [
             ("codec roundtrip", "codec_roundtrip_gbps"),
@@ -220,32 +315,42 @@ def main():
                                f"({cons.get('rule', '')})")
             L += [f"Codec measurement: slope over K/2K chained passes "
                   f"(fixed dispatch cost cancels).  {verdictline}.", ""]
+        # loopback decomposition rows: the collective artifact's own
+        # fused_ring_loopback list (new schema) falls back to the
+        # first-contact loopback artifact (either schema)
         lb_art = _newest("artifacts/first_contact_loopback_*.json")
-        if lb_art:
+        lb_rows, lb_src, lb_badge = [], None, ""
+        if d.get("fused_ring_loopback"):
+            lb_rows, lb_src = d["fused_ring_loopback"], src
+        elif lb_art:
             lb = _load(lb_art)
-            rows_ = [r for r in (lb.get("sweep") or [])
-                     if "pipeline_gbps" in r]
-            if rows_:
-                L += [f"### Fused ring loopback (source: `{_rel(lb_art)}`)",
-                      "", "| payload | streaming | pipeline GB/s |",
-                      "|---|---|---|"]
-                for r in rows_:
-                    L.append(f"| {r['mib']} MiB | {r['streaming']} "
-                             f"| {r['pipeline_gbps']} |")
-                L.append("")
-                staged = next((r for r in rows_ if r.get("stages")), None)
-                if staged:
-                    st = staged["stages"]
-                    L += [f"Per-stage split at {staged['mib']} MiB "
-                          "(one stage of the same schedule compiled in; "
-                          "a pipelined hop is bound by its slowest "
-                          "stage): "
-                          + ", ".join(f"{k} {v['t_ms']} ms"
-                                      for k, v in st.items())
-                          + f" vs full {staged['t_ms']} ms -> binding "
-                          f"stage **{staged['binding_stage']}**, pipeline "
-                          f"efficiency "
-                          f"{staged['pipeline_efficiency']}.", ""]
+            lb_rows = lb.get("sweep") or []
+            lb_src = _rel(lb_art)
+            lb_badge = _badge(lb, "loopback")
+        lb_rows = [r for r in lb_rows if "pipeline_gbps" in r]
+        if lb_rows:
+            L += [f"### Fused ring loopback (source: `{lb_src}`"
+                  f"{lb_badge})", "",
+                  "| payload | streaming | pipeline GB/s | modeled ms "
+                  "| measured ms | efficiency | binding |",
+                  "|---|---|---|---|---|---|---|"]
+            for r in lb_rows:
+                L.append(f"| {r['mib']} MiB | {r['streaming']} "
+                         f"| {r['pipeline_gbps']} "
+                         f"| {r.get('modeled_t_ms', '—')} "
+                         f"| {r.get('t_ms', '—')} "
+                         f"| {r.get('pipeline_efficiency', '—')} "
+                         f"| {r.get('binding_stage', '—')} |")
+            L.append("")
+            for r in lb_rows:
+                if r.get("stages"):
+                    L.append(
+                        f"- per-stage at {r['mib']} MiB: "
+                        + ", ".join(f"{k} {v['t_ms']} ms"
+                                    for k, v in r["stages"].items())
+                        + f" -> binding **{r.get('binding_stage')}**, "
+                        f"efficiency {r.get('pipeline_efficiency')}")
+            L.append("")
         sweep = d.get("sweep") or d.get("mesh_sweep")
         if sweep:
             plat = (d.get("platform") if d.get("sweep")
@@ -285,6 +390,34 @@ def main():
                     L += _render_sweep(
                         sweep, f"`{_rel(cpu_art)}`, platform: "
                                f"{dc.get('platform')}")
+
+    # -- methodology: per-stage roofline accounting --------------------------
+    L += ["## Methodology: pipeline efficiency", "",
+          "Loopback rows are slope-timed (chains of K and 2K "
+          "side-effect-ordered kernel calls in one dispatch, "
+          "differenced — every per-dispatch constant cancels, "
+          "`bench_common.slope_timeit`).  Each row's per-stage split "
+          "runs the SAME slice schedule with exactly one stage compiled "
+          "in (`ring_pallas` `ablate=`: encode / rdma / decode / hbm, "
+          "plus the bare `skeleton` control floor).  `ops.ring_cost` "
+          "combines them into the predicted time of a perfectly "
+          "overlapped pipeline:", "",
+          "```",
+          "t_vpu   = t_encode + t_decode - t_skeleton   "
+          "# codec stages share the VPU: they ADD",
+          "t_model = max(t_vpu, t_rdma, t_hbm)          "
+          "# a pipelined hop runs at its slowest RESOURCE",
+          "pipeline_efficiency = t_model / t_full       "
+          "# 1.0 = every other stage fully hidden",
+          "```", "",
+          "`binding` names the argmax resource — the stage to optimize "
+          "next.  The break-even table is built from the same serial-VPU "
+          "model (the harmonic-combined codec rate must exceed 2x the "
+          "link rate to win), using the fused kernel's own ablated "
+          "stage rates when a decomposition row exists.  Target "
+          "(ROADMAP / round-5 verdict item 2): efficiency >= 0.8 and "
+          "loopback no slower than the slowest single stage at 4-32 "
+          "MiB.", ""]
 
     # -- convergence ---------------------------------------------------------
     conv = os.path.join(ROOT, "docs", "bfp_convergence.json")
