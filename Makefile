@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -30,6 +30,17 @@ collective:
 # regenerate docs/PERF.md strictly from committed artifacts
 perf:
 	python tools/gen_perf_md.py
+
+# the codec x {vmem, streaming} matrix: every registered compression
+# codec's encode/decode/roundtrip slope rates at both payload classes,
+# plus per-codec compression ratio and serial-VPU break-even
+# (bench_collective.codec_matrix_child); snapshot the newest artifact as
+# the round's committed record, same contract as `make collective`
+codec-bench:
+	python bench_collective.py --codec-matrix
+	@latest=$$(ls -t artifacts/codec_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest CODEC_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> CODEC_BENCH_$(ROUND).json"
 
 # multi-chip conversion kit: on any >= 2-real-chip surface this banks the
 # canary -> busbw (bf16 psum vs BFP rings) -> trace-attribution ladder

@@ -415,6 +415,175 @@ def child_main() -> None:
 
 
 # ---------------------------------------------------------------------------
+# codec matrix (`make codec-bench`): codec x {vmem, streaming} payloads
+# ---------------------------------------------------------------------------
+
+# the two payload classes mirror the fused ring's residency split
+# (ops.ring_pallas): "vmem" = fits the resident kernel's on-chip working
+# set, "streaming" = the HBM-streaming size class.  For the separate-op
+# codec chains they are honest size regimes either way (small enough to
+# stay cache-warm vs large enough to stream memory).
+CODEC_MATRIX_MB = (("vmem", 4), ("streaming", 32))
+CODEC_MATRIX_K = 16
+
+# eval-suited constructor opts per codec (registry defaults otherwise)
+CODEC_MATRIX_OPTS = {"bfp": (), "topk": (), "int8": ()}
+
+
+def codec_matrix_child() -> None:
+    """Measure every registered codec's encode/decode/roundtrip GB/s at
+    both payload classes (slope-timed chains — per-dispatch constants
+    cancel, bench_common.slope_timeit), plus per-codec compression ratio
+    and the serial-VPU break-even table (ops.ring_cost.codec_break_even).
+    One JSON line on stdout; merged/saved by the parent."""
+    t0 = time.time()
+
+    def phase(name):
+        log(f"phase={name} t={time.time() - t0:.1f}s")
+
+    phase("import")
+    import jax
+    enable_compile_cache(jax)
+    import jax.numpy as jnp
+    from jax import lax
+
+    from fpga_ai_nic_tpu import compress
+    from fpga_ai_nic_tpu.ops import ring_cost
+
+    platform = jax.default_backend()
+    report = {
+        "metric": "codec_matrix",
+        "platform": platform,
+        "n_devices": jax.device_count(),
+        "payload_classes": {name: f"{mib} MiB" for name, mib
+                            in CODEC_MATRIX_MB},
+        "method": (f"slope over K/2K chained passes (K={CODEC_MATRIX_K}) "
+                   "in one dispatch; rates are floors off-TPU (full-"
+                   "output consumption defeats DCE on the fusible XLA "
+                   "codecs — same caveat as the main collective bench)"),
+        "codec_table": ring_cost.codec_table(),
+        "rows": [],
+    }
+
+    _scalar = jax.jit(lambda t: sum(
+        jnp.sum(l.astype(jnp.float32))
+        for l in jax.tree_util.tree_leaves(t)))
+
+    def sync(tree):
+        return float(_scalar(tree))
+
+    for name in compress.available_codecs():
+        codec = compress.get_codec(name, dict(CODEC_MATRIX_OPTS.get(name,
+                                                                    ())))
+        for klass, mib in CODEC_MATRIX_MB:
+            n_elems = mib * (1 << 20) // 4
+            n_elems -= n_elems % codec.pad_elems
+            gb = n_elems * 4 / 1e9
+            phase(f"{name} {klass} ({mib} MiB)")
+            x = jax.random.normal(jax.random.PRNGKey(0), (n_elems,),
+                                  jnp.float32)
+
+            def mk_rt(k, _c=codec):
+                @jax.jit
+                def chain(v):
+                    def body(i, v):
+                        return _c.roundtrip(v)
+                    return lax.fori_loop(0, k, body, v)
+                return chain
+
+            def mk_enc(k, _c=codec):
+                @jax.jit
+                def chain(v):
+                    def body(i, carry):
+                        v, acc = carry
+                        v = v.at[0].add(acc * 1e-40)
+                        pay = _c.encode(v)
+                        acc = sum(jnp.sum(p.astype(jnp.float32))
+                                  for p in pay)
+                        return v, acc
+                    return lax.fori_loop(0, k, body, (v, jnp.float32(0)))[1]
+                return chain
+
+            pay0 = jax.jit(codec.encode)(x)
+
+            def mk_dec(k, _c=codec, _n=n_elems):
+                @jax.jit
+                def chain(*pay):
+                    def body(i, acc):
+                        rolled = (jnp.roll(pay[0], i, axis=0),) + pay[1:]
+                        out = _c.decode(rolled, _n, jnp.float32)
+                        return acc + jnp.sum(out)
+                    return lax.fori_loop(0, k, body, jnp.float32(0))
+                return chain
+
+            row = {"codec": name, "class": klass, "mib": mib,
+                   "compression_ratio_vs_f32":
+                       round(codec.compression_ratio_vs_f32, 3),
+                   "wire_bytes_per_value":
+                       round(codec.wire_bytes(n_elems) / n_elems, 4)}
+            for stage, mk, args in (("roundtrip", mk_rt, (x,)),
+                                    ("encode", mk_enc, (x,)),
+                                    ("decode", mk_dec, tuple(pay0))):
+                try:
+                    t_iter, diag = slope_timeit(mk, args, CODEC_MATRIX_K,
+                                                sync)
+                except Exception as e:  # noqa: BLE001 — best-effort cell
+                    row[f"{stage}_error"] = repr(e)[:200]
+                    continue
+                row[f"{stage}_gbps"] = (round(gb / t_iter, 2)
+                                        if t_iter > 0 else 0.0)
+                log(f"{name} {klass} {stage}: {row.get(f'{stage}_gbps')} "
+                    "GB/s")
+            enc_g = row.get("encode_gbps") or 0.0
+            dec_g = row.get("decode_gbps") or 0.0
+            if klass == "streaming" and enc_g and dec_g:
+                row["break_even"] = ring_cost.codec_break_even(
+                    codec, enc_g, dec_g,
+                    source=f"{klass} slope chains ({platform})")
+            report["rows"].append(row)
+
+    phase("done")
+    print(json.dumps(report), flush=True)
+
+
+def codec_matrix_main() -> None:
+    """Parent for `make codec-bench`: same wedge-proof ladder discipline
+    as main() — the deciding process never imports jax; a healthy TPU rung
+    wins, else the 8-device CPU mesh rung runs the matrix."""
+    from bench_common import probe_tpu
+    here = os.path.abspath(__file__)
+    attempts = [
+        {"name": "tpu", "cpu": False, "budget_s": 600.0, "silence_s": 240.0},
+        {"name": "cpu_mesh", "cpu": True, "budget_s": 600.0,
+         "silence_s": 240.0},
+    ]
+    errors, result = [], None
+    for att in attempts:
+        if not att["cpu"] and not probe_tpu():
+            errors.append(f"{att['name']}: skipped, tunnel wedged at probe")
+            continue
+        env = cpu_env(8) if att["cpu"] else dict(os.environ)
+        try:
+            result = run_attempt(
+                att["name"],
+                [sys.executable, "-u", here, "--codec-matrix-child"],
+                env=env, budget_s=att["budget_s"],
+                silence_s=att["silence_s"], cwd=os.path.dirname(here))
+            break
+        except Exception as e:  # noqa: BLE001 — one JSON line must happen
+            log(str(e))
+            errors.append(f"{att['name']}: {e}")
+    if result is None:
+        print(json.dumps({"metric": "codec_matrix",
+                          "error": "; ".join(errors)[:800]}), flush=True)
+        sys.exit(1)
+    if errors:
+        result["failed_attempts"] = errors
+    save_artifact("codec_bench", result)
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
 # parent
 # ---------------------------------------------------------------------------
 
@@ -470,5 +639,9 @@ def main() -> None:
 if __name__ == "__main__":
     if len(sys.argv) >= 2 and sys.argv[1] == "--child":
         child_main()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--codec-matrix-child":
+        codec_matrix_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--codec-matrix":
+        codec_matrix_main()
     else:
         main()

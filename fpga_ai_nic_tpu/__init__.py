@@ -6,6 +6,11 @@ optionally compressing ring traffic with block-floating-point (BFP).  This
 package rebuilds every capability of that system TPU-first:
 
 - ``ops.bfp``          — BFP codec (ref: hw/bf16_to_bfp_core.sv, hw/bfp_to_bf16_core.sv)
+- ``compress``         — pluggable gradient-compression codec subsystem: the
+                         Codec protocol + registry with bfp / top-k (error
+                         feedback, SparCML-style) / int8 (stochastic rounding,
+                         EQuARX-style) — the generalization of the single
+                         wire trick the reference hard-wires (docs/COMPRESSION.md)
 - ``ops.ring``         — sliced ring reduce-scatter / all-gather over ``lax.ppermute``
                          (ref: hw/all_reduce.sv st_eth_t FSM)
 - ``ops.fused_update`` — fused scatter → SGD → all-gather-of-updated-weights

@@ -1,0 +1,35 @@
+"""Pluggable gradient-compression codecs for the ring collectives.
+
+The reference ships ONE wire codec (BFP, hw/bfp_adapter.sv).  This package
+turns that single trick into a framework seam: a formal `Codec` protocol
+(encode/decode payload tuples, error-feedback residual carry, declared
+error bound — see `compress.base`), a name registry, numpy golden twins
+(`compress.golden`), and three registered implementations:
+
+  bfp    the reference wire format, refactored out of the previously
+         hard-wired path — behavior-identical (`compress.bfp`)
+  topk   per-bucket magnitude top-k with error feedback, SparCML-style
+         (`compress.topk`)
+  int8   per-block linear int8 with stochastic rounding, EQuARX-style,
+         with fused Pallas encode/decode kernels (`compress.int8`)
+
+Select via ``CollectiveConfig(impl="ring", codec="topk",
+codec_opts=(("k", 32),))``; the legacy ``compression=BFPConfig(...)``
+spelling still resolves to the bfp codec (`resolve`).  Unknown names fail
+fast at config construction with the registered list.
+"""
+
+from .base import (Codec, as_codec, available_codecs, get_codec,  # noqa: F401
+                   register, resolve)
+from . import base, golden  # noqa: F401
+# importing the implementation modules registers them
+from . import bfp, int8, topk  # noqa: F401
+from .bfp import BFPCodec  # noqa: F401
+from .int8 import Int8Codec  # noqa: F401
+from .topk import TopKCodec  # noqa: F401
+
+__all__ = [
+    "Codec", "BFPCodec", "TopKCodec", "Int8Codec",
+    "register", "get_codec", "available_codecs", "resolve", "as_codec",
+    "base", "bfp", "topk", "int8", "golden",
+]

@@ -1,0 +1,218 @@
+"""Codec protocol + registry — the pluggable gradient-compression layer.
+
+The reference NIC ships exactly one wire codec, baked into RTL
+(hw/bfp_adapter.sv sitting between the ring engine and the MAC); our
+reproduction initially hard-wired the same choice by name through
+`ops.ring`, `ops.ring_pallas` and `runtime.chaos`.  But BFP is one point
+in a family: SparCML (arXiv:1802.08021) ships sparse top-k with error
+feedback, EQuARX (arXiv:2506.17615) ships low-bit block-quantized
+all-reduce.  This module is the seam that lets all of them ride the same
+ring: a formal ``Codec`` contract, a name registry, and the resolution
+rule from ``CollectiveConfig(codec=..., codec_opts=...)``.
+
+The contract (what the ring, the trainers and the integrity layer each
+rely on):
+
+  encode/decode   The wire transform.  ``encode`` maps a flat f32 vector
+                  to a TUPLE of arrays (the hop payload — each element is
+                  ``lax.ppermute``d independently); ``decode`` inverts it
+                  given the element count.  Both run inside jit/shard_map.
+  pad_elems       Element alignment of one independent compression unit
+                  (BFP block / top-k bucket / int8 block).  Flat vectors
+                  are padded so each device chunk is a whole number of
+                  units (`ops.fused_update.pad_multiple`), and ring slices
+                  must be unit multiples so slicing changes the schedule,
+                  never the bits (`sliceable`).
+  error_feedback  Whether the codec wants a residual carried across steps
+                  (``state_init``): lossy-by-design codecs (top-k) re-add
+                  what they dropped to the next step's gradient, turning
+                  a biased one-shot truncation into an unbiased-in-the-
+                  limit stream (SparCML §3).  The trainers thread the
+                  residual through ``TrainState``/``FSDPState``.
+  error_bound     Declared per-pass worst-case |x - decode(encode(x))| as
+                  a fraction of the unit's max-abs value.  The collective
+                  integrity layer (`runtime.chaos.integrity_tol`) derives
+                  its corruption-vs-quantization tripwire from THIS
+                  number instead of special-casing BFP: anything outside
+                  the declared bound is corruption, anything inside must
+                  pass.
+  idempotent      decode∘encode is a projection (second pass is bit-
+                  identical).  The ring all-gather forwards one encoded
+                  payload verbatim either way, but idempotent codecs
+                  additionally guarantee sliced/unsliced hop equality
+                  under re-encoding and exact EF fixed points.
+  supports_fused  May ride the fused Pallas ring (`ops.ring_pallas`),
+                  whose wire frames are int8 mantissa+scale tiles — today
+                  BFP only; the registry check turns a silent fallback
+                  into a fail-fast config error.
+
+Every codec must have a numpy golden twin in `compress.golden`, and the
+JAX implementation must match it bit for bit (tests/test_codec.py) — the
+same spec-first discipline as `ops.bfp_golden`/`ops.ring_golden`.
+"""
+
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, Mapping, Optional, Tuple, Type
+
+import jax
+import jax.numpy as jnp
+
+
+class Codec(abc.ABC):
+    """One gradient-compression wire format (see module docstring)."""
+
+    #: registry key (class attribute; set by subclasses)
+    name: str = ""
+    #: decode∘encode is a projection: a second pass is bit-identical
+    idempotent: bool = False
+    #: carries an error-feedback residual across trainer steps
+    error_feedback: bool = False
+    #: may ride the fused Pallas ring kernels (ops.ring_pallas)
+    supports_fused: bool = False
+
+    # -- wire transform -----------------------------------------------------
+
+    @abc.abstractmethod
+    def encode(self, x: jax.Array) -> Tuple[jax.Array, ...]:
+        """Flat f32/bf16 [n] (n % pad_elems == 0) -> payload tuple."""
+
+    @abc.abstractmethod
+    def decode(self, payload: Tuple[jax.Array, ...], n_elems: int,
+               dtype=jnp.float32) -> jax.Array:
+        """Payload tuple -> flat [n_elems] in ``dtype``."""
+
+    def roundtrip(self, x: jax.Array) -> jax.Array:
+        """decode(encode(x)) — the quantization one wire pass applies."""
+        return self.decode(self.encode(x), x.shape[0], x.dtype)
+
+    # -- structure ----------------------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def pad_elems(self) -> int:
+        """Elements per independent compression unit (alignment quantum)."""
+
+    def sliceable(self, chunk_elems: int, slice_elems: Optional[int]) -> bool:
+        """May a [chunk_elems] hop be streamed as [slice_elems] slices with
+        IDENTICAL bits?  True only when slicing cannot change the unit
+        partition (and actually splits the chunk)."""
+        return (slice_elems is not None
+                and chunk_elems > slice_elems
+                and chunk_elems % slice_elems == 0
+                and slice_elems % self.pad_elems == 0)
+
+    # -- error-feedback residual -------------------------------------------
+
+    def state_init(self, n_elems: int) -> Optional[jax.Array]:
+        """Fresh residual carry for an [n_elems] gradient stream (None for
+        codecs without error feedback)."""
+        if not self.error_feedback:
+            return None
+        return jnp.zeros((n_elems,), jnp.float32)
+
+    # -- declared accuracy / rate ------------------------------------------
+
+    @property
+    @abc.abstractmethod
+    def error_bound(self) -> float:
+        """Worst-case per-element |x - roundtrip(x)| as a fraction of the
+        unit's max-abs value, for ONE encode/decode pass.  The integrity
+        layer treats anything beyond this (x hop count, see
+        runtime.chaos.integrity_tol) as corruption."""
+
+    @abc.abstractmethod
+    def wire_bytes(self, n_elems: int) -> int:
+        """Bytes one encoded [n_elems] payload puts on the wire."""
+
+    @property
+    def compression_ratio_vs_f32(self) -> float:
+        n = self.pad_elems
+        return 4.0 * n / self.wire_bytes(n)
+
+    # -- description --------------------------------------------------------
+
+    def describe(self) -> Dict[str, Any]:
+        """Static facts for bench artifacts / docs tables."""
+        return {
+            "codec": self.name,
+            "pad_elems": self.pad_elems,
+            "compression_ratio_vs_f32":
+                round(self.compression_ratio_vs_f32, 3),
+            "error_bound": self.error_bound,
+            "error_feedback": self.error_feedback,
+            "idempotent": self.idempotent,
+            "supports_fused": self.supports_fused,
+        }
+
+    def __repr__(self) -> str:  # pragma: no cover - debugging nicety
+        return f"{type(self).__name__}({self.describe()})"
+
+
+# ---------------------------------------------------------------------------
+# registry
+# ---------------------------------------------------------------------------
+
+_REGISTRY: Dict[str, Type[Codec]] = {}
+
+
+def register(cls: Type[Codec]) -> Type[Codec]:
+    """Class decorator: add a Codec subclass under ``cls.name``."""
+    assert issubclass(cls, Codec) and cls.name, cls
+    _REGISTRY[cls.name] = cls
+    return cls
+
+
+def available_codecs() -> Tuple[str, ...]:
+    return tuple(sorted(_REGISTRY))
+
+
+def get_codec(name: str, opts: Optional[Mapping[str, Any]] = None) -> Codec:
+    """Instantiate a registered codec by name.
+
+    Unknown names fail fast and NAME the alternatives — a config typo must
+    die at construction, not at first collective trace (satellite of the
+    codec-subsystem issue)."""
+    if name not in _REGISTRY:
+        raise ValueError(
+            f"unknown codec {name!r}: registered codecs are "
+            f"{list(available_codecs())}")
+    return _REGISTRY[name](**dict(opts or {}))
+
+
+def resolve(coll) -> Optional[Codec]:
+    """The codec a CollectiveConfig asks for (None = uncompressed).
+
+    Resolution order:
+      - ``coll.codec`` names a registered codec; ``coll.codec_opts``
+        (a (key, value) tuple-of-pairs, kept hashable for the frozen
+        dataclass) are its constructor kwargs.  ``codec="bfp"`` honors a
+        simultaneously-set ``coll.compression`` BFPConfig.
+      - legacy: ``coll.compression`` alone still means BFP (the pre-
+        subsystem spelling; every existing call site keeps working).
+    """
+    from .bfp import BFPCodec
+    name = getattr(coll, "codec", None)
+    if name:
+        opts = dict(getattr(coll, "codec_opts", ()) or ())
+        if name == "bfp" and coll.compression is not None:
+            return BFPCodec(cfg=coll.compression, **opts)
+        return get_codec(name, opts)
+    if getattr(coll, "compression", None) is not None:
+        return BFPCodec(cfg=coll.compression)
+    return None
+
+
+def as_codec(compression) -> Optional[Codec]:
+    """Normalize a ring-level ``compression=`` argument: None, a Codec, or
+    (back-compat) a bare BFPConfig."""
+    if compression is None or isinstance(compression, Codec):
+        return compression
+    from ..utils.config import BFPConfig
+    if isinstance(compression, BFPConfig):
+        from .bfp import BFPCodec
+        return BFPCodec(cfg=compression)
+    raise TypeError(
+        f"compression must be None, a compress.Codec, or a BFPConfig; "
+        f"got {type(compression).__name__}")

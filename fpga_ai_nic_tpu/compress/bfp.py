@@ -1,0 +1,137 @@
+"""BFP as a registered codec — the reference wire format behind the
+generic `compress.Codec` seam.
+
+This is a REFACTOR, not a reimplementation: the encode/decode pair and the
+pallas-vs-xla dispatch are the exact functions `ops.ring` hard-wired before
+the codec subsystem existed (`use_pallas`/`codec_pair` below are that code,
+moved), so ``codec="bfp"`` is bit-identical to the legacy
+``compression=BFPConfig(...)`` path — enforced by tests/test_codec.py's
+bit-compare and by every pre-existing golden test in tests/test_ring.py,
+which still run through this module.
+
+Numerics spec: `ops.bfp_golden` ("flat16" layout for the XLA backend,
+"sublane" for the Pallas kernels).  error_bound: one ULP of the block grid,
+``2**(1 - mantissa_bits)`` of the block max (the bound
+`runtime.chaos.integrity_tol` used to special-case).
+"""
+
+from __future__ import annotations
+
+from dataclasses import replace
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .base import Codec, register
+from ..ops import bfp as _bfp_xla
+from ..ops import bfp_pallas as _bfp_pl
+from ..utils.config import BFPConfig
+
+
+def use_pallas(cfg: BFPConfig, n_elems: int) -> bool:
+    """Does this payload ride the fused Pallas codec kernels?  (Moved
+    verbatim from ops.ring._use_pallas — the dispatch is part of the bit
+    contract: xla and pallas backends quantize in different block
+    partitions.)"""
+    return cfg.codec == "pallas" or (
+        cfg.codec == "auto" and _bfp_pl._is_tpu()
+        and n_elems % (cfg.block_size * _bfp_pl.LANES) == 0)
+
+
+def codec_pair(cfg: BFPConfig, n_elems: int):
+    """(encode, decode) for a flat [n_elems] payload (moved verbatim from
+    ops.ring._codec).
+
+    codec="auto" picks the fused Pallas kernels on TPU when the payload
+    tiles onto (block, 128)-lane registers, else the XLA ops; the default
+    "xla" keeps golden bit-exactness on every platform (see BFPConfig)."""
+    if use_pallas(cfg, n_elems):
+        # inline (un-jitted) kernels: a nested closed_call inside a
+        # vma-checked shard_map trips the checker
+        def enc(x):
+            return _bfp_pl.bfp_encode_inline(x, cfg.block_size,
+                                             cfg.mantissa_bits,
+                                             cfg.rounding)
+
+        def dec(mant, se, dtype):
+            return _bfp_pl.bfp_decode_inline(mant, se, cfg.block_size,
+                                             dtype)
+    else:
+        def enc(x):
+            return _bfp_xla.bfp_encode(x, cfg.block_size,
+                                       cfg.mantissa_bits, cfg.rounding)
+
+        def dec(mant, se, dtype):
+            return _bfp_xla.bfp_decode(mant, se, cfg.block_size, dtype)
+
+    return enc, dec
+
+
+@register
+class BFPCodec(Codec):
+    """Block-floating-point: int8 mantissas + one shared int8 power-of-two
+    exponent per block (hw/bfp_adapter.sv's 136b-per-512b frame)."""
+
+    name = "bfp"
+    idempotent = True          # re-quantizing the decoded grid is exact
+    error_feedback = False     # bounded error; EF optional via opts
+    supports_fused = True      # ops.ring_pallas's wire frames ARE this
+
+    def __init__(self, cfg: Optional[BFPConfig] = None,
+                 error_feedback: bool = False, **overrides):
+        """``overrides`` are BFPConfig fields (mantissa_bits=..., etc.) so
+        ``codec_opts`` can parameterize without constructing a BFPConfig;
+        ``error_feedback=True`` opts the bounded codec into a residual
+        carry too (useful at low mantissa widths)."""
+        self.cfg = replace(cfg or BFPConfig(), **overrides)
+        self.error_feedback = bool(error_feedback)
+
+    # -- wire transform -----------------------------------------------------
+
+    def encode(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        enc, _ = codec_pair(self.cfg, x.shape[0])
+        return tuple(enc(x))
+
+    def decode(self, payload, n_elems: int, dtype=jnp.float32) -> jax.Array:
+        mant, se = payload
+        _, dec = codec_pair(self.cfg, n_elems)
+        return dec(mant, se, dtype)
+
+    # -- structure ----------------------------------------------------------
+
+    @property
+    def pad_elems(self) -> int:
+        return self.cfg.block_size
+
+    def sliceable(self, chunk_elems, slice_elems) -> bool:
+        cfg = self.cfg
+        return (super().sliceable(chunk_elems, slice_elems)
+                # sliced and whole-chunk paths must resolve to the SAME
+                # backend, or slicing would change the block partition
+                # (and the bits)
+                and use_pallas(cfg, slice_elems) == use_pallas(cfg,
+                                                               chunk_elems)
+                # a pallas-bound slice must actually tile onto (block, 128)
+                # lanes; fall back to the whole-chunk hop instead of
+                # tripping the kernel's tiling assert (forced
+                # codec="pallas" case)
+                and not (use_pallas(cfg, slice_elems)
+                         and slice_elems % (cfg.block_size * _bfp_pl.LANES)))
+
+    # -- declared accuracy / rate ------------------------------------------
+
+    @property
+    def error_bound(self) -> float:
+        # one grid step of the block's scale: 2^(1-m) of the block max
+        return 2.0 ** (1 - self.cfg.mantissa_bits)
+
+    def wire_bytes(self, n_elems: int) -> int:
+        return _bfp_xla.wire_bytes(n_elems, self.cfg)
+
+    def describe(self):
+        d = super().describe()
+        d.update(block_size=self.cfg.block_size,
+                 mantissa_bits=self.cfg.mantissa_bits,
+                 rounding=self.cfg.rounding, backend=self.cfg.codec)
+        return d

@@ -1,0 +1,213 @@
+"""Numpy golden models for every registered codec + the codec-generic
+ring golden — the bit-level spec the JAX implementations must match.
+
+Same discipline as `ops.bfp_golden`/`ops.ring_golden` (which remain the
+BFP spec and are reused here): the golden is the specification, the JAX/
+Pallas code is an implementation, and tests/test_codec.py holds them
+bit-for-bit equal — including tie-breaking (top-k) and the stochastic-
+rounding hash (int8), which are therefore part of the contract, not
+implementation accidents.
+
+`ring_reduce_scatter`/`ring_all_gather`/`ring_all_reduce` here generalize
+`ops.ring_golden` from "BFPConfig or None" to ANY (encode∘decode)
+roundtrip callable, with the identical hop schedule and f32 add order —
+so a single golden covers the codec x slice_elems matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import numpy as np
+
+from ..ops import bfp_golden
+
+RoundtripFn = Callable[[np.ndarray], np.ndarray]
+
+
+# ---------------------------------------------------------------------------
+# top-k (spec for compress.topk.TopKCodec)
+# ---------------------------------------------------------------------------
+
+def topk_encode(x: np.ndarray, bucket_elems: int = 512, k: int = 64):
+    """Flat f32 [n] -> (values f32 [nb, k], indices int16 [nb, k]).
+
+    Tie rule (the lax.top_k contract): equal magnitudes keep ascending
+    index order — reproduced by a STABLE argsort on the negated
+    magnitudes."""
+    x = np.asarray(x, np.float32)
+    assert x.ndim == 1 and x.shape[0] % bucket_elems == 0
+    xb = x.reshape(-1, bucket_elems)
+    order = np.argsort(-np.abs(xb), axis=-1, kind="stable")[:, :k]
+    vals = np.take_along_axis(xb, order, axis=-1)
+    return vals, order.astype(np.int16)
+
+
+def topk_decode(vals: np.ndarray, idx: np.ndarray, n_elems: int,
+                bucket_elems: int = 512) -> np.ndarray:
+    nb = n_elems // bucket_elems
+    out = np.zeros((nb, bucket_elems), np.float32)
+    rows = np.arange(nb)[:, None]
+    out[rows, idx.astype(np.int64)] = vals
+    return out.reshape(n_elems)
+
+
+def topk_roundtrip(x: np.ndarray, bucket_elems: int = 512,
+                   k: int = 64) -> np.ndarray:
+    vals, idx = topk_encode(x, bucket_elems, k)
+    return topk_decode(vals, idx, x.shape[0], bucket_elems)
+
+
+# ---------------------------------------------------------------------------
+# int8 (spec for compress.int8.Int8Codec)
+# ---------------------------------------------------------------------------
+
+def hash_u01(bits: np.ndarray, seed: int) -> np.ndarray:
+    """Numpy twin of compress.int8._hash_u01 (murmur3 finalizer over the
+    value bits ^ seed stamp); constants are the bit spec."""
+    with np.errstate(over="ignore"):
+        z = bits.astype(np.uint32) ^ np.uint32((seed * 0x9E3779B9)
+                                               & 0xFFFFFFFF)
+        z = z ^ (z >> np.uint32(16))
+        z = z * np.uint32(0x85EBCA6B)
+        z = z ^ (z >> np.uint32(13))
+        z = z * np.uint32(0xC2B2AE35)
+        z = z ^ (z >> np.uint32(16))
+    return (z >> np.uint32(8)).astype(np.float32) * np.float32(2.0 ** -24)
+
+
+def _to_bf16(x: np.ndarray) -> np.ndarray:
+    """f32 -> bf16 (round-to-nearest-even), kept as ml_dtypes.bfloat16 —
+    the exact cast jax's .astype(jnp.bfloat16) performs."""
+    import ml_dtypes
+    return x.astype(ml_dtypes.bfloat16)
+
+
+def int8_encode(x: np.ndarray, block_size: int = 16,
+                rounding: str = "stochastic", seed: int = 0,
+                layout: str = "flat16"):
+    """Flat f32 [n] -> (int8 q [n], bf16 scale [n/block]).  The bf16
+    scale makes the decode product exact in f32 (<= 15 significand bits)
+    — the FMA-immunity the spec requires; see compress.int8.
+
+    layout: "flat16" = consecutive-element blocks (the XLA backend);
+    "sublane" = lane-column blocks (the Pallas kernels) — reusing
+    ops.bfp_golden's partition machinery so the two codecs share one
+    layout definition."""
+    x = np.ascontiguousarray(x, np.float32)
+    xb = bfp_golden._to_blocks(x, block_size, layout)
+    maxabs = np.abs(xb).max(axis=-1)
+    # multiply-by-reciprocal + bf16 rounding is the bit spec
+    # (see compress.int8)
+    scale = _to_bf16(np.where(maxabs > 0, maxabs * np.float32(1.0 / 127.0),
+                              np.float32(1.0)).astype(np.float32))
+    v = xb / scale.astype(np.float32)[..., None]
+    if rounding == "stochastic":
+        bits = bfp_golden._to_blocks(x.view(np.uint32), block_size, layout)
+        v = np.floor(v + hash_u01(bits, seed))
+    elif rounding == "nearest":
+        v = np.rint(v)
+    else:
+        raise ValueError(rounding)
+    q = np.clip(v, -127.0, 127.0).astype(np.int8)
+    return (bfp_golden._from_blocks(q, x.shape, block_size, layout),
+            scale.reshape(-1))
+
+
+def int8_decode(q: np.ndarray, scale: np.ndarray, block_size: int = 16,
+                dtype=np.float32, layout: str = "flat16") -> np.ndarray:
+    qb = bfp_golden._to_blocks(np.asarray(q, np.int8), block_size,
+                               layout).astype(np.float32)
+    x = qb * np.asarray(scale).reshape(-1).astype(np.float32)[..., None]
+    return bfp_golden._from_blocks(x, q.shape, block_size, layout).astype(
+        dtype)
+
+
+def int8_roundtrip(x: np.ndarray, block_size: int = 16,
+                   rounding: str = "stochastic", seed: int = 0,
+                   layout: str = "flat16") -> np.ndarray:
+    q, s = int8_encode(x, block_size, rounding, seed, layout)
+    return int8_decode(q, s, block_size, np.float32, layout)
+
+
+# ---------------------------------------------------------------------------
+# codec-generic roundtrip lookup
+# ---------------------------------------------------------------------------
+
+def roundtrip_fn(codec) -> RoundtripFn:
+    """The numpy golden roundtrip matching a compress.Codec instance's
+    configuration (including backend/layout dispatch by payload size)."""
+    from .bfp import BFPCodec, use_pallas
+    from .int8 import Int8Codec
+    from .topk import TopKCodec
+
+    if isinstance(codec, BFPCodec):
+        cfg = codec.cfg
+
+        def rt(x):
+            layout = ("sublane" if use_pallas(cfg, x.shape[0]) else "flat16")
+            mant, se = bfp_golden.bfp_encode(
+                x, cfg.block_size, cfg.mantissa_bits, cfg.rounding,
+                layout=layout)
+            return bfp_golden.bfp_decode(mant, se, cfg.block_size,
+                                         layout=layout)
+        return rt
+    if isinstance(codec, TopKCodec):
+        return lambda x: topk_roundtrip(x, codec.bucket_elems, codec.k)
+    if isinstance(codec, Int8Codec):
+        def rt(x):
+            layout = ("sublane" if codec._use_pallas(x.shape[0])
+                      else "flat16")
+            return int8_roundtrip(x, codec.block_size, codec.rounding,
+                                  codec.seed, layout)
+        return rt
+    raise TypeError(f"no golden model registered for {type(codec).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# codec-generic ring golden (generalizes ops.ring_golden)
+# ---------------------------------------------------------------------------
+
+def _rt(x: np.ndarray, roundtrip: Optional[RoundtripFn]) -> np.ndarray:
+    return x if roundtrip is None else roundtrip(np.asarray(x, np.float32))
+
+
+def ring_reduce_scatter(shards: np.ndarray,
+                        roundtrip: Optional[RoundtripFn] = None
+                        ) -> np.ndarray:
+    """[n, L] per-device inputs -> [n, L//n] owned reduced chunks, with
+    ``roundtrip`` applied to every hop payload — the identical schedule and
+    f32 add order as ops.ring_golden.ring_reduce_scatter (which this
+    generalizes from BFP to any codec)."""
+    n, L = shards.shape
+    assert L % n == 0
+    chunks = shards.reshape(n, n, L // n).astype(np.float32).copy()
+    for s in range(n - 1):
+        sends = [_rt(chunks[i, (i - s - 1) % n], roundtrip)
+                 for i in range(n)]
+        for i in range(n):
+            chunks[i, (i - s - 2) % n] += sends[(i - 1) % n]
+    return np.stack([chunks[i, i] for i in range(n)])
+
+
+def ring_all_gather(owned: np.ndarray,
+                    roundtrip: Optional[RoundtripFn] = None) -> np.ndarray:
+    """[n, C] owned chunks -> [n, n*C] reassembled replicas.  The chunk is
+    encoded ONCE on first send and the payload forwarded verbatim (decode
+    of the same payload is deterministic), so replicas are identical even
+    for non-idempotent codecs — matching ops.ring.ring_all_gather."""
+    n, C = owned.shape
+    out = np.zeros((n, n, C), np.float32)
+    carry = np.stack([_rt(owned[i], roundtrip) for i in range(n)])
+    for i in range(n):
+        out[i, i] = carry[i]
+    for s in range(n - 1):
+        carry = carry[(np.arange(n) - 1) % n]
+        for i in range(n):
+            out[i, (i - s - 1) % n] = carry[i]
+    return out.reshape(n, n * C)
+
+
+def ring_all_reduce(shards: np.ndarray,
+                    roundtrip: Optional[RoundtripFn] = None) -> np.ndarray:
+    return ring_all_gather(ring_reduce_scatter(shards, roundtrip), roundtrip)

@@ -1,0 +1,105 @@
+"""Per-bucket magnitude top-k with error feedback (SparCML family,
+arXiv:1802.02021 / 1802.08021).
+
+The gradient is viewed as independent buckets of ``bucket_elems``
+consecutive elements; each bucket keeps only its ``k`` largest-magnitude
+entries.  The wire payload per bucket is (f32 values [k], int16 indices
+[k]) — 6 bytes per kept element, so the rate is tunable by k alone
+(defaults: 512-element buckets, k=64 -> 5.33x vs f32).  Bucketing bounds
+both the selection cost (k-select over 512, not over the whole model) and
+the worst-case information loss per region of the vector — the same
+reasoning as SparCML's blocked top-k — and makes slicing safe: any ring
+slice that is a whole number of buckets quantizes identically
+(`Codec.sliceable`).
+
+Top-k is NOT a bounded-error codec: a one-shot pass can drop almost all
+of a bucket's mass (declared ``error_bound = 1.0``, which the integrity
+layer maps to its gross-corruption cap — see chaos.integrity_tol).  It
+converges because of ERROR FEEDBACK: the dropped residual ``r`` is carried
+in the train state and re-added to the next step's gradient, so every
+coordinate is eventually transmitted (encode sees ``g + r``; what it drops
+becomes the new ``r``).  The trainers thread this through
+``TrainState.codec_state`` / ``FSDPState.codec_state``.
+
+Tie-breaking is part of the bit spec: ``lax.top_k`` returns equal values
+in ascending index order, which `compress.golden.topk_encode` reproduces
+with a stable argsort — the JAX and numpy implementations must agree bit
+for bit (tests/test_codec.py).
+
+No Pallas kernel: the payload is index-gathered, and ``lax.top_k``
+already lowers to the TPU's native sort network — a hand kernel would
+re-implement that sort for zero wire-byte savings.  The VPU-shaped codecs
+(bfp, int8) are where the Pallas encode/decode kernels live.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .base import Codec, register
+
+
+@register
+class TopKCodec(Codec):
+    """Per-bucket magnitude top-k, error-feedback by default."""
+
+    name = "topk"
+    idempotent = True          # re-selecting a k-sparse bucket is exact
+    supports_fused = False
+
+    def __init__(self, bucket_elems: int = 512, k: int = 64,
+                 error_feedback: bool = True):
+        assert 0 < k <= bucket_elems, (k, bucket_elems)
+        assert bucket_elems <= 32768, "int16 wire indices"
+        self.bucket_elems = int(bucket_elems)
+        self.k = int(k)
+        self.error_feedback = bool(error_feedback)
+
+    # -- wire transform -----------------------------------------------------
+
+    def encode(self, x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+        B = self.bucket_elems
+        assert x.shape[0] % B == 0, (x.shape, B)
+        xb = x.astype(jnp.float32).reshape(-1, B)
+        _, idx = lax.top_k(jnp.abs(xb), self.k)       # ties: lowest index
+        vals = jnp.take_along_axis(xb, idx, axis=-1)
+        return vals, idx.astype(jnp.int16)
+
+    def decode(self, payload, n_elems: int, dtype=jnp.float32) -> jax.Array:
+        vals, idx = payload
+        B = self.bucket_elems
+        nb = n_elems // B
+        rows = jnp.arange(nb, dtype=jnp.int32)[:, None]
+        out = jnp.zeros((nb, B), jnp.float32)
+        # top-k indices are distinct within a bucket, so set (not add)
+        out = out.at[rows, idx.astype(jnp.int32)].set(vals)
+        return out.reshape(n_elems).astype(dtype)
+
+    # -- structure ----------------------------------------------------------
+
+    @property
+    def pad_elems(self) -> int:
+        return self.bucket_elems
+
+    # -- declared accuracy / rate ------------------------------------------
+
+    @property
+    def error_bound(self) -> float:
+        # a dropped coordinate can equal the bucket max (ties at the k-th
+        # magnitude): top-k is unbounded-relative-error by design; the
+        # residual carry, not a per-pass bound, is the accuracy story
+        return 1.0
+
+    def wire_bytes(self, n_elems: int) -> int:
+        assert n_elems % self.bucket_elems == 0
+        return (n_elems // self.bucket_elems) * self.k * (4 + 2)
+
+    def describe(self):
+        d = super().describe()
+        d.update(bucket_elems=self.bucket_elems, k=self.k,
+                 density=round(self.k / self.bucket_elems, 4))
+        return d

@@ -1,3 +1,3 @@
-from . import bfp_convergence
+from . import bfp_convergence, codec_convergence  # noqa: F401
 
-__all__ = ["bfp_convergence"]
+__all__ = ["bfp_convergence", "codec_convergence"]

@@ -1,222 +1,18 @@
-"""BFP convergence evaluation — measured accuracy bounds for the lossy codec.
+"""BFP convergence evaluation — back-compat shim.
 
-The reference ships BFP compression with ZERO accuracy evaluation: its own
-docs state the RTL golden compare is *expected to FAIL* with BFP enabled
-(readme.pdf §3.3) and no training-quality measurement exists anywhere.
-This module fills that gap (SURVEY.md §7 "BFP accuracy bounds"): train the
-same model with the same ring collective, compressed vs uncompressed, and
-compare the loss curves — plus a static codec error table over mantissa
-widths.
-
-Isolation discipline: both arms use ``impl='ring'`` (identical hop/add
-order and bucket plan); the ONLY difference is per-hop quantization, so a
-curve gap is attributable to BFP alone, not to reduction reordering.
-
-Results for the committed artifact live in docs/BFP_CONVERGENCE.md +
-docs/bfp_convergence.json (generated by examples/eval_bfp.py); the
-regression gate is tests/test_bfp_convergence.py's final-loss-ratio bound.
+The implementation generalized into `evals.codec_convergence` when the
+codec subsystem landed (the BFP mantissa sweep is now one slice of the
+codec x model matrix); every public name this module historically exported
+resolves there unchanged, and the committed artifact
+(docs/bfp_convergence.json) keeps its schema.  New code should import
+`evals.codec_convergence` directly.
 """
 
 from __future__ import annotations
 
-import dataclasses
-from typing import Dict, List, Optional, Sequence
+from .codec_convergence import (  # noqa: F401
+    MODELS, codec_error_table, run_comparison, run_comparison_multiseed,
+    run_curve)
 
-import numpy as np
-
-import jax
-import jax.numpy as jnp
-
-from ..models import bert, mlp, resnet
-from ..parallel import DDPTrainer, FSDPTrainer, make_mesh
-from ..utils.config import (BFPConfig, CollectiveConfig, MeshConfig,
-                            MLPConfig, OptimizerConfig, TrainConfig)
-
-# "mlp_fsdp" = the MLP trained under ZeRO-3 with the compressed custom-VJP
-# gather (quantized weight all-gather + per-hop-compressed gradient
-# reduce-scatter) — the wire trick on EVERY stream, hw/bfp_adapter.sv.
-MODELS = ("mlp", "bert", "resnet", "mlp_canonical", "mlp_fsdp")
-
-
-# ---------------------------------------------------------------------------
-# synthetic fixed datasets (cycled; loss must go down for ratios to mean
-# anything)
-# ---------------------------------------------------------------------------
-
-def _make_batches(model: str, n_batches: int, batch: int, seed: int):
-    rng = np.random.default_rng(seed)
-    out = []
-    if model in ("mlp", "mlp_canonical", "mlp_fsdp"):
-        # canonical = the reference benchmark's 2048-wide layers
-        # (sw/run.sh:16), depth cut to 3 so the CPU-mesh eval stays cheap
-        canonical = model == "mlp_canonical"
-        width = 2048 if canonical else 128
-        hidden = 2048 if canonical else 256
-        n_cls = 128 if canonical else 32
-        cfg = MLPConfig(layer_sizes=(width, hidden, hidden, n_cls),
-                        dtype="float32")
-        for _ in range(n_batches):
-            x = jnp.asarray(rng.standard_normal((batch, width)), jnp.float32)
-            y = jnp.asarray(rng.integers(0, n_cls, batch), jnp.int32)
-            out.append((x, y))
-        loss = lambda p, b: mlp.loss_fn(p, b, cfg)  # noqa: E731
-        params = mlp.init(jax.random.PRNGKey(seed), cfg)
-    elif model == "bert":
-        cfg = bert.BertConfig.tiny()
-        S = 32
-        for _ in range(n_batches):
-            toks = rng.integers(1, cfg.vocab, (batch, S)).astype(np.int32)
-            labels = np.full((batch, S), -100, np.int32)
-            m = rng.random((batch, S)) < 0.15
-            m[:, 0] = True
-            labels[m] = toks[m]
-            toks[m] = 3
-            out.append((jnp.asarray(toks), jnp.asarray(labels)))
-        loss = lambda p, b: bert.loss_fn(p, b, cfg, dp_axis="dp")  # noqa
-        params = bert.init(jax.random.PRNGKey(seed), cfg)
-    elif model == "resnet":
-        cfg = resnet.ResNetConfig.tiny()
-        for _ in range(n_batches):
-            x = jnp.asarray(rng.standard_normal((batch, 16, 16, 3)),
-                            jnp.float32)
-            y = jnp.asarray(rng.integers(0, cfg.num_classes, batch),
-                            jnp.int32)
-            out.append((x, y))
-        loss = lambda p, b: resnet.loss_fn(p, b, cfg, bn_axis="dp")  # noqa
-        params = resnet.init(jax.random.PRNGKey(seed), cfg)
-    else:
-        raise ValueError(model)
-    return params, loss, out
-
-
-# ---------------------------------------------------------------------------
-# one training curve
-# ---------------------------------------------------------------------------
-
-def run_curve(model: str, steps: int = 200, *, batch: int = 32,
-              mantissa_bits: Optional[int] = None, n_dev: int = 8,
-              seed: int = 0, record_every: int = 5,
-              n_batches: int = 4, tail_k: int = 1) -> Dict:
-    """Train `model` for `steps` on an n_dev dp mesh through the explicit
-    ring; mantissa_bits=None is the uncompressed arm.  Returns
-    {"losses": [...], "final_loss": float, "steps": [...]}, losses recorded
-    every `record_every` steps (mean of the window's last value).
-
-    tail_k: `final_loss` is the mean of the last `tail_k` RECORDED losses
-    — a time-averaged endpoint.  Late in training the per-step loss
-    wiggles chaotically (two CRN-paired arms differing only in per-hop
-    quantization still diverge trajectory-wise), so a single-step
-    endpoint ratio measures wiggle phase, not optimization quality; this
-    was the round-3 m4-ratio-0.4 anomaly.  tail_k=1 preserves the raw
-    endpoint."""
-    comp = (None if mantissa_bits is None
-            else BFPConfig(mantissa_bits=mantissa_bits))
-    fsdp = model.endswith("_fsdp")
-    cfg = TrainConfig(
-        iters=steps, global_batch=batch,
-        mesh=MeshConfig(fsdp=n_dev) if fsdp else MeshConfig(dp=n_dev),
-        collective=CollectiveConfig(impl="ring", compression=comp,
-                                    bucket_elems=1 << 16),
-        optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
-    params, loss_fn, batches = _make_batches(model, n_batches, batch, seed)
-    if fsdp:
-        tr = FSDPTrainer(loss_fn, make_mesh(cfg.mesh), cfg)
-    else:
-        tr = DDPTrainer(loss_fn, make_mesh(cfg.mesh), cfg)
-    state = tr.init_state(params)
-    sharded = [tr.shard_batch(b) for b in batches]
-    losses: List[float] = []
-    rec_steps: List[int] = []
-    for i in range(steps):
-        state, loss = tr.step(state, sharded[i % len(sharded)])
-        if (i + 1) % record_every == 0 or i == steps - 1:
-            losses.append(float(loss))
-            rec_steps.append(i + 1)
-    final = float(np.mean(losses[-max(tail_k, 1):]))
-    return {"losses": losses, "steps": rec_steps, "final_loss": final}
-
-
-def run_comparison(model: str, steps: int = 200, *,
-                   mantissa_sweep: Sequence[int] = (8, 6, 4),
-                   batch: int = 32, n_dev: int = 8, seed: int = 0,
-                   n_batches: int = 4, tail_k: int = 1) -> Dict:
-    """Uncompressed baseline + one arm per mantissa width, PAIRED on
-    common random numbers: every arm at a given seed shares the identical
-    init and batch stream (_make_batches is seeded), so
-    `final_loss_ratio` (arm/baseline) is a per-seed paired statistic —
-    the only difference inside a pair is per-hop quantization.  The
-    regression test bounds it (<= 1.05 at the reference's 8-bit
-    config)."""
-    out = {"model": model, "steps": steps, "tail_k": tail_k,
-           "baseline": run_curve(model, steps, batch=batch, n_dev=n_dev,
-                                 seed=seed, n_batches=n_batches,
-                                 tail_k=tail_k)}
-    base = out["baseline"]["final_loss"]
-    for m in mantissa_sweep:
-        arm = run_curve(model, steps, batch=batch, mantissa_bits=m,
-                        n_dev=n_dev, seed=seed, n_batches=n_batches,
-                        tail_k=tail_k)
-        arm["final_loss_ratio"] = arm["final_loss"] / base
-        out[f"bfp_m{m}"] = arm
-    return out
-
-
-def run_comparison_multiseed(model: str, steps: int = 200, *,
-                             seeds: Sequence[int] = (0, 1, 2, 3, 4),
-                             mantissa_sweep: Sequence[int] = (8, 6, 4),
-                             batch: int = 32, n_dev: int = 8,
-                             n_batches: int = 4, tail_k: int = 8) -> Dict:
-    """`run_comparison` over >= 5 seeds, aggregating the PER-SEED PAIRED
-    final-loss ratio (common random numbers within each seed: identical
-    init + batch stream across arms; time-averaged endpoints via tail_k).
-    The round-3 artifact gated on a 3-sample mean with sigma ~= 40% of
-    the mean — no statistical power; pairing was already in place, so the
-    variance was endpoint chaos, which tail averaging + 5 seeds
-    suppresses.  The regression gate binds on the mean paired ratio AND
-    on sigma(paired ratio) being small enough for the mean to carry
-    meaning."""
-    runs = [run_comparison(model, steps, mantissa_sweep=mantissa_sweep,
-                           batch=batch, n_dev=n_dev, seed=s,
-                           n_batches=n_batches, tail_k=tail_k)
-            for s in seeds]
-    out = {"model": model, "steps": steps, "seeds": list(seeds),
-           "tail_k": tail_k, "pairing": "common-random-numbers",
-           "per_seed": runs}
-    for m in mantissa_sweep:
-        ratios = [r[f"bfp_m{m}"]["final_loss_ratio"] for r in runs]
-        out[f"bfp_m{m}"] = {
-            "paired_ratios": ratios,
-            "ratio_mean": float(np.mean(ratios)),
-            "ratio_std": float(np.std(ratios)),
-            "ratio_min": float(np.min(ratios)),
-            "ratio_max": float(np.max(ratios)),
-        }
-    return out
-
-
-# ---------------------------------------------------------------------------
-# static codec error table (no training)
-# ---------------------------------------------------------------------------
-
-def codec_error_table(mantissa_sweep: Sequence[int] = (2, 3, 4, 6, 8),
-                      n: int = 1 << 16, seed: int = 0) -> List[Dict]:
-    """Roundtrip relative error of one encode/decode pass on N(0,1) data
-    per mantissa width — the error a gradient suffers per ring hop."""
-    from ..ops import bfp
-    rng = np.random.default_rng(seed)
-    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
-    rows = []
-    for m in mantissa_sweep:
-        cfg = dataclasses.replace(BFPConfig(), mantissa_bits=m)
-        mant, se = bfp.bfp_encode(x, cfg.block_size, cfg.mantissa_bits,
-                                  cfg.rounding)
-        y = bfp.bfp_decode(mant, se, cfg.block_size, jnp.float32)
-        err = np.asarray(y) - np.asarray(x)
-        denom = float(np.linalg.norm(np.asarray(x)))
-        rows.append({
-            "mantissa_bits": m,
-            "rel_l2_error": float(np.linalg.norm(err)) / denom,
-            "max_abs_error": float(np.max(np.abs(err))),
-            "wire_bytes_per_value": bfp.wire_bytes(n, cfg) / n,
-        })
-    return rows
+__all__ = ["MODELS", "run_curve", "run_comparison",
+           "run_comparison_multiseed", "codec_error_table"]

@@ -1,0 +1,321 @@
+"""Codec convergence evaluation — measured training-quality bounds for
+EVERY registered gradient-compression codec, generalizing the BFP-only
+eval this module grew out of (`evals.bfp_convergence`, now a thin
+back-compat shim over this one).
+
+The reference ships lossy compression with ZERO accuracy evaluation
+(readme.pdf §3.3: its own golden compare is expected to FAIL with BFP on).
+We measure instead of assert: train the same model through the same
+explicit ring, compressed vs uncompressed, and compare final losses.
+
+Isolation discipline (unchanged from the BFP eval): both arms use
+``impl='ring'`` (identical hop/add order and bucket plan) and are PAIRED
+on common random numbers (identical init + batch stream per seed), so the
+final-loss ratio isolates exactly one variable — the wire codec.  For
+error-feedback codecs (top-k) the arm also exercises the residual carry
+through ``TrainState.codec_state``: the ratio measures compensate-then-
+compress as deployed, not the codec in a vacuum.
+
+Entry points:
+  run_curve             one arm (codec=None is the uncompressed baseline)
+  run_comparison        BFP mantissa sweep (legacy shape, kept byte-
+                        compatible for the committed artifact's schema)
+  run_codec_comparison  codec x opts sweep — the codec-subsystem eval
+  run_comparison_multiseed   multi-seed paired aggregation (BFP)
+  codec_error_table     static BFP roundtrip error per mantissa width
+  codec_static_table    static per-codec roundtrip error / wire rate
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import bert, mlp, resnet
+from ..parallel import DDPTrainer, FSDPTrainer, make_mesh
+from ..parallel.train import DPTrainer  # noqa: F401 (re-export convenience)
+from ..utils.config import (BFPConfig, CollectiveConfig, MeshConfig,
+                            MLPConfig, OptimizerConfig, TrainConfig)
+
+# "mlp_fsdp" = the MLP trained under ZeRO-3 with the compressed custom-VJP
+# gather (quantized weight all-gather + per-hop-compressed gradient
+# reduce-scatter) — the wire trick on EVERY stream, hw/bfp_adapter.sv.
+MODELS = ("mlp", "bert", "resnet", "mlp_canonical", "mlp_fsdp")
+
+# the codec arms the subsystem eval sweeps by default: top-k exercises
+# error feedback, int8 exercises stochastic rounding; both at their
+# registered defaults plus a bucket size small enough that the tiny eval
+# models span multiple buckets
+DEFAULT_CODECS: Tuple[Tuple[str, Tuple], ...] = (
+    ("topk", (("bucket_elems", 256), ("k", 64))),
+    ("int8", ()),
+)
+
+
+# ---------------------------------------------------------------------------
+# synthetic fixed datasets (cycled; loss must go down for ratios to mean
+# anything)
+# ---------------------------------------------------------------------------
+
+def _make_batches(model: str, n_batches: int, batch: int, seed: int):
+    rng = np.random.default_rng(seed)
+    out = []
+    if model in ("mlp", "mlp_canonical", "mlp_fsdp"):
+        # canonical = the reference benchmark's 2048-wide layers
+        # (sw/run.sh:16), depth cut to 3 so the CPU-mesh eval stays cheap
+        canonical = model == "mlp_canonical"
+        width = 2048 if canonical else 128
+        hidden = 2048 if canonical else 256
+        n_cls = 128 if canonical else 32
+        cfg = MLPConfig(layer_sizes=(width, hidden, hidden, n_cls),
+                        dtype="float32")
+        for _ in range(n_batches):
+            x = jnp.asarray(rng.standard_normal((batch, width)), jnp.float32)
+            y = jnp.asarray(rng.integers(0, n_cls, batch), jnp.int32)
+            out.append((x, y))
+        loss = lambda p, b: mlp.loss_fn(p, b, cfg)  # noqa: E731
+        params = mlp.init(jax.random.PRNGKey(seed), cfg)
+    elif model == "bert":
+        cfg = bert.BertConfig.tiny()
+        S = 32
+        for _ in range(n_batches):
+            toks = rng.integers(1, cfg.vocab, (batch, S)).astype(np.int32)
+            labels = np.full((batch, S), -100, np.int32)
+            m = rng.random((batch, S)) < 0.15
+            m[:, 0] = True
+            labels[m] = toks[m]
+            toks[m] = 3
+            out.append((jnp.asarray(toks), jnp.asarray(labels)))
+        loss = lambda p, b: bert.loss_fn(p, b, cfg, dp_axis="dp")  # noqa
+        params = bert.init(jax.random.PRNGKey(seed), cfg)
+    elif model == "resnet":
+        cfg = resnet.ResNetConfig.tiny()
+        for _ in range(n_batches):
+            x = jnp.asarray(rng.standard_normal((batch, 16, 16, 3)),
+                            jnp.float32)
+            y = jnp.asarray(rng.integers(0, cfg.num_classes, batch),
+                            jnp.int32)
+            out.append((x, y))
+        loss = lambda p, b: resnet.loss_fn(p, b, cfg, bn_axis="dp")  # noqa
+        params = resnet.init(jax.random.PRNGKey(seed), cfg)
+    else:
+        raise ValueError(model)
+    return params, loss, out
+
+
+# ---------------------------------------------------------------------------
+# one training curve
+# ---------------------------------------------------------------------------
+
+def run_curve(model: str, steps: int = 200, *, batch: int = 32,
+              codec: Optional[str] = None, codec_opts: Tuple = (),
+              mantissa_bits: Optional[int] = None, n_dev: int = 8,
+              seed: int = 0, record_every: int = 5,
+              n_batches: int = 4, tail_k: int = 1,
+              trainer: str = "ddp") -> Dict:
+    """Train `model` for `steps` on an n_dev mesh through the explicit
+    ring.  The arm is selected by ``codec``/``codec_opts`` (registry
+    names); ``codec=None`` is the uncompressed baseline, and the legacy
+    ``mantissa_bits=m`` spelling still means BFP at that width.  Returns
+    {"losses": [...], "final_loss": float, "steps": [...]}, losses recorded
+    every `record_every` steps.
+
+    trainer: "ddp" (bucketed all-reduce + replicated optimizer — the
+    legacy BFP eval's arm, kept so the committed artifact's semantics are
+    unchanged) or "dp" (ZeRO-1 DPTrainer — REQUIRED for error-feedback
+    codecs, whose residual threads through TrainState.codec_state; the
+    codec comparison uses it for every arm so pairing stays clean).
+    ``*_fsdp`` models override either with the ZeRO-3 trainer.
+
+    tail_k: `final_loss` is the mean of the last `tail_k` RECORDED losses
+    — a time-averaged endpoint.  Late in training the per-step loss
+    wiggles chaotically (two CRN-paired arms differing only in per-hop
+    quantization still diverge trajectory-wise), so a single-step
+    endpoint ratio measures wiggle phase, not optimization quality; this
+    was the round-3 m4-ratio-0.4 anomaly.  tail_k=1 preserves the raw
+    endpoint."""
+    if mantissa_bits is not None:
+        assert codec is None, "pass codec= OR legacy mantissa_bits=, not both"
+        codec = "bfp"
+        codec_opts = tuple(codec_opts) + (("mantissa_bits", mantissa_bits),)
+    fsdp = model.endswith("_fsdp")
+    cfg = TrainConfig(
+        iters=steps, global_batch=batch,
+        mesh=MeshConfig(fsdp=n_dev) if fsdp else MeshConfig(dp=n_dev),
+        collective=CollectiveConfig(impl="ring", codec=codec,
+                                    codec_opts=tuple(codec_opts),
+                                    bucket_elems=1 << 16),
+        optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
+    params, loss_fn, batches = _make_batches(model, n_batches, batch, seed)
+    if fsdp:
+        tr = FSDPTrainer(loss_fn, make_mesh(cfg.mesh), cfg)
+    elif trainer == "dp":
+        tr = DPTrainer(loss_fn, make_mesh(cfg.mesh), cfg)
+    else:
+        assert trainer == "ddp", trainer
+        from ..ops.fused_update import resolve_codec
+        c = resolve_codec(cfg.collective)
+        assert c is None or not c.error_feedback, (
+            "error-feedback codecs need trainer='dp'/'fsdp' (DDPTrainer "
+            "does not thread the residual)")
+        tr = DDPTrainer(loss_fn, make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(params)
+    sharded = [tr.shard_batch(b) for b in batches]
+    losses: List[float] = []
+    rec_steps: List[int] = []
+    for i in range(steps):
+        state, loss = tr.step(state, sharded[i % len(sharded)])
+        if (i + 1) % record_every == 0 or i == steps - 1:
+            losses.append(float(loss))
+            rec_steps.append(i + 1)
+    final = float(np.mean(losses[-max(tail_k, 1):]))
+    return {"losses": losses, "steps": rec_steps, "final_loss": final}
+
+
+# ---------------------------------------------------------------------------
+# comparisons (paired on common random numbers)
+# ---------------------------------------------------------------------------
+
+def run_comparison(model: str, steps: int = 200, *,
+                   mantissa_sweep: Sequence[int] = (8, 6, 4),
+                   batch: int = 32, n_dev: int = 8, seed: int = 0,
+                   n_batches: int = 4, tail_k: int = 1) -> Dict:
+    """Uncompressed baseline + one BFP arm per mantissa width, PAIRED on
+    common random numbers: every arm at a given seed shares the identical
+    init and batch stream (_make_batches is seeded), so
+    `final_loss_ratio` (arm/baseline) is a per-seed paired statistic —
+    the only difference inside a pair is per-hop quantization.  The
+    regression test bounds it (<= 1.05 at the reference's 8-bit
+    config)."""
+    out = {"model": model, "steps": steps, "tail_k": tail_k,
+           "baseline": run_curve(model, steps, batch=batch, n_dev=n_dev,
+                                 seed=seed, n_batches=n_batches,
+                                 tail_k=tail_k)}
+    base = out["baseline"]["final_loss"]
+    for m in mantissa_sweep:
+        arm = run_curve(model, steps, batch=batch, mantissa_bits=m,
+                        n_dev=n_dev, seed=seed, n_batches=n_batches,
+                        tail_k=tail_k)
+        arm["final_loss_ratio"] = arm["final_loss"] / base
+        out[f"bfp_m{m}"] = arm
+    return out
+
+
+def run_codec_comparison(model: str, steps: int = 200, *,
+                         codecs: Sequence[Tuple[str, Tuple]] = DEFAULT_CODECS,
+                         batch: int = 32, n_dev: int = 8, seed: int = 0,
+                         n_batches: int = 4, tail_k: int = 4) -> Dict:
+    """The codec-subsystem convergence eval: uncompressed baseline + one
+    arm per (codec, opts), CRN-paired exactly like run_comparison.  Arm
+    keys are the codec names (``topk``, ``int8``, ``bfp``...); each arm
+    carries its paired ``final_loss_ratio`` plus the codec's static
+    description (rate, error bound, EF) for the artifact."""
+    from .. import compress
+    out: Dict = {"model": model, "steps": steps, "tail_k": tail_k,
+                 "pairing": "common-random-numbers",
+                 "baseline": run_curve(model, steps, batch=batch,
+                                       n_dev=n_dev, seed=seed,
+                                       n_batches=n_batches, tail_k=tail_k,
+                                       trainer="dp")}
+    base = out["baseline"]["final_loss"]
+    for name, opts in codecs:
+        arm = run_curve(model, steps, batch=batch, codec=name,
+                        codec_opts=tuple(opts), n_dev=n_dev, seed=seed,
+                        n_batches=n_batches, tail_k=tail_k, trainer="dp")
+        arm["final_loss_ratio"] = arm["final_loss"] / base
+        arm["codec"] = compress.get_codec(name, dict(opts)).describe()
+        out[name] = arm
+    return out
+
+
+def run_comparison_multiseed(model: str, steps: int = 200, *,
+                             seeds: Sequence[int] = (0, 1, 2, 3, 4),
+                             mantissa_sweep: Sequence[int] = (8, 6, 4),
+                             batch: int = 32, n_dev: int = 8,
+                             n_batches: int = 4, tail_k: int = 8) -> Dict:
+    """`run_comparison` over >= 5 seeds, aggregating the PER-SEED PAIRED
+    final-loss ratio (common random numbers within each seed: identical
+    init + batch stream across arms; time-averaged endpoints via tail_k).
+    The round-3 artifact gated on a 3-sample mean with sigma ~= 40% of
+    the mean — no statistical power; pairing was already in place, so the
+    variance was endpoint chaos, which tail averaging + 5 seeds
+    suppresses.  The regression gate binds on the mean paired ratio AND
+    on sigma(paired ratio) being small enough for the mean to carry
+    meaning."""
+    runs = [run_comparison(model, steps, mantissa_sweep=mantissa_sweep,
+                           batch=batch, n_dev=n_dev, seed=s,
+                           n_batches=n_batches, tail_k=tail_k)
+            for s in seeds]
+    out = {"model": model, "steps": steps, "seeds": list(seeds),
+           "tail_k": tail_k, "pairing": "common-random-numbers",
+           "per_seed": runs}
+    for m in mantissa_sweep:
+        ratios = [r[f"bfp_m{m}"]["final_loss_ratio"] for r in runs]
+        out[f"bfp_m{m}"] = {
+            "paired_ratios": ratios,
+            "ratio_mean": float(np.mean(ratios)),
+            "ratio_std": float(np.std(ratios)),
+            "ratio_min": float(np.min(ratios)),
+            "ratio_max": float(np.max(ratios)),
+        }
+    return out
+
+
+# ---------------------------------------------------------------------------
+# static codec error tables (no training)
+# ---------------------------------------------------------------------------
+
+def codec_error_table(mantissa_sweep: Sequence[int] = (2, 3, 4, 6, 8),
+                      n: int = 1 << 16, seed: int = 0) -> List[Dict]:
+    """Roundtrip relative error of one BFP encode/decode pass on N(0,1)
+    data per mantissa width — the error a gradient suffers per ring hop."""
+    from ..ops import bfp
+    rng = np.random.default_rng(seed)
+    x = jnp.asarray(rng.standard_normal(n), jnp.float32)
+    rows = []
+    for m in mantissa_sweep:
+        cfg = dataclasses.replace(BFPConfig(), mantissa_bits=m)
+        mant, se = bfp.bfp_encode(x, cfg.block_size, cfg.mantissa_bits,
+                                  cfg.rounding)
+        y = bfp.bfp_decode(mant, se, cfg.block_size, jnp.float32)
+        err = np.asarray(y) - np.asarray(x)
+        denom = float(np.linalg.norm(np.asarray(x)))
+        rows.append({
+            "mantissa_bits": m,
+            "rel_l2_error": float(np.linalg.norm(err)) / denom,
+            "max_abs_error": float(np.max(np.abs(err))),
+            "wire_bytes_per_value": bfp.wire_bytes(n, cfg) / n,
+        })
+    return rows
+
+
+def codec_static_table(codecs: Sequence[Tuple[str, Tuple]] = (
+        ("bfp", ()),) + DEFAULT_CODECS,
+        n: int = 1 << 16, seed: int = 0) -> List[Dict]:
+    """One-pass roundtrip error + wire rate per codec on N(0,1) data —
+    the per-hop cost/accuracy point each codec occupies.  Top-k's large
+    one-shot error here is exactly why it ships with error feedback; the
+    training ratio (run_codec_comparison), not this number, is its
+    quality metric."""
+    from .. import compress
+    rng = np.random.default_rng(seed)
+    rows = []
+    for name, opts in codecs:
+        c = compress.get_codec(name, dict(opts))
+        n_use = n - n % c.pad_elems
+        x = jnp.asarray(rng.standard_normal(n_use), jnp.float32)
+        y = np.asarray(c.roundtrip(x))
+        err = y - np.asarray(x)
+        rows.append(dict(
+            c.describe(),
+            rel_l2_error=float(np.linalg.norm(err)
+                               / np.linalg.norm(np.asarray(x))),
+            max_abs_error=float(np.max(np.abs(err))),
+            wire_bytes_per_value=c.wire_bytes(n_use) / n_use,
+        ))
+    return rows

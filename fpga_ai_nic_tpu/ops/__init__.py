@@ -1,3 +1,12 @@
 from . import (bfp, bfp_golden, bfp_pallas, bucketed, flash_pallas,
                fused_update, moe, ring, ring_attention, ring_cost,
                ring_golden, ring_pallas)  # noqa: F401
+
+# explicit export surface (the codec subsystem made the implicit one
+# stale: fused_update now also owns codec resolution / error feedback;
+# the codecs themselves live in fpga_ai_nic_tpu.compress)
+__all__ = [
+    "bfp", "bfp_golden", "bfp_pallas", "bucketed", "flash_pallas",
+    "fused_update", "moe", "ring", "ring_attention", "ring_cost",
+    "ring_golden", "ring_pallas",
+]

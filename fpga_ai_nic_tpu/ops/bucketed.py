@@ -180,6 +180,8 @@ def bucket_wire_bytes(plan: BucketPlan, n: int,
                       coll: CollectiveConfig) -> int:
     """Total per-device ring bytes for one bucketed all-reduce (flit-counter
     observability, hw/bfp_adapter.sv:705-729)."""
+    from .fused_update import resolve_codec
+    codec = resolve_codec(coll)
     return sum(
-        ring_ops.wire_bytes_per_device(b.padded_len, n, coll.compression)
+        ring_ops.wire_bytes_per_device(b.padded_len, n, codec)
         for b in plan.buckets)

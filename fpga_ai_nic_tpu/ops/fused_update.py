@@ -45,16 +45,26 @@ class FlatMeta(NamedTuple):
     padded_len: int
 
 
+def resolve_codec(coll: CollectiveConfig):
+    """The compress.Codec this config asks for (None = uncompressed) —
+    one definition so every consumer (ring routing, padding, trainers,
+    integrity tolerance) resolves identically."""
+    from ..compress import resolve
+    return resolve(coll)
+
+
 def pad_multiple(coll: CollectiveConfig, n: int) -> int:
     """Padding multiple for flat vectors fed to the n-way collective: the
-    per-device chunk (len / n) must be a whole number of BFP blocks — and
-    of (block, 128)-lane tiles when the fused Pallas kernel carries the
-    wire (its frames are native int8 tiles)."""
-    if coll.compression is not None:
+    per-device chunk (len / n) must be a whole number of codec units (BFP
+    block / top-k bucket / int8 block) — and of (block, 128)-lane tiles
+    when the fused Pallas kernel carries the wire (its frames are native
+    int8 tiles)."""
+    codec = resolve_codec(coll)
+    if codec is not None:
         if getattr(coll, "fused_kernel", False):
             from . import ring_pallas
-            return n * coll.compression.block_size * ring_pallas.LANES
-        return n * coll.compression.block_size
+            return n * codec.pad_elems * ring_pallas.LANES
+        return n * codec.pad_elems
     return n
 
 
@@ -123,26 +133,34 @@ def _warn_fused_fallback() -> None:
             "across platforms.", stacklevel=3)
 
 
+def _fused_bfp_cfg(coll: CollectiveConfig):
+    """The BFPConfig driving the fused Pallas kernels (config validation
+    guarantees the resolved codec supports_fused, i.e. is BFP)."""
+    return resolve_codec(coll).cfg
+
+
 def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
                            coll: CollectiveConfig,
                            chunk_len: int) -> jax.Array:
     """Explicit-ring all-reduce respecting the fused_kernel routing (one
     definition shared by all_reduce_mean and ops.bucketed so the
     fallback/slice policy cannot drift between call sites)."""
+    codec = resolve_codec(coll)
     if coll.fused_kernel:
         from . import ring_pallas
+        bcfg = _fused_bfp_cfg(coll)
         slice_e = ring_pallas.pick_slice_elems(
-            chunk_len, coll.slice_elems, coll.compression.block_size)
+            chunk_len, coll.slice_elems, bcfg.block_size)
         if ring_pallas._is_tpu():
             return ring_pallas.ring_all_reduce_fused(
-                flat, axis_name, compression=coll.compression,
+                flat, axis_name, compression=bcfg,
                 slice_elems=slice_e)
         _warn_fused_fallback()
         return ring_ops.ring_all_reduce(
-            flat, axis_name, compression=coll.compression,
+            flat, axis_name, compression=codec,
             slice_elems=slice_e, unroll=coll.unroll_hops)
     return ring_ops.ring_all_reduce(flat, axis_name,
-                                    compression=coll.compression,
+                                    compression=codec,
                                     slice_elems=coll.slice_elems,
                                     unroll=coll.unroll_hops)
 
@@ -152,25 +170,26 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
     if coll.impl == "xla":
         return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
                                 tiled=True)
+    codec = resolve_codec(coll)
     if coll.fused_kernel:
         from . import ring_pallas
         n = lax.axis_size(axis_name)
+        bcfg = _fused_bfp_cfg(coll)
         slice_e = ring_pallas.pick_slice_elems(
-            flat_g.shape[0] // n, coll.slice_elems,
-            coll.compression.block_size)
+            flat_g.shape[0] // n, coll.slice_elems, bcfg.block_size)
         if ring_pallas._is_tpu():
             return ring_pallas.ring_reduce_scatter_fused(
-                flat_g, axis_name, compression=coll.compression,
+                flat_g, axis_name, compression=bcfg,
                 slice_elems=slice_e)
         # off-TPU: the separate-op ring with the CONFIGURED codec (see
         # _warn_fused_fallback); the kernel's own bit-exactness story
         # lives in tests/test_ring_pallas.py
         _warn_fused_fallback()
         return ring_ops.ring_reduce_scatter(
-            flat_g, axis_name, compression=coll.compression,
+            flat_g, axis_name, compression=codec,
             slice_elems=slice_e, unroll=coll.unroll_hops)
     return ring_ops.ring_reduce_scatter(flat_g, axis_name,
-                                        compression=coll.compression,
+                                        compression=codec,
                                         slice_elems=coll.slice_elems,
                                         unroll=coll.unroll_hops)
 
@@ -179,17 +198,18 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
                     coll: CollectiveConfig) -> jax.Array:
     if coll.impl == "xla":
         return lax.all_gather(owned, axis_name, tiled=True)
+    codec = resolve_codec(coll)
     if coll.fused_kernel:
         from . import ring_pallas
         if ring_pallas._is_tpu():
             return ring_pallas.ring_all_gather_fused(
-                owned, axis_name, compression=coll.compression)
+                owned, axis_name, compression=_fused_bfp_cfg(coll))
         _warn_fused_fallback()
         return ring_ops.ring_all_gather(owned, axis_name,
-                                        compression=coll.compression,
+                                        compression=codec,
                                         unroll=coll.unroll_hops)
     return ring_ops.ring_all_gather(owned, axis_name,
-                                    compression=coll.compression,
+                                    compression=codec,
                                     unroll=coll.unroll_hops)
 
 
@@ -231,6 +251,26 @@ def _gather_vjp_bwd(axis_name, coll, _res, ct):
 
 
 all_gather_flat_vjp.defvjp(_gather_vjp_fwd, _gather_vjp_bwd)
+
+
+def error_feedback_encode(codec, flat_g: jax.Array,
+                          residual: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Compensate-then-compress (SparCML §3 / EF-SGD): returns
+    ``(g_wire, new_residual)`` where ``g_wire = roundtrip(flat_g +
+    residual)`` is the locally-quantized gradient handed to the collective
+    and ``new_residual`` is what this pass dropped — carried to the next
+    step in the train state, so every coordinate is eventually
+    transmitted.
+
+    The residual compensates the LOCAL quantization (the first wire pass
+    of this device's contribution); per-hop requantization of partial sums
+    inside the ring stays bounded by the codec's declared error_bound and
+    is measured end-to-end by evals/codec_convergence.  For idempotent
+    codecs (bfp, topk) the ring's first re-encode of ``g_wire`` is exact,
+    so the local roundtrip costs no extra wire error at all."""
+    g_comp = flat_g + residual
+    g_wire = codec.roundtrip(g_comp)
+    return g_wire, g_comp - g_wire
 
 
 def all_reduce_mean(tree, axis_name: str, coll: CollectiveConfig):

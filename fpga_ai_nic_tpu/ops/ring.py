@@ -5,11 +5,14 @@ lines 691-1183).
 
 Why these exist when ``lax.psum_scatter`` does: the XLA collectives cannot
 compress on the wire.  The reference's headline trick is BFP-compressing
-every ring hop (hw/bfp_adapter.sv); here each hop's payload is the
-(int8 mantissa, int8 scale) pair from `ops.bfp`, cutting ICI bytes 3.76x
-vs f32 / 1.88x vs bf16.  Uncompressed mode exists for parity testing and
-as the building block the fused-update engine selects per config
-(`CollectiveConfig.impl`).
+every ring hop (hw/bfp_adapter.sv); here each hop's payload is whatever
+tuple of arrays the configured `compress.Codec` emits — BFP's (int8
+mantissa, int8 scale) pair cutting ICI bytes 3.76x vs f32, top-k's
+(values, indices), int8's (q, scale) — the codec seam generalizing the
+reference's single hard-wired trick.  ``compression=`` accepts a Codec or
+(back-compat) a bare BFPConfig.  Uncompressed mode exists for parity
+testing and as the building block the fused-update engine selects per
+config (`CollectiveConfig.impl`).
 
 Chunk ownership is *natural order* — device i ends with chunk i — unlike
 the reference's rotated slice order (hw/all_reduce.sv:361), which existed
@@ -21,7 +24,8 @@ hw/all_reduce.sv:101-103,330): a compressed hop whose chunk exceeds
 ``slice_elems`` is streamed slice-by-slice, double-buffered so slice k+1's
 encode runs while slice k's ppermute is on the wire — the TPU analogue of
 the bfp_adapter sitting *inside* the ring stream (hw/bfp_adapter.sv).
-Because BFP blocks are independent and ``slice_elems`` is a block multiple,
+Because compression units (BFP blocks / top-k buckets / int8 blocks) are
+independent and ``slice_elems`` is a unit multiple (`Codec.sliceable`),
 sliced and whole-chunk hops are bit-identical; slicing changes the
 schedule, never the numerics.  Uncompressed hops always send the whole
 chunk in one ppermute: with no codec work to overlap, slicing would only
@@ -41,9 +45,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from . import bfp as _bfp_xla
-from . import bfp_pallas as _bfp_pl
-from ..utils.config import BFPConfig
+from ..utils.config import BFPConfig  # noqa: F401 — legacy compression= type
 
 
 def _next_neighbor_perm(n: int):
@@ -76,62 +78,43 @@ def _tap(x: jax.Array, point: str) -> jax.Array:
 
 
 def _use_pallas(cfg: BFPConfig, n_elems: int) -> bool:
-    return cfg.codec == "pallas" or (
-        cfg.codec == "auto" and _bfp_pl._is_tpu()
-        and n_elems % (cfg.block_size * _bfp_pl.LANES) == 0)
+    # kept as a public-ish seam (bench_collective.py keys its consumption
+    # strategy off it); the implementation moved to compress.bfp with the
+    # codec subsystem
+    from ..compress.bfp import use_pallas
+    return use_pallas(cfg, n_elems)
 
 
 def _codec(cfg: BFPConfig, n_elems: int):
-    """(encode, decode) pair for a flat [n_elems] payload.
+    """(encode, decode) pair for a flat [n_elems] BFP payload — moved to
+    compress.bfp.codec_pair (this delegate keeps the bench drivers' entry
+    point stable)."""
+    from ..compress.bfp import codec_pair
+    return codec_pair(cfg, n_elems)
 
-    codec="auto" picks the fused Pallas kernels on TPU when the payload
-    tiles onto (block, 128)-lane registers, else the XLA ops; the default
-    "xla" keeps golden bit-exactness on every platform (see BFPConfig)."""
-    if _use_pallas(cfg, n_elems):
-        # inline (un-jitted) kernels: a nested closed_call inside a
-        # vma-checked shard_map trips the checker
-        def enc(x):
-            return _bfp_pl.bfp_encode_inline(x, cfg.block_size,
-                                             cfg.mantissa_bits,
-                                             cfg.rounding)
 
-        def dec(mant, se, dtype):
-            return _bfp_pl.bfp_decode_inline(mant, se, cfg.block_size,
-                                             dtype)
-    else:
-        def enc(x):
-            return _bfp_xla.bfp_encode(x, cfg.block_size,
-                                       cfg.mantissa_bits, cfg.rounding)
-
-        def dec(mant, se, dtype):
-            return _bfp_xla.bfp_decode(mant, se, cfg.block_size, dtype)
-
-    return enc, dec
+def _as_codec(compression):
+    """Normalize ``compression=``: None | compress.Codec | bare BFPConfig
+    (the pre-subsystem spelling, still honored everywhere)."""
+    from ..compress import as_codec
+    return as_codec(compression)
 
 
 def _send(payload: jax.Array, axis_name: str, n: int,
-          cfg: Optional[BFPConfig],
-          slice_elems: Optional[int] = None) -> jax.Array:
-    """One ring hop, optionally BFP-compressed on the wire."""
+          codec, slice_elems: Optional[int] = None) -> jax.Array:
+    """One ring hop, optionally codec-compressed on the wire.  ``codec``
+    is an already-normalized compress.Codec (or None)."""
     perm = _next_neighbor_perm(n)
-    if cfg is None:
+    if codec is None:
         return lax.ppermute(payload, axis_name, perm)
     C = payload.shape[0]
-    if (slice_elems is None or C <= slice_elems or C % slice_elems
-            or slice_elems % cfg.block_size
-            # sliced and whole-chunk paths must resolve to the SAME codec,
-            # or slicing would change the block partition (and the bits)
-            or _use_pallas(cfg, slice_elems) != _use_pallas(cfg, C)
-            # a pallas-bound slice must actually tile onto (block, 128)
-            # lanes; fall back to the whole-chunk hop instead of tripping
-            # the kernel's tiling assert (forced codec="pallas" case)
-            or (_use_pallas(cfg, slice_elems)
-                and slice_elems % (cfg.block_size * _bfp_pl.LANES))):
-        enc, dec = _codec(cfg, C)
-        mant, se = enc(payload)
-        mant = lax.ppermute(mant, axis_name, perm)
-        se = lax.ppermute(se, axis_name, perm)
-        return dec(mant, se, payload.dtype)
+    if not codec.sliceable(C, slice_elems):
+        # whole-chunk hop (also the fallback when slicing would change the
+        # codec's unit partition — sliced and whole-chunk hops must be
+        # bit-identical, so an incompatible slice_elems degrades to this)
+        pay = codec.encode(payload)
+        pay = tuple(lax.ppermute(p, axis_name, perm) for p in pay)
+        return codec.decode(pay, C, payload.dtype)
 
     # Sliced, double-buffered stream: while slice k's compressed payload is
     # on the wire, encode slice k+1 (they are independent, so XLA's
@@ -140,21 +123,18 @@ def _send(payload: jax.Array, axis_name: str, n: int,
     # worth 1/S of one codec pass — the price of a uniform scan body.
     S = C // slice_elems
     slices = payload.reshape(S, slice_elems)
-    enc, dec = _codec(cfg, slice_elems)
 
     def step(carry, k):
-        mant_k, se_k = carry
-        mant_r = lax.ppermute(mant_k, axis_name, perm)
-        se_r = lax.ppermute(se_k, axis_name, perm)
-        nxt = enc(slices[(k + 1) % S])
-        return nxt, dec(mant_r, se_r, payload.dtype)
+        received = tuple(lax.ppermute(p, axis_name, perm) for p in carry)
+        nxt = codec.encode(slices[(k + 1) % S])
+        return nxt, codec.decode(received, slice_elems, payload.dtype)
 
-    _, received = lax.scan(step, enc(slices[0]), jnp.arange(S))
+    _, received = lax.scan(step, codec.encode(slices[0]), jnp.arange(S))
     return received.reshape(C)
 
 
 def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
-                        compression: Optional[BFPConfig] = None,
+                        compression=None,        # compress.Codec | BFPConfig | None
                         slice_elems: Optional[int] = None,
                         unroll: bool = False) -> jax.Array:
     """Sliced ring reduce-scatter of a flat per-device vector.
@@ -169,6 +149,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    codec = _as_codec(compression)
     if x.ndim != 1 or x.shape[0] % n != 0:
         raise ValueError(f"need flat length divisible by {n}, got {x.shape}")
     if n == 1:
@@ -178,7 +159,7 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
 
     def hop(s, ch):
         send = jnp.take(ch, ((idx - s - 1) % n)[None], axis=0)[0]
-        recv = _send(send, axis_name, n, compression, slice_elems)
+        recv = _send(send, axis_name, n, codec, slice_elems)
         return ch.at[(idx - s - 2) % n].add(recv)
 
     chunks = lax.fori_loop(0, n - 1, hop, chunks, unroll=unroll)
@@ -186,33 +167,33 @@ def ring_reduce_scatter(x: jax.Array, axis_name: str, *,
 
 
 def ring_all_gather(owned: jax.Array, axis_name: str, *,
-                    compression: Optional[BFPConfig] = None,
+                    compression=None,        # compress.Codec | BFPConfig | None
                     unroll: bool = False) -> jax.Array:
     """Ring all-gather: device i contributes chunk i, returns [n * C].
 
     This is the phase that distributes *updated weights* in the fused
     collective (hw/all_reduce.sv FORWARD_OUTPUT/OUTPUT_SEND, lines
-    996-1086).  Under compression the chunk is quantized once at first
-    send and the compressed payload is forwarded verbatim thereafter
-    (BFP roundtrip is idempotent), so every replica sees identical bytes.
+    996-1086).  Under compression the chunk is encoded once at first
+    send and the compressed payload is forwarded VERBATIM thereafter
+    (decoding the same payload is deterministic even for non-idempotent
+    codecs like stochastic int8), so every replica sees identical bytes.
     No per-hop slicing here: the payload is encoded exactly once, so there
     is no codec work to overlap with the forwarding permutes.
     """
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
+    codec = _as_codec(compression)
     owned = _tap(owned, "ring.all_gather")
     if n == 1:
         # still quantize: replicas must see wire-identical bytes at any n,
         # and the golden model quantizes the owned chunk unconditionally
-        if compression is not None:
-            enc, dec = _codec(compression, owned.shape[0])
-            mant, se = enc(owned)
-            return dec(mant, se, owned.dtype)
+        if codec is not None:
+            return codec.roundtrip(owned).astype(owned.dtype)
         return owned
     C = owned.shape[0]
     out = jnp.zeros((n, C), owned.dtype).at[idx].set(owned)
 
-    if compression is None:
+    if codec is None:
         def hop(s, carry):
             out_, pay = carry
             pay = lax.ppermute(pay, axis_name, _next_neighbor_perm(n))
@@ -220,26 +201,24 @@ def ring_all_gather(owned: jax.Array, axis_name: str, *,
 
         out, _ = lax.fori_loop(0, n - 1, hop, (out, owned), unroll=unroll)
     else:
-        enc, dec = _codec(compression, C)
-        mant, se = enc(owned)
+        pay = codec.encode(owned)
         # the local replica stores the same quantized bytes it sends,
         # keeping replicas identical across devices
-        out = out.at[idx].set(dec(mant, se, owned.dtype))
+        out = out.at[idx].set(codec.decode(pay, C, owned.dtype))
 
         def hop(s, carry):
-            out_, m, e = carry
+            out_, pay = carry
             perm = _next_neighbor_perm(n)
-            m = lax.ppermute(m, axis_name, perm)
-            e = lax.ppermute(e, axis_name, perm)
-            return out_.at[(idx - s - 1) % n].set(dec(m, e, owned.dtype)), m, e
+            pay = tuple(lax.ppermute(p, axis_name, perm) for p in pay)
+            return (out_.at[(idx - s - 1) % n].set(
+                codec.decode(pay, C, owned.dtype)), pay)
 
-        out, _, _ = lax.fori_loop(0, n - 1, hop, (out, mant, se),
-                                  unroll=unroll)
+        out, _ = lax.fori_loop(0, n - 1, hop, (out, pay), unroll=unroll)
     return out.reshape(n * C)
 
 
 def ring_all_reduce(x: jax.Array, axis_name: str, *,
-                    compression: Optional[BFPConfig] = None,
+                    compression=None,        # compress.Codec | BFPConfig | None
                     slice_elems: Optional[int] = None,
                     unroll: bool = False) -> jax.Array:
     """Full all-reduce (sum) = reduce-scatter + all-gather."""
@@ -250,13 +229,14 @@ def ring_all_reduce(x: jax.Array, axis_name: str, *,
 
 
 def wire_bytes_per_device(L: int, n: int,
-                          compression: Optional[BFPConfig] = None,
+                          compression=None,
                           dtype_bytes: int = 4) -> int:
     """Bytes each device puts on the ring for one all-reduce of L elements
     (observability parity with the reference's flit counters,
-    hw/bfp_adapter.sv:705-729)."""
+    hw/bfp_adapter.sv:705-729).  ``compression`` is a Codec or (legacy)
+    a BFPConfig."""
     elems = 2 * (n - 1) * (L // n)
-    if compression is None:
+    codec = _as_codec(compression)
+    if codec is None:
         return elems * dtype_bytes
-    from .bfp import wire_bytes
-    return wire_bytes(elems, compression)
+    return codec.wire_bytes(elems)

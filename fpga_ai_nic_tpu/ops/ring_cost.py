@@ -192,6 +192,39 @@ def break_even(encode_gbps: float, decode_gbps: float,
     }
 
 
+def codec_break_even(codec, encode_gbps: float, decode_gbps: float,
+                     link_rates: Sequence[float] = DEFAULT_LINK_RATES,
+                     source: str = "") -> dict:
+    """`break_even` parameterized by a registered compress.Codec: the wire
+    ratio comes from the codec's own byte accounting instead of the
+    hard-wired BFP frame math, so the per-link verdict table extends to
+    topk/int8 (and any plugin) unchanged.  The serial-VPU model is
+    codec-agnostic — encode and decode of ANY codec share the VPU, so
+    their per-byte costs add."""
+    r = float(codec.compression_ratio_vs_f32)
+    out = break_even(encode_gbps, decode_gbps, r, r, link_rates,
+                     source=source or f"codec '{codec.name}' slope chains")
+    out["codec"] = codec.describe()
+    return out
+
+
+def codec_table(n_elems: int = 1 << 16) -> list:
+    """Static cost-model rows for every registered codec (wire ratio,
+    bytes/value, declared error bound, EF) — the accounting half of the
+    codec x {vmem, streaming} bench matrix (`make codec-bench`); the
+    measured half comes from bench_collective.py's slope chains."""
+    from ..compress import available_codecs, get_codec
+    rows = []
+    for name in available_codecs():
+        c = get_codec(name)
+        n_use = n_elems - n_elems % c.pad_elems
+        rows.append(dict(c.describe(),
+                         wire_bytes_per_value=c.wire_bytes(n_use) / n_use,
+                         max_speedup_vs_bf16_psum=round(
+                             c.compression_ratio_vs_f32 / 2, 3)))
+    return rows
+
+
 def decompose(measure, streaming: bool, payload_bytes: int) -> dict:
     """Run the full per-stage decomposition of one loopback row.
 

@@ -55,6 +55,10 @@ class FSDPState(NamedTuple):
     w_own: jax.Array       # this device's f32 master shard [L/n]
     opt_state: Any         # sharded optimizer state
     step: jax.Array
+    # error-feedback residual of the compression codec (per-device full
+    # [L_pad] dropped-gradient carry; None without an EF codec) — same
+    # contract as parallel.train.TrainState.codec_state
+    codec_state: Any = None
 
 
 class FSDPTrainer:
@@ -72,6 +76,10 @@ class FSDPTrainer:
         self.ax = axis_name
         self.n = mesh.shape[axis_name]
         self._meta = None
+        codec = fused_update.resolve_codec(cfg.collective)
+        self._codec = codec
+        self._ef = (cfg.collective.impl == "ring" and codec is not None
+                    and codec.error_feedback)
 
     # -- init ---------------------------------------------------------------
 
@@ -103,7 +111,17 @@ class FSDPTrainer:
             _init, mesh=self.mesh, in_specs=P(),
             out_specs=P(self.ax), check_vma=False))(params)
         return FSDPState(w_own=w_own, opt_state=opt_state,
-                         step=jnp.zeros((), jnp.int32))
+                         step=jnp.zeros((), jnp.int32),
+                         codec_state=self._init_codec_state())
+
+    def _init_codec_state(self):
+        """Zeroed error-feedback residuals, [n * L_pad] sharded over the
+        axis (each device's own full-gradient residual)."""
+        if not self._ef:
+            return None
+        return jax.device_put(
+            jnp.zeros((self.n * self._meta.padded_len,), jnp.float32),
+            NamedSharding(self.mesh, P(self.ax)))
 
     # -- step ---------------------------------------------------------------
 
@@ -113,6 +131,32 @@ class FSDPTrainer:
         meta = self._meta
         assert meta is not None, "call init_state first"
         ax, n = self.ax, self.n
+        codec, ef = self._codec, self._ef
+
+        def shard_step_ef(w_own, opt_state, step, batch, resid):
+            # Error-feedback variant: the gradient collective is explicit
+            # (not the gather's autodiff transpose) so the full local
+            # cotangent can be compensated and re-quantized BEFORE the
+            # per-hop-compressed reduce-scatter.  The forward gather is
+            # unchanged (quantized masters under a compressed ring —
+            # straight-through semantics); memory-wise this materializes
+            # the full flat cotangent, which the transposed path also
+            # produced transiently before its reduce-scatter.
+            flat = fused_update.all_gather_flat(w_own, ax, coll)
+
+            def flat_loss(f):
+                params = fused_update.unflatten_tree(f, meta)
+                return accum.accumulated_loss(
+                    self.loss_fn, self.cfg.accum_steps)(params, batch)
+
+            loss, g_flat = jax.value_and_grad(flat_loss)(flat)
+            g_wire, new_resid = fused_update.error_feedback_encode(
+                codec, g_flat, resid)
+            g_own = fused_update.reduce_scatter(g_wire, ax, coll)
+            g_own = optim.clip_by_global_norm(opt_cfg, g_own / n, (ax,))
+            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
+                                            opt_state, step)
+            return w_new, opt_state2, lax.pmean(loss, ax), new_resid
 
         def shard_step(w_own, opt_state, step, batch):
             def shard_loss(w_own):
@@ -137,12 +181,22 @@ class FSDPTrainer:
             return w_new, opt_state2, lax.pmean(loss, ax)
 
         def _step(state: FSDPState, batch):
-            w_own, opt_state, loss = jax.shard_map(
-                shard_step, mesh=self.mesh,
-                in_specs=(P(ax), P(ax), P(), P(ax)),
-                out_specs=(P(ax), P(ax), P()),
-            )(state.w_own, state.opt_state, state.step, batch)
-            return FSDPState(w_own, opt_state, state.step + 1), loss
+            if ef:
+                w_own, opt_state, loss, codec_state = jax.shard_map(
+                    shard_step_ef, mesh=self.mesh,
+                    in_specs=(P(ax), P(ax), P(), P(ax), P(ax)),
+                    out_specs=(P(ax), P(ax), P(), P(ax)),
+                )(state.w_own, state.opt_state, state.step, batch,
+                  state.codec_state)
+            else:
+                w_own, opt_state, loss = jax.shard_map(
+                    shard_step, mesh=self.mesh,
+                    in_specs=(P(ax), P(ax), P(), P(ax)),
+                    out_specs=(P(ax), P(ax), P()),
+                )(state.w_own, state.opt_state, state.step, batch)
+                codec_state = state.codec_state
+            return FSDPState(w_own, opt_state, state.step + 1,
+                             codec_state), loss
 
         return jax.jit(_step, donate_argnums=(0,))
 
@@ -181,7 +235,8 @@ class FSDPTrainer:
             w_own=jax.device_put(jnp.asarray(restored["w_own"]), sh),
             opt_state={k: jax.device_put(jnp.asarray(v), sh)
                        for k, v in restored["opt_state"].items()},
-            step=jnp.asarray(restored["step"]))
+            step=jnp.asarray(restored["step"]),
+            codec_state=self._init_codec_state())
 
     # -- data ---------------------------------------------------------------
 

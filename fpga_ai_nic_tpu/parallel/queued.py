@@ -95,13 +95,14 @@ class QueuedDDPTrainer(DDPTrainer):
         jitted function, recompiled per bucket shape by jax.jit's own
         cache."""
         coll, ax, n = self.cfg.collective, self.ax, self.n
+        codec = fused_update.resolve_codec(coll)
 
         def shard_reduce(g):
             if coll.impl == "xla":
                 red = lax.pcast(lax.psum(g, ax), ax, to="varying")
             else:
                 red = ring_ops.ring_all_reduce(
-                    g, ax, compression=coll.compression,
+                    g, ax, compression=codec,
                     slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
             return red / n
 
@@ -138,11 +139,12 @@ class QueuedDDPTrainer(DDPTrainer):
         with self.profiler.bucket("grads"):
             bucket_g, loss = self.grads_fn(state.params, batch)
         tickets = []
+        codec = fused_update.resolve_codec(coll)
         with self.profiler.bucket("issue"):
             for b, g in zip(plan.buckets, bucket_g):
                 raw = ring_ops.wire_bytes_per_device(b.padded_len, n, None)
                 wire = ring_ops.wire_bytes_per_device(b.padded_len, n,
-                                                      coll.compression)
+                                                      codec)
                 tickets.append(self.queue.issue(
                     self.reduce_fn, g, raw_bytes=raw, wire_bytes=wire))
         means = tuple(self.queue.wait(t) for t in tickets)

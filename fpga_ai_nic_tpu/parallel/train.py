@@ -46,6 +46,12 @@ class TrainState(NamedTuple):
     w_own: jax.Array       # this device's f32 master shard [L/n] (ZeRO-1)
     opt_state: Any         # sharded optimizer state (ZeRO-1)
     step: jax.Array
+    # error-feedback residual of the configured compression codec: each
+    # device's locally-dropped gradient mass [L_pad], re-added next step
+    # (compress.Codec.state_init; None when the codec carries no state).
+    # Checkpoint restore re-zeros it — EF is self-healing, the residual
+    # is a bounded accumulator, not part of the optimization state proper.
+    codec_state: Any = None
 
 
 class DPTrainer:
@@ -63,6 +69,12 @@ class DPTrainer:
         self.ax = axis_name
         self.n = mesh.shape[axis_name]
         self._meta = None
+        # error-feedback residual carry (compress codecs that declare it,
+        # e.g. top-k): threaded through TrainState.codec_state
+        codec = fused_update.resolve_codec(cfg.collective)
+        self._codec = codec
+        self._ef = (cfg.collective.impl == "ring" and codec is not None
+                    and codec.error_feedback)
 
     # -- init ---------------------------------------------------------------
 
@@ -91,7 +103,17 @@ class DPTrainer:
             _init, mesh=self.mesh, in_specs=P(),
             out_specs=P(self.ax), check_vma=False))(params)
         return TrainState(params=params, w_own=w_own, opt_state=opt_state,
-                          step=jnp.zeros((), jnp.int32))
+                          step=jnp.zeros((), jnp.int32),
+                          codec_state=self._init_codec_state())
+
+    def _init_codec_state(self):
+        """Zeroed per-device error-feedback residuals ([n * L_pad] global,
+        sharded over the axis so each device carries its own [L_pad])."""
+        if not self._ef:
+            return None
+        return jax.device_put(
+            jnp.zeros((self.n * self._meta.padded_len,), jnp.float32),
+            NamedSharding(self.mesh, P(self.ax)))
 
     # -- step ---------------------------------------------------------------
 
@@ -102,11 +124,14 @@ class DPTrainer:
         assert meta is not None, "call init_state first"
         ax = self.ax
 
+        codec, ef = self._codec, self._ef
+
         # Phase 1 (check_vma=True): gradients + reduce-scatter + optimizer.
         # Variance tracking must stay ON anywhere jax.grad runs inside
         # shard_map — with check_vma=False the transposes of collectives
         # inside the loss are silently wrong.
-        def shard_update(params, w_own, opt_state, step, batch):
+        def shard_update(params, w_own, opt_state, step, batch,
+                         *maybe_resid):
             # Cast params dp-varying BEFORE grad: otherwise vma-typed
             # autodiff auto-inserts a full psum over dp for every gradient
             # (params are dp-invariant), which both double-counts once we
@@ -116,8 +141,18 @@ class DPTrainer:
             loss, grads = accum.accumulated_value_and_grad(
                 self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
+            if ef:
+                # compensate-then-compress: the wire sees the locally
+                # quantized gradient; what it dropped carries to the next
+                # step (TrainState.codec_state)
+                resid = maybe_resid[0]
+                flat_g, new_resid = fused_update.error_feedback_encode(
+                    codec, flat_g, resid)
             diag = {}
             if coll.integrity_check:
+                # checksums guard the COLLECTIVE (what actually rides the
+                # wire), so under EF they see the post-compression vector
+                # — local compression is intentional, not corruption
                 expect, l1 = chaos.chunk_checksums(flat_g, ax, self.n)
             g_red = fused_update.reduce_scatter(flat_g, ax, coll)
             if coll.integrity_check:
@@ -141,7 +176,13 @@ class DPTrainer:
                 opt_state2 = jax.tree_util.tree_map(
                     lambda new, old: jnp.where(ok, new, old),
                     opt_state2, opt_state)
-            return w_new, opt_state2, lax.pmean(loss, ax), diag
+                if ef:
+                    # a gated (replayed) step must not mutate the residual
+                    # either, or the retry would double-count this step's
+                    # dropped mass
+                    new_resid = jnp.where(ok, new_resid, maybe_resid[0])
+            out = (w_new, opt_state2, lax.pmean(loss, ax), diag)
+            return out + ((new_resid,) if ef else ())
 
         # Phase 2 (no autodiff): all-gather updated weights -> replicated
         # working params (the reference's host write-back of w_new,
@@ -151,16 +192,21 @@ class DPTrainer:
             return fused_update.unflatten_tree(flat_w, meta)
 
         def _step(state: TrainState, batch):
-            w_own, opt_state, loss, diag = jax.shard_map(
+            in_specs = (P(), P(ax), P(ax), P(), P(ax)) + (
+                (P(ax),) if ef else ())
+            out_specs = (P(ax), P(ax), P(), P()) + ((P(ax),) if ef else ())
+            args = (state.params, state.w_own, state.opt_state, state.step,
+                    batch) + ((state.codec_state,) if ef else ())
+            res = jax.shard_map(
                 shard_update, mesh=self.mesh,
-                in_specs=(P(), P(ax), P(ax), P(), P(ax)),
-                out_specs=(P(ax), P(ax), P(), P()),
-            )(state.params, state.w_own, state.opt_state, state.step, batch)
+                in_specs=in_specs, out_specs=out_specs)(*args)
+            w_own, opt_state, loss, diag = res[:4]
+            codec_state = res[4] if ef else state.codec_state
             new_params = jax.shard_map(
                 shard_gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
                 check_vma=False)(w_own)
             new_state = TrainState(new_params, w_own, opt_state,
-                                   state.step + 1)
+                                   state.step + 1, codec_state)
             if coll.integrity_check:
                 # metrics dict instead of the bare loss: the elastic loop
                 # (parallel.elastic) reads the integrity verdict from here
@@ -208,7 +254,10 @@ class DPTrainer:
             for k, v in restored["opt_state"].items()}
         return TrainState(
             params=self.params_from_master(w_own), w_own=w_own,
-            opt_state=opt_state, step=jnp.asarray(restored["step"]))
+            opt_state=opt_state, step=jnp.asarray(restored["step"]),
+            # EF residual restarts at zero: it is a bounded local
+            # accumulator, and checkpoints persist only the masters
+            codec_state=self._init_codec_state())
 
     # -- data ---------------------------------------------------------------
 

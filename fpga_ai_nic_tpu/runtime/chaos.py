@@ -342,17 +342,28 @@ class activate:
 
 def integrity_tol(coll, n: int) -> float:
     """Checksum tolerance for an n-way all-reduce under the configured wire
-    format.  Uncompressed rings/psum differ from the input sums only by
-    f32 reassociation; BFP adds a bounded per-hop quantization error
-    (<= 2^(1-mantissa_bits) of the block max per element per hop), so the
-    chunk-sum discrepancy is bounded by ~(n-1) * 2^(1-m) * (blockmax/mean)
-    of the chunk L1.  The tolerance is a GROSS-corruption tripwire (NaN,
-    flipped exponent bits, runaway scale), not a bit-exactness check —
-    in-bound quantization noise must pass."""
-    comp = getattr(coll, "compression", None)
-    if comp is None:
+    format — derived from the codec's DECLARED error bound
+    (compress.Codec.error_bound), not from a BFP special case, so the
+    integrity layer works unmodified under any registered codec.
+
+    Uncompressed rings/psum differ from the input sums only by f32
+    reassociation.  A bounded codec adds per-hop quantization error
+    (<= error_bound of the unit max per element per hop: 2^(1-m) for BFP's
+    m-bit mantissa — the pre-subsystem hard-wired formula — 1/127 for
+    stochastic int8), so the chunk-sum discrepancy is bounded by
+    ~(n-1) * error_bound * (unitmax/mean) of the chunk L1.
+    Unbounded-by-design codecs (top-k declares error_bound=1.0) saturate
+    at the 0.5 cap: the checksum then only trips on the failures it can
+    still prove — NaN/inf and runaway scale — with no false trips on
+    intentional compression loss (the error-feedback carry, not a per-pass
+    bound, is top-k's accuracy story).  Either way the tolerance is a
+    GROSS-corruption tripwire (NaN, flipped exponent bits, runaway scale),
+    not a bit-exactness check — in-bound quantization noise must pass."""
+    from ..ops.fused_update import resolve_codec
+    codec = resolve_codec(coll)
+    if codec is None:
         return 1e-3
-    return min(0.5, (n - 1) * (2.0 ** (1 - comp.mantissa_bits)) * 8.0)
+    return min(0.5, (n - 1) * float(codec.error_bound) * 8.0)
 
 
 def chunk_checksums(flat: "Any", axis_name: str, n: int):

@@ -249,6 +249,13 @@ class Checkpointer:
         if is_trainer_state and "params" in tree and (
                 "w_own" in tree or "w_master" in tree):
             tree = {k: v for k, v in tree.items() if k != "params"}
+        if is_trainer_state and ("w_own" in tree or "w_master" in tree):
+            # the error-feedback residual (codec_state) is a bounded
+            # per-device accumulator every restore_state re-zeros — for a
+            # top-k run it is n x full-model f32, so persisting it would
+            # balloon the checkpoint ~(n+1)x for bytes thrown away on
+            # restore (EF is self-healing; see TrainState.codec_state)
+            tree = {k: v for k, v in tree.items() if k != "codec_state"}
         tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
         if self.compress is not None and isinstance(tree, dict):
             for key in ("w_own", "w_master"):

@@ -81,6 +81,18 @@ class CollectiveConfig:
 
     impl: str = "xla"             # "xla" | "ring"
     compression: Optional[BFPConfig] = None
+    # named gradient-compression codec (fpga_ai_nic_tpu.compress registry:
+    # "bfp" | "topk" | "int8" | any registered plugin) with constructor
+    # options as a (key, value) pair tuple — kept hashable so the frozen
+    # config stays usable as a cache key:
+    #   CollectiveConfig(impl="ring", codec="topk",
+    #                    codec_opts=(("k", 32), ("bucket_elems", 256)))
+    # codec=None + compression=BFPConfig(...) is the legacy BFP spelling
+    # (still fully supported); codec="bfp" may combine with compression=
+    # to reuse a BFPConfig.  Unknown names fail HERE, at construction,
+    # with the registered list — not at first collective trace.
+    codec: Optional[str] = None
+    codec_opts: Tuple[Tuple[str, Any], ...] = ()
     # run the compressed ring through the single fused Pallas kernel
     # (ops.ring_pallas: encode-into-hop with RDMA overlap) instead of the
     # separate encode/ppermute/decode XLA ops.  Implies the lane-layout
@@ -124,13 +136,39 @@ class CollectiveConfig:
 
     def __post_init__(self):
         assert self.impl in ("xla", "ring")
-        if self.compression is not None and self.impl != "ring":
-            raise ValueError("BFP compression requires impl='ring' "
+        if ((self.compression is not None or self.codec is not None)
+                and self.impl != "ring"):
+            raise ValueError("gradient compression requires impl='ring' "
                              "(XLA collectives cannot compress on the wire)")
-        if self.fused_kernel and (self.impl != "ring"
-                                  or self.compression is None):
-            raise ValueError("fused_kernel is the compressed-ring Pallas "
-                             "path: requires impl='ring' and compression")
+        if self.codec is not None:
+            if not isinstance(self.codec_opts, tuple):
+                raise ValueError("codec_opts must be a tuple of (key, "
+                                 f"value) pairs, got {self.codec_opts!r}")
+            if self.compression is not None and self.codec != "bfp":
+                raise ValueError(
+                    f"codec={self.codec!r} conflicts with compression= "
+                    "(a BFPConfig): the BFPConfig parameterizes the 'bfp' "
+                    "codec only")
+        if self.codec is not None or self.fused_kernel:
+            if self.fused_kernel and (self.impl != "ring"
+                                      or (self.compression is None
+                                          and self.codec is None)):
+                raise ValueError("fused_kernel is the compressed-ring "
+                                 "Pallas path: requires impl='ring' and a "
+                                 "codec (codec=/compression=)")
+            # fail fast on unknown names / bad options, with the
+            # registered-codec list in the error (compress.get_codec);
+            # import is lazy so constructing codec-less configs never
+            # touches the compress package, and one resolve serves both
+            # the name validation and the fused-capability check
+            from ..compress import resolve
+            c = resolve(self)
+            if self.fused_kernel and not c.supports_fused:
+                raise ValueError(
+                    f"codec {c.name!r} cannot ride the fused Pallas ring "
+                    "(its wire frames are BFP int8 mantissa+scale tiles); "
+                    "use the separate-op ring (fused_kernel=False) or "
+                    "codec='bfp'")
 
 
 @dataclass(frozen=True)

@@ -52,6 +52,11 @@ _WATCH = {
                  "fpga_ai_nic_tpu/ops/ring_cost.py",
                  "fpga_ai_nic_tpu/ops/bfp_pallas.py"],
     "convergence": ["fpga_ai_nic_tpu/evals/", "fpga_ai_nic_tpu/ops/"],
+    "codec_bench": ["bench_collective.py", "bench_common.py",
+                    "fpga_ai_nic_tpu/compress/",
+                    "fpga_ai_nic_tpu/ops/ring_cost.py",
+                    "fpga_ai_nic_tpu/ops/bfp.py",
+                    "fpga_ai_nic_tpu/ops/bfp_pallas.py"],
 }
 
 
@@ -390,6 +395,55 @@ def main():
                     L += _render_sweep(
                         sweep, f"`{_rel(cpu_art)}`, platform: "
                                f"{dc.get('platform')}")
+
+    # -- codec matrix (pluggable compression subsystem) ----------------------
+    cb_art = (_newest("artifacts/codec_bench_*.json")
+              or _newest("CODEC_BENCH_r*.json"))
+    if cb_art:
+        d = _load(cb_art)
+        rows = [r for r in d.get("rows", []) if "roundtrip_gbps" in r]
+        if rows:
+            L += ["## Codec matrix (pluggable compression subsystem)", "",
+                  f"Source: `{_rel(cb_art)}`{_badge(d, 'codec_bench')} "
+                  f"(platform: {d.get('platform')}; `make codec-bench`).  "
+                  "Every registered `fpga_ai_nic_tpu.compress` codec, "
+                  "slope-timed at both payload classes "
+                  "(vmem = on-chip-resident size, streaming = "
+                  "HBM-streaming size).  Ratio is wire bytes vs f32; "
+                  "break-even (streaming rows) applies the serial-VPU "
+                  "model per codec — the codec's harmonic-combined rate "
+                  "must exceed 2x the link rate to beat a bf16 psum.", "",
+                  "| codec | class | ratio vs f32 | encode GB/s | "
+                  "decode GB/s | roundtrip GB/s | wins at 12.5 GB/s? |",
+                  "|---|---|---|---|---|---|---|"]
+            for r in rows:
+                be = (r.get("break_even", {}).get("per_link_rate", {})
+                      .get("link_12.5GBps"))
+                win = ("yes" if be and be.get("bfp_wins")
+                       else "no" if be else "—")
+                L.append(f"| {r['codec']} | {r['class']} "
+                         f"| {r['compression_ratio_vs_f32']}x "
+                         f"| {r.get('encode_gbps', '—')} "
+                         f"| {r.get('decode_gbps', '—')} "
+                         f"| {r.get('roundtrip_gbps', '—')} "
+                         f"| {win} |")
+            L.append("")
+            tbl = d.get("codec_table") or []
+            if tbl:
+                L += ["Declared codec properties (the `Codec` contract "
+                      "the integrity layer and trainers consume — "
+                      "docs/COMPRESSION.md):", "",
+                      "| codec | ratio vs f32 | error bound | "
+                      "error feedback | idempotent | fused-ring capable |",
+                      "|---|---|---|---|---|---|"]
+                for c in tbl:
+                    L.append(
+                        f"| {c['codec']} "
+                        f"| {c['compression_ratio_vs_f32']}x "
+                        f"| {c['error_bound']:.3g} "
+                        f"| {c['error_feedback']} | {c['idempotent']} "
+                        f"| {c['supports_fused']} |")
+                L.append("")
 
     # -- methodology: per-stage roofline accounting --------------------------
     L += ["## Methodology: pipeline efficiency", "",
