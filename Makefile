@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench obs-gate
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -12,7 +12,14 @@ test:
 test-fast:
 	python -m pytest tests/ -q -m "not slow"
 
-ci: codec test
+# telemetry regression gate: diff the banked benchmark artifacts against
+# a run summary (self-diff here — trivially green on an unchanged tree;
+# bench drivers / CI runs pass --summary to gate fresh numbers).  Exits
+# nonzero on any per-metric regression beyond threshold.
+obs-gate:
+	python tools/obs_gate.py
+
+ci: codec test obs-gate
 
 bench:
 	python bench.py

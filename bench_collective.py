@@ -70,7 +70,21 @@ def _timeit(fn, sync, iters=TIMED_ITERS):
 def child_main() -> None:
     t0 = time.time()
 
+    # structured telemetry: every phase lands as a span in an obs event
+    # stream, and the artifact carries the stream's summary — the same
+    # DETAILED_PROFILE-style wall-clock breakdown the trainers get,
+    # without grepping [bench] log lines
+    from fpga_ai_nic_tpu.obs import EventStream
+    events = EventStream()
+    _open_phase = [None]            # (name, ns) of the running phase span
+
     def phase(name):
+        now = EventStream.now_ns()
+        if _open_phase[0] is not None:
+            pname, pns = _open_phase[0]
+            events.emit("span", f"phase.{pname}", t_ns=pns,
+                        dur_ns=now - pns)
+        _open_phase[0] = (name, now)
         log(f"phase={name} t={time.time() - t0:.1f}s")
 
     phase("import")
@@ -411,6 +425,23 @@ def child_main() -> None:
             "see mesh_sweep for the virtual-mesh measurement")
 
     phase("done")
+    report["telemetry"] = events.summary()
+    # gate-compatible flat summary (tools/obs_gate.py --summary), built
+    # from the gate's OWN name contract so producer and extractor can
+    # never drift apart (a drifted name would silently gate nothing)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import obs_gate
+    gate_metrics = {}
+    for key in obs_gate.COLLECTIVE_GATE_KEYS:
+        if report.get(key):
+            gate_metrics[obs_gate.collective_metric(key)] = report[key]
+    for row in report.get("sweep", []):
+        for arm in obs_gate.SWEEP_GATE_ARMS:
+            if row.get(f"{arm}_gbps"):
+                gate_metrics[obs_gate.sweep_metric(row["size_mb"], arm)] = \
+                    row[f"{arm}_gbps"]
+    report["gate_summary"] = gate_metrics
     print(json.dumps(report), flush=True)
 
 

@@ -147,3 +147,88 @@ def tpu_compiler_params(**kwargs) -> Any:
             "semantics), so the fused kernels cannot run here; use "
             "the non-fused paths or a newer jaxlib")
     return cls(**{k: v for k, v in kwargs.items() if k in known})
+
+
+# ---------------------------------------------------------------------------
+# jax.profiler.ProfileData across jaxlibs (utils.trace_analysis's loader)
+# ---------------------------------------------------------------------------
+
+class _XEvent:
+    __slots__ = ("name", "start_ns", "duration_ns")
+
+    def __init__(self, name, start_ns, duration_ns):
+        self.name = name
+        self.start_ns = start_ns
+        self.duration_ns = duration_ns
+
+
+class _XLine:
+    __slots__ = ("name", "events")
+
+    def __init__(self, name, events):
+        self.name = name
+        self.events = events
+
+
+class _XPlane:
+    __slots__ = ("name", "lines")
+
+    def __init__(self, name, lines):
+        self.name = name
+        self.lines = lines
+
+
+class _XSpaceData:
+    """ProfileData-shaped view over a raw xplane.pb parsed with the tsl
+    XSpace proto (ships inside tensorflow; present on the fleet containers
+    whose jaxlib predates jax.profiler.ProfileData).  Only the surface
+    utils.trace_analysis walks: planes -> lines -> events with
+    name/start_ns/duration_ns."""
+
+    def __init__(self, planes):
+        self.planes = planes
+
+    @classmethod
+    def from_file(cls, path):
+        import os
+        os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        planes = []
+        for p in xs.planes:
+            lines = []
+            for l in p.lines:
+                evs = []
+                for e in l.events:
+                    md = p.event_metadata[e.metadata_id]
+                    # same convention as ProfileData: event start is the
+                    # line timestamp plus the ps offset
+                    evs.append(_XEvent(
+                        md.name or md.display_name,
+                        l.timestamp_ns + e.offset_ps // 1000,
+                        e.duration_ps // 1000))
+                lines.append(_XLine(l.name, evs))
+            planes.append(_XPlane(p.name, lines))
+        return cls(planes)
+
+
+def load_profile_data(path: str):
+    """ProfileData.from_file across jaxlibs: the native loader when this
+    jax ships one, the tsl-proto shim otherwise.  Raises ImportError with
+    both reasons when neither exists (no silent empty report)."""
+    try:
+        from jax.profiler import ProfileData
+    except ImportError as e:
+        jax_reason = str(e)
+        ProfileData = None
+    if ProfileData is not None:
+        return ProfileData.from_file(path)
+    try:
+        return _XSpaceData.from_file(path)
+    except ImportError as e:
+        raise ImportError(
+            "trace analysis needs jax.profiler.ProfileData (jax >= 0.5; "
+            f"unavailable here: {jax_reason}) or the tensorflow tsl "
+            f"xplane proto (unavailable here: {e})") from e

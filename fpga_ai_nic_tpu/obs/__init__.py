@@ -1,0 +1,30 @@
+"""Unified telemetry plane: structured event stream, in-graph training
+metrics, and single-timebase Perfetto export.
+
+Three layers, one timebase:
+
+  obs.events     schema-versioned span/counter stream (bounded ring,
+                 O(1) hot path, JSONL sink, honest ``events_dropped``).
+                 ``utils.observability.Profiler`` is a thin facade over
+                 it — its buckets/collective/recovery aggregates remain
+                 the O(1)-memory summary; the stream carries the
+                 individual events underneath.
+  obs.metrics    in-graph metrics (grad norm, codec declared-vs-observed
+                 error, EF residual mass, integrity drift) tapped to a
+                 host MetricsSink via pure_callback; compiled out
+                 entirely when ``TrainConfig.obs_metrics`` is False.
+  obs.timeline   host spans + queue issue/wait tickets + device-plane
+                 trace intervals merged into Chrome-trace/Perfetto JSON.
+
+Gate: ``tools/obs_gate.py`` (``make obs-gate``) diffs a run's telemetry
+summary against the banked benchmark artifacts.  Docs:
+docs/OBSERVABILITY.md.
+"""
+
+from .events import SCHEMA_VERSION, EventStream, read_jsonl  # noqa: F401
+from .metrics import (MetricsSink, active_sink, host_observe,  # noqa: F401
+                      tap, use_sink)
+from . import timeline  # noqa: F401
+
+__all__ = ["SCHEMA_VERSION", "EventStream", "read_jsonl", "MetricsSink",
+           "active_sink", "host_observe", "tap", "use_sink", "timeline"]

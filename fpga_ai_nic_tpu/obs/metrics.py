@@ -1,0 +1,230 @@
+"""In-graph training metrics, tapped to the host via ``jax.pure_callback``.
+
+The hard part of training telemetry on TPU is that the numbers live
+*inside* a jitted, donated-state step: gradient norms, codec error, EF
+residual mass.  This module reuses the mechanism `runtime.chaos` already
+proved for fault injection — route a value through a ``pure_callback``
+whose host half reads an ambient object — but for metrics instead of
+faults: the step's loss is passed through the callback together with the
+metric scalars, so the callback is consumed (never DCE'd) and costs one
+host hop per step.
+
+Zero-cost-when-off contract: the tap is gated by a TRACE-TIME Python bool
+(``TrainConfig.obs_metrics``).  Disabled, ``tap`` returns its input object
+untouched and the metric thunks are never traced — the step's jaxpr/HLO is
+bit-identical to a build without any obs plumbing (asserted by
+tests/test_obs.py's abstract-eval test).
+
+Metric definitions (docs/OBSERVABILITY.md):
+
+  grad_norm           global L2 of the mean-reduced gradient (post-
+                      collective, pre-clip) — psum'd across the axis.
+  codec_obs_rel_err   observed per-unit relative roundtrip error of the
+                      configured codec on this step's gradient: max over
+                      compression units of |x - roundtrip(x)| / max|unit|.
+                      Compare against the codec's DECLARED error_bound
+                      (`declared_error_bound` in the sink's statics): the
+                      EQuARX-style honesty check that the wire format does
+                      what it promises, every step, on real gradients.
+  ef_resid_norm       L2 of the error-feedback residual AFTER this step's
+                      carry update — the unsent gradient mass in flight.
+  integrity_err       worst relative chunk-sum discrepancy from the
+                      collective integrity checksums (runtime.chaos),
+                      when integrity_check is on.
+  loss_ewma /         host-side EWMAs maintained by the sink (loss from
+  step_time_ewma_s    the tapped value, step time from tap arrival
+                      spacing) — the training-health dashboard pair.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Dict, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .events import EventStream
+
+__all__ = ["MetricsSink", "use_sink", "active_sink", "tap",
+           "codec_static_metrics", "codec_observed_error"]
+
+
+# ---------------------------------------------------------------------------
+# host side: the sink
+# ---------------------------------------------------------------------------
+
+class MetricsSink:
+    """Ambient receiver of tapped step metrics (one per run/trainer).
+
+    Thread-safe (XLA callback threads deliver); keeps latest values, EWMA
+    aggregates for loss and inter-tap step time, and mirrors every update
+    into an EventStream as counter events when one is attached — so the
+    Perfetto timeline carries the metric series next to the spans."""
+
+    def __init__(self, ewma_alpha: float = 0.1,
+                 events: Optional[EventStream] = None,
+                 static: Optional[Dict[str, Any]] = None):
+        assert 0.0 < ewma_alpha <= 1.0
+        self.ewma_alpha = ewma_alpha
+        self.events = events
+        self.static = dict(static or {})
+        self.latest: Dict[str, float] = {}
+        self.ewma: Dict[str, float] = {}
+        self.n_updates = 0
+        self._last_t: Optional[float] = None
+        self._lock = threading.Lock()
+
+    def _ewma_update(self, name: str, value: float) -> None:
+        a = self.ewma_alpha
+        prev = self.ewma.get(name)
+        self.ewma[name] = value if prev is None else (1 - a) * prev + a * value
+
+    def update(self, values: Dict[str, float]) -> None:
+        now = time.perf_counter()
+        ev = self.events
+        with self._lock:
+            self.n_updates += 1
+            for name, v in values.items():
+                v = float(v)
+                self.latest[name] = v
+                if name == "loss":
+                    self._ewma_update("loss", v)
+            if self._last_t is not None:
+                self._ewma_update("step_time_s", now - self._last_t)
+            self._last_t = now
+        if ev is not None:
+            for name, v in values.items():
+                ev.counter(f"metric.{name}", float(v))
+
+    def as_dict(self) -> Dict[str, Any]:
+        with self._lock:
+            out: Dict[str, Any] = {
+                "n_updates": self.n_updates,
+                "latest": dict(self.latest),
+                "loss_ewma": self.ewma.get("loss"),
+                "step_time_ewma_s": self.ewma.get("step_time_s"),
+            }
+            if self.static:
+                out["static"] = dict(self.static)
+        return out
+
+
+_ACTIVE_SINK: Optional[MetricsSink] = None
+
+
+def active_sink() -> Optional[MetricsSink]:
+    return _ACTIVE_SINK
+
+
+class use_sink:
+    """Context manager binding the ambient sink the tap callbacks deliver
+    to — same ambient-object pattern (and the same async-dispatch caveat)
+    as ``runtime.chaos.activate``: any step that should be observed must
+    complete before the context exits."""
+
+    def __init__(self, sink: Optional[MetricsSink]):
+        self.sink = sink
+
+    def __enter__(self) -> Optional[MetricsSink]:
+        global _ACTIVE_SINK
+        self._prev = _ACTIVE_SINK
+        _ACTIVE_SINK = self.sink
+        return self.sink
+
+    def __exit__(self, *exc):
+        global _ACTIVE_SINK
+        _ACTIVE_SINK = self._prev
+        return False
+
+
+def host_observe(values: Dict[str, float]) -> None:
+    """Host-side metric delivery for values that never lived in a jitted
+    program (e.g. the queued trainer's per-bucket wire accounting) —
+    no-op without an active sink, same as the tap."""
+    sink = _ACTIVE_SINK
+    if sink is not None:
+        sink.update(values)
+
+
+# ---------------------------------------------------------------------------
+# in-graph side: the tap
+# ---------------------------------------------------------------------------
+
+def tap(out, metrics, enabled: bool = True):
+    """Route ``out`` (any array, typically the step's loss) through a
+    pure_callback that delivers ``metrics`` (name -> scalar array, or a
+    zero-arg thunk returning that dict) to the ambient sink.  Returns
+    ``out`` unchanged numerically.
+
+    ``enabled`` must be a Python (trace-time) bool: False returns ``out``
+    THE SAME OBJECT — no callback, no metric computation (a thunk is
+    never invoked), nothing in the jaxpr (the compiled-out-entirely
+    contract; pass a thunk when the metric computation itself would
+    otherwise be traced dead at the call site)."""
+    if not enabled:
+        return out
+    if callable(metrics):
+        metrics = metrics()
+    if not metrics:
+        return out
+    import jax
+
+    names: Tuple[str, ...] = tuple(sorted(metrics))
+    vals = [metrics[k] for k in names]
+
+    def host(o, *vs):
+        sink = _ACTIVE_SINK
+        if sink is not None:
+            sink.update({k: float(np.asarray(v)) for k, v in zip(names, vs)})
+        return np.asarray(o)
+
+    return jax.pure_callback(
+        host, jax.ShapeDtypeStruct(np.shape(out), out.dtype), out, *vals)
+
+
+# ---------------------------------------------------------------------------
+# metric builders (called inside shard_map, only when enabled)
+# ---------------------------------------------------------------------------
+
+def codec_static_metrics(codec, n_elems: int) -> Dict[str, Any]:
+    """Trace-time-constant codec facts for the sink's ``static`` dict:
+    declared compression ratio, declared error bound, wire bytes per
+    all-reduce pass of an [n_elems] gradient."""
+    if codec is None:
+        return {}
+    return {"codec": codec.name,
+            "compression_ratio_vs_f32":
+                round(float(codec.compression_ratio_vs_f32), 4),
+            "declared_error_bound": float(codec.error_bound),
+            "wire_bytes_per_pass": int(codec.wire_bytes(n_elems))}
+
+
+def codec_observed_error(codec, x, quantized=None):
+    """Observed per-unit relative roundtrip error of ``codec`` on the flat
+    vector ``x`` — the in-graph half of the declared-vs-observed check.
+
+    ``quantized`` (optional) is roundtrip(x) when the caller already has
+    it (the EF path's wire vector); otherwise one extra roundtrip is spent
+    — acceptable for an opt-in telemetry path, and the only way to measure
+    the REAL error instead of re-asserting the declared bound."""
+    import jax.numpy as jnp
+    if quantized is None:
+        quantized = codec.roundtrip(x)
+    pe = codec.pad_elems
+    units = x.reshape(-1, pe).astype(jnp.float32)
+    err = jnp.abs(units - quantized.reshape(-1, pe).astype(jnp.float32))
+    unit_max = jnp.max(jnp.abs(units), axis=1)
+    rel = jnp.max(jnp.max(err, axis=1) / jnp.maximum(unit_max, 1e-20))
+    return rel
+
+
+def l2_norm(x, axis_name: Optional[str] = None):
+    """Global L2 norm of a (possibly axis-sharded) flat vector — psum'd
+    when ``axis_name`` is given (call inside shard_map)."""
+    import jax.numpy as jnp
+    from jax import lax
+    sq = jnp.sum(x.astype(jnp.float32) ** 2)
+    if axis_name is not None:
+        sq = lax.psum(sq, axis_name)
+    return jnp.sqrt(sq)

@@ -148,6 +148,11 @@ class ElasticTrainer:
         self.queue = CollectiveQueue(
             lambda state, batch: self.trainer.step_fn(state, batch),
             trainer.cfg.collective, self.profiler, chaos=plan)
+        if plan is not None and plan.events is None:
+            # injected faults land in the same event stream as the spans
+            # and ticket intervals they perturb — the timeline shows the
+            # fault AND the recovery it provoked on one axis
+            plan.events = self.profiler.events
 
     # -- one attempt (runs inside the watchdog worker thread) ---------------
 
@@ -263,12 +268,15 @@ class ElasticTrainer:
                     kind, step_i, site=getattr(err, "site", ""),
                     error=repr(err))
                 event = event or ev
+                self.profiler.events.instant(
+                    "fault", kind=kind, step=step_i,
+                    site=getattr(err, "site", ""))
                 # a failed attempt's ticket may be un-waitable (a wedged
                 # dispatch): drop the window or stale tickets eventually
                 # wedge issue() itself
                 self.queue.abandon()
                 if attempt >= self.cfg.max_retries:
-                    self.profiler.recovery.failed_recoveries += 1
+                    self.profiler.recovery.record_failed_recovery()
                     raise RecoveryExhausted(
                         f"step {step_i} failed {attempt + 1} times "
                         f"(last: {kind}); giving up after max_retries="
@@ -278,7 +286,8 @@ class ElasticTrainer:
                     # before touching devices again (idempotent; a no-op
                     # single-process, the real thing on a pod restart)
                     multihost.initialize()
-                state = self._restore()
+                with self.profiler.bucket("restore"):
+                    state = self._restore()
                 restored = True
                 if int(state.step) != step_i:
                     # the restore rewound past this step (ckpt_every > 1):
@@ -295,6 +304,8 @@ class ElasticTrainer:
                     self.profiler.recovery.record_recovery(
                         time.monotonic() - t_fault, restored=restored,
                         event=event)
+                    self.profiler.events.instant(
+                        "recovered", step=step_i, restored=restored)
                 self.heartbeat.beat()
                 return new_state, metrics
         raise AssertionError("unreachable")

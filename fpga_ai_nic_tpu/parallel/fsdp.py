@@ -47,7 +47,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import accum
 from . import mesh as mesh_lib
 from .. import optim
-from ..ops import fused_update
+from ..obs import metrics as obs_metrics
+from ..ops import fused_update, ring as ring_ops
 from ..utils.config import TrainConfig
 
 
@@ -132,6 +133,8 @@ class FSDPTrainer:
         assert meta is not None, "call init_state first"
         ax, n = self.ax, self.n
         codec, ef = self._codec, self._ef
+        # trace-time metrics gate (obs.metrics compiled-out contract)
+        obs_on = self.cfg.obs_metrics
 
         def shard_step_ef(w_own, opt_state, step, batch, resid):
             # Error-feedback variant: the gradient collective is explicit
@@ -153,10 +156,24 @@ class FSDPTrainer:
             g_wire, new_resid = fused_update.error_feedback_encode(
                 codec, g_flat, resid)
             g_own = fused_update.reduce_scatter(g_wire, ax, coll)
-            g_own = optim.clip_by_global_norm(opt_cfg, g_own / n, (ax,))
+            g_own = g_own / n
+            m = {}
+            if obs_on:
+                # g_wire IS roundtrip(g_flat + resid): declared-vs-
+                # observed error comes free of an extra roundtrip
+                m["codec_obs_rel_err"] = lax.pmax(
+                    obs_metrics.codec_observed_error(
+                        codec, g_flat + resid, quantized=g_wire), ax)
+                m["ef_resid_norm"] = obs_metrics.l2_norm(new_resid, ax)
+                m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
+            g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
-            return w_new, opt_state2, lax.pmean(loss, ax), new_resid
+            loss_m = lax.pmean(loss, ax)
+            if obs_on:
+                m["loss"] = loss_m
+            return (w_new, opt_state2, loss_m, new_resid) + (
+                (m,) if obs_on else ())
 
         def shard_step(w_own, opt_state, step, batch):
             def shard_loss(w_own):
@@ -175,26 +192,41 @@ class FSDPTrainer:
                     self.loss_fn, self.cfg.accum_steps)(params, batch)
 
             loss, g_own = jax.value_and_grad(shard_loss)(w_own)
-            g_own = optim.clip_by_global_norm(opt_cfg, g_own / n, (ax,))
+            g_own = g_own / n
+            m = {}
+            if obs_on:
+                # the codec path here is the gather's declared VJP — no
+                # explicit encode to compare against, so this variant
+                # carries the norm/loss metrics only
+                m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
+            g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
-            return w_new, opt_state2, lax.pmean(loss, ax)
+            loss_m = lax.pmean(loss, ax)
+            if obs_on:
+                m["loss"] = loss_m
+            return (w_new, opt_state2, loss_m) + ((m,) if obs_on else ())
 
         def _step(state: FSDPState, batch):
+            m_specs = (P(),) if obs_on else ()
             if ef:
-                w_own, opt_state, loss, codec_state = jax.shard_map(
+                res = jax.shard_map(
                     shard_step_ef, mesh=self.mesh,
                     in_specs=(P(ax), P(ax), P(), P(ax), P(ax)),
-                    out_specs=(P(ax), P(ax), P(), P(ax)),
+                    out_specs=(P(ax), P(ax), P(), P(ax)) + m_specs,
                 )(state.w_own, state.opt_state, state.step, batch,
                   state.codec_state)
+                w_own, opt_state, loss, codec_state = res[:4]
             else:
-                w_own, opt_state, loss = jax.shard_map(
+                res = jax.shard_map(
                     shard_step, mesh=self.mesh,
                     in_specs=(P(ax), P(ax), P(), P(ax)),
-                    out_specs=(P(ax), P(ax), P()),
+                    out_specs=(P(ax), P(ax), P()) + m_specs,
                 )(state.w_own, state.opt_state, state.step, batch)
+                w_own, opt_state, loss = res[:3]
                 codec_state = state.codec_state
+            if obs_on:
+                loss = obs_metrics.tap(loss, res[-1])
             return FSDPState(w_own, opt_state, state.step + 1,
                              codec_state), loss
 
@@ -202,6 +234,23 @@ class FSDPTrainer:
 
     def step(self, state: FSDPState, batch) -> Tuple[FSDPState, jax.Array]:
         return self.step_fn(state, batch)
+
+    def obs_static_metrics(self) -> dict:
+        """Same telemetry statics contract (and keys) as DPTrainer.
+        ZeRO-3's per-step wire volume is one forward all-gather plus one
+        backward reduce-scatter — byte-identical to the single all-reduce
+        the 2*(n-1)/n formula accounts, so the same arithmetic applies."""
+        meta = self._meta
+        assert meta is not None, "call init_state first"
+        d = {"padded_len": meta.padded_len, "n_devices": self.n,
+             "impl": self.cfg.collective.impl}
+        d.update(obs_metrics.codec_static_metrics(self._codec,
+                                                  meta.padded_len))
+        d["wire_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
+            meta.padded_len, self.n, self._codec)
+        d["raw_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
+            meta.padded_len, self.n, None)
+        return d
 
     # -- materialization (eval / checkpoint restore) ------------------------
 

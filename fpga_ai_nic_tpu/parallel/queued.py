@@ -38,6 +38,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 from . import accum
 from .ddp import DDPState, DDPTrainer
 from .. import optim
+from ..obs import metrics as obs_metrics
 from ..ops import bucketed, fused_update, ring as ring_ops
 from ..runtime.queue import CollectiveQueue
 from ..utils.config import TrainConfig
@@ -58,6 +59,7 @@ class QueuedDDPTrainer(DDPTrainer):
         self.profiler = profiler or Profiler()
         self.queue = CollectiveQueue(lambda fn, g: fn(g), cfg.collective,
                                      self.profiler)
+        self._bucket_telemetry_done = False
 
     # -- init ---------------------------------------------------------------
 
@@ -141,14 +143,30 @@ class QueuedDDPTrainer(DDPTrainer):
         tickets = []
         codec = fused_update.resolve_codec(coll)
         with self.profiler.bucket("issue"):
-            for b, g in zip(plan.buckets, bucket_g):
+            for i, (b, g) in enumerate(zip(plan.buckets, bucket_g)):
                 raw = ring_ops.wire_bytes_per_device(b.padded_len, n, None)
                 wire = ring_ops.wire_bytes_per_device(b.padded_len, n,
                                                       codec)
+                if not self._bucket_telemetry_done:
+                    # per-bucket wire accounting, once (static per plan):
+                    # the flit-counter view the reference exposes per
+                    # collective (hw/bfp_adapter.sv:705-729).  Named per
+                    # bucket: the stream summary keeps latest-per-name,
+                    # so one shared name would collapse the plan to its
+                    # last bucket
+                    self.profiler.events.counter(
+                        f"bucket{i}.compression_ratio", raw / wire,
+                        bucket=i, padded_len=b.padded_len,
+                        wire_bytes=wire, raw_bytes=raw)
                 tickets.append(self.queue.issue(
                     self.reduce_fn, g, raw_bytes=raw, wire_bytes=wire))
+            self._bucket_telemetry_done = True
         means = tuple(self.queue.wait(t) for t in tickets)
         with self.profiler.bucket("update"):
             params, w_master, opt_state = self.update_fn(
                 means, state.w_master, state.opt_state, state.step)
+        if self.cfg.obs_metrics:
+            # host-side delivery (this trainer's phases are separate
+            # dispatches; the loss fetch syncs an already-waited value)
+            obs_metrics.host_observe({"loss": float(loss)})
         return DDPState(params, w_master, opt_state, state.step + 1), loss

@@ -36,7 +36,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from . import accum
 from . import mesh as mesh_lib
 from .. import optim
-from ..ops import fused_update
+from ..obs import metrics as obs_metrics
+from ..ops import fused_update, ring as ring_ops
 from ..runtime import chaos
 from ..utils.config import TrainConfig
 
@@ -125,6 +126,9 @@ class DPTrainer:
         ax = self.ax
 
         codec, ef = self._codec, self._ef
+        # trace-time metrics gate: False adds NOTHING to the jaxpr (the
+        # obs.metrics compiled-out contract, asserted by tests/test_obs.py)
+        obs_on = self.cfg.obs_metrics
 
         # Phase 1 (check_vma=True): gradients + reduce-scatter + optimizer.
         # Variance tracking must stay ON anywhere jax.grad runs inside
@@ -141,13 +145,25 @@ class DPTrainer:
             loss, grads = accum.accumulated_value_and_grad(
                 self.loss_fn, self.cfg.accum_steps)(params_v, batch)
             flat_g, _ = fused_update.flatten_tree(grads, coll, self.n)
+            m = {}      # in-graph metrics (obs_on only; else stays empty)
             if ef:
                 # compensate-then-compress: the wire sees the locally
                 # quantized gradient; what it dropped carries to the next
                 # step (TrainState.codec_state)
                 resid = maybe_resid[0]
+                flat_raw = flat_g
                 flat_g, new_resid = fused_update.error_feedback_encode(
                     codec, flat_g, resid)
+                if obs_on:
+                    # flat_g IS roundtrip(flat_raw + resid) here, so the
+                    # declared-vs-observed check costs no extra roundtrip
+                    m["codec_obs_rel_err"] = lax.pmax(
+                        obs_metrics.codec_observed_error(
+                            codec, flat_raw + resid, quantized=flat_g), ax)
+                    m["ef_resid_norm"] = obs_metrics.l2_norm(new_resid, ax)
+            elif obs_on and codec is not None:
+                m["codec_obs_rel_err"] = lax.pmax(
+                    obs_metrics.codec_observed_error(codec, flat_g), ax)
             diag = {}
             if coll.integrity_check:
                 # checksums guard the COLLECTIVE (what actually rides the
@@ -164,6 +180,12 @@ class DPTrainer:
             if coll.integrity_check:
                 diag["grad_norm"] = jnp.sqrt(
                     lax.psum(jnp.sum(g_own.astype(jnp.float32) ** 2), ax))
+            if obs_on:
+                # captured HERE, pre-clip (the documented definition):
+                # below this point g_own may be rescaled by clipping
+                m["grad_norm"] = diag["grad_norm"] if "grad_norm" in diag \
+                    else jnp.sqrt(lax.psum(
+                        jnp.sum(g_own.astype(jnp.float32) ** 2), ax))
             g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
@@ -181,8 +203,14 @@ class DPTrainer:
                     # either, or the retry would double-count this step's
                     # dropped mass
                     new_resid = jnp.where(ok, new_resid, maybe_resid[0])
-            out = (w_new, opt_state2, lax.pmean(loss, ax), diag)
-            return out + ((new_resid,) if ef else ())
+            loss_m = lax.pmean(loss, ax)
+            if obs_on:
+                if coll.integrity_check:
+                    m["integrity_err"] = diag["integrity_err"]
+                m["loss"] = loss_m
+            out = (w_new, opt_state2, loss_m, diag)
+            return out + ((new_resid,) if ef else ()) + ((m,) if obs_on
+                                                         else ())
 
         # Phase 2 (no autodiff): all-gather updated weights -> replicated
         # working params (the reference's host write-back of w_new,
@@ -194,7 +222,8 @@ class DPTrainer:
         def _step(state: TrainState, batch):
             in_specs = (P(), P(ax), P(ax), P(), P(ax)) + (
                 (P(ax),) if ef else ())
-            out_specs = (P(ax), P(ax), P(), P()) + ((P(ax),) if ef else ())
+            out_specs = (P(ax), P(ax), P(), P()) + (
+                (P(ax),) if ef else ()) + ((P(),) if obs_on else ())
             args = (state.params, state.w_own, state.opt_state, state.step,
                     batch) + ((state.codec_state,) if ef else ())
             res = jax.shard_map(
@@ -202,6 +231,11 @@ class DPTrainer:
                 in_specs=in_specs, out_specs=out_specs)(*args)
             w_own, opt_state, loss, diag = res[:4]
             codec_state = res[4] if ef else state.codec_state
+            if obs_on:
+                # route the loss through the metrics tap: the callback
+                # delivers the step's metric scalars to the ambient
+                # MetricsSink; consuming the tapped loss keeps it alive
+                loss = obs_metrics.tap(loss, res[-1])
             new_params = jax.shard_map(
                 shard_gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
                 check_vma=False)(w_own)
@@ -217,6 +251,24 @@ class DPTrainer:
 
     def step(self, state: TrainState, batch) -> Tuple[TrainState, jax.Array]:
         return self.step_fn(state, batch)
+
+    # -- telemetry ----------------------------------------------------------
+
+    def obs_static_metrics(self) -> dict:
+        """Trace-time-constant telemetry facts for ``MetricsSink(static=)``:
+        flat layout, declared codec properties, wire bytes per all-reduce
+        (the flit-counter arithmetic of hw/bfp_adapter.sv:705-729)."""
+        meta = self._meta
+        assert meta is not None, "call init_state first"
+        d = {"padded_len": meta.padded_len, "n_devices": self.n,
+             "impl": self.cfg.collective.impl}
+        d.update(obs_metrics.codec_static_metrics(self._codec,
+                                                  meta.padded_len))
+        d["wire_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
+            meta.padded_len, self.n, self._codec)
+        d["raw_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
+            meta.padded_len, self.n, None)
+        return d
 
     # -- restore ------------------------------------------------------------
 

@@ -143,6 +143,11 @@ class FaultPlan:
         self.fired: List[FaultSpec] = []
         self._step = -1
         self._lock = threading.RLock()
+        # optional obs.events.EventStream: every fired spec lands as an
+        # instant event so the Perfetto timeline shows the injected fault
+        # on the same axis as the spans/tickets it perturbs (ElasticTrainer
+        # attaches its profiler's stream automatically)
+        self.events = None
 
     # -- construction -------------------------------------------------------
 
@@ -196,7 +201,12 @@ class FaultPlan:
             if limit is not None:
                 out = out[:limit]
             self.fired.extend(out)
-            return out
+        ev = self.events
+        if ev is not None:
+            for s in out:
+                ev.instant("chaos.fire", kind=s.kind, site=s.site,
+                           step=s.step)
+        return out
 
     # -- host-side firing ---------------------------------------------------
 

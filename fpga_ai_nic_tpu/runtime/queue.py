@@ -41,6 +41,10 @@ class Ticket:
     waited: bool = False
     ready_at: Optional[float] = None
     abandoned: bool = False          # dropped by recovery; never consumed
+    # wire accounting carried per ticket so the telemetry plane can render
+    # bytes-on-the-wire per collective, not just the running totals
+    raw_bytes: int = 0
+    wire_bytes: int = 0
 
 
 class CollectiveQueue:
@@ -96,24 +100,24 @@ class CollectiveQueue:
             # above (a timed-out watchdog worker resuming): the attempt is
             # dead — dispatch nothing, consume no corruption specs, and
             # hand back a ticket wait() treats as already dropped
-            self.profiler.collectives.abandoned += 1
+            self.profiler.collectives.record_abandoned()
             return Ticket(0, None, time.perf_counter(), abandoned=True)
         if self.chaos is not None:
             args = self.chaos.corrupt("queue.issue", args)
         result = self.fn(*args)          # async dispatch
-        t = Ticket(0, result, time.perf_counter())
+        t = Ticket(0, result, time.perf_counter(),
+                   raw_bytes=raw_bytes, wire_bytes=wire_bytes or raw_bytes)
         with self._lock:
             if epoch != self._epoch:     # abandoned during the dispatch
                 t.abandoned = True
-                self.profiler.collectives.abandoned += 1
+                self.profiler.collectives.record_abandoned()
                 return t
             self._uid += 1
             t.uid = self._uid
             self._inflight.append(t)
-        st = self.profiler.collectives
-        st.issued += 1
-        st.raw_bytes += raw_bytes
-        st.wire_bytes += wire_bytes or raw_bytes
+        self.profiler.collectives.record_issue(raw_bytes, wire_bytes)
+        self.profiler.events.instant("queue.issue", uid=t.uid,
+                                     wire_bytes=t.wire_bytes)
         return t
 
     def wait(self, ticket: Ticket) -> Any:
@@ -150,11 +154,25 @@ class CollectiveQueue:
         now = time.perf_counter()
         ticket.waited = True
         ticket.ready_at = now
-        st = self.profiler.collectives
-        st.completed += 1
-        st.record_latency(now - ticket.issued_at)
-        st.stall_s += now - t0                    # network-bound time
-        st.overlap_s += t0 - ticket.issued_at     # compute overlapped
+        latency = now - ticket.issued_at
+        stall = now - t0                          # network-bound time
+        overlap = t0 - ticket.issued_at           # compute overlapped
+        self.profiler.collectives.record_completion(latency, stall, overlap)
+        # the ticket's full issue->ready interval as one structured span
+        # (lane="queue" gives tickets their own Perfetto track): the
+        # host-visible per-collective latency the reference reads from
+        # lpbk_latency CSRs, here with stall/overlap split attached
+        # issued_at is time.perf_counter() — the SAME clock the event
+        # stream timestamps with (perf_counter_ns), so the span starts at
+        # the true issue instant, not a now-minus-latency reconstruction
+        self.profiler.events.emit(
+                "span", "collective", t_ns=int(ticket.issued_at * 1e9),
+                dur_ns=int(latency * 1e9),
+                attrs={"lane": "queue", "uid": ticket.uid,
+                       "stall_s": round(stall, 6),
+                       "overlap_s": round(overlap, 6),
+                       "wire_bytes": ticket.wire_bytes,
+                       "raw_bytes": ticket.raw_bytes})
         return ticket.result
 
     def wait_all(self):
@@ -178,7 +196,9 @@ class CollectiveQueue:
             for t in self._inflight:
                 t.abandoned = True   # a blocked wait() sees this on resume
             self._inflight.clear()
-        self.profiler.collectives.abandoned += n
+        if n:
+            self.profiler.collectives.record_abandoned(n)
+            self.profiler.events.instant("queue.abandon", dropped=n)
         return n
 
     @property

@@ -256,6 +256,12 @@ class TrainConfig:
     optimizer: OptimizerConfig = field(default_factory=OptimizerConfig)
     zero1: bool = True            # sharded optimizer state + fused gather
     seed: int = 0
+    # in-graph training metrics (obs.metrics): grad norm, codec declared-
+    # vs-observed error, EF residual mass, integrity drift — tapped to
+    # the ambient MetricsSink via pure_callback.  TRACE-TIME gate: False
+    # (the default) compiles the step to HLO bit-identical to a build
+    # with no obs plumbing at all (tests/test_obs.py asserts this).
+    obs_metrics: bool = False
 
     @property
     def per_device_batch(self) -> int:

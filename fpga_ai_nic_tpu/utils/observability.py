@@ -13,16 +13,34 @@ issue/wait timeline (SURVEY.md §5): time blocked inside ``wait`` is
 network-bound ("stall_collective"), time between a ticket's issue and its
 wait call is overlapped compute ("overlap"), and wire bytes come from the
 collective config, not sniffing.
+
+This module is now a thin facade over the structured telemetry plane
+(`fpga_ai_nic_tpu.obs`): the aggregates below stay the O(1)-memory
+summary every stats dump embeds, while ``Profiler.events`` (an
+``obs.events.EventStream``) carries the individual spans/counters the
+Perfetto timeline (`obs.timeline`) renders.  All counter mutation goes
+through locked record_* methods — the elastic watchdog worker thread, XLA
+callback threads and the trainer thread write these concurrently, and the
+bare ``+=`` they replaced dropped updates under that interleaving.
 """
 
 from __future__ import annotations
 
 import json
+import threading
 import time
 from collections import defaultdict
 from contextlib import contextmanager
 from dataclasses import dataclass, field
-from typing import Dict, List
+from typing import Dict, List, Optional
+
+from ..obs.events import EventStream
+
+
+def _lock_field():
+    # per-instance lock as a non-compared dataclass field (locks are
+    # neither comparable nor picklable; stats dumps go through as_dict)
+    return field(default_factory=threading.Lock, repr=False, compare=False)
 
 
 @dataclass
@@ -37,26 +55,51 @@ class CollectiveStats:
     latency_max_s: float = 0.0
     stall_s: float = 0.0      # blocked inside wait()  ("network-bound")
     overlap_s: float = 0.0    # issue->wait gap        ("compute overlapped")
+    _lock: threading.Lock = _lock_field()
+
+    # -- locked mutation (queue worker threads vs recovery thread) ----------
+
+    def record_issue(self, raw_bytes: int = 0, wire_bytes: int = 0) -> None:
+        with self._lock:
+            self.issued += 1
+            self.raw_bytes += raw_bytes
+            self.wire_bytes += wire_bytes or raw_bytes
+
+    def record_completion(self, latency_s: float, stall_s: float,
+                          overlap_s: float) -> None:
+        with self._lock:
+            self.completed += 1
+            self.latency_sum_s += latency_s
+            self.latency_max_s = max(self.latency_max_s, latency_s)
+            self.stall_s += stall_s
+            self.overlap_s += overlap_s
+
+    def record_abandoned(self, n: int = 1) -> None:
+        with self._lock:
+            self.abandoned += n
 
     def record_latency(self, seconds: float) -> None:
-        self.latency_sum_s += seconds
-        self.latency_max_s = max(self.latency_max_s, seconds)
+        with self._lock:
+            self.latency_sum_s += seconds
+            self.latency_max_s = max(self.latency_max_s, seconds)
 
     def as_dict(self) -> Dict:
-        n = self.completed
-        return {
-            "issued": self.issued,
-            "completed": self.completed,
-            "abandoned": self.abandoned,
-            "wire_bytes": self.wire_bytes,
-            "raw_bytes": self.raw_bytes,
-            "compression_ratio": (self.raw_bytes / self.wire_bytes
-                                  if self.wire_bytes else 1.0),
-            "mean_latency_ms": (self.latency_sum_s / n * 1e3) if n else 0.0,
-            "max_latency_ms": self.latency_max_s * 1e3,
-            "stall_s": self.stall_s,
-            "overlap_s": self.overlap_s,
-        }
+        with self._lock:
+            n = self.completed
+            return {
+                "issued": self.issued,
+                "completed": self.completed,
+                "abandoned": self.abandoned,
+                "wire_bytes": self.wire_bytes,
+                "raw_bytes": self.raw_bytes,
+                "compression_ratio": (self.raw_bytes / self.wire_bytes
+                                      if self.wire_bytes else 1.0),
+                "mean_latency_ms": (self.latency_sum_s / n * 1e3) if n
+                                   else 0.0,
+                "max_latency_ms": self.latency_max_s * 1e3,
+                "stall_s": self.stall_s,
+                "overlap_s": self.overlap_s,
+            }
 
 
 @dataclass
@@ -78,66 +121,104 @@ class RecoveryStats:
     # bounded event log: [{step, kind, site, error, recovered_in_s}]
     events: List[Dict] = field(default_factory=list)
     max_events: int = 128
+    # faults recorded past max_events: the log truncates, the COUNT never
+    # does — a dump with a full log must say what it left out
+    events_dropped: int = 0
+    _lock: threading.Lock = _lock_field()
 
     def record_fault(self, kind: str, step: int, site: str = "",
                      error: str = "") -> Dict:
-        self.faults[kind] += 1
         ev = {"step": step, "kind": kind, "site": site,
               "error": error[:200], "recovered_in_s": None}
-        if len(self.events) < self.max_events:
-            self.events.append(ev)
+        with self._lock:
+            self.faults[kind] += 1
+            if len(self.events) < self.max_events:
+                self.events.append(ev)
+            else:
+                self.events_dropped += 1
         return ev
 
     def record_recovery(self, seconds: float, *, restored: bool = False,
                         event: Dict = None) -> None:
-        self.recoveries += 1
-        if restored:
-            self.checkpoint_restores += 1
-        self.mttr_sum_s += seconds
-        self.mttr_max_s = max(self.mttr_max_s, seconds)
+        with self._lock:
+            self.recoveries += 1
+            if restored:
+                self.checkpoint_restores += 1
+            self.mttr_sum_s += seconds
+            self.mttr_max_s = max(self.mttr_max_s, seconds)
         if event is not None:
             event["recovered_in_s"] = round(seconds, 4)
 
+    def record_failed_recovery(self) -> None:
+        with self._lock:
+            self.failed_recoveries += 1
+
     def as_dict(self) -> Dict:
-        n = self.recoveries
-        return {
-            "faults": dict(self.faults),
-            "faults_total": sum(self.faults.values()),
-            "recoveries": n,
-            "failed_recoveries": self.failed_recoveries,
-            "checkpoint_restores": self.checkpoint_restores,
-            "mttr_mean_s": (self.mttr_sum_s / n) if n else 0.0,
-            "mttr_max_s": self.mttr_max_s,
-            "events": list(self.events),
-        }
+        with self._lock:
+            n = self.recoveries
+            return {
+                "faults": dict(self.faults),
+                "faults_total": sum(self.faults.values()),
+                "recoveries": n,
+                "failed_recoveries": self.failed_recoveries,
+                "checkpoint_restores": self.checkpoint_restores,
+                "mttr_mean_s": (self.mttr_sum_s / n) if n else 0.0,
+                "mttr_max_s": self.mttr_max_s,
+                "events": list(self.events),
+                "events_dropped": self.events_dropped,
+            }
 
 
 class Profiler:
     """Named wall-clock buckets (DETAILED_PROFILE equivalent) + collective
-    stats. One instance per trainer/queue; cheap enough to leave on."""
+    stats + the structured event stream underneath.  One instance per
+    trainer/queue; cheap enough to leave on.
 
-    def __init__(self):
+    Facade contract: ``buckets``/``counts``/``collectives``/``recovery``
+    keep their pre-telemetry-plane shapes (every existing consumer — the
+    chaos bench, the examples, the elastic loop — reads them unchanged);
+    each ``bucket()`` additionally lands a span in ``self.events`` and
+    ``report()`` gains an ``events`` summary with explicit
+    ``events_dropped`` accounting."""
+
+    def __init__(self, events: Optional[EventStream] = None,
+                 capacity: int = 1 << 16):
         self.buckets: Dict[str, float] = defaultdict(float)
         self.counts: Dict[str, int] = defaultdict(int)
         self.collectives = CollectiveStats()
         self.recovery = RecoveryStats()
+        self.events = events if events is not None else EventStream(capacity)
+        self._lock = threading.Lock()
 
     @contextmanager
     def bucket(self, name: str):
         t0 = time.perf_counter()
+        t0_ns = time.perf_counter_ns()
         try:
             yield
         finally:
-            self.buckets[name] += time.perf_counter() - t0
-            self.counts[name] += 1
+            dt = time.perf_counter() - t0
+            with self._lock:
+                self.buckets[name] += dt
+                self.counts[name] += 1
+            self.events.emit("span", name, t_ns=t0_ns,
+                             dur_ns=time.perf_counter_ns() - t0_ns)
 
     def report(self) -> Dict:
+        with self._lock:
+            buckets = dict(self.buckets)
+            counts = dict(self.counts)
         return {
-            "buckets_s": dict(self.buckets),
-            "counts": dict(self.counts),
+            "buckets_s": buckets,
+            "counts": counts,
             "collectives": self.collectives.as_dict(),
             "recovery": self.recovery.as_dict(),
+            "events": self.events.summary(),
         }
 
     def json_line(self) -> str:
         return json.dumps(self.report())
+
+    def dump_events(self, path: str) -> str:
+        """JSONL sink for the underlying stream (obs.timeline input)."""
+        return self.events.dump_jsonl(path)

@@ -18,8 +18,11 @@ xprof dependency.
 
 from __future__ import annotations
 
+import argparse
+import json
 import os
 import re
+import sys
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
 # hyphenated HLO collective op names — the device-plane classifier matches
@@ -102,6 +105,16 @@ def find_xplane(trace_dir: str) -> str:
     return max(cands)[1]
 
 
+def _load_trace(trace_dir: str, data=None):
+    """(xplane path, parsed profile data), reusing an already-parsed
+    ``data`` when the caller has one — the tsl-proto shim walks every
+    event in pure python, so re-parsing a multi-MB xplane per analysis
+    pass dominates CLI runtime."""
+    from ..compat import load_profile_data
+    path = find_xplane(trace_dir)
+    return path, (data if data is not None else load_profile_data(path))
+
+
 def _is_collective(name: str) -> bool:
     """Device-plane classifier: hyphenated HLO collective names only."""
     n = name.lower()
@@ -151,7 +164,7 @@ def _attribution_report(sync_ivs: List[Interval],
 
 
 def analyze_trace(trace_dir: str, *,
-                  plane_substr: str = "/device:") -> Dict:
+                  plane_substr: str = "/device:", data=None) -> Dict:
     """Overlap/stall report for every device plane in the trace.
 
     Returns {"devices": {plane_name: report}, "xplane": path}; each report:
@@ -161,9 +174,7 @@ def analyze_trace(trace_dir: str, *,
       exposed_s        — async time with the device otherwise idle (stall)
       top_exposed      — worst offenders [(op, exposed_s)], most first
     """
-    from jax.profiler import ProfileData
-    path = find_xplane(trace_dir)
-    data = ProfileData.from_file(path)
+    path, data = _load_trace(trace_dir, data)
     devices: Dict[str, Dict] = {}
     for plane in data.planes:
         if plane_substr not in plane.name:
@@ -193,8 +204,11 @@ def analyze_trace(trace_dir: str, *,
 
 
 # thunks execute on the per-shard executor threads AND the shared Eigen
-# intra-op pool threads; both carry leaf op events
-_CPU_LINE_PREFIXES = ("tf_XLAPjRtCpuClient", "tf_XLAEigen")
+# intra-op pool threads; both carry leaf op events.  The executor line's
+# prefix follows the CPU client's name across jaxlibs: TfrtCpuClient
+# before the PjRt rename (jax <= 0.4.x), PjRtCpuClient after.
+_CPU_LINE_PREFIXES = ("tf_XLAPjRtCpuClient", "tf_XLATfrtCpuClient",
+                      "tf_XLAEigen")
 # leaf thunk events are bare HLO instruction names ("wrapped_tanh",
 # "psum.7", "broadcast_add_fusion"); executor infrastructure events mostly
 # carry spaces or "::" ("ThunkExecutor::Execute (...)", "end: X",
@@ -208,7 +222,8 @@ _CPU_INFRA = frozenset({"Rendezvous"})   # collective-internal wait event,
 _CPU_CONTAINER_RE = re.compile(r"(while|call|conditional)(\.\d+)?")
 
 
-def analyze_cpu_thunk_trace(trace_dir: str) -> Dict:
+def analyze_cpu_thunk_trace(trace_dir: str, *,
+                            data=None) -> Dict:
     """Overlap attribution from a CPU thunk-executor trace — the virtual
     8-device mesh's substitute for TPU device planes (which a CPU trace
     does not carry; capture with ``ProfileOptions.host_tracer_level=3`` so
@@ -223,9 +238,7 @@ def analyze_cpu_thunk_trace(trace_dir: str) -> Dict:
     while shards sat in the collective" question the reference answers
     with stall_eth counters (hw/all_reduce.sv:94-97).  Exposed = no shard
     computed: true mesh-wide stall."""
-    from jax.profiler import ProfileData
-    path = find_xplane(trace_dir)
-    data = ProfileData.from_file(path)
+    path, data = _load_trace(trace_dir, data)
     sync_ivs: List[Interval] = []
     async_evs: List[Tuple[str, Interval]] = []
     n_lines = 0
@@ -263,14 +276,66 @@ def analyze_cpu_thunk_trace(trace_dir: str) -> Dict:
     return {"devices": {"cpu-thunk-mesh": rep}, "xplane": path}
 
 
-def analyze_any(trace_dir: str) -> Dict:
+def analyze_any(trace_dir: str, *, data=None) -> Dict:
     """Device-plane analysis when the trace has one (TPU), CPU thunk-mode
     otherwise — so the same tooling attributes collectives on the real
     chip and on the virtual mesh."""
+    _, data = _load_trace(trace_dir, data)
     try:
-        return analyze_trace(trace_dir)
+        return analyze_trace(trace_dir, data=data)
     except ValueError:
-        return analyze_cpu_thunk_trace(trace_dir)
+        return analyze_cpu_thunk_trace(trace_dir, data=data)
+
+
+def device_intervals(trace_dir: str, *,
+                     data=None) -> List[Dict]:
+    """Raw per-op intervals for the telemetry timeline (obs.timeline):
+    every device-plane sync/async event as
+    ``{"plane", "line", "name", "start_ns", "end_ns", "cls"}`` — TPU
+    device planes when the trace has them, the CPU thunk-executor lines
+    otherwise (classified with the same word-scoped rules the aggregate
+    reports use, so the timeline and the attribution numbers can never
+    disagree about what counts as a collective)."""
+    path, data = _load_trace(trace_dir, data)
+    out: List[Dict] = []
+    for plane in data.planes:
+        if "/device:" not in plane.name:
+            continue
+        for line in plane.lines:
+            if line.name not in ("XLA Ops", "Async XLA Ops"):
+                continue
+            is_async = line.name == "Async XLA Ops"
+            for ev in line.events:
+                if not ev.duration_ns:
+                    continue
+                name = ev.name.split(" = ")[0]
+                out.append({"plane": plane.name, "line": line.name,
+                            "name": name, "start_ns": ev.start_ns,
+                            "end_ns": ev.start_ns + ev.duration_ns,
+                            "cls": "async" if is_async else "sync"})
+    if out:
+        return out
+    # CPU thunk fallback (virtual-mesh traces): same event filtering as
+    # analyze_cpu_thunk_trace, emitted as intervals instead of aggregates
+    for plane in data.planes:
+        if not plane.name.startswith("/host:"):
+            continue
+        for line in plane.lines:
+            if not line.name.startswith(_CPU_LINE_PREFIXES):
+                continue
+            for ev in line.events:
+                if (not _CPU_OP_RE.fullmatch(ev.name)
+                        or not ev.duration_ns
+                        or ev.name in _CPU_INFRA
+                        or _CPU_CONTAINER_RE.fullmatch(ev.name)):
+                    continue
+                base = ev.name.removeprefix("wrapped_")
+                out.append({"plane": plane.name, "line": line.name,
+                            "name": ev.name, "start_ns": ev.start_ns,
+                            "end_ns": ev.start_ns + ev.duration_ns,
+                            "cls": ("async" if _is_cpu_collective(base)
+                                    else "sync")})
+    return out
 
 
 def summarize(report: Dict) -> Dict:
@@ -294,3 +359,55 @@ def summarize(report: Dict) -> Dict:
             by_op[name] = by_op.get(name, 0.0) + s
     agg["top_exposed"] = sorted(by_op.items(), key=lambda kv: -kv[1])[:5]
     return agg
+
+
+# ---------------------------------------------------------------------------
+# CLI: device-plane stall attribution without writing code
+# ---------------------------------------------------------------------------
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``python -m fpga_ai_nic_tpu.utils.trace_analysis <trace-dir>`` —
+    the stall-attribution report as one JSON object on stdout (the same
+    numbers a driver embeds when run with ``--trace-dir``)."""
+    ap = argparse.ArgumentParser(
+        prog="python -m fpga_ai_nic_tpu.utils.trace_analysis",
+        description="Overlap/stall attribution from a jax.profiler trace "
+                    "directory: async collective/DMA wall time split into "
+                    "compute-overlapped vs exposed (device idle).")
+    ap.add_argument("trace_dir", help="jax.profiler.trace output directory")
+    ap.add_argument("--mode", choices=("auto", "device", "cpu"),
+                    default="auto",
+                    help="device = TPU device planes only, cpu = thunk-"
+                         "executor lines only, auto = device with cpu "
+                         "fallback (default)")
+    ap.add_argument("--per-plane", action="store_true",
+                    help="full per-plane reports instead of the flattened "
+                         "summary")
+    ap.add_argument("--intervals", metavar="FILE", default=None,
+                    help="also dump raw per-op intervals (obs.timeline "
+                         "input shape) to FILE")
+    args = ap.parse_args(argv)
+    analyze = {"auto": analyze_any, "device": analyze_trace,
+               "cpu": analyze_cpu_thunk_trace}[args.mode]
+    try:
+        # one parse serves the report AND the interval dump (the shim
+        # loader walks the whole xplane in python — parse it once)
+        _, data = _load_trace(args.trace_dir)
+        report = analyze(args.trace_dir, data=data)
+    except (FileNotFoundError, ValueError, ImportError) as e:
+        # ImportError: no ProfileData loader on this jaxlib/container
+        # (compat.load_profile_data) — same JSON error contract as a
+        # missing xplane, never a raw traceback
+        print(json.dumps({"error": str(e)}))
+        return 1
+    if args.intervals:
+        with open(args.intervals, "w") as f:
+            json.dump(device_intervals(args.trace_dir, data=data), f)
+    out = dict(report if args.per_plane else summarize(report),
+               xplane=report["xplane"])
+    print(json.dumps(out, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

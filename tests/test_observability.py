@@ -1,0 +1,171 @@
+"""Direct unit tests for utils.observability — the aggregate counters
+every stats dump embeds (previously the only subsystem with zero direct
+tests: its behavior was pinned only incidentally, through the chaos bench
+and the queued trainer).
+
+Covers the accounting contracts the rest of the stack relies on:
+abandoned-ticket counting through recovery, compression_ratio's
+wire_bytes=0 convention, the MTTR aggregates, json_line round-tripping,
+RecoveryStats' bounded event log with honest drop accounting, and — the
+round-4 cross-thread fix — that concurrent mutation from watchdog-worker
+and trainer threads loses no updates."""
+
+import json
+import threading
+
+import jax.numpy as jnp
+import pytest
+
+from fpga_ai_nic_tpu.runtime.queue import CollectiveQueue
+from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+from fpga_ai_nic_tpu.utils.observability import (CollectiveStats, Profiler,
+                                                 RecoveryStats)
+
+
+# ---------------------------------------------------------------------------
+# CollectiveStats
+# ---------------------------------------------------------------------------
+
+def test_compression_ratio_with_zero_wire_bytes_is_one():
+    st = CollectiveStats()
+    assert st.as_dict()["compression_ratio"] == 1.0
+    st.record_issue(raw_bytes=400, wire_bytes=100)
+    assert st.as_dict()["compression_ratio"] == 4.0
+
+
+def test_wire_bytes_default_to_raw():
+    st = CollectiveStats()
+    st.record_issue(raw_bytes=128)           # wire omitted -> raw
+    d = st.as_dict()
+    assert d["wire_bytes"] == d["raw_bytes"] == 128
+
+
+def test_latency_and_stall_aggregates():
+    st = CollectiveStats()
+    st.record_completion(latency_s=0.2, stall_s=0.05, overlap_s=0.15)
+    st.record_completion(latency_s=0.4, stall_s=0.10, overlap_s=0.30)
+    d = st.as_dict()
+    assert d["completed"] == 2
+    assert d["mean_latency_ms"] == pytest.approx(300.0)
+    assert d["max_latency_ms"] == pytest.approx(400.0)
+    assert d["stall_s"] == pytest.approx(0.15)
+    assert d["overlap_s"] == pytest.approx(0.45)
+
+
+def test_abandoned_ticket_counting_through_queue():
+    """abandon() drops every inflight ticket, counts each exactly once,
+    and a wait() on a dropped ticket records nothing."""
+    prof = Profiler()
+    q = CollectiveQueue(lambda x: x * 2.0, CollectiveConfig(impl="ring"),
+                        prof)
+    t1 = q.issue(jnp.ones(8), raw_bytes=32)
+    t2 = q.issue(jnp.ones(8), raw_bytes=32)
+    assert q.abandon() == 2
+    assert prof.collectives.abandoned == 2
+    q.wait(t1)                                # dead ticket: no stats
+    q.wait(t2)
+    d = prof.collectives.as_dict()
+    assert d["issued"] == 2
+    assert d["completed"] == 0
+    assert d["abandoned"] == 2
+    assert q.outstanding == 0
+    # a live ticket after recovery records normally again
+    t3 = q.issue(jnp.ones(8), raw_bytes=32)
+    q.wait(t3)
+    assert prof.collectives.as_dict()["completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# RecoveryStats
+# ---------------------------------------------------------------------------
+
+def test_mttr_aggregates():
+    rs = RecoveryStats()
+    ev = rs.record_fault("hang", step=3, site="queue.wait")
+    rs.record_recovery(2.0, restored=True, event=ev)
+    rs.record_fault("corruption", step=5)
+    rs.record_recovery(1.0)
+    d = rs.as_dict()
+    assert d["faults"] == {"hang": 1, "corruption": 1}
+    assert d["faults_total"] == 2
+    assert d["recoveries"] == 2
+    assert d["checkpoint_restores"] == 1
+    assert d["mttr_mean_s"] == pytest.approx(1.5)
+    assert d["mttr_max_s"] == pytest.approx(2.0)
+    assert ev["recovered_in_s"] == pytest.approx(2.0)
+
+
+def test_recovery_event_log_truncates_with_explicit_drop_count():
+    """The bounded event log keeps the first max_events faults; everything
+    past that increments events_dropped so the dump can never read as
+    complete when it is not."""
+    rs = RecoveryStats(max_events=4)
+    for i in range(10):
+        rs.record_fault("hang", step=i)
+    d = rs.as_dict()
+    assert len(d["events"]) == 4
+    assert d["events_dropped"] == 6
+    assert d["faults_total"] == 10            # the COUNT never truncates
+    assert [e["step"] for e in d["events"]] == [0, 1, 2, 3]
+
+
+def test_json_line_round_trip():
+    prof = Profiler()
+    with prof.bucket("grads"):
+        pass
+    prof.collectives.record_issue(raw_bytes=64, wire_bytes=16)
+    ev = prof.recovery.record_fault("hang", step=1)
+    prof.recovery.record_recovery(0.5, event=ev)
+    parsed = json.loads(prof.json_line())
+    assert parsed == prof.report()
+    assert parsed["collectives"]["compression_ratio"] == 4.0
+    assert parsed["recovery"]["events_dropped"] == 0
+    assert parsed["counts"]["grads"] == 1
+    assert parsed["events"]["schema_version"] == 1
+
+
+# ---------------------------------------------------------------------------
+# cross-thread mutation (the elastic watchdog / trainer interleaving)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("n_threads,per_thread", [(8, 500)])
+def test_threaded_counter_stress_loses_no_updates(n_threads, per_thread):
+    """The elastic loop's reality: watchdog worker threads mutate
+    CollectiveStats while the trainer thread mutates RecoveryStats and
+    reads dumps.  Every record_* must land exactly once — the bare ``+=``
+    these methods replaced drops updates under this schedule."""
+    prof = Profiler()
+    barrier = threading.Barrier(n_threads)
+
+    def hammer(tid):
+        barrier.wait()
+        for i in range(per_thread):
+            prof.collectives.record_issue(raw_bytes=4, wire_bytes=1)
+            prof.collectives.record_completion(0.001, 0.0005, 0.0005)
+            prof.collectives.record_abandoned()
+            prof.recovery.record_fault("hang", step=i)
+            prof.recovery.record_recovery(0.001)
+            with prof.bucket(f"b{tid % 2}"):
+                pass
+            prof.collectives.as_dict()        # concurrent reads too
+
+    threads = [threading.Thread(target=hammer, args=(t,))
+               for t in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+
+    total = n_threads * per_thread
+    c = prof.collectives.as_dict()
+    assert c["issued"] == total
+    assert c["completed"] == total
+    assert c["abandoned"] == total
+    assert c["raw_bytes"] == 4 * total
+    assert c["wire_bytes"] == total
+    assert c["stall_s"] == pytest.approx(0.0005 * total, rel=1e-6)
+    r = prof.recovery.as_dict()
+    assert r["faults_total"] == total
+    assert r["recoveries"] == total
+    assert len(r["events"]) + r["events_dropped"] == total
+    assert sum(prof.counts.values()) == total

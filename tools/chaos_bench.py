@@ -177,6 +177,7 @@ def run_cell(rig: WireRig, kind: str, site: str, mode: str,
                              and rec["recoveries"] >= 1
                              and rec["checkpoint_restores"] >= 1)
         ok = cell["recovered"]
+    ev = report.get("events", {})
     cell.update(
         ok=bool(ok and finite),
         final_loss=round(float(metrics["loss"]), 6),
@@ -184,6 +185,12 @@ def run_cell(rig: WireRig, kind: str, site: str, mode: str,
         checkpoint_restores=rec["checkpoint_restores"],
         mttr_mean_s=round(rec["mttr_mean_s"], 4),
         stats_dump_has_recovery="recovery" in report,
+        # the structured stream's view of the same run: injected-fault /
+        # detection / recovery instants landed as events (obs.events),
+        # with honest drop accounting
+        events_recorded=ev.get("recorded", 0),
+        events_dropped=ev.get("events_dropped", 0),
+        chaos_fired=len(plan.fired),
         wall_s=round(time.time() - t0, 2))
     return cell
 

@@ -57,6 +57,10 @@ _WATCH = {
                     "fpga_ai_nic_tpu/ops/ring_cost.py",
                     "fpga_ai_nic_tpu/ops/bfp.py",
                     "fpga_ai_nic_tpu/ops/bfp_pallas.py"],
+    # the telemetry summary is an extraction over the other artifacts, so
+    # its staleness watch is the extractor + the telemetry plane itself
+    "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
+            "fpga_ai_nic_tpu/utils/observability.py"],
 }
 
 
@@ -444,6 +448,30 @@ def main():
                         f"| {c['error_feedback']} | {c['idempotent']} "
                         f"| {c['supports_fused']} |")
                 L.append("")
+
+    # -- telemetry summary (obs gate) ----------------------------------------
+    obs_art = _newest("artifacts/obs_summary_*.json")
+    if obs_art:
+        d = _load(obs_art)
+        summ = (d.get("summary") or {}).get("metrics") or {}
+        verdict = d.get("verdict") or {}
+        if summ:
+            L += ["## Telemetry summary (obs gate)", "",
+                  f"Source: `{_rel(obs_art)}`{_badge(d, 'obs')}.  The "
+                  "metric set `make obs-gate` diffs a run's telemetry "
+                  "summary against (per-metric thresholds; exits nonzero "
+                  "on regression — wired into `make ci`).  Last gate "
+                  f"verdict: **{'ok' if verdict.get('ok') else 'FAILED'}** "
+                  f"({verdict.get('compared', 0)} metrics compared, "
+                  f"{len(verdict.get('regressions', []))} regression(s)).",
+                  "",
+                  "| metric | banked value | tol | source artifact |",
+                  "|---|---|---|---|"]
+            for name in sorted(summ):
+                m = summ[name]
+                L.append(f"| {name} | {m['value']} "
+                         f"| ±{m['rel_tol']:.0%} | `{m['source']}` |")
+            L.append("")
 
     # -- methodology: per-stage roofline accounting --------------------------
     L += ["## Methodology: pipeline efficiency", "",

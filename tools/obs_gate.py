@@ -1,0 +1,220 @@
+#!/usr/bin/env python
+"""Telemetry regression gate: diff a run's telemetry summary against the
+banked benchmark artifacts, with per-metric thresholds.
+
+The observability plane's closing loop: artifacts (BENCH_r*.json,
+COLLECTIVE_r*.json, CODEC_BENCH_r*.json and their artifacts/ twins) bank
+what the stack measured; this gate turns them from documentation into a
+*contract* — a new run whose telemetry summary regresses a banked metric
+beyond its threshold exits nonzero, in CI (`make obs-gate`, wired into
+`make ci`).
+
+    python tools/obs_gate.py                      # gate-on-self: extract
+                                                  # the banked summary and
+                                                  # diff it against itself
+                                                  # (must pass trivially)
+    python tools/obs_gate.py --summary run.json   # diff a run's summary
+    python tools/obs_gate.py --write-summary f.json --save-artifact
+
+Summary schema (v1): ``{"schema_version": 1, "metrics": {name:
+{"value", "higher_is_better", "rel_tol", "source"}}}``; a candidate file
+may also be a flat ``{name: value}`` mapping — direction/threshold then
+come from the banked side.  Only metrics present on BOTH sides are
+compared (a run that measures a subset gates that subset); the verdict
+lists compared/missing counts so a trivially-green gate that compared
+nothing is visible, never silent.
+
+No jax import — the gate must run (and fail meaningfully) on a machine
+with a wedged tunnel.
+"""
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ROOT = os.path.dirname(HERE)
+sys.path.insert(0, ROOT)
+
+SCHEMA_VERSION = 1
+
+# default relative tolerances per metric family: slope-timed rates jitter
+# run to run (shared CI machines), so the gate trips on real regressions,
+# not scheduler noise
+TOL_RATE = 0.25          # GB/s codec / ring rates
+TOL_THROUGHPUT = 0.30    # samples/s (the banked record is a CPU fallback)
+TOL_LOOPBACK = 0.25      # fused-kernel loopback GB/s
+
+# THE metric-name contract, shared with producers of fresh-run summaries
+# (bench_collective.py imports these): gate() compares only names present
+# on both sides, so a name that drifted between producer and extractor
+# would silently gate nothing for that family
+COLLECTIVE_GATE_KEYS = ("codec_roundtrip_gbps", "codec_encode_gbps",
+                        "codec_decode_gbps", "fused_ring_loopback_gbps")
+SWEEP_GATE_ARMS = ("psum_bf16", "ring_f32", "ring_bfp")
+
+
+def collective_metric(key: str) -> str:
+    return f"collective.{key}"
+
+
+def sweep_metric(size_mb, arm: str) -> str:
+    return f"sweep.{size_mb}mb.{arm}_gbps"
+
+
+def _load(path):
+    with open(path) as f:
+        return json.load(f)
+
+
+def _newest(pattern):
+    paths = sorted(glob.glob(os.path.join(ROOT, pattern)))
+    return paths[-1] if paths else None
+
+
+def _metric(value, source, *, higher=True, tol=TOL_RATE):
+    return {"value": float(value), "source": source,
+            "higher_is_better": bool(higher), "rel_tol": float(tol)}
+
+
+def build_banked_summary() -> dict:
+    """Extract the gate's metric set from the newest banked artifact of
+    each family.  Families without a banked artifact simply contribute no
+    metrics — the gate never invents a baseline."""
+    metrics = {}
+
+    # -- headline training throughput (driver record) -----------------------
+    p = _newest("BENCH_r*.json")
+    if p:
+        d = _load(p).get("parsed") or {}
+        if d.get("value") is not None:
+            metrics["bench.samples_per_sec_per_chip"] = _metric(
+                d["value"], os.path.basename(p), tol=TOL_THROUGHPUT)
+
+    # -- collective / wire path ---------------------------------------------
+    p = (_newest("artifacts/collective_tpu_*.json")
+         or _newest("COLLECTIVE_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        for key in COLLECTIVE_GATE_KEYS:
+            if d.get(key):
+                tol = (TOL_LOOPBACK if key == "fused_ring_loopback_gbps"
+                       else TOL_RATE)
+                metrics[collective_metric(key)] = _metric(d[key], src,
+                                                          tol=tol)
+        for row in d.get("sweep") or d.get("mesh_sweep") or []:
+            for arm in SWEEP_GATE_ARMS:
+                v = row.get(f"{arm}_gbps")
+                if v:
+                    metrics[sweep_metric(row["size_mb"], arm)] = \
+                        _metric(v, src)
+
+    # -- codec matrix --------------------------------------------------------
+    p = (_newest("artifacts/codec_bench_*.json")
+         or _newest("CODEC_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        for row in d.get("rows", []):
+            base = f"codec_matrix.{row['codec']}.{row['class']}"
+            for stage in ("roundtrip", "encode", "decode"):
+                v = row.get(f"{stage}_gbps")
+                if v:
+                    metrics[f"{base}.{stage}_gbps"] = _metric(v, src)
+
+    return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
+
+
+def _normalize_candidate(d: dict, banked: dict) -> dict:
+    """Accept the full schema or a flat {name: value} mapping (direction
+    and tolerance then inherited from the banked metric)."""
+    if "metrics" in d:
+        ver = d.get("schema_version")
+        if ver != SCHEMA_VERSION:
+            raise ValueError(f"candidate summary schema v{ver!r} != "
+                             f"supported v{SCHEMA_VERSION}")
+        return {k: float(v["value"]) if isinstance(v, dict) else float(v)
+                for k, v in d["metrics"].items()}
+    return {k: float(v) for k, v in d.items()
+            if isinstance(v, (int, float))}
+
+
+def gate(candidate: dict, banked: dict,
+         threshold_scale: float = 1.0) -> dict:
+    """Compare candidate values against banked metrics.  Returns the
+    verdict dict: regressions (beyond tol), improvements, compared /
+    missing accounting, ok flag."""
+    cand = _normalize_candidate(candidate, banked)
+    regressions, improvements, compared = [], [], 0
+    for name, spec in banked["metrics"].items():
+        if name not in cand:
+            continue
+        compared += 1
+        ref, got = spec["value"], cand[name]
+        tol = spec["rel_tol"] * threshold_scale
+        if spec["higher_is_better"]:
+            bad = got < ref * (1.0 - tol)
+            better = got > ref * (1.0 + tol)
+        else:
+            bad = got > ref * (1.0 + tol)
+            better = got < ref * (1.0 - tol)
+        entry = {"metric": name, "banked": ref, "got": got,
+                 "rel_change": round((got - ref) / ref, 4) if ref else None,
+                 "rel_tol": tol, "source": spec["source"]}
+        if bad:
+            regressions.append(entry)
+        elif better:
+            improvements.append(entry)
+    return {"schema_version": SCHEMA_VERSION,
+            "ok": not regressions,
+            "compared": compared,
+            "banked_total": len(banked["metrics"]),
+            "candidate_total": len(cand),
+            "missing_from_candidate": len(banked["metrics"]) - compared,
+            "regressions": regressions,
+            "improvements": improvements}
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--summary", default=None,
+                    help="candidate telemetry summary JSON to gate "
+                         "(default: the banked summary itself — a "
+                         "self-diff that must pass trivially)")
+    ap.add_argument("--write-summary", metavar="FILE", default=None,
+                    help="write the banked summary to FILE and exit 0 "
+                         "unless gating also fails")
+    ap.add_argument("--save-artifact", action="store_true",
+                    help="bank the summary + verdict under artifacts/ "
+                         "(obs_summary_*.json, rendered into docs/PERF.md "
+                         "by tools/gen_perf_md.py)")
+    ap.add_argument("--threshold-scale", type=float, default=1.0,
+                    help="multiply every per-metric tolerance (e.g. 0.5 "
+                         "for a stricter manual check)")
+    args = ap.parse_args(argv)
+
+    banked = build_banked_summary()
+    if not banked["metrics"]:
+        print(json.dumps({"ok": False,
+                          "error": "no banked artifacts to gate against"}))
+        return 1
+    if args.write_summary:
+        with open(args.write_summary, "w") as f:
+            json.dump(banked, f, indent=1)
+    candidate = _load(args.summary) if args.summary else banked
+    verdict = gate(candidate, banked,
+                   threshold_scale=args.threshold_scale)
+    verdict["mode"] = "candidate" if args.summary else "self"
+    if args.save_artifact:
+        from bench_common import save_artifact
+        save_artifact("obs_summary", {"summary": banked,
+                                      "verdict": verdict})
+    print(json.dumps(verdict, indent=1))
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
