@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench obs-gate
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench obs-gate lint lint-fixtures
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -19,7 +19,23 @@ test-fast:
 obs-gate:
 	python tools/obs_gate.py
 
-ci: codec test obs-gate
+# graftlint static analysis (docs/LINT.md): AST rules R1-R5 over the
+# package/tools/bench tree, ruff+mypy on the strict typed core (when
+# installed), and the jaxpr invariant sweep J1-J6 (codec x trainer x obs
+# grid traced abstractly on the 8-device virtual CPU mesh — no TPU).
+# Runs AHEAD of obs-gate in `make ci`: structural regressions fail before
+# any benchmark artifact is consulted.
+lint:
+	python tools/graftlint.py
+
+# fast fixture-corpus loop (<30 s, CPU-only): every rule fires on its bad
+# fixture / stays silent on the good one, suppression hygiene, and the
+# copied-into-the-package exit-code demonstration — without the jaxpr grid
+lint-fixtures:
+	env JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q \
+	    -k "not Jaxpr" -p no:cacheprovider
+
+ci: codec test lint obs-gate
 
 bench:
 	python bench.py

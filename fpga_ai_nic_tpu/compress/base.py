@@ -59,6 +59,10 @@ from typing import Any, Dict, Mapping, Optional, Tuple, Type
 import jax
 import jax.numpy as jnp
 
+# numpy/jax dtype designator (jax.typing.DTypeLike is unstable across the
+# jaxlib versions this repo supports, so the alias stays loose on purpose)
+DTypeLike = Any
+
 
 class Codec(abc.ABC):
     """One gradient-compression wire format (see module docstring)."""
@@ -80,7 +84,7 @@ class Codec(abc.ABC):
 
     @abc.abstractmethod
     def decode(self, payload: Tuple[jax.Array, ...], n_elems: int,
-               dtype=jnp.float32) -> jax.Array:
+               dtype: DTypeLike = jnp.float32) -> jax.Array:
         """Payload tuple -> flat [n_elems] in ``dtype``."""
 
     def roundtrip(self, x: jax.Array) -> jax.Array:
@@ -181,7 +185,7 @@ def get_codec(name: str, opts: Optional[Mapping[str, Any]] = None) -> Codec:
     return _REGISTRY[name](**dict(opts or {}))
 
 
-def resolve(coll) -> Optional[Codec]:
+def resolve(coll: Any) -> Optional[Codec]:
     """The codec a CollectiveConfig asks for (None = uncompressed).
 
     Resolution order:
@@ -204,7 +208,7 @@ def resolve(coll) -> Optional[Codec]:
     return None
 
 
-def as_codec(compression) -> Optional[Codec]:
+def as_codec(compression: Any) -> Optional[Codec]:
     """Normalize a ring-level ``compression=`` argument: None, a Codec, or
     (back-compat) a bare BFPConfig."""
     if compression is None or isinstance(compression, Codec):

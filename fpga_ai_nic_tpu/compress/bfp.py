@@ -18,12 +18,12 @@ Numerics spec: `ops.bfp_golden` ("flat16" layout for the XLA backend,
 from __future__ import annotations
 
 from dataclasses import replace
-from typing import Optional, Tuple
+from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 
-from .base import Codec, register
+from .base import Codec, DTypeLike, register
 from ..ops import bfp as _bfp_xla
 from ..ops import bfp_pallas as _bfp_pl
 from ..utils.config import BFPConfig
@@ -39,7 +39,7 @@ def use_pallas(cfg: BFPConfig, n_elems: int) -> bool:
         and n_elems % (cfg.block_size * _bfp_pl.LANES) == 0)
 
 
-def codec_pair(cfg: BFPConfig, n_elems: int):
+def codec_pair(cfg: BFPConfig, n_elems: int) -> Tuple[Callable, Callable]:
     """(encode, decode) for a flat [n_elems] payload (moved verbatim from
     ops.ring._codec).
 
@@ -49,20 +49,22 @@ def codec_pair(cfg: BFPConfig, n_elems: int):
     if use_pallas(cfg, n_elems):
         # inline (un-jitted) kernels: a nested closed_call inside a
         # vma-checked shard_map trips the checker
-        def enc(x):
+        def enc(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
             return _bfp_pl.bfp_encode_inline(x, cfg.block_size,
                                              cfg.mantissa_bits,
                                              cfg.rounding)
 
-        def dec(mant, se, dtype):
+        def dec(mant: jax.Array, se: jax.Array,
+                dtype: DTypeLike) -> jax.Array:
             return _bfp_pl.bfp_decode_inline(mant, se, cfg.block_size,
                                              dtype)
     else:
-        def enc(x):
+        def enc(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
             return _bfp_xla.bfp_encode(x, cfg.block_size,
                                        cfg.mantissa_bits, cfg.rounding)
 
-        def dec(mant, se, dtype):
+        def dec(mant: jax.Array, se: jax.Array,
+                dtype: DTypeLike) -> jax.Array:
             return _bfp_xla.bfp_decode(mant, se, cfg.block_size, dtype)
 
     return enc, dec
@@ -79,7 +81,7 @@ class BFPCodec(Codec):
     supports_fused = True      # ops.ring_pallas's wire frames ARE this
 
     def __init__(self, cfg: Optional[BFPConfig] = None,
-                 error_feedback: bool = False, **overrides):
+                 error_feedback: bool = False, **overrides: Any) -> None:
         """``overrides`` are BFPConfig fields (mantissa_bits=..., etc.) so
         ``codec_opts`` can parameterize without constructing a BFPConfig;
         ``error_feedback=True`` opts the bounded codec into a residual
@@ -93,7 +95,8 @@ class BFPCodec(Codec):
         enc, _ = codec_pair(self.cfg, x.shape[0])
         return tuple(enc(x))
 
-    def decode(self, payload, n_elems: int, dtype=jnp.float32) -> jax.Array:
+    def decode(self, payload: Tuple[jax.Array, ...], n_elems: int,
+               dtype: DTypeLike = jnp.float32) -> jax.Array:
         mant, se = payload
         _, dec = codec_pair(self.cfg, n_elems)
         return dec(mant, se, dtype)
@@ -104,7 +107,8 @@ class BFPCodec(Codec):
     def pad_elems(self) -> int:
         return self.cfg.block_size
 
-    def sliceable(self, chunk_elems, slice_elems) -> bool:
+    def sliceable(self, chunk_elems: int,
+                  slice_elems: Optional[int]) -> bool:
         cfg = self.cfg
         return (super().sliceable(chunk_elems, slice_elems)
                 # sliced and whole-chunk paths must resolve to the SAME
@@ -129,7 +133,7 @@ class BFPCodec(Codec):
     def wire_bytes(self, n_elems: int) -> int:
         return _bfp_xla.wire_bytes(n_elems, self.cfg)
 
-    def describe(self):
+    def describe(self) -> Dict[str, Any]:
         d = super().describe()
         d.update(block_size=self.cfg.block_size,
                  mantissa_bits=self.cfg.mantissa_bits,

@@ -16,7 +16,7 @@ so a single golden covers the codec x slice_elems matrix.
 
 from __future__ import annotations
 
-from typing import Callable, Optional
+from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
@@ -29,7 +29,8 @@ RoundtripFn = Callable[[np.ndarray], np.ndarray]
 # top-k (spec for compress.topk.TopKCodec)
 # ---------------------------------------------------------------------------
 
-def topk_encode(x: np.ndarray, bucket_elems: int = 512, k: int = 64):
+def topk_encode(x: np.ndarray, bucket_elems: int = 512,
+                k: int = 64) -> Tuple[np.ndarray, np.ndarray]:
     """Flat f32 [n] -> (values f32 [nb, k], indices int16 [nb, k]).
 
     Tie rule (the lax.top_k contract): equal magnitudes keep ascending
@@ -85,7 +86,8 @@ def _to_bf16(x: np.ndarray) -> np.ndarray:
 
 def int8_encode(x: np.ndarray, block_size: int = 16,
                 rounding: str = "stochastic", seed: int = 0,
-                layout: str = "flat16"):
+                layout: str = "flat16"
+                ) -> Tuple[np.ndarray, np.ndarray]:
     """Flat f32 [n] -> (int8 q [n], bf16 scale [n/block]).  The bf16
     scale makes the decode product exact in f32 (<= 15 significand bits)
     — the FMA-immunity the spec requires; see compress.int8.
@@ -115,7 +117,8 @@ def int8_encode(x: np.ndarray, block_size: int = 16,
 
 
 def int8_decode(q: np.ndarray, scale: np.ndarray, block_size: int = 16,
-                dtype=np.float32, layout: str = "flat16") -> np.ndarray:
+                dtype: Any = np.float32,
+                layout: str = "flat16") -> np.ndarray:
     qb = bfp_golden._to_blocks(np.asarray(q, np.int8), block_size,
                                layout).astype(np.float32)
     x = qb * np.asarray(scale).reshape(-1).astype(np.float32)[..., None]
@@ -134,7 +137,7 @@ def int8_roundtrip(x: np.ndarray, block_size: int = 16,
 # codec-generic roundtrip lookup
 # ---------------------------------------------------------------------------
 
-def roundtrip_fn(codec) -> RoundtripFn:
+def roundtrip_fn(codec: Any) -> RoundtripFn:
     """The numpy golden roundtrip matching a compress.Codec instance's
     configuration (including backend/layout dispatch by payload size)."""
     from .bfp import BFPCodec, use_pallas
@@ -144,7 +147,7 @@ def roundtrip_fn(codec) -> RoundtripFn:
     if isinstance(codec, BFPCodec):
         cfg = codec.cfg
 
-        def rt(x):
+        def rt(x: np.ndarray) -> np.ndarray:
             layout = ("sublane" if use_pallas(cfg, x.shape[0]) else "flat16")
             mant, se = bfp_golden.bfp_encode(
                 x, cfg.block_size, cfg.mantissa_bits, cfg.rounding,
@@ -155,7 +158,7 @@ def roundtrip_fn(codec) -> RoundtripFn:
     if isinstance(codec, TopKCodec):
         return lambda x: topk_roundtrip(x, codec.bucket_elems, codec.k)
     if isinstance(codec, Int8Codec):
-        def rt(x):
+        def rt(x: np.ndarray) -> np.ndarray:
             layout = ("sublane" if codec._use_pallas(x.shape[0])
                       else "flat16")
             return int8_roundtrip(x, codec.block_size, codec.rounding,

@@ -56,13 +56,13 @@ by evals/codec_convergence.
 from __future__ import annotations
 
 import functools
-from typing import Optional, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .base import Codec, register
+from .base import Codec, DTypeLike, register
 from ..ops import bfp_pallas as _bfp_pl
 from ..ops.bfp_pallas import LANES
 
@@ -114,7 +114,7 @@ def int8_encode(x: jax.Array, block_size: int = 16,
 
 @functools.partial(jax.jit, static_argnames=("block_size", "dtype"))
 def int8_decode(q: jax.Array, scale: jax.Array, block_size: int = 16,
-                dtype=jnp.float32) -> jax.Array:
+                dtype: DTypeLike = jnp.float32) -> jax.Array:
     qb = q.reshape(-1, block_size).astype(jnp.float32)
     # int8 x bf16 -> <= 15 significand bits: this multiply is EXACT in
     # f32 (never rounds), hence FMA-safe — see module docstring
@@ -126,7 +126,9 @@ def int8_decode(q: jax.Array, scale: jax.Array, block_size: int = 16,
 # Pallas backend ("sublane" layout: lane-column blocks, as bfp_pallas)
 # ---------------------------------------------------------------------------
 
-def _encode_kernel(x_ref, q_ref, scale_ref, *, block_size, rounding, seed):
+def _encode_kernel(x_ref: Any, q_ref: Any, scale_ref: Any, *,
+                   block_size: int, rounding: str,
+                   seed: int) -> None:
     from jax.experimental.pallas import tpu as pltpu
     x = x_ref[:]                                   # (T*B, 128) f32
     T = x.shape[0] // block_size
@@ -143,7 +145,8 @@ def _encode_kernel(x_ref, q_ref, scale_ref, *, block_size, rounding, seed):
     scale_ref[:] = scale
 
 
-def _decode_kernel(q_ref, scale_ref, out_ref, *, block_size):
+def _decode_kernel(q_ref: Any, scale_ref: Any, out_ref: Any, *,
+                   block_size: int) -> None:
     q = q_ref[:].astype(jnp.float32)
     sf = scale_ref[:].astype(jnp.float32)
     out_ref[:] = q * _bfp_pl._bcast_blocks(sf, block_size, "repeat")
@@ -191,7 +194,8 @@ def int8_encode_pallas(x: jax.Array, block_size: int = 16,
 
 
 def int8_decode_pallas(q: jax.Array, scale: jax.Array, block_size: int = 16,
-                       dtype=jnp.float32, interpret: Optional[bool] = None,
+                       dtype: DTypeLike = jnp.float32,
+                       interpret: Optional[bool] = None,
                        tiles_per_step: int = _bfp_pl._DEF_TILES) -> jax.Array:
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -235,7 +239,7 @@ class Int8Codec(Codec):
 
     def __init__(self, block_size: int = 16, rounding: str = "stochastic",
                  seed: int = 0, backend: str = "xla",
-                 error_feedback: bool = False):
+                 error_feedback: bool = False) -> None:
         assert rounding in ("stochastic", "nearest"), rounding
         assert backend in ("xla", "pallas", "auto"), backend
         assert block_size >= 2
@@ -259,7 +263,8 @@ class Int8Codec(Codec):
         return tuple(int8_encode(x, self.block_size, self.rounding,
                                  self.seed))
 
-    def decode(self, payload, n_elems: int, dtype=jnp.float32) -> jax.Array:
+    def decode(self, payload: Tuple[jax.Array, ...], n_elems: int,
+               dtype: DTypeLike = jnp.float32) -> jax.Array:
         q, scale = payload
         if self._use_pallas(n_elems):
             return int8_decode_pallas(q, scale, self.block_size, dtype)
@@ -271,7 +276,8 @@ class Int8Codec(Codec):
     def pad_elems(self) -> int:
         return self.block_size
 
-    def sliceable(self, chunk_elems, slice_elems) -> bool:
+    def sliceable(self, chunk_elems: int,
+                  slice_elems: Optional[int]) -> bool:
         return (super().sliceable(chunk_elems, slice_elems)
                 # same backend-consistency rules as BFPCodec: the block
                 # partition must not depend on how the chunk is sliced
@@ -293,7 +299,7 @@ class Int8Codec(Codec):
         assert n_elems % self.block_size == 0
         return n_elems + 2 * (n_elems // self.block_size)
 
-    def describe(self):
+    def describe(self) -> Dict[str, Any]:
         d = super().describe()
         d.update(block_size=self.block_size, rounding=self.rounding,
                  seed=self.seed, backend=self.backend)

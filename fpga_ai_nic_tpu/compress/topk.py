@@ -34,13 +34,13 @@ re-implement that sort for zero wire-byte savings.  The VPU-shaped codecs
 
 from __future__ import annotations
 
-from typing import Tuple
+from typing import Any, Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .base import Codec, register
+from .base import Codec, DTypeLike, register
 
 
 @register
@@ -52,7 +52,7 @@ class TopKCodec(Codec):
     supports_fused = False
 
     def __init__(self, bucket_elems: int = 512, k: int = 64,
-                 error_feedback: bool = True):
+                 error_feedback: bool = True) -> None:
         assert 0 < k <= bucket_elems, (k, bucket_elems)
         assert bucket_elems <= 32768, "int16 wire indices"
         self.bucket_elems = int(bucket_elems)
@@ -69,7 +69,8 @@ class TopKCodec(Codec):
         vals = jnp.take_along_axis(xb, idx, axis=-1)
         return vals, idx.astype(jnp.int16)
 
-    def decode(self, payload, n_elems: int, dtype=jnp.float32) -> jax.Array:
+    def decode(self, payload: Tuple[jax.Array, ...], n_elems: int,
+               dtype: DTypeLike = jnp.float32) -> jax.Array:
         vals, idx = payload
         B = self.bucket_elems
         nb = n_elems // B
@@ -98,7 +99,7 @@ class TopKCodec(Codec):
         assert n_elems % self.bucket_elems == 0
         return (n_elems // self.bucket_elems) * self.k * (4 + 2)
 
-    def describe(self):
+    def describe(self) -> Dict[str, Any]:
         d = super().describe()
         d.update(bucket_elems=self.bucket_elems, k=self.k,
                  density=round(self.k / self.bucket_elems, 4))

@@ -1,0 +1,38 @@
+"""graftlint — JAX/Pallas-aware static analysis for this repo.
+
+Two planes (docs/LINT.md is the rule catalog):
+
+Plane 1 — AST rules over the package source (`lint.engine` + `lint.rules`):
+  R1  lock discipline: CollectiveStats/RecoveryStats counters mutate only
+      inside their locked ``record_*`` methods (the PR-4 race class).
+  R2  trace-time capture hazards: ``time.*`` / ``np.random.*`` /
+      ``os.environ`` reads / mutable default args inside jitted,
+      shard_map'd or Pallas-kernel bodies.
+  R3  Pallas tiling: integer literals feeding BlockSpec / scratch shapes
+      must be lane/sublane multiples (or named LANES/SUBLANES math), and
+      kernel bodies must not Python-branch on traced values.
+  R4  callback gating: pure_callback/io_callback (and the obs metrics
+      tap) in ops/ and parallel/ hot paths must sit under a trace-time
+      config gate, never unconditional.
+  R5  artifact honesty: bench writers must not bank a headline
+      ``value``/``unit`` from a ``max(..., default=0)``-style fallback.
+  R0  suppression hygiene: ``# graftlint: disable=RN`` requires a
+      ``-- reason``; unknown codes are errors.
+
+Plane 2 — jaxpr invariant sweep (`lint.jaxpr_sweep`, CPU-only):
+  J1  obs_metrics=False compiles to zero callback primitives.
+  J2  no f64 avals anywhere in the step jaxpr.
+  J3  donated buffers are actually donated (pjit donated_invars).
+  J4  declared Codec.wire_bytes matches the bytes implied by the
+      jaxpr's ppermute operands (with static trip counts).
+  J5  every ppermute/psum axis name exists on the mesh.
+
+The sweep is registry-driven: every codec in ``compress.available_codecs``
+is covered automatically, and the run fails loudly if one is missed.
+"""
+
+from .findings import Finding, RULE_DOCS
+from .engine import lint_paths, lint_source, default_targets
+
+__all__ = ["Finding", "RULE_DOCS", "lint_paths", "lint_source",
+           "default_targets"]

@@ -1,0 +1,48 @@
+"""Finding record + rule catalog shared by both analysis planes."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, Tuple
+
+# One-line docs keyed by code; docs/LINT.md carries the full rationale.
+RULE_DOCS: Dict[str, str] = {
+    "R0": "suppression hygiene: disable= needs a '-- reason' and a known code",
+    "R1": "lock discipline: stats counters mutate only in locked record_* "
+          "methods",
+    "R2": "trace-time capture hazard inside a jit/shard_map/Pallas body",
+    "R3": "Pallas tiling: literal block dims must be lane/sublane multiples; "
+          "no Python branch on traced values in kernel bodies",
+    "R4": "callback gating: pure_callback/io_callback in ops//parallel/ must "
+          "be dominated by a trace-time config gate",
+    "R5": "artifact honesty: never bank value/unit from a "
+          "max(..., default=0)-style fallback",
+    "J1": "jaxpr: obs off must compile to zero callback primitives",
+    "J2": "jaxpr: no f64 avals may leak into the step",
+    "J3": "jaxpr: donated state buffers must actually be donated",
+    "J4": "jaxpr: declared Codec.wire_bytes must match ppermute operand "
+          "bytes",
+    "J5": "jaxpr: every collective axis name must exist on the mesh",
+    "J6": "jaxpr sweep coverage: every registered codec must be swept",
+}
+
+AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5")
+JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One diagnostic.  ``suppressed`` findings are reported but do not fail
+    the run; a suppression must carry a reason (else the engine emits R0)."""
+
+    code: str
+    path: str
+    line: int
+    message: str
+    suppressed: bool = False
+    suppress_reason: str = field(default="", compare=False)
+
+    def format(self) -> str:
+        tag = " (suppressed: %s)" % self.suppress_reason if self.suppressed \
+            else ""
+        return f"{self.path}:{self.line}: {self.code}: {self.message}{tag}"

@@ -1,0 +1,617 @@
+"""R1–R5 AST rule implementations.
+
+Every rule is a generator ``rule(ctx) -> Iterable[Finding]`` over one
+parsed module (`engine.ModuleCtx`).  Rules are heuristic by design — they
+encode the *bug classes the advisor rounds actually found* (docs/LINT.md
+maps each rule to its motivating finding), tuned so the current tree is
+clean without blanket suppressions.  False positives are handled with
+``# graftlint: disable=RN -- reason`` (reason mandatory, rule R0).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from .findings import Finding
+
+# ---------------------------------------------------------------------------
+# R1 — lock discipline on the shared stats objects
+# ---------------------------------------------------------------------------
+
+# Counter fields of utils.observability.CollectiveStats / RecoveryStats.
+# These are written concurrently by the trainer thread, the elastic
+# watchdog worker and XLA callback threads; PR 4 routed ALL mutation
+# through locked record_* methods after bare `+=` provably dropped
+# updates.  This rule freezes that invariant.
+COLLECTIVE_COUNTERS = frozenset({
+    "issued", "completed", "abandoned", "wire_bytes", "raw_bytes",
+    "latency_sum_s", "latency_max_s", "stall_s", "overlap_s"})
+RECOVERY_COUNTERS = frozenset({
+    "faults", "recoveries", "failed_recoveries", "checkpoint_restores",
+    "mttr_sum_s", "mttr_max_s", "events", "events_dropped"})
+STATS_CLASSES = {"CollectiveStats": COLLECTIVE_COUNTERS,
+                 "RecoveryStats": RECOVERY_COUNTERS}
+ALL_COUNTERS = COLLECTIVE_COUNTERS | RECOVERY_COUNTERS
+# attribute / variable names through which the stats objects travel
+STATS_HANDLES = {"collectives", "recovery", "stats", "cstats", "rstats"}
+MUTATING_METHODS = {"append", "extend", "insert", "pop", "clear", "update",
+                    "setdefault", "remove"}
+
+
+def _enclosing_class(ctx, node) -> Optional[ast.ClassDef]:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.ClassDef):
+            return anc
+    return None
+
+
+def _counter_mutation(ctx, target) -> Optional[Tuple[str, ast.AST]]:
+    """(field, object-expr) if ``target`` writes a stats counter field.
+    Handles ``obj.field`` and ``obj.field[key]`` targets."""
+    if isinstance(target, ast.Subscript):
+        target = target.value
+    if isinstance(target, ast.Attribute) and target.attr in ALL_COUNTERS:
+        return target.attr, target.value
+    return None
+
+
+def _is_stats_object(ctx, obj, fieldname, node) -> bool:
+    """Does ``obj`` (the expression left of .fieldname) plausibly hold a
+    CollectiveStats/RecoveryStats instance?"""
+    dotted = ctx.dotted(obj)
+    if not dotted:
+        return False
+    last = dotted.split(".")[-1]
+    if dotted == "self":
+        cls = _enclosing_class(ctx, node)
+        return (cls is not None and cls.name in STATS_CLASSES
+                and fieldname in STATS_CLASSES[cls.name])
+    if last in ("collectives", "cstats"):
+        return fieldname in COLLECTIVE_COUNTERS
+    if last in ("recovery", "rstats"):
+        return fieldname in RECOVERY_COUNTERS
+    # generic handles ('stats', ...): either stats class may be behind
+    # them, so any counter field counts
+    return last in STATS_HANDLES
+
+
+def _under_lock(ctx, node) -> bool:
+    for anc in ctx.ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if ctx.dotted(item.context_expr).endswith("_lock"):
+                    return True
+    return False
+
+
+def _in_record_method(ctx, node) -> bool:
+    fn = ctx.enclosing_function(node)
+    while fn is not None:
+        if isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            cls = _enclosing_class(ctx, fn)
+            if (cls is not None and cls.name in STATS_CLASSES
+                    and fn.name.startswith("record_")):
+                return True
+        fn = ctx.enclosing_function(fn)
+    return False
+
+
+def rule_r1_lock_discipline(ctx) -> Iterable[Finding]:
+    for node in ast.walk(ctx.tree):
+        targets: List[ast.AST] = []
+        if isinstance(node, ast.AugAssign):
+            targets = [node.target]
+        elif isinstance(node, ast.Assign):
+            targets = list(node.targets)
+        elif isinstance(node, ast.Call):
+            # obj.field.append(...) and friends mutate the field too
+            f = node.func
+            if (isinstance(f, ast.Attribute) and f.attr in MUTATING_METHODS):
+                targets = [f.value]
+        for t in targets:
+            hit = _counter_mutation(ctx, t)
+            if hit is None:
+                continue
+            fieldname, obj = hit
+            if not _is_stats_object(ctx, obj, fieldname, node):
+                continue
+            if _in_record_method(ctx, node):
+                if _under_lock(ctx, node):
+                    continue
+                yield Finding(
+                    "R1", ctx.path, node.lineno,
+                    f"stats counter '{fieldname}' mutated inside a record_* "
+                    "method but OUTSIDE `with self._lock:` — the lock is "
+                    "the whole point of the record_* funnel")
+                continue
+            yield Finding(
+                "R1", ctx.path, node.lineno,
+                f"stats counter '{fieldname}' mutated outside a locked "
+                "record_* method (cross-thread `+=` drops updates; route "
+                "through CollectiveStats/RecoveryStats.record_*)")
+
+
+# ---------------------------------------------------------------------------
+# traced-function discovery (shared by R2 and R3)
+# ---------------------------------------------------------------------------
+
+# wrappers whose function arguments are traced at jit time; bare names
+# cover `from jax import jit` style imports
+_WRAPPERS = {"jit", "pmap", "shard_map", "pallas_call", "core_map"}
+# dotted-only wrappers (too generic as bare names)
+_DOTTED_WRAPPERS = {"lax.scan", "jax.lax.scan", "lax.fori_loop",
+                    "jax.lax.fori_loop", "lax.while_loop",
+                    "jax.lax.while_loop", "lax.cond", "jax.lax.cond",
+                    "jax.checkpoint", "jax.remat", "jax.grad",
+                    "jax.value_and_grad", "jax.vmap"}
+_CALLBACK_FUNCS = {"pure_callback", "io_callback"}
+
+
+@dataclass
+class TracedInfo:
+    traced: Dict[ast.AST, str] = field(default_factory=dict)
+    kernels: Dict[ast.AST, str] = field(default_factory=dict)
+    host_defs: Set[ast.AST] = field(default_factory=set)
+    host_subtrees: List[ast.AST] = field(default_factory=list)
+
+
+def _wrapper_kind(ctx, func_expr) -> str:
+    d = ctx.dotted(func_expr)
+    if not d:
+        return ""
+    last = d.split(".")[-1]
+    if last in _WRAPPERS:
+        return last
+    if d in _DOTTED_WRAPPERS or (ctx.from_imports.get(d, "") or "").endswith(
+            tuple("." + w for w in _WRAPPERS)):
+        return last
+    return ""
+
+
+def _is_callback_call(ctx, call: ast.Call) -> bool:
+    d = ctx.dotted(call.func)
+    last = d.split(".")[-1] if d else ""
+    return last in _CALLBACK_FUNCS or d.endswith("debug.callback")
+
+
+def find_traced_functions(ctx) -> TracedInfo:
+    info = TracedInfo()
+    defs_by_name: Dict[str, List[ast.AST]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(node.name, []).append(node)
+
+    # host-callback targets are NOT traced (they run on the host thread):
+    # exclude the first argument of pure_callback/io_callback/debug.callback
+    host_names: Set[str] = set()
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Call) and _is_callback_call(ctx, node):
+            if node.args:
+                tgt = node.args[0]
+                info.host_subtrees.append(tgt)
+                if isinstance(tgt, ast.Name):
+                    host_names.add(tgt.id)
+    for name in host_names:
+        for d in defs_by_name.get(name, []):
+            info.host_defs.add(d)
+
+    def mark(fn_node, reason):
+        if fn_node in info.host_defs or fn_node in info.traced:
+            return
+        info.traced[fn_node] = reason
+        # nested defs run under the same trace when called
+        for sub in ast.walk(fn_node):
+            if (sub is not fn_node
+                    and isinstance(sub, (ast.FunctionDef,
+                                         ast.AsyncFunctionDef))
+                    and sub not in info.host_defs
+                    and sub not in info.traced):
+                info.traced[sub] = f"defined inside traced '{_name(fn_node)}'"
+
+    # 1) decorators
+    for fns in defs_by_name.values():
+        for fn in fns:
+            for dec in getattr(fn, "decorator_list", ()):
+                expr = dec
+                if isinstance(expr, ast.Call):
+                    # @jax.jit(...) or @functools.partial(jax.jit, ...)
+                    if ctx.dotted(expr.func).split(".")[-1] == "partial" \
+                            and expr.args:
+                        expr = expr.args[0]
+                    else:
+                        expr = expr.func
+                kind = _wrapper_kind(ctx, expr)
+                if kind:
+                    mark(fn, f"decorated with {kind}")
+
+    # alias map: `kern = functools.partial(_kernel, ...)` / `g = f` — the
+    # idiom every Pallas call site here uses to bind static kernel params
+    alias_of: Dict[str, Set[str]] = {}
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign) and len(node.targets) == 1 \
+                and isinstance(node.targets[0], ast.Name):
+            tgt = node.targets[0].id
+            src = _unwrap_partial(ctx, node.value)
+            if src:
+                alias_of.setdefault(tgt, set()).add(src)
+
+    def resolve(arg) -> List[ast.AST]:
+        """FunctionDefs an argument expression may refer to (through
+        partial() wrapping and simple name aliasing; an alias reused at
+        several call sites resolves to every aliased kernel)."""
+        if isinstance(arg, ast.Lambda):
+            return [arg]
+        name = _unwrap_partial(ctx, arg)
+        if not name:
+            return []
+        out: List[ast.AST] = []
+        seen: Set[str] = set()
+        frontier = {name}
+        while frontier:
+            nm = frontier.pop()
+            seen.add(nm)
+            out.extend(defs_by_name.get(nm, ()))
+            frontier |= alias_of.get(nm, set()) - seen
+        return out
+
+    # 2) call sites: jax.jit(f), shard_map(f, ...), pl.pallas_call(kernel)
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        kind = _wrapper_kind(ctx, node.func)
+        if not kind:
+            continue
+        cands = list(node.args) + [kw.value for kw in node.keywords
+                                   if kw.arg in ("f", "fun", "kernel",
+                                                 "body_fn", "body")]
+        for i, arg in enumerate(cands):
+            for fn in resolve(arg):
+                mark(fn, f"passed to {kind}")
+                if kind == "pallas_call" and i == 0:
+                    info.kernels[fn] = _name(fn)
+
+    # 3) transitive closure: functions called from traced bodies are traced
+    changed = True
+    while changed:
+        changed = False
+        for fn in list(info.traced):
+            for call in _walk_skipping(fn, info.host_subtrees):
+                if not isinstance(call, ast.Call):
+                    continue
+                if _is_callback_call(ctx, call):
+                    continue
+                if isinstance(call.func, ast.Name):
+                    for cand in defs_by_name.get(call.func.id, []):
+                        if cand not in info.traced \
+                                and cand not in info.host_defs:
+                            mark(cand, f"called from traced '{_name(fn)}'")
+                            changed = True
+    return info
+
+
+def _name(fn) -> str:
+    return getattr(fn, "name", "<lambda>")
+
+
+def _unwrap_partial(ctx, expr) -> str:
+    """Name referenced by ``expr``, seeing through functools.partial(f, …)
+    (returns '' when the expression is not a name/partial-of-name)."""
+    while isinstance(expr, ast.Call) \
+            and ctx.dotted(expr.func).split(".")[-1] == "partial" \
+            and expr.args:
+        expr = expr.args[0]
+    if isinstance(expr, ast.Name):
+        return expr.id
+    return ""
+
+
+def _walk_skipping(root, skip_subtrees):
+    """ast.walk that does not descend into any of ``skip_subtrees``
+    (host-callback bodies live inside traced functions but run on host)."""
+    skip = set(map(id, skip_subtrees))
+    stack = [root]
+    while stack:
+        node = stack.pop()
+        for child in ast.iter_child_nodes(node):
+            if id(child) in skip:
+                continue
+            stack.append(child)
+        yield node
+
+
+# ---------------------------------------------------------------------------
+# R2 — trace-time capture hazards
+# ---------------------------------------------------------------------------
+
+_MUTABLE_CTORS = {"dict", "list", "set"}
+
+
+def _hazard_of_call(ctx, call: ast.Call) -> str:
+    d = ctx.dotted(call.func)
+    if not d:
+        return ""
+    root = d.split(".")[0]
+    mod = ctx.mod_aliases.get(root, "")
+    if mod == "time":
+        return f"'{d}()' captures host wall-clock at trace time"
+    if (mod == "numpy" and ".random" in d) \
+            or mod.startswith("numpy.random"):
+        # covers `np.random.x()` and `import numpy.random as npr`
+        return (f"'{d}()' draws host randomness at trace time (use "
+                "jax.random with a threaded key)")
+    if mod == "random":
+        return f"'{d}()' draws host randomness at trace time"
+    if mod == "os" and (d.endswith("getenv") or ".environ" in d):
+        return f"'{d}()' reads the environment at trace time"
+    if mod == "datetime" and d.split(".")[-1] in ("now", "utcnow", "today"):
+        return f"'{d}()' captures host wall-clock at trace time"
+    src = ctx.from_imports.get(d, "")
+    if src.startswith("time."):
+        return f"'{d}()' (= {src}) captures host wall-clock at trace time"
+    if src.startswith("random.") or src.startswith("numpy.random"):
+        return f"'{d}()' (= {src}) draws host randomness at trace time"
+    if src == "os.getenv":
+        return f"'{d}()' (= os.getenv) reads the environment at trace time"
+    return ""
+
+
+def rule_r2_trace_capture(ctx) -> Iterable[Finding]:
+    info = ctx.traced
+    seen: Set[Tuple[int, str]] = set()
+    for fn, reason in info.traced.items():
+        # mutable default arguments on the traced function itself: the
+        # default is captured ONCE and aliased across every trace
+        args = getattr(fn, "args", None)
+        if args is not None:
+            for dflt in list(args.defaults) + [d for d in args.kw_defaults
+                                               if d is not None]:
+                bad = isinstance(dflt, (ast.List, ast.Dict, ast.Set)) or (
+                    isinstance(dflt, ast.Call)
+                    and ctx.dotted(dflt.func) in _MUTABLE_CTORS)
+                if bad:
+                    key = (fn.lineno, "default")
+                    if key not in seen:
+                        seen.add(key)
+                        yield Finding(
+                            "R2", ctx.path, fn.lineno,
+                            f"traced function '{_name(fn)}' ({reason}) has "
+                            "a mutable default argument — captured once, "
+                            "shared across traces")
+        for node in _direct_body(fn, info):
+            msg = ""
+            if isinstance(node, ast.Call):
+                msg = _hazard_of_call(ctx, node)
+            elif isinstance(node, ast.Attribute) \
+                    and ctx.dotted(node) == "os.environ" \
+                    and ctx.mod_aliases.get("os") == "os":
+                msg = "'os.environ' read at trace time"
+            if msg:
+                key = (node.lineno, msg)
+                if key not in seen:
+                    seen.add(key)
+                    yield Finding(
+                        "R2", ctx.path, node.lineno,
+                        f"{msg} inside traced function '{_name(fn)}' "
+                        f"({reason}) — the captured value is frozen into "
+                        "the compiled program")
+
+
+def _direct_body(fn, info: TracedInfo):
+    """Nodes of ``fn``'s body, excluding nested host-callback defs and
+    nested traced defs (they are scanned as their own entries)."""
+    skip = list(info.host_subtrees)
+    for sub in ast.walk(fn):
+        if sub is not fn and isinstance(sub, (ast.FunctionDef,
+                                              ast.AsyncFunctionDef)) \
+                and sub in info.host_defs:
+            skip.append(sub)
+    yield from _walk_skipping(fn, skip)
+
+
+# ---------------------------------------------------------------------------
+# R3 — Pallas tiling discipline
+# ---------------------------------------------------------------------------
+
+LANE = 128
+SUBLANE = 8
+
+
+def _module_uses_pallas(ctx) -> bool:
+    for v in list(ctx.mod_aliases.values()) + list(ctx.from_imports.values()):
+        if "pallas" in v:
+            return True
+    return False
+
+
+def _check_block_tuple(ctx, tup: ast.Tuple, what: str):
+    elems = tup.elts
+    if not elems:
+        return
+    lane = elems[-1]
+    if isinstance(lane, ast.Constant) and isinstance(lane.value, int) \
+            and lane.value % LANE != 0:
+        yield Finding(
+            "R3", ctx.path, lane.lineno,
+            f"{what}: literal lane dimension {lane.value} is not a "
+            f"multiple of {LANE} — use the module's LANES constant or a "
+            "lane-tileable size (Mosaic will reject or relayout this on "
+            "real hardware)")
+    if len(elems) >= 2:
+        sub = elems[-2]
+        if isinstance(sub, ast.Constant) and isinstance(sub.value, int) \
+                and sub.value != 1 and sub.value % SUBLANE != 0:
+            yield Finding(
+                "R3", ctx.path, sub.lineno,
+                f"{what}: literal sublane dimension {sub.value} is not a "
+                f"multiple of {SUBLANE} (or 1) — use SUBLANES-derived "
+                "sizes")
+
+
+def rule_r3_pallas_tiling(ctx) -> Iterable[Finding]:
+    if not _module_uses_pallas(ctx):
+        return
+    # (a) literal block shapes in BlockSpec / VMEM scratch
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        last = ctx.dotted(node.func).split(".")[-1]
+        if last in ("BlockSpec", "VMEM") and node.args \
+                and isinstance(node.args[0], ast.Tuple):
+            yield from _check_block_tuple(ctx, node.args[0],
+                                          f"{last} block shape")
+    # (b) Python branches on traced values inside kernel bodies
+    info = ctx.traced
+    for fn in info.kernels:
+        params = {a.arg for a in fn.args.args}
+        for node in ast.walk(fn):
+            if not isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                continue
+            reason = _traced_test(ctx, node.test, params)
+            if reason:
+                yield Finding(
+                    "R3", ctx.path, node.lineno,
+                    f"kernel '{_name(fn)}' Python-branches on a traced "
+                    f"value ({reason}) — this silently bakes one branch "
+                    "into the kernel at trace time; use pl.when or "
+                    "lax.cond/select")
+
+
+def _traced_test(ctx, test, params: Set[str]) -> str:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Subscript):
+            root = ctx.dotted(node.value).split(".")[0]
+            if root in params:
+                return f"ref load '{root}[...]'"
+        if isinstance(node, ast.Call):
+            d = ctx.dotted(node.func)
+            last = d.split(".")[-1]
+            if last == "program_id":
+                return "pl.program_id(...)"
+            if last == "load" and node.args:
+                root = ctx.dotted(node.args[0]).split(".")[0]
+                if root in params:
+                    return f"pl.load({root}, ...)"
+    return ""
+
+
+# ---------------------------------------------------------------------------
+# R4 — callback gating in hot paths
+# ---------------------------------------------------------------------------
+
+def _is_hot_path(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "ops" in parts[:-1] or "parallel" in parts[:-1]
+
+
+def _gate_ancestor(ctx, node) -> bool:
+    fn = ctx.enclosing_function(node)
+    for anc in ctx.ancestors(node):
+        if anc is fn:
+            break
+        if isinstance(anc, (ast.If, ast.IfExp)):
+            return True
+    if fn is None or isinstance(fn, ast.Lambda):
+        return False
+    # early-return guard: a DIRECT `if <gate>: return/raise` statement of
+    # the enclosing function, lexically before the call.  Walking nested
+    # defs or deeper branches here would let any unrelated guard anywhere
+    # in the function count as a gate (round-review finding).
+    for stmt in fn.body:
+        if stmt.lineno >= node.lineno:
+            break
+        if isinstance(stmt, ast.If) \
+                and any(isinstance(s, (ast.Return, ast.Raise))
+                        for s in stmt.body):
+            return True
+    return False
+
+
+def rule_r4_callback_gating(ctx) -> Iterable[Finding]:
+    if not _is_hot_path(ctx.path):
+        return
+    for node in ast.walk(ctx.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        d = ctx.dotted(node.func)
+        last = d.split(".")[-1] if d else ""
+        is_cb = (last in _CALLBACK_FUNCS or d.endswith("debug.callback")
+                 or (last == "tap" and ("metrics" in d or "obs" in d)))
+        if not is_cb:
+            continue
+        if _gate_ancestor(ctx, node):
+            continue
+        yield Finding(
+            "R4", ctx.path, node.lineno,
+            f"'{d}' in a hot path is not dominated by a trace-time config "
+            "gate (obs_metrics / chaos plan) — an unconditional callback "
+            "serializes every step on a host round-trip")
+
+
+# ---------------------------------------------------------------------------
+# R5 — artifact honesty in bench writers
+# ---------------------------------------------------------------------------
+
+def _is_bench_writer(path: str) -> bool:
+    parts = path.replace("\\", "/").split("/")
+    return "tools" in parts[:-1] or parts[-1].startswith("bench")
+
+
+def _bad_fallback(node) -> str:
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Call):
+            last = sub.func.attr if isinstance(sub.func, ast.Attribute) \
+                else getattr(sub.func, "id", "")
+            if last in ("max", "min"):
+                for kw in sub.keywords:
+                    if kw.arg == "default" and isinstance(kw.value,
+                                                          ast.Constant):
+                        return (f"{last}(..., default="
+                                f"{kw.value.value!r})")
+                # max(r.get(k, 0) for r in rows): the fallback hides as
+                # the .get default instead of max's — same fake headline
+                for inner in ast.walk(sub):
+                    if isinstance(inner, ast.Call) \
+                            and isinstance(inner.func, ast.Attribute) \
+                            and inner.func.attr == "get" \
+                            and len(inner.args) >= 2 \
+                            and isinstance(inner.args[1], ast.Constant) \
+                            and inner.args[1].value in (0, 0.0):
+                        return (f"{last}(... .get(k, "
+                                f"{inner.args[1].value!r}) ...)")
+        if isinstance(sub, ast.BoolOp) and isinstance(sub.op, ast.Or):
+            tail = sub.values[-1]
+            if isinstance(tail, ast.Constant) and tail.value in (0, 0.0):
+                return f"'... or {tail.value!r}' fallback"
+    return ""
+
+
+def rule_r5_artifact_honesty(ctx) -> Iterable[Finding]:
+    if not _is_bench_writer(ctx.path):
+        return
+    sites: List[Tuple[ast.AST, ast.AST]] = []   # (key-ish node, rhs)
+    for node in ast.walk(ctx.tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) \
+                        and isinstance(t.slice, ast.Constant) \
+                        and t.slice.value in ("value", "unit"):
+                    sites.append((t, node.value))
+        elif isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) and k.value in ("value",
+                                                               "unit"):
+                    sites.append((k, v))
+        elif isinstance(node, ast.Call) and ctx.dotted(node.func) == "dict":
+            for kw in node.keywords:
+                if kw.arg in ("value", "unit"):
+                    sites.append((kw.value, kw.value))
+    for key_node, rhs in sites:
+        why = _bad_fallback(rhs)
+        if why:
+            yield Finding(
+                "R5", ctx.path, key_node.lineno,
+                f"artifact headline banked from a {why} — a missing "
+                "measurement must surface as an explicit *_error field, "
+                "never a fake default (the multichip 0.0 GB/s class)")

@@ -41,7 +41,7 @@ import threading
 import time
 from collections import deque
 from contextlib import contextmanager
-from typing import Any, Deque, Dict, List, Optional, Tuple
+from typing import Any, Deque, Dict, Iterator, List, Optional, Tuple
 
 SCHEMA_VERSION = 1
 
@@ -61,7 +61,7 @@ class EventStream:
     ticket traffic while bounding memory for million-step runs.
     """
 
-    def __init__(self, capacity: int = 1 << 16):
+    def __init__(self, capacity: int = 1 << 16) -> None:
         assert capacity > 0
         self.capacity = int(capacity)
         # ring slots: (t_ns, dur_ns, kind, name, value, attrs, tid)
@@ -101,14 +101,15 @@ class EventStream:
                 self.events_dropped += 1
             self._buf.append((t_ns, dur_ns, kind, name, value, attrs, tid))
 
-    def instant(self, name: str, **attrs) -> None:
+    def instant(self, name: str, **attrs: Any) -> None:
         self.emit(INSTANT, name, attrs=attrs or None)
 
-    def counter(self, name: str, value: float, **attrs) -> None:
+    def counter(self, name: str, value: float,
+                **attrs: Any) -> None:
         self.emit(COUNTER, name, value=float(value), attrs=attrs or None)
 
     @contextmanager
-    def span(self, name: str, **attrs):
+    def span(self, name: str, **attrs: Any) -> Iterator[None]:
         """Timed span; records on exit (exceptions still record — a span
         that died is exactly the span the timeline must show)."""
         t0 = time.perf_counter_ns()

@@ -40,7 +40,7 @@ from __future__ import annotations
 
 import threading
 import time
-from typing import Any, Dict, Optional, Sequence, Tuple
+from typing import Any, Dict, Optional, Tuple
 
 import numpy as np
 
@@ -64,7 +64,7 @@ class MetricsSink:
 
     def __init__(self, ewma_alpha: float = 0.1,
                  events: Optional[EventStream] = None,
-                 static: Optional[Dict[str, Any]] = None):
+                 static: Optional[Dict[str, Any]] = None) -> None:
         assert 0.0 < ewma_alpha <= 1.0
         self.ewma_alpha = ewma_alpha
         self.events = events
@@ -123,7 +123,7 @@ class use_sink:
     as ``runtime.chaos.activate``: any step that should be observed must
     complete before the context exits."""
 
-    def __init__(self, sink: Optional[MetricsSink]):
+    def __init__(self, sink: Optional[MetricsSink]) -> None:
         self.sink = sink
 
     def __enter__(self) -> Optional[MetricsSink]:
@@ -132,10 +132,9 @@ class use_sink:
         _ACTIVE_SINK = self.sink
         return self.sink
 
-    def __exit__(self, *exc):
+    def __exit__(self, *exc: Any) -> None:
         global _ACTIVE_SINK
         _ACTIVE_SINK = self._prev
-        return False
 
 
 def host_observe(values: Dict[str, float]) -> None:
@@ -151,7 +150,7 @@ def host_observe(values: Dict[str, float]) -> None:
 # in-graph side: the tap
 # ---------------------------------------------------------------------------
 
-def tap(out, metrics, enabled: bool = True):
+def tap(out: Any, metrics: Any, enabled: bool = True) -> Any:
     """Route ``out`` (any array, typically the step's loss) through a
     pure_callback that delivers ``metrics`` (name -> scalar array, or a
     zero-arg thunk returning that dict) to the ambient sink.  Returns
@@ -173,7 +172,7 @@ def tap(out, metrics, enabled: bool = True):
     names: Tuple[str, ...] = tuple(sorted(metrics))
     vals = [metrics[k] for k in names]
 
-    def host(o, *vs):
+    def host(o: Any, *vs: Any) -> np.ndarray:
         sink = _ACTIVE_SINK
         if sink is not None:
             sink.update({k: float(np.asarray(v)) for k, v in zip(names, vs)})
@@ -187,7 +186,8 @@ def tap(out, metrics, enabled: bool = True):
 # metric builders (called inside shard_map, only when enabled)
 # ---------------------------------------------------------------------------
 
-def codec_static_metrics(codec, n_elems: int) -> Dict[str, Any]:
+def codec_static_metrics(codec: Any,
+                         n_elems: int) -> Dict[str, Any]:
     """Trace-time-constant codec facts for the sink's ``static`` dict:
     declared compression ratio, declared error bound, wire bytes per
     all-reduce pass of an [n_elems] gradient."""
@@ -200,7 +200,8 @@ def codec_static_metrics(codec, n_elems: int) -> Dict[str, Any]:
             "wire_bytes_per_pass": int(codec.wire_bytes(n_elems))}
 
 
-def codec_observed_error(codec, x, quantized=None):
+def codec_observed_error(codec: Any, x: Any,
+                         quantized: Any = None) -> Any:
     """Observed per-unit relative roundtrip error of ``codec`` on the flat
     vector ``x`` — the in-graph half of the declared-vs-observed check.
 
@@ -219,7 +220,7 @@ def codec_observed_error(codec, x, quantized=None):
     return rel
 
 
-def l2_norm(x, axis_name: Optional[str] = None):
+def l2_norm(x: Any, axis_name: Optional[str] = None) -> Any:
     """Global L2 norm of a (possibly axis-sharded) flat vector — psum'd
     when ``axis_name`` is given (call inside shard_map)."""
     import jax.numpy as jnp

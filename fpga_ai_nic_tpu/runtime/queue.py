@@ -22,7 +22,7 @@ from __future__ import annotations
 import threading
 import time
 from collections import deque
-from dataclasses import dataclass, field
+from dataclasses import dataclass
 from typing import Any, Callable, Deque, Optional
 
 import jax
@@ -58,7 +58,8 @@ class CollectiveQueue:
     """
 
     def __init__(self, fn: Callable, coll: CollectiveConfig,
-                 profiler: Optional[Profiler] = None, chaos=None):
+                 profiler: Optional[Profiler] = None,
+                 chaos: Optional[Any] = None) -> None:
         self.fn = fn
         self.coll = coll
         self.profiler = profiler or Profiler()
@@ -81,7 +82,8 @@ class CollectiveQueue:
 
     # -- reference ABI ------------------------------------------------------
 
-    def issue(self, *args, raw_bytes: int = 0, wire_bytes: int = 0) -> Ticket:
+    def issue(self, *args: Any, raw_bytes: int = 0,
+              wire_bytes: int = 0) -> Ticket:
         with self._lock:
             epoch = self._epoch
         while True:
@@ -175,7 +177,7 @@ class CollectiveQueue:
                        "raw_bytes": ticket.raw_bytes})
         return ticket.result
 
-    def wait_all(self):
+    def wait_all(self) -> None:
         while True:
             with self._lock:
                 if not self._inflight:

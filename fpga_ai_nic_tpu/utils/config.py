@@ -51,7 +51,7 @@ class BFPConfig:
     # unchanged on TPU; opt into "auto"/"pallas" for wire-path speed.
     codec: str = "xla"
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         assert self.block_size >= 2 and self.block_size & (self.block_size - 1) == 0
         assert 2 <= self.mantissa_bits <= 8
         assert self.rounding in ("nearest", "rtz")
@@ -134,7 +134,7 @@ class CollectiveConfig:
     integrity_check: bool = False
     integrity_tol: Optional[float] = None
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         assert self.impl in ("xla", "ring")
         if ((self.compression is not None or self.codec is not None)
                 and self.impl != "ring"):
@@ -195,7 +195,7 @@ class OptimizerConfig:
     # sharded and single-device training clip identically.
     clip_norm: Optional[float] = None
 
-    def __post_init__(self):
+    def __post_init__(self) -> None:
         assert self.kind in ("sgd", "momentum", "adamw")
         # 0.0 would silently zero every gradient; "off" is None
         assert self.clip_norm is None or self.clip_norm > 0, self.clip_norm
@@ -286,7 +286,7 @@ def coerce_value(T: Any, v: str) -> Any:
 _coerce = coerce_value
 
 
-def from_flags(cls, argv: Sequence[str]):
+def from_flags(cls: Any, argv: Sequence[str]) -> Any:
     """Build a (possibly nested) config dataclass from --dotted.key=value
     flags, e.g. ``from_flags(TrainConfig, ["--mesh.dp=4", "--iters=100"])``."""
     cfg = cls()
@@ -302,7 +302,7 @@ def from_flags(cls, argv: Sequence[str]):
     return cfg
 
 
-def _declared_type(cfg, name):
+def _declared_type(cfg: Any, name: str) -> Any:
     """The field's annotation with Optional[...] unwrapped."""
     import typing
     T = typing.get_type_hints(type(cfg)).get(name)
@@ -310,7 +310,7 @@ def _declared_type(cfg, name):
     return args[0] if len(args) == 1 else T
 
 
-def _replace_path(cfg, path, val):
+def _replace_path(cfg: Any, path: Sequence[str], val: str) -> Any:
     name, rest = path[0], path[1:]
     fields = {f.name: f for f in dataclasses.fields(cfg)}
     if name not in fields:

@@ -1,0 +1,22 @@
+"""BAD fixture — R0 suppression hygiene.
+
+Suppressions without reasons (and with unknown codes) are themselves
+errors: a reasonless disable is exactly the blanket suppression the lint
+gate exists to prevent, and it suppresses NOTHING.
+"""
+
+import time
+
+import jax
+
+
+@jax.jit
+def step(x):
+    t0 = time.perf_counter()    # graftlint: disable=R2
+    return x + t0
+
+
+@jax.jit
+def step2(x):
+    t0 = time.time()    # graftlint: disable=R9 -- no such rule
+    return x + t0

@@ -1,0 +1,16 @@
+"""GOOD fixture — R0 suppression hygiene.
+
+A deliberate hazard carrying a *reasoned* suppression: the finding still
+prints (marked suppressed) but does not fail the run.
+"""
+
+import time
+
+import jax
+
+
+@jax.jit
+def selftest_step(x):
+    # graftlint: disable=R2 -- selftest stamps trace wall-time on purpose;
+    t0 = time.perf_counter()
+    return x + t0

@@ -1,0 +1,20 @@
+"""BAD fixture — R1 lock discipline.
+
+Bare mutation of the shared stats counters outside the locked record_*
+funnel: the exact cross-thread `+=` race PR 4 eliminated (elastic
+watchdog thread vs trainer thread vs XLA callback threads).  Copying
+this file anywhere into the package must make `make lint` exit nonzero.
+"""
+
+
+class Worker:
+    def __init__(self, profiler):
+        self.profiler = profiler
+
+    def on_issue(self, stats, nbytes):
+        stats.issued += 1                                   # R1
+        stats.wire_bytes += nbytes                          # R1
+
+    def on_giveup(self):
+        self.profiler.collectives.abandoned += 1            # R1
+        self.profiler.recovery.events_dropped = 0           # R1

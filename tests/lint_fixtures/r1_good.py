@@ -1,0 +1,22 @@
+"""GOOD fixture — R1 lock discipline.
+
+All counter mutation routed through the locked record_* methods; reads
+(as_dict) are free.  graftlint must stay silent on this file.
+"""
+
+
+class Worker:
+    def __init__(self, profiler):
+        self.profiler = profiler
+
+    def on_issue(self, stats, nbytes):
+        stats.record_issue(raw_bytes=nbytes, wire_bytes=nbytes)
+
+    def on_complete(self, stats, latency_s):
+        stats.record_completion(latency_s, 0.0, 0.0)
+
+    def on_giveup(self):
+        self.profiler.collectives.record_abandoned()
+
+    def snapshot(self):
+        return self.profiler.collectives.as_dict()

@@ -1,0 +1,31 @@
+"""BAD fixture — R2 trace-time capture hazards.
+
+Host wall-clock, host randomness, environment reads and a mutable
+default argument all captured inside jitted bodies: each value is frozen
+at trace time into the compiled program (stale timestamps, a constant
+"random" tensor, a config that silently stops responding to the
+environment).
+"""
+
+import os
+import time
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def step(x, scratch=[]):                                    # R2 (default)
+    t0 = time.perf_counter()                                # R2
+    noise = np.random.normal(size=x.shape)                  # R2
+    if os.environ.get("DEBUG_SCALE"):                       # R2
+        x = x * 2.0
+    return x + noise + t0
+
+
+def _inner(x):
+    return x * time.time()                                  # R2 (transitive)
+
+
+def make_step():
+    return jax.jit(_inner)

@@ -1,0 +1,29 @@
+"""GOOD fixture — R2 trace-time capture hazards.
+
+The same shapes done right: host values enter as *arguments*, randomness
+is jax.random with a threaded key, env reads happen at config time on
+the host, and the host fn handed to pure_callback may do host things.
+"""
+
+import time
+
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def step(x, t0, key):
+    noise = jax.random.normal(key, x.shape)
+    return x + noise + t0
+
+
+def run(x, key):
+    t0 = time.perf_counter()        # host side: fine
+    return step(x, jnp.float32(t0), key)
+
+
+def tap(x):
+    def host(v):                    # pure_callback target runs on host
+        return v + time.time() * 0.0
+
+    return jax.pure_callback(host, jax.ShapeDtypeStruct(x.shape, x.dtype), x)

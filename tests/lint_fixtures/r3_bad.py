@@ -1,0 +1,32 @@
+"""BAD fixture — R3 Pallas tiling discipline.
+
+A BlockSpec whose literal lane dim is not a multiple of 128 (Mosaic
+rejects or silently relayouts this on real hardware — it "works" under
+the CPU interpreter and explodes in the TPU window), a sublane literal
+off the 8-row grid, and a kernel that Python-branches on traced values
+(one branch is baked in at trace time).
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref, *, scale):
+    if x_ref[0, 0] > 0:                                     # R3 (ref load)
+        o_ref[...] = x_ref[...] * scale
+    if pl.program_id(0) == 0:                               # R3 (program_id)
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+
+def encode(x):
+    kern = functools.partial(_kernel, scale=2.0)
+    return pl.pallas_call(
+        kern,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((16, 100), lambda i: (i, 0))],   # R3 (lane)
+        out_specs=pl.BlockSpec((3, 128), lambda i: (i, 0)),     # R3 (sublane)
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

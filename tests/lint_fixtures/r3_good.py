@@ -1,0 +1,37 @@
+"""GOOD fixture — R3 Pallas tiling discipline.
+
+Block dims derived from the module's LANES/SUBLANES constants (or
+lane-tileable literals), traced branches expressed with pl.when, Python
+branches only on trace-time-static closure values.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+LANES = 128
+SUBLANES = 8
+
+
+def _kernel(x_ref, o_ref, *, rows, zero_first):
+    @pl.when(pl.program_id(0) == 0)
+    def _init():
+        o_ref[...] = jnp.zeros_like(o_ref)
+
+    if zero_first:          # static closure bool: a trace-time branch
+        o_ref[...] = x_ref[...] * 0.0
+    else:
+        o_ref[...] = x_ref[...]
+
+
+def encode(x, rows):
+    kern = functools.partial(_kernel, rows=rows, zero_first=False)
+    return pl.pallas_call(
+        kern,
+        grid=(4,),
+        in_specs=[pl.BlockSpec((SUBLANES * 2, LANES), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((rows, 2 * LANES), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)

@@ -1,0 +1,20 @@
+"""BAD fixture — R4 callback gating.
+
+An UNCONDITIONAL pure_callback in a hot-path module (destination:
+fpga_ai_nic_tpu/ops/ or parallel/): every compiled step now serializes
+on a host round-trip whether or not anyone is looking at the metrics.
+The PR-4 contract is that obs taps are trace-time-gated (obs_metrics /
+chaos plan) so obs-off compiles to the identity.
+"""
+
+import jax
+
+
+def all_reduce_logged(x, axis_name):
+    def host(v):
+        return v
+
+    # no trace-time gate anywhere above this call
+    x = jax.pure_callback(host, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                          x)                                # R4
+    return jax.lax.psum(x, axis_name)

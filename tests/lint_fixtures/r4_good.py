@@ -1,0 +1,25 @@
+"""GOOD fixture — R4 callback gating.
+
+The same tap dominated by a trace-time config gate: obs off means the
+callback is never traced, so the hot step compiles clean (the
+obs.metrics compiled-out contract, asserted by the jaxpr sweep J1).
+"""
+
+import jax
+
+
+def all_reduce_logged(x, axis_name, obs_metrics: bool):
+    if obs_metrics:             # trace-time gate: False -> no callback
+        def host(v):
+            return v
+
+        x = jax.pure_callback(host,
+                              jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+    return jax.lax.psum(x, axis_name)
+
+
+def tapped(x, plan=None):
+    if plan is None:
+        return x                # early-return guard is a gate too
+    return jax.pure_callback(lambda v: v,
+                             jax.ShapeDtypeStruct(x.shape, x.dtype), x)

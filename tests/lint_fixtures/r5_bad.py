@@ -1,0 +1,26 @@
+"""BAD fixture — R5 artifact honesty.
+
+A bench writer banking its headline metric from fallback defaults: when
+every measurement fails, the artifact still reports a confident-looking
+0.0 — the multichip_bench "0.0 GB/s" class the round-1 advisor caught.
+Missing measurements must become explicit *_error fields.
+"""
+
+import json
+
+
+def bank(rows):
+    out = {"metric": "ring_bfp_gbps"}
+    out["value"] = max((r.get("gbps") for r in rows
+                        if "gbps" in r), default=0)         # R5
+    out["unit"] = "GB/s"
+    return out
+
+
+def bank_inline(rates):
+    return {"value": max(r.get("gbps", 0) for r in rates),  # R5
+            "unit": "GB/s"}
+
+
+def main(rows):
+    print(json.dumps(bank(rows)))

@@ -1,0 +1,28 @@
+"""GOOD fixture — R5 artifact honesty.
+
+The same writer with the committed convention: a headline only when a
+real measurement exists, an explicit error marker (and nonzero exit)
+when none does.
+"""
+
+import json
+import sys
+
+
+def bank(rows):
+    out = {"metric": "ring_bfp_gbps"}
+    measured = [r["gbps"] for r in rows if "gbps" in r]
+    if measured:
+        out["value"] = max(measured)
+        out["unit"] = "GB/s"
+    else:
+        out["error"] = next((r["error"] for r in rows if "error" in r),
+                            "no row produced gbps")
+    return out
+
+
+def main(rows):
+    out = bank(rows)
+    print(json.dumps(out))
+    if "error" in out:
+        sys.exit(1)

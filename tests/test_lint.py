@@ -1,0 +1,366 @@
+"""graftlint test battery.
+
+Three layers:
+
+1. Fixture corpus (`tests/lint_fixtures/`): every rule R1–R5 (plus the
+   R0 suppression hygiene rule) fires on its bad fixture and stays
+   silent on the good one, linted AT the package destination the
+   acceptance criterion names ("copied into the package").
+2. End-to-end: `tools/graftlint.py --ast` exits 0 on HEAD and nonzero
+   with any single bad fixture physically copied into the package.
+3. jaxpr sweep: the codec x trainer x obs grid is registry-driven
+   (a future codec is auto-covered), green on HEAD, and each invariant
+   checker (J1–J4) demonstrably detects a violation.
+"""
+
+import os
+import shutil
+import subprocess
+import sys
+
+import pytest
+
+from fpga_ai_nic_tpu.lint import default_targets, lint_paths, lint_source
+from fpga_ai_nic_tpu.lint.findings import AST_CODES, RULE_DOCS
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
+
+# where each fixture would land if copied into the package: R4 is scoped
+# to ops//parallel/, R5 to tools//bench writers, the rest fire anywhere
+DEST = {
+    "r0": "fpga_ai_nic_tpu",
+    "r1": "fpga_ai_nic_tpu/runtime",
+    "r2": "fpga_ai_nic_tpu",
+    "r3": "fpga_ai_nic_tpu/ops",
+    "r4": "fpga_ai_nic_tpu/parallel",
+    "r5": "tools",
+}
+EXPECT_CODE = {"r0": "R0", "r1": "R1", "r2": "R2", "r3": "R3",
+               "r4": "R4", "r5": "R5"}
+
+
+def _fixture(rule, kind):
+    with open(os.path.join(FIXTURES, f"{rule}_{kind}.py")) as fh:
+        return fh.read()
+
+
+def _live(findings):
+    return [f for f in findings if not f.suppressed]
+
+
+class TestFixtureCorpus:
+    @pytest.mark.parametrize("rule", sorted(DEST))
+    def test_bad_fixture_fires(self, rule):
+        dest = os.path.join(DEST[rule], f"zz_{rule}.py")
+        live = _live(lint_source(dest, _fixture(rule, "bad")))
+        codes = {f.code for f in live}
+        assert EXPECT_CODE[rule] in codes, (rule, live)
+        # the bad fixture must be bad for exactly the documented reason
+        # (plus R2 riders in the R0 fixture, whose hazards are unsuppressed)
+        allowed = {EXPECT_CODE[rule]} | ({"R2"} if rule == "r0" else set())
+        assert codes <= allowed, (rule, codes)
+
+    @pytest.mark.parametrize("rule", sorted(DEST))
+    def test_good_fixture_silent(self, rule):
+        dest = os.path.join(DEST[rule], f"zz_{rule}.py")
+        assert _live(lint_source(dest, _fixture(rule, "good"))) == [], rule
+
+    def test_every_ast_rule_has_both_fixtures(self):
+        # R0..R5 all covered; adding a rule without a corpus entry fails
+        assert set(EXPECT_CODE.values()) == set(AST_CODES)
+        for rule in DEST:
+            for kind in ("bad", "good"):
+                assert os.path.exists(
+                    os.path.join(FIXTURES, f"{rule}_{kind}.py")), (rule, kind)
+
+
+class TestSuppression:
+    SRC = ("import time\nimport jax\n\n"
+           "@jax.jit\n"
+           "def f(x):\n"
+           "    t = time.time(){}\n"
+           "    return x + t\n")
+
+    def test_reasoned_suppression_suppresses_but_reports(self):
+        fs = lint_source("fpga_ai_nic_tpu/zz.py", self.SRC.format(
+            "    # graftlint: disable=R2 -- deliberate trace stamp"))
+        assert _live(fs) == []
+        sup = [f for f in fs if f.suppressed]
+        assert len(sup) == 1 and sup[0].code == "R2"
+        assert "deliberate trace stamp" in sup[0].suppress_reason
+
+    def test_suppression_without_reason_is_an_error(self):
+        fs = lint_source("fpga_ai_nic_tpu/zz.py",
+                         self.SRC.format("    # graftlint: disable=R2"))
+        codes = {f.code for f in _live(fs)}
+        assert codes == {"R0", "R2"}   # reasonless disable suppresses nothing
+
+    def test_unknown_code_is_an_error(self):
+        fs = lint_source("fpga_ai_nic_tpu/zz.py", self.SRC.format(
+            "    # graftlint: disable=R7 -- misremembered code"))
+        assert "R0" in {f.code for f in _live(fs)}
+
+    def test_file_wide_disable(self):
+        src = ("# graftlint: disable-file=R2 -- probe tool stamps times\n"
+               + self.SRC.format(""))
+        assert _live(lint_source("fpga_ai_nic_tpu/zz.py", src)) == []
+
+    def test_wrong_code_does_not_suppress(self):
+        fs = lint_source("fpga_ai_nic_tpu/zz.py", self.SRC.format(
+            "    # graftlint: disable=R1 -- wrong rule entirely"))
+        assert "R2" in {f.code for f in _live(fs)}
+
+
+class TestReviewBlindSpots:
+    """Regression cases for holes the round's code review found."""
+
+    def test_r2_sees_through_dotted_and_aliased_imports(self):
+        # `import os.path` binds `os`; `import numpy.random as npr`
+        # binds the dotted module — both used to blind the hazard check
+        src = ("import os.path\n"
+               "import numpy.random as npr\n"
+               "import jax\n\n"
+               "@jax.jit\n"
+               "def step(x):\n"
+               "    if os.environ.get('SCALE'):\n"
+               "        x = x * 2\n"
+               "    return x + npr.standard_normal(3).sum()\n")
+        codes = [f.code for f in _live(lint_source("fpga_ai_nic_tpu/zz.py",
+                                                   src))]
+        assert codes and set(codes) == {"R2"} and len(codes) >= 2
+
+    def test_r4_nested_def_guard_is_not_a_gate(self):
+        src = ("import jax\n"
+               "def hot(x):\n"
+               "    def helper(y):\n"
+               "        if y is None:\n"
+               "            return None\n"
+               "        return y\n"
+               "    return jax.pure_callback(lambda v: v,\n"
+               "        jax.ShapeDtypeStruct(x.shape, x.dtype), x)\n")
+        fs = _live(lint_source("fpga_ai_nic_tpu/ops/zz.py", src))
+        assert [f.code for f in fs] == ["R4"]
+
+    def test_r1_collective_handle_restricted_to_collective_fields(self):
+        src = ("def f(self):\n"
+               "    self.profiler.collectives.recoveries += 1\n"
+               "    self.profiler.recovery.recoveries += 1\n")
+        fs = _live(lint_source("fpga_ai_nic_tpu/zz.py", src))
+        # only the recovery-handle mutation is a finding: 'recoveries'
+        # is not a CollectiveStats field
+        assert len(fs) == 1 and fs[0].code == "R1" and fs[0].line == 3
+
+
+class TestEmbeddedSources:
+    def test_embedded_child_script_is_linted(self):
+        src = ('CHILD_SRC = r"""\n'
+               "import json\n"
+               "rows = []\n"
+               'out = {}\n'
+               'out["value"] = max((r.get("gbps") for r in rows), default=0)\n'
+               "print(json.dumps(out))\n"
+               '"""\n'
+               "def run():\n"
+               "    return CHILD_SRC\n")
+        live = _live(lint_source("tools/zz.py", src))
+        assert [f.code for f in live] == ["R5"]
+        assert "embedded CHILD_SRC" in live[0].message
+        # line must point at the offending FILE line: the string opens on
+        # line 1 and the max(..., default=0) is embedded content line 5,
+        # i.e. file line 5 (off-by-one found by the round review)
+        assert live[0].line == 5, live[0]
+
+
+class TestTreeIsClean:
+    def test_default_targets_lint_green(self):
+        findings = _live(lint_paths(default_targets(REPO)))
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_default_targets_cover_the_stack(self):
+        targets = {os.path.relpath(p, REPO) for p in default_targets(REPO)}
+        for must in ("fpga_ai_nic_tpu/ops/ring.py",
+                     "fpga_ai_nic_tpu/parallel/train.py",
+                     "fpga_ai_nic_tpu/runtime/queue.py",
+                     "tools/multichip_bench.py", "bench_collective.py"):
+            assert must in targets, must
+
+
+def _run_graftlint(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "graftlint.py")]
+        + list(args), cwd=REPO, env=env, capture_output=True, text=True,
+        timeout=300)
+
+
+class TestMakeLintExitCodes:
+    def test_ast_plane_green_on_head(self):
+        proc = _run_graftlint("--ast")
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+
+    @pytest.mark.parametrize("rule", sorted(DEST))
+    def test_bad_fixture_copied_into_package_fails(self, rule):
+        dest_dir = os.path.join(REPO, DEST[rule])
+        dest = os.path.join(dest_dir, f"zz_graftlint_fixture_{rule}.py")
+        shutil.copyfile(os.path.join(FIXTURES, f"{rule}_bad.py"), dest)
+        try:
+            proc = _run_graftlint("--ast")
+            assert proc.returncode != 0, proc.stdout + proc.stderr
+            assert EXPECT_CODE[rule] + ":" in proc.stdout
+        finally:
+            os.remove(dest)
+
+
+# ---------------------------------------------------------------------------
+# plane 2 — jaxpr invariant sweep
+# ---------------------------------------------------------------------------
+
+class TestJaxprSweep:
+    def test_grid_covers_every_registered_codec(self):
+        from fpga_ai_nic_tpu.compress import available_codecs
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _TRAINERS, sweep_grid
+        grid = sweep_grid()
+        codecs = {c for c, _, _ in grid}
+        assert codecs == {None} | set(available_codecs())
+        trainers = {t for _, t, _ in grid}
+        assert trainers == set(_TRAINERS) == {
+            "DPTrainer", "FSDPTrainer", "QueuedDDPTrainer"}
+        for c in codecs:
+            for t in trainers:
+                assert {(c, t, False), (c, t, True)} <= set(grid)
+
+    def test_sweep_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_sweep
+        findings = run_sweep()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_unconstructible_codec_fails_loudly(self):
+        """A registered codec the sweep cannot build must surface as J6
+        findings, never a silent skip (the coverage criterion)."""
+        from fpga_ai_nic_tpu.compress import base as cbase
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_sweep, sweep_grid
+
+        class Broken:   # not even a Codec: get_codec() raises TypeError
+            name = "zz_broken_lint"
+
+            def __init__(self):
+                raise TypeError("deliberately unconstructible")
+
+        cbase._REGISTRY["zz_broken_lint"] = Broken
+        try:
+            assert any(c == "zz_broken_lint" for c, _, _ in sweep_grid())
+            findings = run_sweep()
+            j6 = [f for f in findings if f.code == "J6"
+                  and "zz_broken_lint" in f.path]
+            assert len(j6) == 6, findings   # 3 trainers x 2 obs, all loud
+        finally:
+            del cbase._REGISTRY["zz_broken_lint"]
+
+    # -- each invariant checker detects a violation -------------------------
+
+    def _dp_phases(self, codec="bfp", obs=False):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _trace_dp
+        from fpga_ai_nic_tpu.utils.config import (CollectiveConfig,
+                                                  MeshConfig, TrainConfig)
+        cfg = TrainConfig(mesh=MeshConfig(dp=8),
+                          collective=CollectiveConfig(impl="ring",
+                                                      codec=codec),
+                          global_batch=64, obs_metrics=obs)
+        return _trace_dp(cfg, "dp")
+
+    def test_j1_detects_ungated_callback(self):
+        import jax
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+
+        def leaky(x):
+            return jax.pure_callback(
+                lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+
+        jx = jax.make_jaxpr(jax.jit(leaky))(
+            jax.ShapeDtypeStruct((4,), "float32"))
+        fs = _check_cell("cell", "DPTrainer", None, False,
+                         [("step", jx, {})], None, 8, ("dp",))
+        assert [f.code for f in fs] == ["J1"]
+
+    def test_j1_detects_vanished_tap(self):
+        # obs=True with zero callbacks = the tap plumbing silently died
+        import jax
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+        jx = jax.make_jaxpr(lambda x: x + 1)(
+            jax.ShapeDtypeStruct((4,), "float32"))
+        fs = _check_cell("cell", "DPTrainer", None, True,
+                         [("step", jx, {})], None, 8, ("dp",))
+        assert [f.code for f in fs] == ["J1"]
+
+    def test_j2_detects_f64_leak(self):
+        import jax
+        import jax.numpy as jnp
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+        with jax.experimental.enable_x64():
+            jx = jax.make_jaxpr(
+                lambda x: x.astype(jnp.float64) * 2.0)(
+                jax.ShapeDtypeStruct((4,), "float32"))
+        fs = _check_cell("cell", "DPTrainer", None, False,
+                         [("step", jx, {})], None, 8, ("dp",))
+        assert "J2" in {f.code for f in fs}
+
+    def test_j3_detects_lost_donation(self):
+        import jax
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+        jx = jax.make_jaxpr(jax.jit(lambda s, b: s + b))(
+            jax.ShapeDtypeStruct((4,), "float32"),
+            jax.ShapeDtypeStruct((4,), "float32"))   # nothing donated
+        fs = _check_cell("cell", "DPTrainer", None, False,
+                         [("step", jx, {"n_donate": 1})], None, 8, ("dp",))
+        assert [f.code for f in fs] == ["J3"]
+
+    def test_j4_detects_wire_mismatch(self):
+        phases, L, n = self._dp_phases(codec="bfp")
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+        ok = _check_cell("cell", "DPTrainer", "bfp", False, phases, L, n,
+                         ("dp",))
+        assert ok == []
+        bad = _check_cell("cell", "DPTrainer", "bfp", False, phases,
+                          2 * L, n, ("dp",))   # declared bytes now double
+        assert [f.code for f in bad] == ["J4"]
+
+    def test_j4_cond_branches_are_not_summed(self):
+        """A ppermute under lax.cond runs in exactly ONE branch; summing
+        both branch jaxprs would double-count wire bytes (round-review
+        finding) — conditional collectives must surface as statically
+        unaccountable instead."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _collect
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+
+        def hop(x):
+            return jax.lax.ppermute(
+                x, "dp", [(i, (i + 1) % 8) for i in range(8)])
+
+        def step(pred, x):
+            return jax.lax.cond(pred, hop, hop, x)
+
+        jx = jax.make_jaxpr(jax.jit(jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P("dp")),
+            out_specs=P("dp"))))(
+            jax.ShapeDtypeStruct((), jnp.bool_),
+            jax.ShapeDtypeStruct((64,), jnp.float32))
+        c = _collect(jx.jaxpr)
+        assert c["wire_unknown"] and c["wire_bytes"] == 0, c
+
+    def test_j5_detects_foreign_axis(self):
+        phases, L, n = self._dp_phases(codec="bfp")
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _check_cell
+        fs = _check_cell("cell", "DPTrainer", "bfp", False, phases, L, n,
+                         mesh_axes=("tp",))    # step collects over 'dp'
+        assert "J5" in {f.code for f in fs}
+
+    def test_rule_docs_cover_all_codes(self):
+        from fpga_ai_nic_tpu.lint.findings import JAXPR_CODES
+        for code in AST_CODES + JAXPR_CODES:
+            assert code in RULE_DOCS

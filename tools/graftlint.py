@@ -1,0 +1,127 @@
+#!/usr/bin/env python
+"""graftlint CLI — the repo's static-analysis entry point (`make lint`).
+
+Planes (docs/LINT.md):
+  --ast     AST rules R1–R5 over the package/tools/bench tree (no jax
+            import; sub-second)
+  --jaxpr   jaxpr invariant sweep J1–J6: codec x trainer x obs grid traced
+            abstractly on the 8-device virtual CPU mesh (no TPU)
+  --ext     ruff + mypy on the strict core, when installed (skipped with a
+            notice otherwise — the container may not carry them)
+
+Default is all three.  Exit status: nonzero iff any unsuppressed finding
+(or external linter failure) is present.
+
+CPU-only by construction: the jaxpr plane must never wait on a TPU
+window, so the environment is pinned before jax ever loads.
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+
+# Pin the virtual CPU mesh BEFORE any jax import (same contract as
+# tests/conftest.py; the sweep needs exactly 8 host devices).  This runs
+# at module import, ahead of the fpga_ai_nic_tpu import below.
+_flags = re.sub(r"--xla_force_host_platform_device_count=\d+", "",
+                os.environ.get("XLA_FLAGS", ""))
+os.environ["XLA_FLAGS"] = (
+    _flags.strip() + " --xla_force_host_platform_device_count=8").strip()
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from fpga_ai_nic_tpu.lint import default_targets, lint_paths  # noqa: E402
+
+# the strict typed core for ruff (mypy reads its own scope from
+# pyproject [tool.mypy] files= — invoked bare so the two cannot drift)
+STRICT_CORE = ["fpga_ai_nic_tpu/compress", "fpga_ai_nic_tpu/obs",
+               "fpga_ai_nic_tpu/utils/config.py",
+               "fpga_ai_nic_tpu/runtime/queue.py"]
+
+
+def run_ast(paths) -> int:
+    findings = lint_paths(paths)
+    live = [f for f in findings if not f.suppressed]
+    for f in findings:
+        print(f.format())
+    n_sup = sum(f.suppressed for f in findings)
+    print(f"[graftlint:ast] {len(paths)} files, {len(live)} findings"
+          f" ({n_sup} suppressed)")
+    return 1 if live else 0
+
+
+def run_jaxpr() -> int:
+    from fpga_ai_nic_tpu.lint import jaxpr_sweep
+    findings = jaxpr_sweep.run_sweep(verbose=True)
+    for f in findings:
+        print(f.format())
+    print(f"[graftlint:jaxpr] {len(findings)} findings")
+    return 1 if findings else 0
+
+
+def run_ext() -> int:
+    """ruff (pycodestyle/pyflakes subset) + mypy on the strict core.
+    Both are OPTIONAL in this container: absence is a notice, not a
+    failure.  Diagnostics are ADVISORY by default and blocking under
+    GRAFTLINT_EXT_STRICT=1 — the strict core's annotation claim was
+    audited by AST, but mypy itself has never executed in this
+    container, and a first-ever mypy run must not be able to take CI
+    down inside a hard gate (round-review finding).  Flip CI to strict
+    after one green run with the tools installed."""
+    strict = os.environ.get("GRAFTLINT_EXT_STRICT") == "1"
+    rc = 0
+    # rule selection AND mypy's file scope live in pyproject
+    # ([tool.ruff.lint] / [tool.mypy] files=) — no CLI duplicates that
+    # would silently override or drift from the config
+    for tool, args in (("ruff", ["check"] + STRICT_CORE),
+                       ("mypy", [])):
+        try:
+            proc = subprocess.run([tool] + args, cwd=REPO)
+        except FileNotFoundError:
+            print(f"[graftlint:ext] {tool} not installed — skipped "
+                  "(install to tighten the gate; CI images carry it)")
+            continue
+        if proc.returncode != 0:
+            if strict:
+                print(f"[graftlint:ext] {tool} FAILED")
+                rc = 1
+            else:
+                print(f"[graftlint:ext] {tool} reported findings "
+                      "(advisory; set GRAFTLINT_EXT_STRICT=1 to gate)")
+        else:
+            print(f"[graftlint:ext] {tool} clean")
+    return rc
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--ast", action="store_true", help="AST plane only")
+    ap.add_argument("--jaxpr", action="store_true", help="jaxpr plane only")
+    ap.add_argument("--ext", action="store_true",
+                    help="external linters (ruff/mypy) only")
+    ap.add_argument("paths", nargs="*",
+                    help="explicit files for the AST plane (default: the "
+                         "package + tools + bench drivers + examples)")
+    args = ap.parse_args(argv)
+    planes = {p for p in ("ast", "jaxpr", "ext") if getattr(args, p)}
+    if not planes:
+        planes = {"ast", "jaxpr", "ext"}
+    rc = 0
+    if "ast" in planes:
+        paths = args.paths or default_targets(REPO)
+        rc |= run_ast(paths)
+    if "ext" in planes:
+        rc |= run_ext()
+    if "jaxpr" in planes:
+        rc |= run_jaxpr()
+    print("[graftlint] " + ("FAIL" if rc else "OK"))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
