@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench obs-gate lint lint-fixtures
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench obs-gate lint lint-fixtures
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -64,6 +64,18 @@ codec-bench:
 	@latest=$$(ls -t artifacts/codec_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest CODEC_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> CODEC_BENCH_$(ROUND).json"
+
+# fused decode+accumulate+optimizer vs ring-then-optimizer: per optimizer
+# kind, slope-timed fused step vs the two-pass baseline + the standalone
+# optimizer HBM roofline (bench_collective.fused_opt_child); snapshot the
+# newest artifact as the round's committed record, same contract as
+# `make codec-bench`.  obs-gate consumes the committed row
+# (tools/obs_gate.py FUSED_OPT_GATE_KEYS).
+fused-opt-bench:
+	python bench_collective.py --fused-optimizer
+	@latest=$$(ls -t artifacts/fused_opt_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest FUSED_OPT_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> FUSED_OPT_BENCH_$(ROUND).json"
 
 # multi-chip conversion kit: on any >= 2-real-chip surface this banks the
 # canary -> busbw (bf16 psum vs BFP rings) -> trace-attribution ladder

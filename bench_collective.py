@@ -615,6 +615,291 @@ def codec_matrix_main() -> None:
 
 
 # ---------------------------------------------------------------------------
+# fused-optimizer bench (`make fused-opt-bench`): fused
+# decode+accumulate+update vs ring-then-optimizer
+# ---------------------------------------------------------------------------
+
+FUSED_OPT_MB = 8                  # flat f32 vector size for the comparison
+FUSED_OPT_K = 8                   # slope-measurement chain length
+FUSED_OPT_KINDS = ("sgd", "momentum", "adamw")
+
+
+def fused_opt_child() -> None:
+    """Per optimizer kind, slope-time three data-dependent chains on the
+    dp mesh: the FUSED step (ring reduce-scatter with the update fused —
+    in-kernel on TPU, XLA-fused after the reduce elsewhere), the ring
+    ALONE, and the standalone optimizer pass ALONE.  The unfused baseline
+    is ring + optimizer (they are sequential passes by construction —
+    the sum is a LOWER bound on the two-dispatch schedule, so a fused win
+    against it is conservative).  The success metric of ROADMAP item 4:
+    fused_ms < ring_then_opt_ms by ~ the optimizer's standalone time,
+    i.e. the optimizer runs on zero exposed time.  On TPU the row also
+    carries the full per-stage loopback decomposition (ablate= incl. the
+    new "update" stage, ops.ring_cost fused_opt=True).  One JSON line on
+    stdout; merged/saved by the parent."""
+    t0 = time.time()
+
+    def phase(name):
+        log(f"phase={name} t={time.time() - t0:.1f}s")
+
+    phase("import")
+    import jax
+    enable_compile_cache(jax)
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fpga_ai_nic_tpu import optim
+    from fpga_ai_nic_tpu.ops import fused_update, ring_cost
+    from fpga_ai_nic_tpu.utils.config import (CollectiveConfig,
+                                              OptimizerConfig,
+                                              OptimizerSpec)
+
+    platform = jax.default_backend()
+    n_dev = jax.device_count()
+    on_tpu = is_tpu_platform(platform)
+    # fused_kernel=True so the TPU rung times the IN-KERNEL Pallas path
+    # (off TPU, reduce_scatter_update falls back to the separate-op ring
+    # + the XLA-fused shared formula — the dryrun arms)
+    coll = CollectiveConfig(impl="ring", codec="bfp", fused_kernel=True,
+                            fused_optimizer=True)
+    from fpga_ai_nic_tpu.compress import resolve
+    codec = resolve(coll)
+    L = FUSED_OPT_MB * (1 << 20) // 4
+    L -= L % (n_dev * codec.pad_elems * 128)
+    C = L // n_dev
+    mesh = Mesh(jax.devices(), ("dp",))
+
+    _scalar = jax.jit(lambda t: sum(
+        jnp.sum(l.astype(jnp.float32))
+        for l in jax.tree_util.tree_leaves(t)))
+
+    def sync(tree):
+        return float(_scalar(tree))
+
+    report = {
+        "metric": "fused_opt_bench",
+        "platform": platform,
+        "n_devices": n_dev,
+        "flat_mib": FUSED_OPT_MB,
+        "chunk_bytes": C * 4,
+        "codec": "bfp",
+        "method": (f"slope over K/2K data-dependent chained steps "
+                   f"(K={FUSED_OPT_K}) inside one dispatch per arm; "
+                   "ring_then_opt = ring-alone + optimizer-alone (a "
+                   "LOWER bound on the unfused two-pass schedule, so "
+                   "the fused win is conservative).  Off-TPU the fused "
+                   "update is the XLA-fused shared formula, not the "
+                   "Pallas in-kernel path — rates are dryrun-class "
+                   "floors, the schedule comparison is still honest"),
+        "rows": [],
+    }
+
+    rng = jax.random.PRNGKey(0)
+    x0 = jax.random.normal(rng, (L,), jnp.float32)
+
+    def shmap(fn, n_extra):
+        return jax.jit(jax.shard_map(
+            fn, mesh=mesh, in_specs=(P(),) + (P("dp"),) * n_extra,
+            out_specs=(P(),) + (P("dp"),) * n_extra, check_vma=False))
+
+    for kind in FUSED_OPT_KINDS:
+        phase(f"fused-opt {kind}")
+        spec = OptimizerSpec(kind=kind)
+        opt_cfg = OptimizerConfig(kind=kind, learning_rate=1e-3)
+        hyper = optim.fused_hyperparams(opt_cfg, jnp.zeros((), jnp.int32))
+        w0 = jnp.zeros((n_dev * C,), jnp.float32)
+        st0 = tuple(jnp.zeros((n_dev * C,), jnp.float32)
+                    for _ in spec.state_keys)
+        nst = spec.n_state
+
+        def mk_fused(k, _kind=kind, _spec=spec):
+            def body_fn(x, w, *st):
+                def body(i, carry):
+                    x, w, st = carry
+                    g, w2, st2 = fused_update.reduce_scatter_update(
+                        x, w, dict(zip(_spec.state_keys, st)),
+                        jnp.int32(0), "dp", coll, opt_cfg)
+                    # full data dependence: next input reads every
+                    # element of this step's outputs (no cross-iteration
+                    # overlap, no DCE)
+                    x = x + jnp.tile(g, n_dev) * 1e-30
+                    return x, w2, tuple(st2[k2]
+                                        for k2 in _spec.state_keys)
+                x, w, st = lax.fori_loop(0, k, body, (x, w, st))
+                return (x, w) + st
+            return shmap(body_fn, 1 + nst)
+
+        def mk_ring(k):
+            def body_fn(x):
+                def body(i, x):
+                    g = fused_update.reduce_scatter(x, "dp", coll)
+                    return x + jnp.tile(g, n_dev) * 1e-30
+                return (lax.fori_loop(0, k, body, x),)
+            return shmap(body_fn, 0)
+
+        def mk_opt(k, _spec=spec):
+            def body_fn(g, w, *st):
+                def body(i, carry):
+                    w, st = carry
+                    w2, st2 = optim.fused_apply_flat(
+                        _spec, w, g + w * 1e-30,
+                        dict(zip(_spec.state_keys, st)), hyper, n_dev)
+                    return w2, tuple(st2[k2] for k2 in _spec.state_keys)
+                w, st = lax.fori_loop(0, k, body, (w, st))
+                return (g, w) + st
+            # every operand is an owned [C] shard (the standalone ZeRO-1
+            # optimizer pass the fused kernel absorbs)
+            return jax.jit(jax.shard_map(
+                body_fn, mesh=mesh, in_specs=(P("dp"),) * (2 + nst),
+                out_specs=(P("dp"),) * (2 + nst), check_vma=False))
+
+        row = {"kind": kind}
+        row.update(ring_cost.optimizer_roofline(kind, C * 4))
+        try:
+            t_f, _ = slope_timeit(mk_fused, (x0, w0) + st0, FUSED_OPT_K,
+                                  sync)
+            t_r, _ = slope_timeit(mk_ring, (x0,), FUSED_OPT_K, sync)
+            g0 = jnp.zeros((n_dev * C,), jnp.float32)
+            t_o, _ = slope_timeit(mk_opt, (g0, w0) + st0, FUSED_OPT_K,
+                                  sync)
+        except Exception as e:  # noqa: BLE001 — best-effort cell
+            row["error"] = repr(e)[:300]
+            report["rows"].append(row)
+            continue
+        if t_f <= 0 or t_r <= 0 or t_o <= 0:
+            row["error"] = ("non-positive slope (noise swamped the "
+                            "chain-length difference); row invalid")
+            report["rows"].append(row)
+            continue
+        row["fused_ms"] = round(t_f * 1e3, 3)
+        row["ring_ms"] = round(t_r * 1e3, 3)
+        row["opt_standalone_ms"] = round(t_o * 1e3, 3)
+        row["ring_then_opt_ms"] = round((t_r + t_o) * 1e3, 3)
+        row["opt_exposed_ms"] = round((t_f - t_r) * 1e3, 3)
+        row["speedup_vs_ring_then_opt"] = round((t_r + t_o) / t_f, 3)
+        row["fused_wins"] = bool(t_f < t_r + t_o)
+        row["opt_fully_hidden"] = bool(t_f <= t_r * 1.05)
+        log(f"{kind}: fused {row['fused_ms']} ms vs ring+opt "
+            f"{row['ring_then_opt_ms']} ms (opt alone "
+            f"{row['opt_standalone_ms']} ms) -> "
+            f"speedup {row['speedup_vs_ring_then_opt']}")
+        report["rows"].append(row)
+
+    # TPU only: the per-stage loopback decomposition with the in-kernel
+    # update stage (ablate="update") — the Perfetto-level evidence that
+    # the update rides inside the ring schedule
+    if on_tpu:
+        phase("fused-opt loopback decomposition (TPU)")
+        try:
+            from bench_common import chain_kernel_calls
+            from fpga_ai_nic_tpu.ops import ring_pallas
+            vn = 8
+            rows = []
+            report["fused_opt_loopback"] = rows
+            for mib, slice_elems, streaming in ((4, 1 << 16, False),
+                                                (32, 1 << 16, True)):
+                Lb = mib * (1 << 20) // 4
+                Lb -= Lb % (vn * slice_elems)
+                xf = jax.random.normal(jax.random.PRNGKey(2), (Lb,),
+                                       jnp.float32)
+                hop_bytes = (vn - 1) * (Lb // vn) * 4
+
+                def measure(ablate, _x=xf, _se=slice_elems, _st=streaming):
+                    kw = {"slice_elems": _se, "streaming": _st,
+                          "opt_kind": "adamw"}
+                    if ablate:
+                        kw["ablate"] = ablate
+                    phase(f"fused-opt loopback {mib}MiB stage="
+                          f"{ablate or 'full'}")
+
+                    def mk(k):
+                        return chain_kernel_calls(
+                            lambda v: ring_pallas.loopback_update_microbench(
+                                v, vn, **kw), k)
+                    t_iter, _ = slope_timeit(mk, (_x,), 8, sync)
+                    return t_iter
+
+                rows.append(dict(
+                    mib=mib, streaming=streaming, opt_kind="adamw",
+                    **ring_cost.decompose(measure, streaming, hop_bytes,
+                                          fused_opt=True)))
+        except Exception as e:  # noqa: BLE001 — best-effort
+            report["fused_opt_loopback_error"] = repr(e)[:300]
+
+    phase("done")
+    if not on_tpu:
+        # rates on the 8-way-oversubscribed virtual CPU mesh carry run-
+        # to-run noise of the same order as the effect (measured: the
+        # IDENTICAL ring chain varied ~30% across kinds/runs), so the
+        # cpu rung banks code-path validation + exact byte accounting,
+        # never a timing verdict — same convention as the multichip
+        # dryrun artifacts
+        report["dryrun"] = True
+        report["dryrun_note"] = (
+            "cpu mesh rung: fused/ring/opt times are recorded for "
+            "inspection but are NOT gated and carry no win/loss claim "
+            "(oversubscription noise ~ the effect size); the schedule "
+            "verdict is a TPU measurement — run `make fused-opt-bench` "
+            "on a TPU surface for the gated row")
+        for row in report["rows"]:
+            row.pop("fused_wins", None)
+            row.pop("opt_fully_hidden", None)
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import obs_gate
+    gate_metrics = {}
+    gate_keys = (obs_gate.FUSED_OPT_BYTE_KEYS if report.get("dryrun")
+                 else obs_gate.FUSED_OPT_GATE_KEYS)
+    for row in report["rows"]:
+        for key in gate_keys:
+            # zero is a real value for the byte-accounting keys (sgd has
+            # no moment state) — only absence skips
+            if row.get(key) is not None:
+                gate_metrics[obs_gate.fused_opt_metric(row["kind"],
+                                                       key)] = row[key]
+    report["gate_summary"] = gate_metrics
+    print(json.dumps(report), flush=True)
+
+
+def fused_opt_main() -> None:
+    """Parent for `make fused-opt-bench`: same wedge-proof ladder as the
+    codec matrix — the deciding process never imports jax."""
+    from bench_common import probe_tpu
+    here = os.path.abspath(__file__)
+    attempts = [
+        {"name": "tpu", "cpu": False, "budget_s": 600.0,
+         "silence_s": 240.0},
+        {"name": "cpu_mesh", "cpu": True, "budget_s": 600.0,
+         "silence_s": 240.0},
+    ]
+    errors, result = [], None
+    for att in attempts:
+        if not att["cpu"] and not probe_tpu():
+            errors.append(f"{att['name']}: skipped, tunnel wedged at probe")
+            continue
+        env = cpu_env(8) if att["cpu"] else dict(os.environ)
+        try:
+            result = run_attempt(
+                att["name"],
+                [sys.executable, "-u", here, "--fused-optimizer-child"],
+                env=env, budget_s=att["budget_s"],
+                silence_s=att["silence_s"], cwd=os.path.dirname(here))
+            break
+        except Exception as e:  # noqa: BLE001 — one JSON line must happen
+            log(str(e))
+            errors.append(f"{att['name']}: {e}")
+    if result is None:
+        print(json.dumps({"metric": "fused_opt_bench",
+                          "error": "; ".join(errors)[:800]}), flush=True)
+        sys.exit(1)
+    if errors:
+        result["failed_attempts"] = errors
+    save_artifact("fused_opt_bench", result)
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
 # parent
 # ---------------------------------------------------------------------------
 
@@ -674,5 +959,9 @@ if __name__ == "__main__":
         codec_matrix_child()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--codec-matrix":
         codec_matrix_main()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--fused-optimizer-child":
+        fused_opt_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--fused-optimizer":
+        fused_opt_main()
     else:
         main()

@@ -31,7 +31,8 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
 def run(steps: int = 6, out_dir: str = "/tmp/obs_demo",
-        trace: bool = True, codec: str = "bfp") -> dict:
+        trace: bool = True, codec: str = "bfp",
+        fused_optimizer: bool = False) -> dict:
     import jax
     import jax.numpy as jnp
 
@@ -46,10 +47,16 @@ def run(steps: int = 6, out_dir: str = "/tmp/obs_demo",
     os.makedirs(out_dir, exist_ok=True)
     n = jax.device_count()
     mcfg = MLPConfig(layer_sizes=(64, 128, 128, 10), dtype="float32")
+    # fused_optimizer folds the update into the reduce-scatter (the
+    # optimizer then has no exposed span of its own on the timeline —
+    # the ROADMAP item-4 acceptance view); it is incompatible with the
+    # integrity gate, which needs the pre-step state the fused path
+    # donates, so the demo swaps one for the other
     cfg = TrainConfig(
         iters=steps, global_batch=16 * n, mesh=MeshConfig(dp=n),
         collective=CollectiveConfig(impl="ring", codec=codec,
-                                    integrity_check=True),
+                                    integrity_check=not fused_optimizer,
+                                    fused_optimizer=fused_optimizer),
         obs_metrics=True)
     trainer = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg),
                         make_mesh(cfg.mesh), cfg)
@@ -77,7 +84,10 @@ def run(steps: int = 6, out_dir: str = "/tmp/obs_demo",
                 t = queue.issue(state, batch,
                                 raw_bytes=wire["raw_bytes_per_allreduce"],
                                 wire_bytes=wire["wire_bytes_per_allreduce"])
-                state, metrics = queue.wait(t)
+                state, out = queue.wait(t)
+                # integrity-gated steps return a metrics dict; the fused-
+                # optimizer arm (no gate) returns the bare loss
+                metrics = out if isinstance(out, dict) else {"loss": out}
                 jax.block_until_ready(metrics["loss"])
         return metrics            # k=0 (steps=1): warmup's metrics stand
 
@@ -111,6 +121,7 @@ def run(steps: int = 6, out_dir: str = "/tmp/obs_demo",
 
     summary = {"profiler": profiler.report(), "metrics": sink.as_dict(),
                "final_loss": float(metrics["loss"]),
+               "fused_optimizer": fused_optimizer,
                "timeline": tl["otherData"]}
     with open(os.path.join(out_dir, "summary.json"), "w") as f:
         json.dump(summary, f, indent=1)
@@ -136,9 +147,12 @@ def main(argv):
             kw["codec"] = v or None
         elif k == "trace":
             kw["trace"] = v.lower() in ("1", "true", "yes", "on")
+        elif k == "fused":
+            kw["fused_optimizer"] = v.lower() in ("1", "true", "yes", "on")
         else:
             raise SystemExit(f"unknown flag {a!r} "
-                             "(--steps= --out= --codec= --trace=)")
+                             "(--steps= --out= --codec= --trace= "
+                             "--fused=)")
     run(**kw)
     return 0
 

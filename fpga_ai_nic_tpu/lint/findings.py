@@ -24,10 +24,12 @@ RULE_DOCS: Dict[str, str] = {
           "bytes",
     "J5": "jaxpr: every collective axis name must exist on the mesh",
     "J6": "jaxpr sweep coverage: every registered codec must be swept",
+    "J7": "per-replica gradient must be invariant to n_dp on a fixed "
+          "batch (no collective on a loss head's gradient path)",
 }
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5")
-JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6")
+JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7")
 
 
 @dataclass(frozen=True)

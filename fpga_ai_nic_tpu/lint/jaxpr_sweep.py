@@ -310,6 +310,157 @@ def _check_cell(cell: str, trainer: str, codec_name: Optional[str],
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J7 — per-replica gradient invariant to n_dp (the psum-transpose
+# gradient-scale class: docs/KNOWN_FAILURES.md #1-16, all root-caused to
+# collectives sitting on a loss head's gradient path, whose transpose
+# convention moved between jaxlibs and silently scaled every update by
+# the axis size).  Unlike J1-J6 this rule evaluates tiny CONCRETE
+# gradients (a jaxpr alone cannot prove a value-level invariant): a fixed
+# global batch with UNEVENLY masked labels is sharded over n_dp in
+# {2, 4}; the trainer-effective update (psum/n of the per-replica grads)
+# must match the single-device gradient of the same objective — and each
+# other — to f32 tolerance.  An n_dp-proportional mismatch is exactly
+# the 8x-learning-rate bug class.
+# ---------------------------------------------------------------------------
+
+_J7_NDPS = (2, 4)
+_J7_RTOL = 2e-3
+
+
+def _j7_bert_build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import bert
+
+    cfg = bert.BertConfig(vocab=64, dim=32, n_layers=1, n_heads=2,
+                          ffn_dim=64, max_pos=16, dtype="float32",
+                          attn_impl="xla")
+    params = bert.init(jax.random.PRNGKey(0), cfg)
+    r = np.random.default_rng(0)
+    tokens = jnp.asarray(r.integers(1, 64, (8, 8)).astype(np.int32))
+    labels = np.asarray(r.integers(0, 64, (8, 8)), np.int32)
+    # uneven masking: shard token counts differ, so uniform-mean vs
+    # token-weighted gradients genuinely disagree (the correction term
+    # carries weight)
+    labels[:4, :6] = -100
+    labels[4:, :2] = -100
+
+    def loss(p, batch, dp_axis):
+        return bert.loss_fn(p, batch, cfg, dp_axis=dp_axis)
+
+    return params, (tokens, jnp.asarray(labels)), loss
+
+
+def _j7_llama_build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from ..models import llama
+
+    cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1, n_heads=2,
+                                 n_kv_heads=1, ffn_dim=64)
+    params = llama.init(jax.random.PRNGKey(0), cfg)
+    r = np.random.default_rng(1)
+    tokens = jnp.asarray(r.integers(1, 64, (8, 8)).astype(np.int32))
+    labels = np.asarray(r.integers(0, 64, (8, 8)), np.int32)
+    labels[:4, :6] = -100
+    labels[4:, :2] = -100
+
+    def loss(p, batch, dp_axis):
+        return llama.loss_fn(p, batch, cfg, dp_axis=dp_axis)
+
+    return params, (tokens, jnp.asarray(labels)), loss
+
+
+def j7_surfaces() -> List[Tuple[str, Callable]]:
+    """The dp-axis-correcting loss heads under guard.  The
+    GRAFTLINT_J7_FIXTURE env var appends a surface from a module path
+    exposing ``build()`` — the bad-fixture / exit-code hook
+    (tests/test_lint.py)."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("models.bert.loss_fn", _j7_bert_build),
+        ("models.llama.loss_fn", _j7_llama_build),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J7_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j7_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def check_grad_scale(name: str, build: Callable,
+                     ndps: Tuple[int, ...] = _J7_NDPS,
+                     rtol: float = _J7_RTOL) -> List[Finding]:
+    """Evaluate one J7 surface: trainer-effective gradient at each n_dp
+    vs the single-device gradient of the identical objective."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import lax
+
+    findings: List[Finding] = []
+    params, batch, loss = build()
+    ref = jax.jit(jax.grad(lambda p: loss(p, batch, None)))(params)
+    ref_flat = np.concatenate([np.asarray(l, np.float32).ravel()
+                               for l in jax.tree_util.tree_leaves(ref)])
+    scale = float(np.abs(ref_flat).max()) or 1.0
+    for ndp in ndps:
+        mesh = Mesh(np.array(jax.devices()[:ndp]), ("dp",))
+
+        def shard(p, b):
+            p = jax.tree_util.tree_map(
+                lambda x: lax.pcast(x, "dp", to="varying"), p)
+            g = jax.grad(lambda pp: loss(pp, b, "dp"))(p)
+            # the trainer-effective update: sum over replicas / n_dp
+            return jax.tree_util.tree_map(
+                lambda x: lax.psum(x, "dp") / ndp, g)
+
+        got = jax.jit(jax.shard_map(
+            shard, mesh=mesh, in_specs=(P(), P("dp")), out_specs=P(),
+            check_vma=False))(params, batch)
+        got_flat = np.concatenate([
+            np.asarray(l, np.float32).ravel()
+            for l in jax.tree_util.tree_leaves(got)])
+        err = float(np.abs(got_flat - ref_flat).max()) / scale
+        if not np.isfinite(err) or err > rtol:
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ratio = float(np.nanmedian(got_flat / ref_flat))
+            findings.append(Finding(
+                "J7", f"jaxpr[grad-scale {name}]", 0,
+                f"per-replica gradient is NOT invariant to n_dp: at "
+                f"n_dp={ndp} the trainer-effective update deviates from "
+                f"the single-device gradient by rel {err:.3g} (median "
+                f"elementwise ratio {ratio:.3g}; a ratio ~= n_dp is the "
+                f"psum-transpose gradient-scale class — keep collectives "
+                f"off the loss head's gradient path)"))
+    return findings
+
+
+def run_j7(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j7_surfaces():
+        try:
+            fs = check_grad_scale(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J7", f"jaxpr[grad-scale {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] grad-scale {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -320,6 +471,43 @@ def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
             for obs in (False, True):
                 cells.append((codec, trainer, obs))
     return cells
+
+
+# fused-optimizer donation cells (the acceptance gate of the fused-ring
+# issue: moments + master params must stay donated on the fused
+# TrainState/FSDPState) — a focused extra sweep rather than a fourth grid
+# axis, so the grid's public (codec, trainer, obs) triple shape is stable
+_FUSED_OPT_CELLS = ((None, "DPTrainer"), ("bfp", "DPTrainer"),
+                    ("topk", "DPTrainer"), (None, "FSDPTrainer"),
+                    ("bfp", "FSDPTrainer"))
+
+
+def run_fused_opt_cells(verbose: bool = False) -> List[Finding]:
+    from ..utils.config import (CollectiveConfig, MeshConfig,
+                                OptimizerConfig, TrainConfig)
+    findings: List[Finding] = []
+    for codec_name, trainer in _FUSED_OPT_CELLS:
+        cell = f"jaxpr[fused-opt {codec_name or 'none'} x {trainer}]"
+        trace_fn, axis = _TRAINERS[trainer]
+        try:
+            cfg = TrainConfig(
+                mesh=MeshConfig(**{axis: _NDEV}),
+                collective=CollectiveConfig(impl="ring", codec=codec_name,
+                                            fused_optimizer=True),
+                optimizer=OptimizerConfig(kind="adamw"),
+                global_batch=_BATCH, obs_metrics=False)
+            phases, L, n = trace_fn(cfg, axis)
+            cell_findings = _check_cell(cell, trainer, codec_name, False,
+                                        phases, L, n, mesh_axes=(axis,))
+        except Exception as e:  # noqa: BLE001 — a cell must fail LOUDLY
+            cell_findings = [Finding(
+                "J6", cell, 0, f"cell failed to trace: {type(e).__name__}: "
+                f"{str(e)[:300]}")]
+        findings.extend(cell_findings)
+        if verbose:
+            status = "FAIL" if cell_findings else "ok"
+            print(f"[graftlint:jaxpr] {cell}: {status}")
+    return findings
 
 
 def run_sweep(verbose: bool = False) -> List[Finding]:
@@ -364,4 +552,6 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
             "J6", "jaxpr[coverage]", 0,
             f"codec(s) registered after the grid snapshot, never swept: "
             f"{sorted(missing)} — re-run the sweep"))
+    findings.extend(run_fused_opt_cells(verbose=verbose))
+    findings.extend(run_j7(verbose=verbose))
     return findings

@@ -176,11 +176,22 @@ def loss_fn(params: Dict, batch, cfg: BertConfig, *,
     if dp_axis is None:
         return local_sum / jnp.maximum(count, 1)
     total = lax.psum(local_sum, dp_axis)
-    denom = jnp.maximum(lax.psum(count, dp_axis), 1).astype(jnp.float32)
+    denom = lax.stop_gradient(
+        jnp.maximum(lax.psum(count, dp_axis), 1).astype(jnp.float32))
     loss = total / denom
     n_dp = lax.axis_size(dp_axis)
+    # Gradient path rides the LOCAL sum only: the per-replica gradient is
+    # n_dp * d(local_sum)/denom by construction, so a trainer's uniform
+    # sum/n_dp recovers the exact global token-weighted gradient — and no
+    # collective sits on the gradient path, so the result cannot depend on
+    # which psum-transpose convention (identity vs psum) the jaxlib uses.
+    # The previous formulation differentiated through psum(local_sum) and
+    # inherited exactly that convention: on jaxlibs whose transpose is a
+    # psum, every replica's gradient came out n_dp x the reference (the
+    # 8x-learning-rate bug of docs/KNOWN_FAILURES.md #1-2), frozen as
+    # graftlint rule J7.
     return lax.stop_gradient(loss) + (
-        n_dp * (total - lax.stop_gradient(total)) / denom)
+        n_dp * (local_sum - lax.stop_gradient(local_sum)) / denom)
 
 
 def num_params(cfg: BertConfig) -> int:

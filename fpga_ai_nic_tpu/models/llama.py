@@ -417,16 +417,23 @@ def _weighted_loss(local_sum: jax.Array, count: jax.Array,
     """Token-weighted global mean over the token-sharding axes (sp/dp/ep).
     With dp_axis, the gradient carries an n_dp factor that cancels the
     trainer's uniform /n_dp average so the effective update is the true
-    global-mean gradient (see loss_fn docstring)."""
+    global-mean gradient (see loss_fn docstring).
+
+    The loss VALUE is the psum'd global mean, but the gradient path rides
+    the LOCAL sum only: per-replica gradient = scale * d(local_sum)/denom
+    with no collective on the gradient path, so the result is invariant to
+    the jaxlib's psum-transpose convention (the n_dp-scaled-gradient class
+    of docs/KNOWN_FAILURES.md #1-4, frozen as graftlint rule J7)."""
     axes = tuple(a for a in batch_axes if a is not None)
     if not axes:
         return local_sum / jnp.maximum(count, 1)
     total = lax.psum(local_sum, axes)
-    denom = jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32)
-    loss = total / denom
-    if dp_axis is not None:
-        loss = _grad_scale(loss, lax.axis_size(dp_axis))
-    return loss
+    denom = lax.stop_gradient(
+        jnp.maximum(lax.psum(count, axes), 1).astype(jnp.float32))
+    loss = lax.stop_gradient(total / denom)
+    scale = lax.axis_size(dp_axis) if dp_axis is not None else 1
+    return loss + scale * (local_sum
+                           - lax.stop_gradient(local_sum)) / denom
 
 
 def loss_fn(params: Dict, batch, cfg: LlamaConfig, *,
@@ -565,7 +572,9 @@ def loss_fn_pp(params: Dict, batch, cfg: LlamaConfig, *,
         # gradient by the axis size.
         aux = lax.pmean(aux, batch_axes)
     nll = jnp.where(valid, _token_nll(logits, safe, tp_axis), 0.0)
-    local_sum = pl.from_last_stage(jnp.sum(nll), pp_axis)
+    # local-grad variant: this loss is differentiated INSIDE shard_map, so
+    # the last-stage mask must not put a psum on the gradient path (J7)
+    local_sum = pl.from_last_stage_local_grad(jnp.sum(nll), pp_axis)
     # ep shards the batch alongside dp (ShardedTrainer._bspec), so the
     # token-weighted reduction must span it too — matching loss_fn
     loss = _weighted_loss(local_sum, jnp.sum(valid),

@@ -93,6 +93,38 @@ def flatten_tree(tree, coll: CollectiveConfig, n: int) -> Tuple[jax.Array, FlatM
     return flat, meta
 
 
+def repad_flat(v, meta: FlatMeta) -> jax.Array:
+    """Re-fit a saved flat master/optimizer vector to THIS layout's
+    padded length.  The padding multiple depends on the collective's
+    device count (``pad_multiple(coll, n)``), so a checkpoint written on
+    one mesh shape carries a different tail padding than the mesh it
+    restores onto (dp8 -> dp4 after a preemption, or a codec change);
+    the LIVE elements (``sum(meta.sizes)``) are mesh-invariant, and every
+    pad element is zero by construction (flatten_tree zero-pads, and the
+    optimizers keep zero-gradient pad lanes at zero), so the re-fit is
+    value-exact.  A vector with fewer than the live elements is a
+    different model's checkpoint — loud error, never a truncation."""
+    v = jnp.asarray(v)
+    total = sum(meta.sizes)
+    if v.shape[0] < total:
+        raise ValueError(
+            f"flat state of length {v.shape[0]} cannot hold this "
+            f"layout's {total} live elements — wrong checkpoint/model")
+    if v.shape[0] == meta.padded_len:
+        return v
+    # the stripped tail must be the zero padding — a NONZERO tail means
+    # the vector belongs to a different model/layout whose live elements
+    # extend past this layout's, and stripping it would silently corrupt
+    # the restore (eager-only check: restore paths run outside jit)
+    tail = v[total:]
+    if tail.size and float(jnp.abs(tail).max()) != 0.0:
+        raise ValueError(
+            f"flat state of length {v.shape[0]} carries nonzero data "
+            f"past this layout's {total} live elements — wrong "
+            "checkpoint/model (refusing to truncate)")
+    return jnp.pad(v[:total], (0, meta.padded_len - total))
+
+
 def unflatten_tree(flat: jax.Array, meta: FlatMeta):
     leaves, off = [], 0
     for shape, dtype, size in zip(meta.shapes, meta.dtypes, meta.sizes):
@@ -192,6 +224,48 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
                                         compression=codec,
                                         slice_elems=coll.slice_elems,
                                         unroll=coll.unroll_hops)
+
+
+def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
+                          step, axis_name: str, coll: CollectiveConfig,
+                          opt_cfg: OptimizerConfig):
+    """Fused gradient reduce + ZeRO-1 optimizer update: the reference's
+    whole point (decode feeds hw/weight_update.sv, no separate optimizer
+    pass over HBM) + cross-replica weight-update sharding (ZeRO-1).
+
+    Routing (one definition so trainers cannot drift):
+      - fused_kernel on TPU: the in-kernel path —
+        ops.ring_pallas.ring_reduce_scatter_update_fused updates the
+        owned shard as each final-hop slice decodes, inside the depth-D
+        pipeline; w/state shards are donated kernel operands.
+      - everything else (xla psum_scatter, separate-op ring with any
+        codec, the off-TPU fallback, n == 1): the identical update
+        formula (optim.fused_apply_flat) fused into the step right after
+        the reduce — same hyper vector, same golden twin, so the
+        numerics contract is uniform across routes.
+
+    Returns ``(g_own_sum, w_new, opt_state_new)``; g_own_sum is the raw
+    reduced SUM shard (callers /n for metrics), bit-identical to
+    ``reduce_scatter`` on the same route."""
+    from ..utils.config import OptimizerSpec
+    spec = OptimizerSpec.from_optimizer(opt_cfg)
+    n = lax.axis_size(axis_name)
+    hyper = optim.fused_hyperparams(opt_cfg, step)
+    if coll.fused_kernel and n > 1:
+        from . import ring_pallas
+        if ring_pallas._is_tpu():
+            bcfg = _fused_bfp_cfg(coll)
+            slice_e = ring_pallas.pick_slice_elems(
+                flat_g.shape[0] // n, coll.slice_elems, bcfg.block_size)
+            return ring_pallas.ring_reduce_scatter_update_fused(
+                flat_g, w_own, opt_state, hyper, axis_name,
+                opt_kind=spec.kind, compression=bcfg, slice_elems=slice_e)
+        # off-TPU: reduce_scatter itself warns and routes to the
+        # separate-op ring; the update below stays the shared formula
+    g_own = reduce_scatter(flat_g, axis_name, coll)
+    w_new, st2 = optim.fused_apply_flat(spec, w_own, g_own, opt_state,
+                                        hyper, n)
+    return g_own, w_new, st2
 
 
 def all_gather_flat(owned: jax.Array, axis_name: str,

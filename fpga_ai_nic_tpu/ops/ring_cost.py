@@ -41,17 +41,58 @@ from __future__ import annotations
 from typing import Mapping, Optional, Sequence
 
 # stage names understood by ring_pallas's ablate= (skeleton = bare
-# schedule: loop + slot bookkeeping, no stage work)
+# schedule: loop + slot bookkeeping, no stage work; update = the fused
+# in-kernel optimizer stage, fused-opt kernels only)
 STAGES_RESIDENT = ("skeleton", "encode", "rdma", "decode")
 STAGES_STREAMING = ("skeleton", "encode", "rdma", "decode", "hbm")
 
+# per-optimizer state-tensor count (w excluded) and rough update FLOPs
+# per element — the static half of the fused-optimizer stage accounting
+# (the measured half is ablate="update")
+OPT_N_STATE = {"sgd": 0, "momentum": 1, "adamw": 2}
+OPT_FLOPS_PER_ELEM = {"sgd": 4, "momentum": 6, "adamw": 14}
 
-def stages_for(streaming: bool) -> Sequence[str]:
-    return STAGES_STREAMING if streaming else STAGES_RESIDENT
+
+def stages_for(streaming: bool, fused_opt: bool = False) -> Sequence[str]:
+    base = STAGES_STREAMING if streaming else STAGES_RESIDENT
+    return base + ("update",) if fused_opt else base
+
+
+def optimizer_roofline(opt_kind: str, chunk_bytes: int,
+                       hbm_gbps: float = 0.0) -> dict:
+    """Static accounting of the STANDALONE (unfused) ZeRO-1 optimizer
+    pass the fused kernel absorbs: per step and replica it reads the
+    reduced gradient shard + master shard and writes the master, plus a
+    read+write of every moment-state shard — all over HBM, with nothing
+    to overlap against.  That byte count / the HBM rate is the minimum
+    exposed time `bench_collective --fused-optimizer` expects the fused
+    path to win back (the success metric of ROADMAP item 4).
+
+    chunk_bytes: the owned f32 shard (L/n * 4).  hbm_gbps <= 0 omits the
+    time estimate (bytes are still exact)."""
+    ns = OPT_N_STATE[opt_kind]
+    # read g_own + read w + write w + (read + write) per moment tensor
+    traffic = chunk_bytes * (3 + 2 * ns)
+    out = {
+        "opt_kind": opt_kind,
+        "n_state_tensors": ns,
+        "moment_state_bytes": chunk_bytes * ns,
+        "standalone_hbm_bytes": traffic,
+        "update_flops_per_elem": OPT_FLOPS_PER_ELEM[opt_kind],
+        "model": ("standalone optimizer pass = (3 + 2*n_state) * "
+                  "chunk_bytes over HBM (read g_own, read+write w, "
+                  "read+write each moment); the fused kernel folds this "
+                  "into the final-hop decodes where the remaining ring "
+                  "hops hide it"),
+    }
+    if hbm_gbps and hbm_gbps > 0:
+        out["standalone_roofline_s"] = traffic / (hbm_gbps * 1e9)
+    return out
 
 
 def model_pipeline(stage_s: Mapping[str, float],
-                   full_s: Optional[float] = None) -> dict:
+                   full_s: Optional[float] = None,
+                   expect_update: bool = False) -> dict:
     """Combine per-stage schedule times (seconds) into the predicted
     pipeline time.
 
@@ -78,18 +119,28 @@ def model_pipeline(stage_s: Mapping[str, float],
         return float(t) if t is not None and t > 0 else None
 
     skel, enc, dec = get("skeleton"), get("encode"), get("decode")
+    upd = get("update")
+    # the fused-optimizer update shares the VPU instruction stream with
+    # encode/decode, so its schedule time ADDS to the serial VPU term
+    # (same reasoning as encode+decode; its state-slice DMAs ride along
+    # inside the measured stage).  expect_update marks a fused-opt
+    # schedule whose update slope drowned — the model is then partial.
+    vpu_parts = [p for p in (enc, dec, upd) if p is not None]
+    n_expected = 3 if expect_update else 2
     terms = {}
     vpu_partial = False
-    if enc is not None and dec is not None:
+    if len(vpu_parts) == n_expected:
         # each ablated run includes the skeleton once; the serial VPU sum
-        # must count it once, not twice
-        terms["vpu"] = enc + dec - (skel or 0.0)
-    elif enc is not None or dec is not None:
-        # half the VPU cost is unmeasured: keep the term as a FLOOR for
-        # the display, but the model is not valid — a confident
-        # modeled_t_ms from half the serial chain would be exactly the
-        # fabricated-rate failure this module exists to prevent
-        terms["vpu"] = enc if enc is not None else dec
+        # must count it once, not n_expected times
+        terms["vpu"] = sum(vpu_parts) - (len(vpu_parts) - 1) * (skel or 0.0)
+    elif vpu_parts:
+        # part of the VPU cost is unmeasured: keep the MEASURED serial
+        # sum (skeleton counted once) as a FLOOR for the display — the
+        # tightest bound the surviving slopes support — but the model is
+        # not valid: a confident modeled_t_ms from part of the serial
+        # chain would be exactly the fabricated-rate failure this module
+        # exists to prevent
+        terms["vpu"] = sum(vpu_parts) - (len(vpu_parts) - 1) * (skel or 0.0)
         vpu_partial = True
     rdma, hbm = get("rdma"), get("hbm")
     if rdma is not None:
@@ -225,17 +276,19 @@ def codec_table(n_elems: int = 1 << 16) -> list:
     return rows
 
 
-def decompose(measure, streaming: bool, payload_bytes: int) -> dict:
+def decompose(measure, streaming: bool, payload_bytes: int,
+              fused_opt: bool = False) -> dict:
     """Run the full per-stage decomposition of one loopback row.
 
     measure(ablate_or_None) -> seconds (slope-based; <= 0 means the
     measurement drowned in noise and is dropped).  Returns the
     model_pipeline dict extended with per-stage {t_ms, gbps} rows ready
     for the artifact, or {"valid": False, ...} when the full-pipeline
-    measurement itself failed."""
+    measurement itself failed.  fused_opt adds the "update" stage (the
+    in-kernel optimizer) to the sweep and to the serial-VPU term."""
     full_s = measure(None)
     stage_s, stage_errors = {}, {}
-    for name in stages_for(streaming):
+    for name in stages_for(streaming, fused_opt):
         # a stage variant that crashes (fresh compile path on a scarce
         # tunnel window) must not cost the already-measured full rate —
         # partial evidence is evidence
@@ -246,7 +299,8 @@ def decompose(measure, streaming: bool, payload_bytes: int) -> dict:
             continue
         if t is not None and t > 0:
             stage_s[name] = t
-    out = model_pipeline(stage_s, full_s if full_s and full_s > 0 else None)
+    out = model_pipeline(stage_s, full_s if full_s and full_s > 0 else None,
+                         expect_update=fused_opt)
     out["stages"] = {
         k: {"t_ms": round(v * 1e3, 3),
             "gbps": round(payload_bytes / v / 1e9, 2)}

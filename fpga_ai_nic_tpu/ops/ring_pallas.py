@@ -50,7 +50,7 @@ payload size; both are bit-identical.
 from __future__ import annotations
 
 import functools
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -61,7 +61,8 @@ from jax.experimental.pallas import tpu as pltpu
 from .. import compat
 
 from .bfp_pallas import LANES, _is_tpu
-from ..utils.config import BFPConfig
+from .. import optim as _optim
+from ..utils.config import BFPConfig, OptimizerSpec
 
 
 def _encode_rows(x, block_size: int, mantissa_bits: int, rounding: str):
@@ -245,12 +246,30 @@ def _rs_offsets(ids, n: int, S: int, slice_rows: int):
     return jnp.stack([send, recv]).astype(jnp.int32)
 
 
-def _rs_kernel(ids_ref, sched_ref, x_ref, out_ref, acc, send_pkt, recv_pkt,
-               send_sem, recv_sem, credit_sem, *, n: int, n_slices: int,
+def _rs_parse_refs(opt_kind: Optional[str], refs):
+    """Split a fused-opt (or plain) RS kernel's positional refs into the
+    named slots shared by both kernels: pallas passes inputs, then
+    outputs, then scratch, and the fused variants add (hyper, w, *state)
+    inputs and (w_new, *state_new) outputs.  Returns
+    (hyper, x, w, st_in, out, w_out, st_out, *scratch6)."""
+    if opt_kind is None:
+        x_ref, out_ref = refs[0], refs[1]
+        return (None, x_ref, None, (), out_ref, None, ()) + tuple(refs[2:])
+    ns = OptimizerSpec(kind=opt_kind).n_state
+    hyper_ref, x_ref, w_ref = refs[:3]
+    st_in = tuple(refs[3:3 + ns])
+    out_ref, w_out = refs[3 + ns], refs[4 + ns]
+    st_out = tuple(refs[5 + ns:5 + 2 * ns])
+    return (hyper_ref, x_ref, w_ref, st_in, out_ref, w_out,
+            st_out) + tuple(refs[5 + 2 * ns:])
+
+
+def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                slice_rows: int, block_size: int, mantissa_bits: int,
                rounding: str, flow_control: bool, unrolled: bool,
                depth: int, n_slots: int, launch_first: bool,
-               ablate: Optional[str] = None):
+               ablate: Optional[str] = None,
+               opt_kind: Optional[str] = None):
     """The whole sliced ring reduce-scatter, one kernel invocation, as a
     depth-D pipeline: encode(g+D), RDMA(g+D-1 .. g+1), and
     decode+accumulate(g) proceed concurrently over an (D+1)-slot comm
@@ -281,11 +300,33 @@ def _rs_kernel(ids_ref, sched_ref, x_ref, out_ref, acc, send_pkt, recv_pkt,
     hw/all_reduce.sv:94-97).  Ablated outputs are garbage by design:
     "rdma" sends whatever is in the frames, "decode" decodes stale
     frames — timing is data-independent on the VPU/DMA so rates are
-    unaffected.  Loopback/bench use only; never a collective."""
-    assert ablate in (None, "encode", "rdma", "decode", "skeleton"), ablate
+    unaffected.  Loopback/bench use only; never a collective.
+
+    opt_kind (STATIC): None runs the plain reduce-scatter; "sgd" /
+    "momentum" / "adamw" fuse the ZeRO-1 optimizer update into the
+    final-hop decode — the reference's weight_update.sv sitting inside
+    the decode datapath (SURVEY.md §3.2), generalized to pluggable
+    formulas.  The refs then grow (hyper SMEM f32[HYPER_LEN], w shard,
+    state shards) on the input side and (w_new, state_new) outputs
+    aliased onto the shards; each owned sub-slice chunk updates in the
+    same block-aligned `_sub_rows` pieces its decode retires, while the
+    ring's remaining hops are still in flight.  The GRADIENT path (acc,
+    out_ref) is bit-identical to the unfused kernel at every depth (same
+    slices, same add order); the update formula is
+    optim.fused_apply_blocks, bit-specified by optim.golden_fused_apply.
+    ablate gains "update": ONLY the update stage of the same schedule
+    (its VPU cost + nothing else), for ring_cost's fused-opt term."""
+    assert ablate in (None, "encode", "rdma", "decode", "skeleton",
+                      "update"), ablate
+    assert ablate != "update" or opt_kind is not None, \
+        "ablate='update' needs a fused optimizer"
     do_enc = ablate in (None, "encode")
     do_rdma = ablate in (None, "rdma")
     do_dec = ablate in (None, "decode")
+    do_upd = opt_kind is not None and ablate in (None, "update")
+    refs = _rs_parse_refs(opt_kind, refs)
+    (hyper_ref, x_ref, w_ref, st_in, out_ref, w_out, st_out, acc,
+     send_pkt, recv_pkt, send_sem, recv_sem, credit_sem) = refs
     idx = ids_ref[0]
     right = ids_ref[1]               # we send downstream (IKL ring order,
     left = ids_ref[2]                # sw/setup_route.sh:12-40)
@@ -296,6 +337,7 @@ def _rs_kernel(ids_ref, sched_ref, x_ref, out_ref, acc, send_pkt, recv_pkt,
     chunk_rows = S * R
     total = (n - 1) * S              # global send/consume count
     D = depth
+    final_g0 = (n - 2) * S           # consumes >= this land in OUR chunk
 
     acc[:] = x_ref[:]
 
@@ -354,18 +396,46 @@ def _rs_kernel(ids_ref, sched_ref, x_ref, out_ref, acc, send_pkt, recv_pkt,
             if do_rdma:
                 rdma(q).start()
 
+    def update_chunk(off, loc, c):
+        # fused ZeRO-1 optimizer update of owned sub-chunk c: the mean
+        # gradient is read straight out of the just-retired accumulator
+        # rows, the master/state shards update in place (aliased outputs)
+        # — the decode feeds weight_update with no HBM round-trip in
+        # between, and the remaining ring hops still overlap this VPU
+        # work.  Formula/bit contract: optim.fused_apply_blocks.
+        gblk = acc[pl.ds(off + c, sub)] / jnp.float32(n)
+        wblk = w_ref[pl.ds(loc + c, sub)]
+        stblks = tuple(s[pl.ds(loc + c, sub)] for s in st_in)
+        w2, st2 = _optim.fused_apply_blocks(opt_kind, wblk, gblk, stblks,
+                                            lambda i: hyper_ref[i])
+        w_out[pl.ds(loc + c, sub)] = w2
+        for so, sv in zip(st_out, st2):
+            so[pl.ds(loc + c, sub)] = sv
+
     def consume(g):
         # decode slice g + accumulate into the chunk this hop owns
         if do_rdma:
             rdma(g).wait_recv()
-        if do_dec:
-            off = sched_ref[1, g]
-            slot = g % n_slots
-            for c in range(0, R, sub):
+        if not (do_dec or do_upd):
+            if flow_control and do_rdma:
+                pltpu.semaphore_signal(
+                    credit_sem, inc=1, device_id=left,
+                    device_id_type=pltpu.DeviceIdType.LOGICAL)
+            return
+        off = sched_ref[1, g]
+        slot = g % n_slots
+        final = g >= final_g0           # this slice lands in OUR chunk
+        loc = off - idx * chunk_rows    # owned-shard row offset (final only)
+        for c in range(0, R, sub):
+            if do_dec:
                 dec = _decode_rows(recv_pkt[slot, pl.ds(c, sub)],
                                    recv_pkt[slot, pl.ds(R + c // B, sub // B)],
                                    B)
                 acc[pl.ds(off + c, sub)] = acc[pl.ds(off + c, sub)] + dec
+            if do_upd:
+                @_when(final, unrolled)
+                def _upd(c=c):
+                    update_chunk(off, loc, c)
         if flow_control and do_rdma:
             # free the slot for our upstream sender
             pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
@@ -432,15 +502,21 @@ def _ring_ids(axis_name: Optional[str]) -> jax.Array:
     return jnp.stack([idx, (idx + 1) % n, (idx - 1) % n]).astype(jnp.int32)
 
 
-@functools.partial(jax.jit, static_argnames=(
+@functools.partial(jax.jit, donate_argnames=("w2", "opt_st"),
+                   static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id", "loopback_n", "ablate", "depth"))
+    "interpret", "collective_id", "loopback_n", "ablate", "depth",
+    "opt_kind"))
 def _rs_call(x2, axis_name: Optional[str], block_size: int,
              mantissa_bits: int, rounding: str, slice_elems: int,
              interpret: bool, collective_id: int,
              loopback_n: Optional[int] = None,
              ablate: Optional[str] = None,
-             depth: Optional[int] = None):
+             depth: Optional[int] = None,
+             opt_kind: Optional[str] = None,
+             w2: Optional[jax.Array] = None,
+             opt_st: Tuple[jax.Array, ...] = (),
+             hyper: Optional[jax.Array] = None):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -456,16 +532,37 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         block_size=block_size, mantissa_bits=mantissa_bits,
         rounding=rounding, flow_control=_flow, unrolled=_unrolled,
         depth=D, n_slots=n_slots, launch_first=launch_first,
-        ablate=ablate)
+        ablate=ablate, opt_kind=opt_kind)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
-    return pl.pallas_call(
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
+
+    def sds(shape):
+        return compat.shape_dtype_struct(shape, jnp.float32, vma=vma)
+
+    if opt_kind is None:
+        out_shape = sds((chunk_rows, LANES))
+        in_specs = [smem, smem, vmem]
+        args = (ids, sched, x2)
+        io_alias = {}
+    else:
+        ns = OptimizerSpec(kind=opt_kind).n_state
+        assert w2 is not None and hyper is not None and len(opt_st) == ns
+        # outputs: g_own (raw SUM — the gradient path stays bit-identical
+        # to the unfused kernel), then w_new + state_new aliased onto the
+        # donated shard operands (ZeRO-1: each replica owns 1/n of the
+        # master + moments, updated in place)
+        out_shape = [sds((chunk_rows, LANES))] * (2 + ns)
+        in_specs = [smem, smem, smem] + [vmem] * (2 + ns)
+        args = (ids, sched, hyper, x2, w2) + tuple(opt_st)
+        io_alias = {4: 1, **{5 + i: 2 + i for i in range(ns)}}
+    out = pl.pallas_call(
         kern,
-        out_shape=compat.shape_dtype_struct((chunk_rows, LANES), jnp.float32,
-                                       vma=vma),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.VMEM)],
-        out_specs=pl.BlockSpec(memory_space=pltpu.VMEM),
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=(vmem if opt_kind is None
+                   else [vmem] * (2 + OptimizerSpec(kind=opt_kind).n_state)),
+        input_output_aliases=io_alias,
         scratch_shapes=[
             pltpu.VMEM((L_rows, LANES), jnp.float32),          # acc
             pltpu.VMEM((n_slots, pkt_rows, LANES), jnp.int8),  # send frames
@@ -477,7 +574,10 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
-    )(ids, sched, x2)
+    )(*args)
+    if opt_kind is None:
+        return out
+    return (out[0], out[1], tuple(out[2:]))
 
 
 # above this per-device payload, the whole-vector VMEM-resident kernel
@@ -543,13 +643,12 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
     return out.reshape(C)
 
 
-def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
-                      recv_pkt, ld_sem, st_ld_sem, wb_sem, send_sem,
-                      recv_sem, credit_sem, *, n: int, n_slices: int,
+def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                       slice_rows: int, block_size: int, mantissa_bits: int,
                       rounding: str, flow_control: bool, unrolled: bool,
                       depth: int, n_slots: int, launch_first: bool,
-                      ablate: Optional[str] = None):
+                      ablate: Optional[str] = None,
+                      opt_kind: Optional[str] = None):
     """HBM-streaming variant of _rs_kernel: the vector stays in HBM (acc
     aliases the input buffer) and only two slices of working f32 plus the
     int8 frames live in VMEM — the reference's exact memory shape, which
@@ -563,23 +662,56 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
     hazard (hop s sends what hop s-1 wrote back) is guarded by the
     writeback wait discipline below.
 
-    del x_hbm: the aliased acc ref IS the input buffer.
+    del x_hbm: the aliased acc ref IS the input buffer (same for the
+    fused-opt w/state shards: their aliased OUTPUT refs are the buffers).
+
+    opt_kind (STATIC): as in _rs_kernel — fuse the ZeRO-1 optimizer
+    update into the final-hop decode.  Streaming adds the reference's
+    memory shape to the update too: the owned master/state slice streams
+    HBM->VMEM while the wire wait is in flight, updates in VMEM in the
+    same `_sub_rows` chunks the decode retires, and writes back on its
+    own DMA pair — so the optimizer's entire HBM traffic (read+write of
+    w and moments, 1/n of the model per replica) hides under the ring's
+    remaining hops instead of running as a separate exposed pass.
     """
-    del x_hbm
     # Stage ablation (loopback attribution only — see _rs_kernel): each
     # variant keeps exactly one pipeline resource class of the SAME
     # schedule: "hbm" = slice load + store-load + writeback streaming,
     # "encode" = load + codec-in, "rdma" = the wire chain alone,
-    # "decode" = store-load + codec-out+add + writeback, "skeleton" =
-    # none of them (the control-flow floor, ops.ring_cost).
+    # "decode" = store-load + codec-out+add + writeback, "update" = the
+    # fused-optimizer stage alone (its state-slice DMAs + VPU update),
+    # "skeleton" = none of them (the control-flow floor, ops.ring_cost).
     assert ablate in (None, "encode", "rdma", "decode", "hbm",
-                      "skeleton"), ablate
+                      "skeleton", "update"), ablate
+    assert ablate != "update" or opt_kind is not None, \
+        "ablate='update' needs a fused optimizer"
     do_ld = ablate in (None, "encode", "hbm")
     do_enc = ablate in (None, "encode")
     do_rdma = ablate in (None, "rdma")
     do_stld = ablate in (None, "hbm", "decode")
     do_dec = ablate in (None, "decode")
     do_wb = ablate in (None, "hbm", "decode")
+    do_upd = opt_kind is not None and ablate in (None, "update")
+    ns = 0 if opt_kind is None else OptimizerSpec(kind=opt_kind).n_state
+    n_t = 1 + ns                     # fused-opt tensors: w + state shards
+    if opt_kind is None:
+        x_hbm = refs[0]
+        hyper_ref = None
+        acc = refs[1]
+        opt_out = ()
+        (ld, st, send_pkt, recv_pkt, ld_sem, st_ld_sem, wb_sem, send_sem,
+         recv_sem, credit_sem) = refs[2:]
+        opt_buf = opt_ld_sem = opt_wb_sem = None
+    else:
+        hyper_ref, x_hbm = refs[0], refs[1]
+        # inputs w_hbm/st_hbm are aliased onto the outputs right after
+        # acc — the out refs ARE the buffers (del the input handles)
+        acc = refs[2 + n_t]
+        opt_out = tuple(refs[3 + n_t:3 + 2 * n_t])
+        (ld, st, send_pkt, recv_pkt, opt_buf, ld_sem, st_ld_sem, wb_sem,
+         opt_ld_sem, opt_wb_sem, send_sem, recv_sem,
+         credit_sem) = refs[3 + 2 * n_t:]
+    del refs, x_hbm
     idx = ids_ref[0]
     right = ids_ref[1]
     left = ids_ref[2]
@@ -590,6 +722,7 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
     chunk_rows = S * R
     total = (n - 1) * S
     D = depth
+    final_g0 = (n - 2) * S           # consumes >= this land in OUR chunk
 
     def send_off(q):
         # clamp guarded-dead loads past the table (see _rs_kernel's
@@ -626,6 +759,40 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
                                        mantissa_bits, rounding)
             send_pkt[slot, pl.ds(c, sub)] = mant
             send_pkt[slot, pl.ds(R + c // B, sub // B)] = scale
+
+    # -- fused-optimizer streaming plumbing (opt_kind only): the owned
+    # master/state slice of final-hop consume g cycles through a 2-deep
+    # VMEM window per tensor (opt_buf[t]), with its own ld/wb DMA pairs.
+    # Each tensor's HBM rows for consume g are touched by exactly one
+    # (load, update, writeback) triple, so the only hazard is VMEM slot
+    # reuse: ld(g) must not overwrite a buffer wb(g-2) still drains —
+    # guarded at consume entry; the last two writebacks drain at exit.
+    def opt_loc(g):
+        return recv_off(g) - idx * chunk_rows
+
+    def opt_ld_dma(t, g):
+        return pltpu.make_async_copy(
+            opt_out[t].at[pl.ds(opt_loc(g), R)], opt_buf.at[t, g % 2],
+            opt_ld_sem.at[t * 2 + g % 2])
+
+    def opt_wb_dma(t, g):
+        return pltpu.make_async_copy(
+            opt_buf.at[t, g % 2], opt_out[t].at[pl.ds(opt_loc(g), R)],
+            opt_wb_sem.at[t * 2 + g % 2])
+
+    def update_slice(g):
+        # mean-gradient slice straight from the decode buffer; update in
+        # place in the VMEM window (formula: optim.fused_apply_blocks)
+        for c in range(0, R, sub):
+            gblk = st[g % 2, pl.ds(c, sub)] / jnp.float32(n)
+            wblk = opt_buf[0, g % 2, pl.ds(c, sub)]
+            stblks = tuple(opt_buf[1 + i, g % 2, pl.ds(c, sub)]
+                           for i in range(ns))
+            w2, st2 = _optim.fused_apply_blocks(
+                opt_kind, wblk, gblk, stblks, lambda i: hyper_ref[i])
+            opt_buf[0, g % 2, pl.ds(c, sub)] = w2
+            for i, sv in enumerate(st2):
+                opt_buf[1 + i, g % 2, pl.ds(c, sub)] = sv
 
     if flow_control and do_rdma:
         _neighbor_barrier(left, right)
@@ -682,6 +849,16 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
                 rdma(q).start()
 
     def consume(g):
+        if do_upd:
+            @_when(g >= final_g0 + 2, unrolled)
+            def _opt_slot_free():          # VMEM window slot reuse guard
+                for t in range(n_t):
+                    opt_wb_dma(t, g - 2).wait()
+
+            @_when(g >= final_g0, unrolled)
+            def _opt_ld():                 # hide the state read under the
+                for t in range(n_t):       # wire wait + decode
+                    opt_ld_dma(t, g).start()
         if do_stld:
             stld_dma(g).start()            # overlap load with the wire
         if do_rdma:
@@ -700,6 +877,14 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
                                    device_id_type=pltpu.DeviceIdType.LOGICAL)
         if do_wb:
             wb_dma(g).start()
+        if do_upd:
+            @_when(g >= final_g0, unrolled)
+            def _opt_update():             # grad wb streams out above
+                for t in range(n_t):       # while the VPU updates here
+                    opt_ld_dma(t, g).wait()
+                update_slice(g)
+                for t in range(n_t):
+                    opt_wb_dma(t, g).start()
 
     # Writeback discipline: each wb_dma is waited EXACTLY ONCE, at a point
     # that dominates both of its consumers — the send-side RAW (the load
@@ -738,6 +923,12 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
 
     if do_wb and launch_first:
         wb_dma(total - 1).wait()           # D==S waits each wb in-loop
+    if do_upd:
+        # drain the last min(2, S) state writebacks (earlier ones were
+        # waited by the in-loop slot-reuse guard); bounds are static
+        for gg in range(max(final_g0, total - 2), total):
+            for t in range(n_t):
+                opt_wb_dma(t, gg).wait()
     if do_rdma:
         for j in range(max(0, total - n_slots), total):
             rdma(j).wait_send()
@@ -745,15 +936,21 @@ def _rs_stream_kernel(ids_ref, sched_ref, x_hbm, acc, ld, st, send_pkt,
             pltpu.semaphore_wait(credit_sem, min(total, n_slots))
 
 
-@functools.partial(jax.jit, donate_argnums=(0,), static_argnames=(
+@functools.partial(jax.jit, donate_argnums=(0,),
+                   donate_argnames=("w2", "opt_st"), static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
-    "interpret", "collective_id", "loopback_n", "ablate", "depth"))
+    "interpret", "collective_id", "loopback_n", "ablate", "depth",
+    "opt_kind"))
 def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
                     mantissa_bits: int, rounding: str, slice_elems: int,
                     interpret: bool, collective_id: int,
                     loopback_n: Optional[int] = None,
                     ablate: Optional[str] = None,
-                    depth: Optional[int] = None):
+                    depth: Optional[int] = None,
+                    opt_kind: Optional[str] = None,
+                    w2: Optional[jax.Array] = None,
+                    opt_st: Tuple[jax.Array, ...] = (),
+                    hyper: Optional[jax.Array] = None):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -769,25 +966,49 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
         block_size=block_size, mantissa_bits=mantissa_bits,
         rounding=rounding, flow_control=_flow, unrolled=_unrolled,
         depth=D, n_slots=n_slots, launch_first=launch_first,
-        ablate=ablate)
+        ablate=ablate, opt_kind=opt_kind)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
-    acc = pl.pallas_call(
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    hbm = pl.BlockSpec(memory_space=pl.ANY)
+
+    def sds(shape):
+        return compat.shape_dtype_struct(shape, jnp.float32, vma=vma)
+
+    ns = 0 if opt_kind is None else OptimizerSpec(kind=opt_kind).n_state
+    n_t = 1 + ns
+    if opt_kind is None:
+        out_shape = sds((L_rows, LANES))
+        in_specs = [smem, smem, hbm]
+        args = (ids, sched, x2)
+        io_alias = {2: 0}
+        opt_scratch = []
+    else:
+        assert w2 is not None and hyper is not None and len(opt_st) == ns
+        out_shape = [sds((L_rows, LANES))] + [sds((chunk_rows, LANES))] * n_t
+        in_specs = [smem, smem, smem] + [hbm] * (1 + n_t)
+        args = (ids, sched, hyper, x2, w2) + tuple(opt_st)
+        io_alias = {3: 0, **{4 + i: 1 + i for i in range(n_t)}}
+    res = pl.pallas_call(
         kern,
-        out_shape=compat.shape_dtype_struct((L_rows, LANES), jnp.float32,
-                                       vma=vma),
-        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pltpu.SMEM),
-                  pl.BlockSpec(memory_space=pl.ANY)],
-        out_specs=pl.BlockSpec(memory_space=pl.ANY),
-        input_output_aliases={2: 0},
+        out_shape=out_shape,
+        in_specs=in_specs,
+        out_specs=(hbm if opt_kind is None else [hbm] * (1 + n_t)),
+        input_output_aliases=io_alias,
         scratch_shapes=[
             pltpu.VMEM((2, R, LANES), jnp.float32),        # send loads
             pltpu.VMEM((2, R, LANES), jnp.float32),        # recv acc
             pltpu.VMEM((n_slots, pkt_rows, LANES), jnp.int8),  # send frames
             pltpu.VMEM((n_slots, pkt_rows, LANES), jnp.int8),  # recv frames
+        ] + ([] if opt_kind is None else [
+            pltpu.VMEM((n_t, 2, R, LANES), jnp.float32),   # w/state window
+        ]) + [
             pltpu.SemaphoreType.DMA((2,)),                 # ld
             pltpu.SemaphoreType.DMA((2,)),                 # st load
             pltpu.SemaphoreType.DMA((2,)),                 # writeback
+        ] + ([] if opt_kind is None else [
+            pltpu.SemaphoreType.DMA((n_t * 2,)),           # state ld
+            pltpu.SemaphoreType.DMA((n_t * 2,)),           # state wb
+        ]) + [
             pltpu.SemaphoreType.DMA((n_slots,)),           # rdma send
             pltpu.SemaphoreType.DMA((n_slots,)),           # rdma recv
             pltpu.SemaphoreType.REGULAR,
@@ -795,12 +1016,16 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
         compiler_params=compat.tpu_compiler_params(
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
-    )(ids, sched, x2)
+    )(*args)
+    acc = res if opt_kind is None else res[0]
     # the owned chunk lives at rows [idx*chunk_rows, +chunk_rows) of the
     # accumulated (aliased) vector
     idx = jnp.int32(0) if axis_name is None else lax.axis_index(axis_name)
-    return lax.dynamic_slice_in_dim(acc, idx * chunk_rows, chunk_rows,
-                                    axis=0)
+    g_own = lax.dynamic_slice_in_dim(acc, idx * chunk_rows, chunk_rows,
+                                     axis=0)
+    if opt_kind is None:
+        return g_own
+    return (g_own, res[1], tuple(res[2:]))
 
 
 def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
@@ -1405,6 +1630,61 @@ def ring_all_gather_fused(owned: jax.Array, axis_name: str, *,
     return jnp.concatenate(outs, axis=1).reshape(n * C)
 
 
+def ring_reduce_scatter_update_fused(
+        x: jax.Array, w_own: jax.Array, opt_state, hyper: jax.Array,
+        axis_name: str, *, opt_kind: str,
+        compression: Optional[BFPConfig] = None,
+        slice_elems: int = 8192, streaming: Optional[bool] = None,
+        interpret: Optional[bool] = None,
+        pipeline_depth: Optional[int] = None, collective_id: int = 9):
+    """Fused ring reduce-scatter + in-kernel ZeRO-1 optimizer update —
+    the reference's defining datapath (decode feeds weight_update.sv with
+    no host round-trip, SURVEY.md §3.2) plus ZeRO-1 weight-update
+    sharding: each replica's owned slice of params + optimizer state
+    updates AS its final-hop decode retires, inside the same depth-D
+    pipelined kernel, so the optimizer costs zero exposed time.
+
+    x:        flat f32 [L] local gradients (the collective input)
+    w_own:    [L/n] owned f32 master shard (DONATED: updated in place)
+    opt_state: dict of [L/n] f32 shards per OptimizerSpec(kind).state_keys
+              (DONATED)
+    hyper:    optim.fused_hyperparams(cfg, step) scalar vector — SMEM
+              operand, so lr/schedule/weight-decay changes never recompile
+
+    Returns ``(g_own_sum [L/n], w_new [L/n], new_state dict)`` —
+    g_own_sum is the raw reduced SUM, bit-identical to
+    ring_reduce_scatter_fused at every pipeline depth; the update formula
+    is optim.fused_apply_blocks (bit spec: optim.golden_fused_apply
+    composed with the codec's golden ring decode).  Same slicing/
+    residency constraints and routing as ring_reduce_scatter_fused."""
+    cfg = compression or BFPConfig()
+    spec = OptimizerSpec(kind=opt_kind)
+    n = lax.axis_size(axis_name)
+    L = x.shape[0]
+    if interpret is None:
+        interpret = not _is_tpu()
+    assert L % n == 0, (L, n)
+    C = L // n
+    assert n >= 2, "n == 1 is routed by ops.fused_update (no wire)"
+    if C % slice_elems or slice_elems % (cfg.block_size * LANES):
+        raise ValueError(
+            f"fused ring needs chunk {C} % slice_elems {slice_elems} == 0 "
+            f"and slice_elems % {cfg.block_size * LANES} == 0")
+    if streaming is None:
+        streaming = L * 4 > _VMEM_RESIDENT_MAX_BYTES
+    x2 = x.astype(jnp.float32).reshape(-1, LANES)
+    w2 = w_own.astype(jnp.float32).reshape(-1, LANES)
+    st = tuple(opt_state[k].astype(jnp.float32).reshape(-1, LANES)
+               for k in spec.state_keys)
+    call = _rs_stream_call if streaming else _rs_call
+    g2, w_new2, st2 = call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+                           cfg.rounding, slice_elems, interpret,
+                           collective_id, depth=pipeline_depth,
+                           opt_kind=opt_kind, w2=w2, opt_st=st, hyper=hyper)
+    return (g2.reshape(C), w_new2.reshape(C),
+            {k: v.reshape(C) for k, v in zip(spec.state_keys, st2)})
+
+
 def ring_all_reduce_fused(x: jax.Array, axis_name: str, *,
                           compression: Optional[BFPConfig] = None,
                           slice_elems: int = 8192,
@@ -1664,6 +1944,56 @@ def loopback_microbench(x: jax.Array, virtual_n: int = 4, *,
                        loopback_n=virtual_n, ablate=ablate,
                        depth=pipeline_depth), x2)
     return out.reshape(C)
+
+
+def loopback_update_microbench(x: jax.Array, virtual_n: int = 4, *,
+                               opt_kind: str = "adamw",
+                               hyper: Optional[jax.Array] = None,
+                               compression: Optional[BFPConfig] = None,
+                               slice_elems: int = 8192,
+                               streaming: bool = False,
+                               interpret: Optional[bool] = None,
+                               pipeline_depth: Optional[int] = None,
+                               ablate: Optional[str] = None) -> jax.Array:
+    """Single-chip exercise of the fused reduce-scatter + IN-KERNEL
+    optimizer pipeline (`loopback_microbench` with opt_kind): the same
+    self-addressed virtual ring, plus chunk-sized master/state shards
+    updated on the final-hop decodes.  Returns the updated w chunk
+    (consuming any output runs the whole opaque kernel, so O(1)
+    consumption is exact for slope timing).  ablate adds "update" — the
+    optimizer stage alone on the same schedule — feeding ring_cost's
+    fused-optimizer decomposition."""
+    cfg = compression or BFPConfig()
+    spec = OptimizerSpec(kind=opt_kind)
+    if interpret is None:
+        interpret = not _is_tpu()
+    L = x.shape[0]
+    assert L % virtual_n == 0, (L, virtual_n)
+    C = L // virtual_n
+    if C % slice_elems or slice_elems % (cfg.block_size * LANES):
+        raise ValueError((C, slice_elems, cfg.block_size * LANES))
+    if hyper is None:
+        from ..utils.config import OptimizerConfig
+        hyper = _optim.fused_hyperparams(
+            OptimizerConfig(kind=opt_kind, learning_rate=1e-3),
+            jnp.zeros((), jnp.int32))
+    x2 = x.astype(jnp.float32).reshape(-1, LANES)
+    w2 = jnp.zeros((C // LANES, LANES), jnp.float32)
+    st = tuple(jnp.zeros((C // LANES, LANES), jnp.float32)
+               for _ in spec.state_keys)
+    call = _rs_stream_call if streaming else _rs_call
+    if ablate == "hbm" and not streaming:
+        raise ValueError("'hbm' ablates the streaming kernel's slice "
+                         "load/store stages; the resident kernel has none")
+
+    def run(v):
+        res = call(v, None, cfg.block_size, cfg.mantissa_bits,
+                   cfg.rounding, slice_elems, interpret, 9,
+                   loopback_n=virtual_n, ablate=ablate,
+                   depth=pipeline_depth, opt_kind=opt_kind,
+                   w2=w2, opt_st=st, hyper=hyper)
+        return res[1]
+    return _loopback_shmap(run, x2).reshape(C)
 
 
 def loopback_gather_microbench(owned: jax.Array, virtual_n: int = 4, *,

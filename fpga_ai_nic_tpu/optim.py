@@ -15,15 +15,174 @@ shard, so the whole thing shards trivially over the dp axis.
 
 from __future__ import annotations
 
-from typing import Dict, Tuple
+from typing import Callable, Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 from jax import lax
 
-from .utils.config import OptimizerConfig
+from .utils.config import OptimizerConfig, OptimizerSpec
 
 OptState = Dict[str, jax.Array]
+
+# ---------------------------------------------------------------------------
+# fused-update hyperparameter vector
+# ---------------------------------------------------------------------------
+# Layout of the f32[HYPER_LEN] scalar vector the fused paths consume: the
+# Pallas ring kernels read it from SMEM (ops.ring_pallas fused-opt
+# variants), the jnp fused path reads it as a traced array, and the numpy
+# golden twins take the identical values — one definition so a
+# hyperparameter (lr schedule step, weight decay, bias correction) can
+# NEVER recompile a kernel or drift between implementations.  Bias
+# corrections ride as RECIPROCALS (rc1 = 1/(1-b1^t)): the fused adam
+# update multiplies instead of dividing so the kernel has exactly ONE
+# elementwise division (num/den) — XLA's (a/b)/c -> a/(b*c) rewrite would
+# otherwise re-associate a second division and break golden bit-parity.
+H_LR, H_WD, H_MOM, H_B2, H_EPS, H_RC1, H_RC2 = 0, 1, 2, 3, 4, 5, 6
+HYPER_LEN = 8
+
+
+def fused_hyperparams(cfg: OptimizerConfig, step=None) -> jax.Array:
+    """The f32[HYPER_LEN] scalar vector for one fused update at ``step``
+    (traced; scheduled lr and adam bias corrections are plain traced
+    expressions, so changing them never recompiles the kernel)."""
+    if step is None:
+        assert cfg.schedule == "constant" and cfg.warmup_steps == 0, (
+            "lr schedules need the step count")
+        lr = jnp.float32(cfg.learning_rate)
+    else:
+        lr = learning_rate_at(cfg, step)
+    if cfg.kind == "adamw":
+        assert step is not None, "adamw needs the (replicated) step count"
+        t = (jnp.asarray(step) + 1).astype(jnp.float32)
+        b1, b2 = jnp.float32(cfg.b1), jnp.float32(cfg.b2)
+        rc1 = jnp.float32(1.0) / (jnp.float32(1.0) - b1 ** t)
+        rc2 = jnp.float32(1.0) / (jnp.float32(1.0) - b2 ** t)
+    else:
+        rc1 = rc2 = jnp.float32(1.0)
+    mom = jnp.float32(cfg.momentum if cfg.kind == "momentum" else cfg.b1)
+    return jnp.stack([lr.astype(jnp.float32), jnp.float32(cfg.weight_decay),
+                      mom, jnp.float32(cfg.b2), jnp.float32(cfg.eps),
+                      rc1, rc2, jnp.float32(0.0)])
+
+
+def fused_apply_blocks(kind: str, w, g, state: Tuple, h: Callable):
+    """THE fused-update formula — shared verbatim by the Pallas ring
+    kernels (operating on VMEM sub-slice blocks, scalars read from the
+    SMEM hyper vector) and the jnp fused path (flat arrays, traced hyper
+    vector).  ``h(i)`` reads hyper scalar i (optim.H_*); ``state`` is the
+    positional tuple per OptimizerSpec.state_keys.  Returns
+    ``(w_new, new_state)``.
+
+    BIT CONTRACT (tests/test_fused_optimizer.py): every expression here
+    is shaped so each add/sub has at most ONE inexact multiply operand —
+    an unambiguous FMA contraction site.  XLA:CPU (and LLVM generally)
+    contracts exactly those into fused multiply-adds, which
+    ``golden_fused_apply`` mirrors with explicit emulated fmaf, so kernel
+    and twin agree bit for bit on this container.  Adam uses the
+    EMA-increment form m + (1-b1)*(g-m) (not b1*m + (1-b1)*g, whose
+    two-product add contracts ambiguously) and reciprocal bias
+    corrections (see the hyper-layout comment).  On a backend that does
+    not contract at all the bits would differ from the twin by final-ulp
+    rounding only — the parity tests pin THIS container's backend."""
+    one = jnp.float32(1.0)
+    lr, wd = h(H_LR), h(H_WD)
+    if kind == "sgd":
+        return w - lr * (g + wd * w), ()
+    if kind == "momentum":
+        # DECOUPLED weight decay (SGDW): wd rides its own final term
+        # instead of folding into the accumulator.  Not (only) a
+        # semantics choice — `mom*m + (g + wd*w)` chains two contraction
+        # candidates through an add's ADDEND slot, and XLA's fusion
+        # boundaries split that chain differently per context (measured:
+        # the Pallas-kernel route contracted only the outer site while
+        # the flat route contracted both), so no single twin could match
+        # both routes.  Each site below has raw operands beside its one
+        # mul; single-step math is identical to the coupled form.
+        (m,) = state
+        m2 = h(H_MOM) * m + g
+        t1 = w - lr * m2
+        return t1 - (lr * wd) * w, (m2,)
+    if kind == "adamw":
+        m, v = state
+        m2 = m + (one - h(H_MOM)) * (g - m)
+        v2 = v + (one - h(H_B2)) * (g * g - v)
+        num = h(H_RC1) * m2
+        den = jnp.sqrt(h(H_RC2) * v2) + h(H_EPS)
+        upd = num / den + wd * w
+        return w - lr * upd, (m2, v2)
+    raise ValueError(kind)
+
+
+def fused_apply_flat(spec: OptimizerSpec, w: jax.Array, g_sum: jax.Array,
+                     state: OptState, hyper: jax.Array,
+                     n: int) -> Tuple[jax.Array, OptState]:
+    """The fused update on a flat owned shard OUTSIDE the Pallas kernel —
+    the routing target for fused_optimizer mode off the fused-kernel path
+    (XLA psum_scatter / separate-op ring / n == 1), numerically identical
+    to the in-kernel update: same formula, same hyper vector, same
+    golden twin.  ``g_sum`` is the reduce-scattered gradient SUM; the /n
+    mean happens here, matching the kernel."""
+    w = w.astype(jnp.float32)
+    g = g_sum.astype(jnp.float32) / jnp.float32(n)
+    st = tuple(state[k] for k in spec.state_keys)
+    w2, st2 = fused_apply_blocks(spec.kind, w, g, st,
+                                 lambda i: hyper[i])
+    return w2, dict(zip(spec.state_keys, st2))
+
+
+# ---------------------------------------------------------------------------
+# numpy golden twins (the bit spec of the fused update)
+# ---------------------------------------------------------------------------
+
+def _np_fmaf(a, b, c):
+    """Exact float32 fused multiply-add via float64: the f32xf32 product
+    is exact in f64 (<= 48 significand bits) and 53 >= 2*24 + 2 makes the
+    double rounding innocuous, so this equals fmaf(a, b, c) bit for bit
+    on every input."""
+    import numpy as np
+    return (np.asarray(a, np.float64) * np.asarray(b, np.float64)
+            + np.asarray(c, np.float64)).astype(np.float32)
+
+
+def golden_fused_apply(kind: str, w, g_sum, state: Dict, hyper,
+                       n: int) -> Tuple:
+    """Numpy golden twin of ``fused_apply_blocks`` composed with the /n
+    gradient mean — the bit-level SPEC of the fused optimizer, mirroring
+    the FMA contraction XLA:CPU applies to the jnp formula (each fmaf
+    below is one contraction site; the rest round separately).  Composed
+    with compress.golden's codec-generic ring golden it specifies the
+    whole fused decode+update path per codec (tests/test_fused_optimizer).
+
+    Returns ``(w_new, new_state_dict)`` in float32.  ``hyper`` is the
+    (materialized) fused_hyperparams vector — pass the SAME values the
+    kernel saw; recomputing lr/bias corrections host-side would compare
+    two pow implementations, not the update."""
+    import numpy as np
+    spec = OptimizerSpec(kind=kind)
+    w = np.asarray(w, np.float32)
+    g = np.asarray(g_sum, np.float32) / np.float32(n)
+    h = np.asarray(hyper, np.float32)
+    lr, wd = h[H_LR], h[H_WD]
+    one = np.float32(1.0)
+    if kind == "sgd":
+        w2 = _np_fmaf(-lr, _np_fmaf(wd, w, g), w)
+        return w2, {}
+    if kind == "momentum":
+        m = np.asarray(state["m"], np.float32)
+        m2 = _np_fmaf(h[H_MOM], m, g)
+        t1 = _np_fmaf(-lr, m2, w)
+        return _np_fmaf(-(lr * wd), w, t1), {"m": m2}
+    if kind == "adamw":
+        m = np.asarray(state["m"], np.float32)
+        v = np.asarray(state["v"], np.float32)
+        m2 = _np_fmaf(one - h[H_MOM], g - m, m)
+        v2 = _np_fmaf(one - h[H_B2], _np_fmaf(g, g, -v), v)
+        num = h[H_RC1] * m2
+        den = (np.sqrt(h[H_RC2] * v2) + h[H_EPS]).astype(np.float32)
+        upd = _np_fmaf(wd, w, num / den)
+        return _np_fmaf(-lr, upd, w), {"m": m2, "v": v2}
+    raise ValueError(spec.kind)
 
 
 def init_state(cfg: OptimizerConfig, shard_len: int) -> OptState:

@@ -49,7 +49,7 @@ from . import mesh as mesh_lib
 from .. import optim
 from ..obs import metrics as obs_metrics
 from ..ops import fused_update, ring as ring_ops
-from ..utils.config import TrainConfig
+from ..utils.config import OptimizerSpec, TrainConfig
 
 
 class FSDPState(NamedTuple):
@@ -81,6 +81,11 @@ class FSDPTrainer:
         self._codec = codec
         self._ef = (cfg.collective.impl == "ring" and codec is not None
                     and codec.error_feedback)
+        if cfg.collective.fused_optimizer \
+                and cfg.optimizer.clip_norm is not None:
+            raise ValueError(
+                "fused_optimizer cannot honor clip_norm (same contract "
+                "as DPTrainer: no barrier between reduce and update)")
 
     # -- init ---------------------------------------------------------------
 
@@ -155,8 +160,6 @@ class FSDPTrainer:
             loss, g_flat = jax.value_and_grad(flat_loss)(flat)
             g_wire, new_resid = fused_update.error_feedback_encode(
                 codec, g_flat, resid)
-            g_own = fused_update.reduce_scatter(g_wire, ax, coll)
-            g_own = g_own / n
             m = {}
             if obs_on:
                 # g_wire IS roundtrip(g_flat + resid): declared-vs-
@@ -165,10 +168,21 @@ class FSDPTrainer:
                     obs_metrics.codec_observed_error(
                         codec, g_flat + resid, quantized=g_wire), ax)
                 m["ef_resid_norm"] = obs_metrics.l2_norm(new_resid, ax)
-                m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
-            g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
-            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
-                                            opt_state, step)
+            if coll.fused_optimizer:
+                # decode+accumulate+update in one pass (see DPTrainer)
+                g_sum, w_new, opt_state2 = \
+                    fused_update.reduce_scatter_update(
+                        g_wire, w_own, opt_state, step, ax, coll, opt_cfg)
+                if obs_on:
+                    m["grad_norm"] = obs_metrics.l2_norm(g_sum / n, ax)
+            else:
+                g_own = fused_update.reduce_scatter(g_wire, ax, coll)
+                g_own = g_own / n
+                if obs_on:
+                    m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
+                g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
+                w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
+                                                opt_state, step)
             loss_m = lax.pmean(loss, ax)
             if obs_on:
                 m["loss"] = loss_m
@@ -191,17 +205,25 @@ class FSDPTrainer:
                 return accum.accumulated_loss(
                     self.loss_fn, self.cfg.accum_steps)(params, batch)
 
-            loss, g_own = jax.value_and_grad(shard_loss)(w_own)
-            g_own = g_own / n
+            loss, g_sum = jax.value_and_grad(shard_loss)(w_own)
+            g_own = g_sum / n
             m = {}
             if obs_on:
                 # the codec path here is the gather's declared VJP — no
                 # explicit encode to compare against, so this variant
                 # carries the norm/loss metrics only
                 m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
-            g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
-            w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
-                                            opt_state, step)
+            if coll.fused_optimizer:
+                # the gather transpose already landed the summed shard;
+                # the update is the shared fused formula (same hyper
+                # vector / golden twin as the in-kernel path)
+                w_new, opt_state2 = optim.fused_apply_flat(
+                    OptimizerSpec.from_optimizer(opt_cfg), w_own, g_sum,
+                    opt_state, optim.fused_hyperparams(opt_cfg, step), n)
+            else:
+                g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
+                w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
+                                                opt_state, step)
             loss_m = lax.pmean(loss, ax)
             if obs_on:
                 m["loss"] = loss_m
@@ -279,11 +301,16 @@ class FSDPTrainer:
             self._ensure_meta(params_like)
         assert self._meta is not None, (
             "flat layout unknown: call init_state first or pass params_like")
+        # mesh-shape-portable: re-pad the live elements onto THIS mesh's
+        # flat layout (see fused_update.repad_flat / DPTrainer)
         sh = NamedSharding(self.mesh, P(self.ax))
         return FSDPState(
-            w_own=jax.device_put(jnp.asarray(restored["w_own"]), sh),
-            opt_state={k: jax.device_put(jnp.asarray(v), sh)
-                       for k, v in restored["opt_state"].items()},
+            w_own=jax.device_put(
+                fused_update.repad_flat(restored["w_own"], self._meta), sh),
+            opt_state={
+                k: jax.device_put(
+                    fused_update.repad_flat(v, self._meta), sh)
+                for k, v in restored["opt_state"].items()},
             step=jnp.asarray(restored["step"]),
             codec_state=self._init_codec_state())
 

@@ -76,6 +76,14 @@ class DPTrainer:
         self._codec = codec
         self._ef = (cfg.collective.impl == "ring" and codec is not None
                     and codec.error_feedback)
+        if cfg.collective.fused_optimizer \
+                and cfg.optimizer.clip_norm is not None:
+            raise ValueError(
+                "fused_optimizer cannot honor clip_norm: a global-norm "
+                "clip needs a cross-replica barrier BETWEEN the "
+                "reduce-scatter and the update — exactly the exposed "
+                "optimizer time the fused path removes; clip before the "
+                "collective or run unfused")
 
     # -- init ---------------------------------------------------------------
 
@@ -165,6 +173,24 @@ class DPTrainer:
                 m["codec_obs_rel_err"] = lax.pmax(
                     obs_metrics.codec_observed_error(codec, flat_g), ax)
             diag = {}
+            if coll.fused_optimizer:
+                # decode+accumulate+update in one pass (in-kernel on the
+                # TPU fused-ring path; the same formula fused after the
+                # reduce elsewhere — ops.fused_update.reduce_scatter_
+                # update): the optimizer runs on zero exposed time, and
+                # the EF residual carry above is untouched by the fusion
+                # (it compensates the LOCAL encode, before the wire)
+                g_sum, w_new, opt_state2 = fused_update.reduce_scatter_update(
+                    flat_g, w_own, opt_state, step, ax, coll, opt_cfg)
+                g_own = g_sum / self.n
+                if obs_on:
+                    m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
+                loss_m = lax.pmean(loss, ax)
+                if obs_on:
+                    m["loss"] = loss_m
+                out = (w_new, opt_state2, loss_m, diag)
+                return out + ((new_resid,) if ef else ()) + (
+                    (m,) if obs_on else ())
             if coll.integrity_check:
                 # checksums guard the COLLECTIVE (what actually rides the
                 # wire), so under EF they see the post-compression vector
@@ -297,12 +323,14 @@ class DPTrainer:
             self._ensure_meta(params_like)
         assert self._meta is not None, (
             "flat layout unknown: call init_state first or pass params_like")
+        # re-pad onto THIS mesh's flat layout: the checkpoint may have
+        # been written at a different dp width (fused_update.repad_flat),
+        # so restore re-gathers the same live elements under new padding
+        sh = NamedSharding(self.mesh, P(self.ax))
         w_own = jax.device_put(
-            jnp.asarray(restored["w_own"]),
-            NamedSharding(self.mesh, P(self.ax)))
+            fused_update.repad_flat(restored["w_own"], self._meta), sh)
         opt_state = {
-            k: jax.device_put(jnp.asarray(v),
-                              NamedSharding(self.mesh, P(self.ax)))
+            k: jax.device_put(fused_update.repad_flat(v, self._meta), sh)
             for k, v in restored["opt_state"].items()}
         return TrainState(
             params=self.params_from_master(w_own), w_own=w_own,

@@ -65,6 +65,39 @@ class BFPConfig:
 
 
 @dataclass(frozen=True)
+class OptimizerSpec:
+    """STATIC shape of a fused in-kernel optimizer — what the Pallas ring
+    kernels specialize on (state operand count, update formula), as
+    opposed to the hyperparameters, which ride the kernel as SMEM scalars
+    (``optim.fused_hyperparams``) so an lr/schedule change never
+    recompiles.  The reference bakes even the lr into RTL
+    (hw/weight_update.sv:439-452); we bake only the FORMULA.
+
+    kinds: "sgd" (stateless), "momentum" (1 state vector m),
+    "adamw" (2 state vectors m, v).  Weight decay / schedules / bias
+    correction are all dynamic scalars, never spec."""
+
+    kind: str = "sgd"             # "sgd" | "momentum" | "adamw"
+
+    def __post_init__(self) -> None:
+        assert self.kind in ("sgd", "momentum", "adamw"), self.kind
+
+    @property
+    def state_keys(self) -> Tuple[str, ...]:
+        """Optimizer-state slot names, in kernel operand order."""
+        return {"sgd": (), "momentum": ("m",),
+                "adamw": ("m", "v")}[self.kind]
+
+    @property
+    def n_state(self) -> int:
+        return len(self.state_keys)
+
+    @classmethod
+    def from_optimizer(cls, opt: "OptimizerConfig") -> "OptimizerSpec":
+        return cls(kind=opt.kind)
+
+
+@dataclass(frozen=True)
 class CollectiveConfig:
     """All-reduce engine configuration.
 
@@ -110,6 +143,23 @@ class CollectiveConfig:
     # (tools/first_contact.py stage 'canary', or loopback_microbench /
     # loopback_gather_microbench directly) on one chip of that platform.
     fused_kernel: bool = False
+    # fuse the optimizer update into the gradient reduce-scatter (the
+    # reference's weight_update.sv trick + ZeRO-1 weight-update sharding):
+    # each replica updates its owned master shard and optimizer-state
+    # shard AS the final-hop decode of that shard retires, and the
+    # all-gather then distributes fresh params.  With fused_kernel=True
+    # on TPU the update runs INSIDE the depth-D Pallas ring kernel
+    # (ops.ring_pallas fused-opt variants: state shards are donated
+    # kernel operands, hyperparams are SMEM scalars — an lr change never
+    # recompiles); otherwise the same update formula
+    # (optim.fused_apply_flat, bit-specified by the numpy golden twins in
+    # optim.py) runs fused into the step right after the reduce.
+    # Incompatible with integrity_check (the gate needs the pre-step
+    # state, which the fused path donates) — and the trainers reject
+    # clip_norm (a global-norm clip needs a barrier between the reduce
+    # and the update, which is exactly the exposed optimizer time this
+    # mode removes).  See docs/FUSED_OPTIMIZER.md.
+    fused_optimizer: bool = False
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
     # unroll the n-1 ring-hop loop at trace time: marginally better codegen
     # for tiny rings, O(n) compile-time blowup for real ones — rolled
@@ -140,6 +190,13 @@ class CollectiveConfig:
                 and self.impl != "ring"):
             raise ValueError("gradient compression requires impl='ring' "
                              "(XLA collectives cannot compress on the wire)")
+        if self.fused_optimizer and self.integrity_check:
+            raise ValueError(
+                "fused_optimizer is incompatible with integrity_check: the "
+                "in-kernel update donates the pre-step master/optimizer "
+                "state, so there is nothing left to gate a tripped "
+                "checksum back to — run the integrity guard on the "
+                "unfused path")
         if self.codec is not None:
             if not isinstance(self.codec_opts, tuple):
                 raise ValueError("codec_opts must be a tuple of (key, "

@@ -1,0 +1,37 @@
+"""J7 bad fixture: a dp-axis token-weighted loss correction that
+differentiates THROUGH psum — the per-replica gradient then inherits the
+jaxlib's psum-transpose convention and comes out n_dp x the reference on
+this container (the 8x-learning-rate class of docs/KNOWN_FAILURES.md
+#1-2, which J7 freezes).  The good form keeps the collective on the
+VALUE path only (see models.bert.loss_fn after the fix)."""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def build():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    params = {"w": jnp.asarray(rng.standard_normal(16).astype(np.float32))}
+    x = jnp.asarray(rng.standard_normal((8, 16)).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal(8).astype(np.float32))
+    valid = jnp.asarray(np.arange(8) % 3 != 0)
+
+    def loss(p, batch, dp_axis):
+        xb, yb, vb = batch
+        nll = jnp.where(vb, (xb @ p["w"] - yb) ** 2, 0.0)
+        local_sum = jnp.sum(nll)
+        count = jnp.sum(vb)
+        if dp_axis is None:
+            return local_sum / jnp.maximum(count, 1)
+        total = lax.psum(local_sum, dp_axis)
+        denom = jnp.maximum(lax.psum(count, dp_axis),
+                            1).astype(jnp.float32)
+        n = lax.axis_size(dp_axis)
+        # BAD: `total` (a psum) on the gradient path — the n factor is
+        # applied once here and once by the psum transpose
+        return lax.stop_gradient(total / denom) + (
+            n * (total - lax.stop_gradient(total)) / denom)
+
+    return params, (x, y, valid), loss

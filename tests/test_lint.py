@@ -364,3 +364,45 @@ class TestJaxprSweep:
         from fpga_ai_nic_tpu.lint.findings import JAXPR_CODES
         for code in AST_CODES + JAXPR_CODES:
             assert code in RULE_DOCS
+
+
+class TestJ7GradScale:
+    """J7: per-replica gradient invariant to n_dp on a fixed batch — the
+    psum-transpose gradient-scale class (KNOWN_FAILURES #1-16) frozen as
+    a sweep rule."""
+
+    FIXTURE = os.path.join(FIXTURES, "j7_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j7
+        findings = run_j7()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_fused_opt_donation_cells_green(self):
+        """The fused TrainState/FSDPState (master + adamw moments) must
+        keep full donation (J3) and honest wire accounting (J4)."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_fused_opt_cells
+        findings = run_fused_opt_cells()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_with_ndp_ratio(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j7_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_grad_scale
+        fs = check_grad_scale("j7_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J7"}
+        # the finding must name the smoking gun: a ratio ~ n_dp
+        assert "ratio 2" in fs[0].message and "ratio 4" in fs[1].message
+
+    def test_exit_code_with_fixture_env(self):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   GRAFTLINT_J7_FIXTURE=self.FIXTURE)
+        proc = subprocess.run(
+            [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
+             "--jaxpr"], cwd=REPO, env=env, capture_output=True,
+            text=True, timeout=600)
+        assert proc.returncode != 0, proc.stdout + proc.stderr
+        assert "J7:" in proc.stdout

@@ -57,6 +57,11 @@ _WATCH = {
                     "fpga_ai_nic_tpu/ops/ring_cost.py",
                     "fpga_ai_nic_tpu/ops/bfp.py",
                     "fpga_ai_nic_tpu/ops/bfp_pallas.py"],
+    "fused_opt": ["bench_collective.py", "bench_common.py",
+                  "fpga_ai_nic_tpu/ops/ring_pallas.py",
+                  "fpga_ai_nic_tpu/ops/ring_cost.py",
+                  "fpga_ai_nic_tpu/ops/fused_update.py",
+                  "fpga_ai_nic_tpu/optim.py"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -447,6 +452,58 @@ def main():
                         f"| {c['error_bound']:.3g} "
                         f"| {c['error_feedback']} | {c['idempotent']} "
                         f"| {c['supports_fused']} |")
+                L.append("")
+
+    # -- fused-optimizer bench ----------------------------------------------
+    fo_art = (_newest("artifacts/fused_opt_bench_*.json")
+              or _newest("FUSED_OPT_BENCH_r*.json"))
+    if fo_art:
+        d = _load(fo_art)
+        rows = d.get("rows", [])
+        if rows:
+            dry = bool(d.get("dryrun"))
+            L += ["## Fused optimizer (decode+accumulate+update in one "
+                  "pass)", "",
+                  f"Source: `{_rel(fo_art)}`{_badge(d, 'fused_opt')} "
+                  f"(platform: {d.get('platform')}; "
+                  "`make fused-opt-bench`).  The ZeRO-1 optimizer fused "
+                  "into the gradient reduce-scatter (in-kernel on the "
+                  "TPU fused ring — `ops.ring_pallas` opt_kind; the "
+                  "same formula XLA-fused elsewhere) vs the two-pass "
+                  "ring-then-optimizer baseline; `opt standalone` is "
+                  "the separate optimizer pass the fusion absorbs "
+                  "(its HBM accounting: `ring_cost.optimizer_roofline`).",
+                  ""]
+            if dry:
+                L += ["**Dryrun row** (virtual CPU mesh): timings are "
+                      "recorded for inspection only — oversubscription "
+                      "noise is of the effect's order, so no win/loss "
+                      "claim is made and `make obs-gate` gates only the "
+                      "byte accounting.  The schedule verdict needs a "
+                      "TPU surface.", ""]
+            L += ["| optimizer | fused ms | ring+opt ms | opt standalone "
+                  "ms | speedup | moment-state bytes | standalone HBM "
+                  "bytes |",
+                  "|---|---|---|---|---|---|---|"]
+            for r in rows:
+                L.append(
+                    f"| {r['kind']} | {r.get('fused_ms', '—')} "
+                    f"| {r.get('ring_then_opt_ms', '—')} "
+                    f"| {r.get('opt_standalone_ms', '—')} "
+                    f"| {r.get('speedup_vs_ring_then_opt', '—')} "
+                    f"| {r.get('moment_state_bytes', '—')} "
+                    f"| {r.get('standalone_hbm_bytes', '—')} |")
+            L.append("")
+            lb = d.get("fused_opt_loopback") or []
+            for r in lb:
+                if r.get("stages", {}).get("update"):
+                    L.append(
+                        f"- loopback {r['mib']} MiB "
+                        f"(streaming={r['streaming']}): update stage "
+                        f"{r['stages']['update']['t_ms']} ms inside the "
+                        f"pipeline, binding {r.get('binding_stage')}, "
+                        f"efficiency {r.get('pipeline_efficiency')}")
+            if lb:
                 L.append("")
 
     # -- telemetry summary (obs gate) ----------------------------------------

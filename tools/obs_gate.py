@@ -54,6 +54,18 @@ TOL_LOOPBACK = 0.25      # fused-kernel loopback GB/s
 COLLECTIVE_GATE_KEYS = ("codec_roundtrip_gbps", "codec_encode_gbps",
                         "codec_decode_gbps", "fused_ring_loopback_gbps")
 SWEEP_GATE_ARMS = ("psum_bf16", "ring_f32", "ring_bfp")
+# fused-optimizer bench rows (FUSED_OPT_BENCH_r*.json): step/update-stage
+# times gate lower-is-better, the speedup higher-is-better, and the
+# moment-state byte accounting is exact (a change means the state layout
+# changed — tiny tolerance, not timing noise)
+FUSED_OPT_GATE_KEYS = ("fused_ms", "ring_then_opt_ms",
+                       "opt_standalone_ms", "speedup_vs_ring_then_opt",
+                       "moment_state_bytes")
+# dryrun (cpu-mesh) fused-opt artifacts gate ONLY the exact accounting:
+# their timings carry oversubscription noise of the effect's own order
+FUSED_OPT_BYTE_KEYS = ("moment_state_bytes", "standalone_hbm_bytes")
+TOL_FUSED_OPT_TIME = 0.35
+TOL_EXACT = 0.01
 
 
 def collective_metric(key: str) -> str:
@@ -62,6 +74,10 @@ def collective_metric(key: str) -> str:
 
 def sweep_metric(size_mb, arm: str) -> str:
     return f"sweep.{size_mb}mb.{arm}_gbps"
+
+
+def fused_opt_metric(kind: str, key: str) -> str:
+    return f"fused_opt.{kind}.{key}"
 
 
 def _load(path):
@@ -74,9 +90,15 @@ def _newest(pattern):
     return paths[-1] if paths else None
 
 
-def _metric(value, source, *, higher=True, tol=TOL_RATE):
+def _metric(value, source, *, higher=True, tol=TOL_RATE,
+            two_sided=False):
+    """two_sided: ANY relative change beyond tol is a regression — for
+    exact accounting facts (byte counts) where a silent shrink is as
+    wrong as a growth (a halved moment-state byte count means the state
+    dtype/layout changed, not that memory 'improved')."""
     return {"value": float(value), "source": source,
-            "higher_is_better": bool(higher), "rel_tol": float(tol)}
+            "higher_is_better": bool(higher), "rel_tol": float(tol),
+            "two_sided": bool(two_sided)}
 
 
 def build_banked_summary() -> dict:
@@ -125,6 +147,28 @@ def build_banked_summary() -> dict:
                 if v:
                     metrics[f"{base}.{stage}_gbps"] = _metric(v, src)
 
+    # -- fused-optimizer bench ----------------------------------------------
+    p = (_newest("artifacts/fused_opt_bench_*.json")
+         or _newest("FUSED_OPT_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (FUSED_OPT_BYTE_KEYS if d.get("dryrun")
+                else FUSED_OPT_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:       # 0 is a real value (sgd moment bytes)
+                    continue
+                if key == "speedup_vs_ring_then_opt":
+                    m = _metric(v, src, tol=TOL_FUSED_OPT_TIME)
+                elif key in FUSED_OPT_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                else:
+                    m = _metric(v, src, higher=False,
+                                tol=TOL_FUSED_OPT_TIME)
+                metrics[fused_opt_metric(row["kind"], key)] = m
+
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
 
@@ -155,7 +199,12 @@ def gate(candidate: dict, banked: dict,
         compared += 1
         ref, got = spec["value"], cand[name]
         tol = spec["rel_tol"] * threshold_scale
-        if spec["higher_is_better"]:
+        if spec.get("two_sided"):
+            # exact accounting: any drift beyond tol fails (ref == 0
+            # degenerates to "any nonzero value fails")
+            bad = abs(got - ref) > abs(ref) * tol
+            better = False
+        elif spec["higher_is_better"]:
             bad = got < ref * (1.0 - tol)
             better = got > ref * (1.0 + tol)
         else:
