@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench obs-gate lint lint-fixtures
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench obs-gate lint lint-fixtures
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -95,6 +95,19 @@ zoo-validate:
 
 # the chaos fault matrix: every fault class x injection site x wire
 # format, each cell a real supervised run that must recover (or absorb)
-# on the 8-device virtual CPU mesh — docs/CHAOS.md
+# on the 8-device virtual CPU mesh — docs/CHAOS.md.  Per wire it also
+# runs the preempt-shrink cell: live reshard (dp8->dp4, no checkpoint)
+# vs checkpoint-restore MTTR, side by side.
 chaos-bench:
 	python tools/chaos_bench.py --fast
+
+# reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
+# the same mid-run preemption recovered by the live-reshard tier and by
+# checkpoint-restore; snapshot the newest artifact as the round's
+# committed record (obs-gate consumes it — dryrun CPU rows gate only the
+# exact plan wire-byte accounting)
+reshard-bench:
+	python tools/chaos_bench.py --fast --reshard-bench
+	@latest=$$(ls -t artifacts/reshard_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest RESHARD_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> RESHARD_BENCH_$(ROUND).json"

@@ -26,10 +26,14 @@ RULE_DOCS: Dict[str, str] = {
     "J6": "jaxpr sweep coverage: every registered codec must be swept",
     "J7": "per-replica gradient must be invariant to n_dp on a fixed "
           "batch (no collective on a loss head's gradient path)",
+    "J8": "reshard program: callback-free, sources donated, and ppermute "
+          "operand bytes == exactly the bytes that change owner per the "
+          "intersection table",
 }
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5")
-JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7")
+JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
+                                "J8")
 
 
 @dataclass(frozen=True)

@@ -461,6 +461,118 @@ def run_j7(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J8 — the live-reshard transfer program (parallel.reshard).  The MTTR
+# claim of the reshard recovery tier rests on the program moving EXACTLY
+# the bytes the intersection table says change owner — no padding waste,
+# no hidden host round-trips, and the source buffers actually donated
+# (the transfer must run in ~one state's footprint).  Checked statically
+# the same way J4 checks the ring: trace the lowered program abstractly,
+# sum ppermute operand bytes x static trip counts, and compare against
+# the plan's declared wire_bytes; any callback primitive or lost
+# donation is a finding.  Surfaces cover a shrink (dp8->dp4, divisor), a
+# NON-divisor shrink (dp8->dp3 — the boundary-splitting segments), and
+# an EF-residual move (topk-padded layout).
+# ---------------------------------------------------------------------------
+
+def _j8_build(n_src: int, n_tgt: int, codec_name: Optional[str],
+              n_flat_leaves: int, residual: bool):
+    def build():
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+        from ..compress import get_codec
+        from ..parallel import reshard as reshard_lib
+
+        live = 5000                    # deliberately non-round
+        unit = 1 if codec_name is None else get_codec(codec_name).pad_elems
+        pad_src = live + (-live) % (n_src * unit)
+        pad_tgt = live + (-live) % (n_tgt * unit)
+        plan = reshard_lib.make_plan(
+            live, n_src, pad_src, n_tgt, pad_tgt,
+            n_flat_leaves=n_flat_leaves, residual=residual)
+        mesh = Mesh(np.array(jax.devices()[:plan.flat.n_union]), ("dp",))
+        fn = reshard_lib.lower_apply(plan, mesh, "dp", donate=True)
+        jx = jax.make_jaxpr(fn)(*reshard_lib.abstract_operands(plan))
+        n_ops = plan.n_flat_leaves + (1 if plan.residual else 0)
+        return jx, plan.wire_bytes(), n_ops
+    return build
+
+
+def j8_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs; build() -> (closed jaxpr, declared wire
+    bytes, donated operand count).  GRAFTLINT_J8_FIXTURE appends a
+    surface from a module path exposing ``build()`` — the bad-fixture /
+    exit-code hook, same contract as J7's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("reshard dp8->dp4 adamw", _j8_build(8, 4, None, 3, False)),
+        ("reshard dp8->dp3 non-divisor", _j8_build(8, 3, None, 1, False)),
+        ("reshard dp8->dp4 topk+EF", _j8_build(8, 4, "topk", 2, True)),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J8_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j8_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def check_reshard_program(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J8 surface against the three invariants."""
+    findings: List[Finding] = []
+    jx, declared, n_ops = build()
+    c = _collect(jx.jaxpr)
+    cell = f"jaxpr[reshard {name}]"
+    if c["callbacks"]:
+        findings.append(Finding(
+            "J8", cell, 0,
+            f"{c['callbacks']} callback primitive(s) in the transfer "
+            "program — a reshard that round-trips the host is a "
+            "checkpoint restore wearing a costume"))
+    if c["wire_unknown"]:
+        findings.append(Finding(
+            "J8", cell, 0,
+            "ppermute under a while_loop — transfer bytes not statically "
+            "accountable (lower with a static table, not a data-"
+            "dependent loop)"))
+    elif c["wire_bytes"] != declared:
+        findings.append(Finding(
+            "J8", cell, 0,
+            f"the lowered program's ppermute operands move "
+            f"{c['wire_bytes']} bytes but the intersection table "
+            f"declares {declared} changing owner — the reshard wire "
+            "accounting (MTTR claims, obs counters) is lying"))
+    donated = c["donated"] or ()
+    if sum(donated) < n_ops:
+        findings.append(Finding(
+            "J8", cell, 0,
+            f"expected all {n_ops} source operands donated, pjit "
+            f"donated_invars shows {sum(donated)}/{len(donated)} — the "
+            "transfer holds two full states in memory"))
+    return findings
+
+
+def run_j8(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j8_surfaces():
+        try:
+            fs = check_reshard_program(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J8", f"jaxpr[reshard {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] reshard {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -554,4 +666,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
             f"{sorted(missing)} — re-run the sweep"))
     findings.extend(run_fused_opt_cells(verbose=verbose))
     findings.extend(run_j7(verbose=verbose))
+    findings.extend(run_j8(verbose=verbose))
     return findings

@@ -125,6 +125,16 @@ def repad_flat(v, meta: FlatMeta) -> jax.Array:
     return jnp.pad(v[:total], (0, meta.padded_len - total))
 
 
+def params_like_from_meta(meta: FlatMeta):
+    """Rebuild a zero-device-work params pytree (ShapeDtypeStructs) from
+    flattening metadata — the handle a TARGET trainer needs to derive its
+    own layout (``_ensure_meta``) when the live state arrives from another
+    mesh shape (parallel.reshard) instead of from ``init_state``."""
+    leaves = [jax.ShapeDtypeStruct(s, d)
+              for s, d in zip(meta.shapes, meta.dtypes)]
+    return jax.tree_util.tree_unflatten(meta.treedef, leaves)
+
+
 def unflatten_tree(flat: jax.Array, meta: FlatMeta):
     leaves, off = [], 0
     for shape, dtype, size in zip(meta.shapes, meta.dtypes, meta.sizes):

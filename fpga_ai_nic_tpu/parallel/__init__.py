@@ -1,6 +1,7 @@
-from . import multihost, pipeline
+from . import multihost, pipeline, reshard
 from .ddp import DDPState, DDPTrainer
-from .elastic import ElasticConfig, ElasticTrainer, RecoveryExhausted
+from .elastic import (ElasticConfig, ElasticTrainer, RecoveryExhausted,
+                      ReshardPolicy)
 from .fsdp import FSDPState, FSDPTrainer
 from .mesh import make_mesh
 from .queued import QueuedDDPTrainer
@@ -11,4 +12,5 @@ __all__ = ["make_mesh", "DPTrainer", "TrainState",
            "ShardedTrainer", "ShardedState",
            "DDPTrainer", "DDPState", "QueuedDDPTrainer",
            "FSDPTrainer", "FSDPState", "pipeline", "multihost",
-           "ElasticTrainer", "ElasticConfig", "RecoveryExhausted"]
+           "ElasticTrainer", "ElasticConfig", "RecoveryExhausted",
+           "ReshardPolicy", "reshard"]

@@ -21,6 +21,10 @@ every detected fault into a bounded recovery instead of a lost job:
             heartbeat.beat(); maybe checkpoint
         on failure:
             classify -> record fault (observability.RecoveryStats)
+            shrinkable (preemption, state intact, ReshardPolicy armed):
+                multihost re-init -> LIVE mesh reshard onto the shrink
+                target (parallel.reshard: collective redistribution, no
+                disk, no replay) -> retry the same step on the new mesh
             preemption: multihost re-init
             restore last-good checkpoint -> retry with backoff
 
@@ -73,13 +77,40 @@ from ..runtime.watchdog import DeviceHangError, Heartbeat, Watchdog
 from ..utils.checkpoint import Checkpointer
 from ..utils.observability import Profiler
 
-__all__ = ["ElasticConfig", "ElasticTrainer", "RecoveryExhausted"]
+__all__ = ["ElasticConfig", "ElasticTrainer", "RecoveryExhausted",
+           "ReshardPolicy"]
 
 
 class RecoveryExhausted(RuntimeError):
     """A step kept failing after max_retries recoveries — the fault is not
     transient (or the recovery path itself is broken); escalate instead of
     looping forever the way the reference's wait() poll does."""
+
+
+@dataclass
+class ReshardPolicy:
+    """Arms the FIRST recovery tier: survive a preemption by migrating the
+    live TrainState to a smaller mesh (parallel.reshard) instead of a
+    checkpoint restore + replay.
+
+    ``trainer_factory(n) -> trainer`` builds an API-compatible trainer of
+    axis width ``n`` over the surviving devices (same loss/model/codec —
+    reshard keeps the wire format fixed across the move).  ``shrink_to``
+    is the explicit target width: the caller knows its batch-divisibility
+    and capacity constraints; the supervisor does not guess.  With
+    ``prewarm`` (the spare-capacity discipline), ``ElasticTrainer.
+    prewarm_reshard`` compiles the transfer program and the target
+    trainer's step AHEAD of the fault on a zeros ghost state, so the
+    measured MTTR is the migration itself, not a compile.
+
+    The tier is single-shot per supervisor: after a reshard the policy is
+    disarmed (a second preemption falls back to checkpoint restore on the
+    already-shrunk mesh); re-arm by constructing a new policy against the
+    new width."""
+
+    trainer_factory: Callable[[int], Any]
+    shrink_to: int
+    prewarm: bool = True
 
 
 @dataclass(frozen=True)
@@ -125,11 +156,19 @@ class ElasticTrainer:
                  cfg: Optional[ElasticConfig] = None, *,
                  plan: Optional[chaos_lib.FaultPlan] = None,
                  stage_fn: Optional[Callable[[Any], Any]] = None,
-                 profiler: Optional[Profiler] = None):
+                 profiler: Optional[Profiler] = None,
+                 reshard: Optional[ReshardPolicy] = None):
         self.trainer = trainer
         self.cfg = cfg or ElasticConfig()
         self.plan = plan
         self.stage_fn = stage_fn
+        self.reshard_policy = reshard
+        self._reshard_trainer = None     # built lazily from the factory
+        # set once a reshard moved the loop onto a different mesh: every
+        # later batch may still be placed for the OLD mesh (callers'
+        # batch_fn pre-shards), so step() re-places through the current
+        # trainer — a no-op for correctly placed batches
+        self._mesh_moved = False
         self.profiler = profiler or Profiler()
         self.watchdog = Watchdog(self.cfg.step_timeout_s)
         self.heartbeat = Heartbeat(stall_after_s=self.cfg.stall_after_s)
@@ -208,9 +247,16 @@ class ElasticTrainer:
 
     # -- recovery -----------------------------------------------------------
 
-    @staticmethod
-    def _classify(err: BaseException) -> str:
+    def _classify(self, err: BaseException, state: Any = None) -> str:
         if isinstance(err, chaos_lib.InjectedPreemption):
+            # a preemption whose pre-step state is still intact AND for
+            # which a shrink target is armed is SHRINKABLE: tier-1
+            # recovery migrates the live state to the smaller mesh
+            # (parallel.reshard) — no disk, no replay.  One detected at
+            # the wait boundary may have donated the state into the
+            # failed attempt; only checkpoint restore can rebuild that.
+            if self._reshard_available(state):
+                return "shrinkable"
             return "preemption"
         if isinstance(err, DeviceHangError):
             return "hang"
@@ -219,6 +265,67 @@ class ElasticTrainer:
         if isinstance(err, chaos_lib.InjectedFault):
             return err.kind
         return "error"
+
+    # -- tier 1: live mesh reshard ------------------------------------------
+
+    def _reshard_available(self, state) -> bool:
+        pol = self.reshard_policy
+        return (pol is not None
+                and 0 < pol.shrink_to < self.trainer.n
+                and state is not None
+                and chaos_lib.state_buffers_alive(state))
+
+    def _ensure_reshard_trainer(self):
+        if self._reshard_trainer is None:
+            pol = self.reshard_policy
+            assert pol is not None, "no ReshardPolicy armed"
+            self._reshard_trainer = pol.trainer_factory(pol.shrink_to)
+        return self._reshard_trainer
+
+    def _do_reshard(self, state):
+        """Migrate the live state to the shrink target and swap the loop
+        onto the new trainer.  The queue's dispatch closure reads
+        ``self.trainer`` at call time, so the swap re-routes every
+        subsequent attempt; the policy disarms (single-shot)."""
+        from . import reshard as reshard_lib
+        tgt = self._ensure_reshard_trainer()
+        new_state = reshard_lib.reshard_state(
+            self.trainer, tgt, state, events=self.profiler.events)
+        self.trainer = tgt
+        self.reshard_policy = None
+        self._reshard_trainer = None
+        self._mesh_moved = True
+        return new_state
+
+    def prewarm_reshard(self, state, batch=None) -> None:
+        """Compile the whole tier-1 path ahead of the fault (the
+        spare-capacity discipline): the transfer program, the target
+        trainer's params gather and — given a representative ``batch`` —
+        its step.  Runs on a zeros GHOST of ``state`` (same shapes/
+        shardings) so the live state is never donated into a warmup."""
+        from . import reshard as reshard_lib
+        pol = self.reshard_policy
+        if pol is None or not pol.prewarm:
+            return
+        tgt = self._ensure_reshard_trainer()
+
+        def ghost_leaf(a):
+            if isinstance(a, jax.Array):
+                return jax.device_put(
+                    np.zeros(a.shape, a.dtype), a.sharding)
+            return a
+
+        ghost = jax.tree_util.tree_map(ghost_leaf, state)
+        with self.profiler.bucket("reshard.prewarm"):
+            gstate = reshard_lib.reshard_state(self.trainer, tgt, ghost)
+            if batch is not None:
+                # EXECUTE one ghost step (not .lower().compile(): the
+                # AOT path does not populate the jit dispatch cache the
+                # fault-time retry will hit)
+                out = tgt.step_fn(gstate, tgt.shard_batch(batch))
+                jax.block_until_ready(out)
+
+    # -- tier 2: checkpoint restore -----------------------------------------
 
     def _restore(self):
         """Last-good state from the checkpoint directory.  The loop saved
@@ -248,11 +355,16 @@ class ElasticTrainer:
         it the retry can only reuse ``batch``, which is wrong data for a
         rewound step — run() always passes it."""
         step_i = int(state.step)
+        if self._mesh_moved and hasattr(self.trainer, "shard_batch"):
+            # the loop lives on a different mesh than the caller's
+            # batch_fn placed for: re-place (no-op when already right)
+            batch = self.trainer.shard_batch(batch)
         if self.plan is not None:
             self.plan.begin_step(step_i)
         t_fault = None
         event = None
         restored = False
+        resharded = False
         for attempt in range(self.cfg.max_retries + 1):
             try:
                 new_state, metrics = self.watchdog.run(
@@ -261,7 +373,7 @@ class ElasticTrainer:
                 metrics = self._check(metrics, step_i)
                 self._check_state(new_state, step_i)
             except Exception as err:  # noqa: BLE001 — the recovery boundary
-                kind = self._classify(err)
+                kind = self._classify(err, state)
                 now = time.monotonic()
                 t_fault = t_fault if t_fault is not None else now
                 ev = self.profiler.recovery.record_fault(
@@ -281,11 +393,31 @@ class ElasticTrainer:
                         f"step {step_i} failed {attempt + 1} times "
                         f"(last: {kind}); giving up after max_retries="
                         f"{self.cfg.max_retries}") from err
-                if kind == "preemption":
+                if kind in ("preemption", "shrinkable"):
                     # the process 'lost its slice': control-plane re-init
                     # before touching devices again (idempotent; a no-op
                     # single-process, the real thing on a pod restart)
                     multihost.initialize()
+                if kind == "shrinkable":
+                    # tier 1: migrate the LIVE state onto the shrink
+                    # target by collective redistribution — no disk IO,
+                    # no step replay; the retry re-runs THIS step on the
+                    # new mesh.  Any failure falls through to tier 2.
+                    try:
+                        with self.profiler.bucket("reshard"):
+                            state = self._do_reshard(state)
+                        resharded = True
+                        # the batch was placed for the OLD mesh: re-place
+                        # it for the new trainer's sharding
+                        raw = batch_fn(step_i) if batch_fn is not None \
+                            else batch
+                        batch = self.trainer.shard_batch(raw)
+                        time.sleep(self.cfg.backoff_s * (2 ** attempt))
+                        continue
+                    except Exception as rerr:  # noqa: BLE001 — tier fallback
+                        self.profiler.events.instant(
+                            "reshard.failed", step=step_i,
+                            error=repr(rerr)[:200])
                 with self.profiler.bucket("restore"):
                     state = self._restore()
                 restored = True
@@ -298,14 +430,20 @@ class ElasticTrainer:
                         batch = batch_fn(step_i)
                     if self.plan is not None:
                         self.plan.begin_step(step_i)
+                if resharded:
+                    # a restore AFTER a reshard lands on the new mesh:
+                    # repad_flat re-fits the checkpoint bytes, but the
+                    # batch placement must follow the current trainer
+                    batch = self.trainer.shard_batch(batch)
                 time.sleep(self.cfg.backoff_s * (2 ** attempt))
             else:
                 if t_fault is not None:
                     self.profiler.recovery.record_recovery(
                         time.monotonic() - t_fault, restored=restored,
-                        event=event)
+                        resharded=resharded, event=event)
                     self.profiler.events.instant(
-                        "recovered", step=step_i, restored=restored)
+                        "recovered", step=step_i, restored=restored,
+                        resharded=resharded)
                 self.heartbeat.beat()
                 return new_state, metrics
         raise AssertionError("unreachable")

@@ -314,6 +314,22 @@ class FSDPTrainer:
             step=jnp.asarray(restored["step"]),
             codec_state=self._init_codec_state())
 
+    # -- live resharding (parallel.reshard) ---------------------------------
+
+    def reshard_leaves(self, state: FSDPState) -> dict:
+        """Flat-vector leaves for a live mesh move — the shared transfer
+        naming (reshard.pack_state_leaves); ZeRO-3 has no replicated
+        params to rebuild, the shards ARE the state."""
+        from . import reshard as reshard_lib
+        return reshard_lib.pack_state_leaves(state.w_own, state.opt_state)
+
+    def state_from_reshard(self, leaves: dict, step,
+                           codec_state) -> FSDPState:
+        from . import reshard as reshard_lib
+        w_own, opt_state = reshard_lib.split_state_leaves(leaves)
+        return FSDPState(w_own=w_own, opt_state=opt_state,
+                         step=jnp.asarray(step), codec_state=codec_state)
+
     # -- data ---------------------------------------------------------------
 
     def shard_batch(self, batch):

@@ -46,3 +46,19 @@ def single_axis_mesh(axis: str = "dp", n: Optional[int] = None,
     if n is not None:
         devices = devices[:n]
     return Mesh(np.array(devices), (axis,))
+
+
+def flat_union_mesh(a: Mesh, b: Mesh, axis: str) -> Mesh:
+    """1-D mesh over the UNION of two meshes' device lists (order: a's
+    devices first, then b's not already present) — the transfer surface a
+    live reshard (parallel.reshard) runs its collective program on.  For a
+    shrink the union is just the source mesh flattened; for a grow it adds
+    the new devices after the survivors, so every source shard stays on
+    its original device when the program starts."""
+    devs = list(a.devices.reshape(-1))
+    seen = {d.id for d in devs}
+    for d in b.devices.reshape(-1):
+        if d.id not in seen:
+            devs.append(d)
+            seen.add(d.id)
+    return Mesh(np.array(devs), (axis,))

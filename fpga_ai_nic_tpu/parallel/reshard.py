@@ -1,0 +1,515 @@
+"""Live mesh resharding — recover from a shrink/grow by collective state
+redistribution instead of checkpoint-restore.
+
+The reference keeps training alive only while its ring is intact: losing
+one FPGA means a full shell reset and a cold restart
+(sw/mlp_mpi_example_f32.cpp:54-57).  Our ElasticTrainer (PR 1) survives
+faults, but every recovery is checkpoint-restore + replay — a preempted
+replica costs cold-start MTTR.  This module is ROADMAP item 5: migrate
+the **live** TrainState between mesh shapes (dp8 -> dp4 after a
+preemption, a scale-up under load) with portable collective
+redistribution (arXiv:2112.01075, memory-efficient array
+redistribution), reusing the ring's ppermute hop as the transfer
+primitive.  No disk, no replay: the state never leaves device memory.
+
+What moves, and how:
+
+  flat master / moment shards   Every ZeRO-1 leaf is one flat f32 vector
+      (``ops.fused_update.flat_meta``): ``live`` model elements plus a
+      mesh-shape-dependent zero tail (``pad_multiple(coll, n)``).  The
+      live range is mesh-invariant, so a mesh change is *exactly* an
+      array redistribution: cut [0, live) at every source-chunk and
+      target-chunk boundary; each resulting segment has one source owner
+      and one target owner — that is the **intersection table**.  The
+      lowering emits one ``lax.ppermute`` per owner-changing segment
+      with the segment's EXACT length as the operand (zero padding
+      waste), and a local slice-copy for segments that stay put.
+      graftlint rule J8 pins this statically: the traced program's
+      ppermute operand bytes must sum to precisely the bytes the table
+      says change owner.
+
+  EF codec residuals            ``codec_state`` is per-DEVICE state (the
+      gradient mass device i's local quantization dropped), not a shard
+      of one logical vector — so it redistributes by OWNERSHIP TRANSFER,
+      not by slicing: old device i's residual is assigned to new device
+      ``i * n_tgt // n_src`` and summed there in ascending-i order
+      (``golden_redistribute_residual`` is the bit-exact numpy twin).
+      Checkpoint restore re-zeros the residual (EF is self-healing, so
+      that is *correct* but loses one step's worth of compensated mass);
+      the reshard path preserves it bit-for-bit — the error-feedback
+      fixed point survives the migration.
+
+The whole transfer is ONE jitted program over a flat 1-D "union" mesh
+(``parallel.mesh.flat_union_mesh``) with every source buffer DONATED —
+the reference's updated-weights-over-gradient-buffer aliasing trick
+(hw/all_reduce.sv:240), applied to recovery.  For a shrink the union is
+the source mesh and nothing moves before the program runs; for a grow
+the source vector is first re-laid onto the union mesh (an XLA
+``device_put`` — recorded honestly as ``seed_bytes``, outside the J8
+ppermute accounting) and the collective program finishes the job.
+
+``reshard_state(src_trainer, tgt_trainer, state)`` is the one-stop API
+the elastic loop's first recovery tier calls (docs/RESHARD.md).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from . import mesh as mesh_lib
+from ..ops import fused_update
+
+__all__ = [
+    "Transfer", "FlatPlan", "ResidualPlan", "ReshardPlan",
+    "intersection_table", "residual_owners", "make_plan", "lower_apply",
+    "golden_redistribute_residual", "reshard_state", "abstract_operands",
+    "pack_state_leaves", "split_state_leaves",
+]
+
+
+def pack_state_leaves(w_own, opt_state) -> Dict[str, Any]:
+    """THE flat-leaf naming convention of a live move (w_own + sorted
+    ``opt.<k>`` moments) — one definition shared by every trainer's
+    ``reshard_leaves`` so the transfer set cannot drift between trainer
+    kinds (``reshard_state`` asserts its length against the plan)."""
+    d = {"w_own": w_own}
+    d.update({f"opt.{k}": v for k, v in sorted((opt_state or {}).items())})
+    return d
+
+
+def split_state_leaves(leaves: Dict[str, Any]):
+    """Inverse of ``pack_state_leaves``: (w_own, opt_state)."""
+    return leaves["w_own"], {k[len("opt."):]: v for k, v in leaves.items()
+                             if k.startswith("opt.")}
+
+
+class Transfer(NamedTuple):
+    """One intersection-table segment: ``length`` contiguous live elements
+    moving from source device ``src`` (at chunk-local ``src_off``) to
+    target device ``dst`` (at chunk-local ``dst_off``).  ``src == dst``
+    means the bytes stay resident — a local copy, zero wire."""
+
+    src: int
+    dst: int
+    src_off: int
+    dst_off: int
+    length: int
+
+
+def intersection_table(live: int, chunk_src: int,
+                       chunk_tgt: int) -> Tuple[Transfer, ...]:
+    """Source->target shard intersections of a [live] flat vector chunked
+    ``chunk_src`` per source device vs ``chunk_tgt`` per target device:
+    cut [0, live) at every chunk boundary of either layout; each segment
+    between consecutive cuts has exactly one owner on each side.  The
+    segments PARTITION the live range (asserted), so nothing is moved
+    twice and nothing is dropped."""
+    assert live > 0 and chunk_src > 0 and chunk_tgt > 0
+    cuts = {0, live}
+    cuts.update(range(chunk_src, live, chunk_src))
+    cuts.update(range(chunk_tgt, live, chunk_tgt))
+    edges = sorted(cuts)
+    table = []
+    for a, b in zip(edges, edges[1:]):
+        src, dst = a // chunk_src, a // chunk_tgt
+        table.append(Transfer(src=src, dst=dst,
+                              src_off=a - src * chunk_src,
+                              dst_off=a - dst * chunk_tgt,
+                              length=b - a))
+    assert sum(t.length for t in table) == live
+    return tuple(table)
+
+
+class FlatPlan(NamedTuple):
+    """Redistribution plan for ONE flat-vector layout (all master/moment
+    leaves of a state share it).  ``chunk_src`` is the per-device chunk
+    in the UNION layout the program reads (== the trainer layout's chunk
+    for a shrink); ``chunk_tgt`` the target trainer layout's chunk."""
+
+    live: int
+    n_src: int
+    n_tgt: int
+    n_union: int
+    chunk_src: int
+    chunk_tgt: int
+    padded_src: int          # source trainer layout length (n_src chunks)
+    padded_tgt: int          # target trainer layout length (n_tgt chunks)
+    seed_len: int            # union input layout length (n_union chunks)
+    table: Tuple[Transfer, ...]
+
+    @property
+    def wire_elems(self) -> int:
+        """Elements that change owner — what the ppermutes move."""
+        return sum(t.length for t in self.table if t.src != t.dst)
+
+    @property
+    def local_elems(self) -> int:
+        return self.live - self.wire_elems
+
+    @property
+    def seed_elems(self) -> int:
+        """Elements the grow-path seeding re-lays out BEFORE the program
+        — counted with the same intersection rule (source layout vs
+        union layout; only owner changes move).  0 for a shrink: the
+        union layout IS the source layout."""
+        if self.n_union == self.n_src:
+            return 0
+        c_src_trainer = self.padded_src // self.n_src
+        return sum(t.length for t in intersection_table(
+            self.live, c_src_trainer, self.chunk_src) if t.src != t.dst)
+
+
+class ResidualPlan(NamedTuple):
+    """Redistribution plan for per-device EF residuals: old device i's
+    [pad_src] residual (live prefix) is summed into new device
+    ``owners[i]``'s [pad_tgt] residual, ascending-i order."""
+
+    live: int
+    n_src: int
+    n_tgt: int
+    n_union: int
+    pad_src: int             # source per-device residual length
+    pad_tgt: int             # target per-device residual length
+    owners: Tuple[int, ...]
+
+    @property
+    def wire_elems(self) -> int:
+        return self.live * sum(1 for i, o in enumerate(self.owners)
+                               if i != o)
+
+
+def residual_owners(n_src: int, n_tgt: int) -> Tuple[int, ...]:
+    """Old device -> new owner assignment: contiguous groups, every old
+    residual has exactly one new home (mass is conserved), fresh devices
+    beyond the assignment start at zero (a new replica has dropped
+    nothing yet)."""
+    assert n_src > 0 and n_tgt > 0
+    return tuple(i * n_tgt // n_src for i in range(n_src))
+
+
+class ReshardPlan(NamedTuple):
+    """The full mesh-shape change as a static collective program
+    description: one FlatPlan shared by ``n_flat_leaves`` state vectors
+    (master + optimizer moments) plus an optional ResidualPlan."""
+
+    flat: FlatPlan
+    n_flat_leaves: int
+    residual: Optional[ResidualPlan]
+
+    def wire_bytes(self, itemsize: int = 4) -> int:
+        """EXACTLY the bytes that change owner per the intersection table
+        — the number graftlint J8 holds the lowered program's ppermute
+        operands to."""
+        n = self.n_flat_leaves * self.flat.wire_elems
+        if self.residual is not None:
+            n += self.residual.wire_elems
+        return n * itemsize
+
+    def seed_bytes(self, itemsize: int = 4) -> int:
+        """Bytes the grow-path union seeding moves via device_put before
+        the collective program (0 for a shrink) — reported, never hidden
+        inside the ppermute accounting."""
+        return self.n_flat_leaves * self.flat.seed_elems * itemsize
+
+    def describe(self) -> Dict[str, Any]:
+        f = self.flat
+        return {
+            "n_src": f.n_src, "n_tgt": f.n_tgt, "live_elems": f.live,
+            "n_flat_leaves": self.n_flat_leaves,
+            "transfers": len(f.table),
+            "wire_bytes": self.wire_bytes(),
+            "seed_bytes": self.seed_bytes(),
+            "residual_moved": (0 if self.residual is None
+                               else self.residual.wire_elems // max(
+                                   self.residual.live, 1)),
+        }
+
+
+def make_plan(live: int, n_src: int, padded_src: int, n_tgt: int,
+              padded_tgt: int, *, n_flat_leaves: int,
+              residual: bool = False) -> ReshardPlan:
+    """Plan a mesh-shape change for a state of ``n_flat_leaves`` flat
+    vectors (source layout [padded_src] over n_src devices, target
+    [padded_tgt] over n_tgt) plus, with ``residual=True``, per-device EF
+    residuals ([padded_src] each -> [padded_tgt] each)."""
+    assert padded_src % n_src == 0, (padded_src, n_src)
+    assert padded_tgt % n_tgt == 0, (padded_tgt, n_tgt)
+    assert 0 < live <= min(padded_src, padded_tgt)
+    assert n_flat_leaves >= 1
+    n_union = max(n_src, n_tgt)
+    if n_tgt <= n_src:
+        # shrink: the union layout IS the source layout — no seeding
+        chunk_src, seed_len = padded_src // n_src, padded_src
+    else:
+        # grow: the source vector is re-laid onto n_union devices first
+        # (seed device_put); the smallest even chunking that holds the
+        # live elements keeps the seed cheap
+        chunk_src = -(-live // n_union)
+        seed_len = n_union * chunk_src
+    chunk_tgt = padded_tgt // n_tgt
+    flat = FlatPlan(live=live, n_src=n_src, n_tgt=n_tgt, n_union=n_union,
+                    chunk_src=chunk_src, chunk_tgt=chunk_tgt,
+                    padded_src=padded_src, padded_tgt=padded_tgt,
+                    seed_len=seed_len,
+                    table=intersection_table(live, chunk_src, chunk_tgt))
+    rp = None
+    if residual:
+        # the EF residual is per-DEVICE state: each device carries a FULL
+        # padded-model vector ([padded_len], not a chunk) — see
+        # DPTrainer._init_codec_state
+        rp = ResidualPlan(live=live, n_src=n_src, n_tgt=n_tgt,
+                          n_union=n_union,
+                          pad_src=padded_src, pad_tgt=padded_tgt,
+                          owners=residual_owners(n_src, n_tgt))
+    return ReshardPlan(flat=flat, n_flat_leaves=n_flat_leaves, residual=rp)
+
+
+# ---------------------------------------------------------------------------
+# lowering: the plan as one jitted shard_map program (donated sources)
+# ---------------------------------------------------------------------------
+
+def _move_chunk(plan: FlatPlan, ax: str, chunk: jax.Array,
+                idx: jax.Array) -> jax.Array:
+    """SPMD body for one flat leaf: [chunk_src] -> [chunk_tgt].  Each
+    intersection segment is one exact-length hop: a single-pair ppermute
+    when the owner changes (receivers outside the pair get zeros — the
+    where-mask keeps only the true destination's write), a resident
+    slice-copy when it does not.  All offsets/lengths are static, so the
+    program is a fixed DAG the J8 sweep can account byte-for-byte."""
+    out = jnp.zeros((plan.chunk_tgt,), chunk.dtype)
+    for t in plan.table:
+        payload = lax.dynamic_slice_in_dim(chunk, t.src_off, t.length)
+        if t.src != t.dst:
+            payload = lax.ppermute(payload, ax, [(t.src, t.dst)])
+        upd = lax.dynamic_update_slice_in_dim(out, payload, t.dst_off, 0)
+        out = jnp.where(idx == t.dst, upd, out)
+    return out
+
+
+def _move_residual(plan: ResidualPlan, ax: str, resid: jax.Array,
+                   idx: jax.Array) -> jax.Array:
+    """SPMD body for the EF residual: old device i's live residual lands
+    (summed, ascending-i order — the golden twin's order) on new device
+    ``owners[i]``.  Devices with no assignment keep zeros: a fresh
+    replica has dropped nothing yet."""
+    live = lax.dynamic_slice_in_dim(resid, 0, plan.live)
+    out = jnp.zeros((plan.pad_tgt,), resid.dtype)
+    for i, owner in enumerate(plan.owners):
+        payload = live if i == owner else lax.ppermute(live, ax,
+                                                       [(i, owner)])
+        upd = out.at[:plan.live].add(payload)
+        out = jnp.where(idx == owner, upd, out)
+    return out
+
+
+def lower_apply(plan: ReshardPlan, union_mesh, ax: str, *,
+                donate: bool = True):
+    """The plan as ONE jitted transfer program over the union mesh.
+
+    Positional args: ``n_flat_leaves`` flat vectors in the union-source
+    layout ([seed_len], sharded P(ax)) then, if planned, the residual
+    global ([n_union * pad_src], sharded P(ax)).  Returns the same
+    leaves in the union-target layout ([n_union * chunk_tgt] each).
+    Every input is donated by default: the sources are dead the moment
+    the transfer lands (the elastic loop never touches them again), so
+    the program runs in ~one state's footprint, not two."""
+    fp = plan.flat
+    n_ops = plan.n_flat_leaves + (1 if plan.residual is not None else 0)
+
+    def body(*chunks):
+        idx = lax.axis_index(ax)
+        outs = [_move_chunk(fp, ax, c, idx)
+                for c in chunks[:plan.n_flat_leaves]]
+        if plan.residual is not None:
+            outs.append(_move_residual(plan.residual, ax, chunks[-1], idx))
+        return tuple(outs)
+
+    sm = jax.shard_map(body, mesh=union_mesh, in_specs=(P(ax),) * n_ops,
+                       out_specs=(P(ax),) * n_ops, check_vma=False)
+    return jax.jit(sm, donate_argnums=(tuple(range(n_ops)) if donate
+                                       else ()))
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_apply(plan: ReshardPlan, union_mesh, ax: str, donate: bool):
+    """Memoized ``lower_apply``: a supervisor reshards against a handful
+    of (plan, mesh) pairs at most, and reusing the jitted callable lets a
+    prewarmed transfer hit the compile cache at fault time — the MTTR
+    the recovery tier is measured on (plans and meshes are hashable
+    value types, so the key is exact)."""
+    return lower_apply(plan, union_mesh, ax, donate=donate)
+
+
+def abstract_operands(plan: ReshardPlan,
+                      dtype=jnp.float32) -> Tuple[jax.ShapeDtypeStruct, ...]:
+    """ShapeDtypeStructs matching ``lower_apply``'s positional args — the
+    zero-device-work handle the graftlint J8 sweep traces the program
+    through."""
+    fp = plan.flat
+    ops = [jax.ShapeDtypeStruct((fp.seed_len,), dtype)
+           for _ in range(plan.n_flat_leaves)]
+    if plan.residual is not None:
+        rp = plan.residual
+        ops.append(jax.ShapeDtypeStruct((rp.n_union * rp.pad_src,), dtype))
+    return tuple(ops)
+
+
+# ---------------------------------------------------------------------------
+# numpy golden twin (residual redistribution is the only value-changing
+# part of a reshard — flat leaves move bytes, residuals SUM)
+# ---------------------------------------------------------------------------
+
+def golden_redistribute_residual(res: np.ndarray, live: int, n_tgt: int,
+                                 pad_tgt: int) -> np.ndarray:
+    """Bit-exact twin of ``_move_residual`` over the whole mesh:
+    ``res[n_src, pad_src]`` -> ``[n_tgt, pad_tgt]``, f32 sums in
+    ascending-source order (the lowered program's order — sequential
+    dependent adds XLA may not reassociate)."""
+    res = np.asarray(res, np.float32)
+    n_src = res.shape[0]
+    out = np.zeros((n_tgt, pad_tgt), np.float32)
+    for i, owner in enumerate(residual_owners(n_src, n_tgt)):
+        out[owner, :live] = out[owner, :live] + res[i, :live]
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the one-stop API: reshard a live trainer state between mesh shapes
+# ---------------------------------------------------------------------------
+
+def _wire_format(trainer):
+    """Everything that parameterizes the trainer's wire format — name
+    AND options AND the legacy BFPConfig.  A name-only comparison would
+    let e.g. an int8+error_feedback source reshard onto an int8 no-EF
+    target: the residual would be moved, handed over, and silently never
+    consumed (the target's step takes the non-EF path)."""
+    coll = trainer.cfg.collective
+    return (coll.codec, tuple(coll.codec_opts or ()), coll.compression,
+            bool(getattr(trainer, "_ef", False)))
+
+
+def plan_for(src_trainer, tgt_trainer) -> ReshardPlan:
+    """Build the ReshardPlan for a src->tgt trainer pair (both metas must
+    be known — the source trained, the target gets its layout derived
+    from the source's via ``fused_update.params_like_from_meta``)."""
+    if type(src_trainer) is not type(tgt_trainer):
+        raise ValueError(
+            f"reshard moves state between mesh SHAPES, not trainer kinds: "
+            f"{type(src_trainer).__name__} -> "
+            f"{type(tgt_trainer).__name__}")
+    if src_trainer.ax != tgt_trainer.ax:
+        raise ValueError(
+            f"axis mismatch: {src_trainer.ax!r} -> {tgt_trainer.ax!r}")
+    if _wire_format(src_trainer) != _wire_format(tgt_trainer):
+        raise ValueError(
+            "reshard keeps the wire format fixed across the move "
+            f"(codec/opts/EF {_wire_format(src_trainer)} -> "
+            f"{_wire_format(tgt_trainer)}); change codecs via "
+            "checkpoint-restore")
+    src_meta = src_trainer._meta
+    assert src_meta is not None, "source trainer has no layout (init first)"
+    if tgt_trainer._meta is None:
+        tgt_trainer._ensure_meta(fused_update.params_like_from_meta(src_meta))
+    tgt_meta = tgt_trainer._meta
+    live = sum(src_meta.sizes)
+    if live != sum(tgt_meta.sizes):
+        raise ValueError(
+            f"layout mismatch: {live} live elements at the source vs "
+            f"{sum(tgt_meta.sizes)} at the target — different models")
+    from .. import optim
+    n_flat = 1 + len(optim.OptimizerSpec.from_optimizer(
+        src_trainer.cfg.optimizer).state_keys)
+    ef = bool(getattr(src_trainer, "_ef", False))
+    return make_plan(live, src_trainer.n, src_meta.padded_len,
+                     tgt_trainer.n, tgt_meta.padded_len,
+                     n_flat_leaves=n_flat, residual=ef)
+
+
+def _to_union(v: jax.Array, plan: FlatPlan, sharding) -> jax.Array:
+    """Source-layout [padded_src] -> union-source layout [seed_len] on
+    the union mesh.  Shrink: identity layout, free placement.  Grow: the
+    seed device_put (plan.seed_bytes) — XLA's resharding, counted apart
+    from the collective program's wire bytes."""
+    if plan.seed_len < plan.padded_src:
+        v = lax.slice_in_dim(v, 0, plan.seed_len)
+    elif plan.seed_len > plan.padded_src:
+        v = jnp.pad(v, (0, plan.seed_len - plan.padded_src))
+    return jax.device_put(v, sharding)
+
+
+def reshard_state(src_trainer, tgt_trainer, state, *, events=None,
+                  donate: bool = True):
+    """Move a live TrainState/FSDPState from ``src_trainer``'s mesh to
+    ``tgt_trainer``'s in one collective transfer program (see module
+    docstring).  Returns the target trainer's state, step preserved,
+    masters/moments value-exact (the live elements only ever move),
+    EF residual redistributed (not re-zeroed).  With ``donate`` the
+    source buffers are consumed."""
+    plan = plan_for(src_trainer, tgt_trainer)
+    fp = plan.flat
+    ax = src_trainer.ax
+    union_mesh = mesh_lib.flat_union_mesh(src_trainer.mesh,
+                                          tgt_trainer.mesh, ax)
+    assert union_mesh.shape[ax] >= fp.n_union
+    if union_mesh.shape[ax] > fp.n_union:
+        union_mesh = mesh_lib.single_axis_mesh(
+            ax, fp.n_union, list(union_mesh.devices.reshape(-1)))
+    u_shard = NamedSharding(union_mesh, P(ax))
+
+    leaves = src_trainer.reshard_leaves(state)
+    names = list(leaves)
+    assert len(names) == plan.n_flat_leaves, (names, plan.n_flat_leaves)
+    ops = [_to_union(leaves[k], fp, u_shard) for k in names]
+    if plan.residual is not None:
+        resid = state.codec_state
+        assert resid is not None, "EF codec with no residual state"
+        rp = plan.residual
+        if rp.n_union > rp.n_src:
+            resid = jnp.pad(
+                resid, (0, (rp.n_union - rp.n_src) * rp.pad_src))
+        ops.append(jax.device_put(resid, u_shard))
+
+    run = _cached_apply(plan, union_mesh, ax, donate)
+    span = (events.span("reshard.transfer", **plan.describe())
+            if events is not None else None)
+    if span is not None:
+        with span:
+            outs = run(*ops)
+            jax.block_until_ready(outs)
+    else:
+        outs = run(*ops)
+
+    # union-target layout -> the target trainer's mesh (shards 0..n_tgt-1
+    # are already resident on the right devices; the tail shards are the
+    # union's scratch and are dropped)
+    t_shard = NamedSharding(tgt_trainer.mesh, P(ax))
+
+    def land(v):
+        if fp.n_union > fp.n_tgt:
+            v = v[:fp.padded_tgt]
+        return jax.device_put(v, t_shard)
+
+    landed = {k: land(v) for k, v in zip(names, outs[:plan.n_flat_leaves])}
+    codec_state = None
+    if plan.residual is not None:
+        rp = plan.residual
+        r = outs[-1]
+        if rp.n_union > rp.n_tgt:
+            r = r[:rp.n_tgt * rp.pad_tgt]
+        codec_state = jax.device_put(r, t_shard)
+    elif getattr(tgt_trainer, "_ef", False):
+        codec_state = tgt_trainer._init_codec_state()
+    step = jnp.asarray(jax.device_get(state.step))
+    new_state = tgt_trainer.state_from_reshard(landed, step, codec_state)
+    if events is not None:
+        events.instant("reshard.done", n_src=fp.n_src, n_tgt=fp.n_tgt,
+                       wire_bytes=plan.wire_bytes(),
+                       seed_bytes=plan.seed_bytes())
+    return new_state

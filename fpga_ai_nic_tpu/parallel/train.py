@@ -94,6 +94,7 @@ class DPTrainer:
         self._meta = fused_update.flat_meta(params_like,
                                             self.cfg.collective, self.n)
         self.__dict__.pop("step_fn", None)
+        self.__dict__.pop("_gather_fn", None)
 
     def init_state(self, params) -> TrainState:
         """Split replicated params into ZeRO-1 master shards (the analogue
@@ -298,10 +299,12 @@ class DPTrainer:
 
     # -- restore ------------------------------------------------------------
 
-    def params_from_master(self, w_own: jax.Array):
-        """Rebuild the replicated working params from the sharded f32 master
-        vector — the checkpoint-restore analogue of the fused step's gather
-        phase.  Needed because checkpoints persist only the master shards."""
+    @functools.cached_property
+    def _gather_fn(self):
+        """The jitted master->params gather, built ONCE per layout: a
+        fresh closure per call would re-enter jax's jit cache (and
+        recompile) on every restore/reshard — recovery-path time that is
+        pure waste.  Invalidated with step_fn by _ensure_meta."""
         meta = self._meta
         assert meta is not None, "call init_state first (defines the layout)"
         coll, ax = self.cfg.collective, self.ax
@@ -312,7 +315,13 @@ class DPTrainer:
 
         return jax.jit(jax.shard_map(
             _gather, mesh=self.mesh, in_specs=P(self.ax), out_specs=P(),
-            check_vma=False))(w_own)
+            check_vma=False))
+
+    def params_from_master(self, w_own: jax.Array):
+        """Rebuild the replicated working params from the sharded f32 master
+        vector — the checkpoint-restore analogue of the fused step's gather
+        phase.  Needed because checkpoints persist only the master shards."""
+        return self._gather_fn(w_own)
 
     def restore_state(self, restored: dict,
                       params_like=None) -> TrainState:
@@ -338,6 +347,30 @@ class DPTrainer:
             # EF residual restarts at zero: it is a bounded local
             # accumulator, and checkpoints persist only the masters
             codec_state=self._init_codec_state())
+
+    # -- live resharding (parallel.reshard) ---------------------------------
+
+    def reshard_leaves(self, state: TrainState) -> dict:
+        """The state's flat-vector leaves in the shared transfer naming
+        (reshard.pack_state_leaves) — what a live mesh move must
+        transport (masters + optimizer moments; the replicated working
+        params are REBUILT from the landed masters, not moved, and the
+        EF residual rides its own per-device plan)."""
+        from . import reshard as reshard_lib
+        return reshard_lib.pack_state_leaves(state.w_own, state.opt_state)
+
+    def state_from_reshard(self, leaves: dict, step,
+                           codec_state) -> TrainState:
+        """Assemble this trainer's state from landed reshard leaves (the
+        inverse of ``reshard_leaves`` on the TARGET mesh): params are
+        rematerialized by the same gather phase a checkpoint restore
+        uses, so a resharded state and a restored one are constructed
+        identically — the bit-parity contract."""
+        from . import reshard as reshard_lib
+        w_own, opt_state = reshard_lib.split_state_leaves(leaves)
+        return TrainState(params=self.params_from_master(w_own),
+                          w_own=w_own, opt_state=opt_state,
+                          step=jnp.asarray(step), codec_state=codec_state)
 
     # -- data ---------------------------------------------------------------
 

@@ -62,7 +62,7 @@ __all__ = [
     "FaultSpec", "FaultPlan", "NormDriftGuard",
     "chunk_checksums", "collective_integrity", "integrity_tol",
     "check_step_diag", "install_collective_tap", "uninstall_collective_tap",
-    "activate",
+    "activate", "state_buffers_alive",
 ]
 
 FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
@@ -93,6 +93,21 @@ class IntegrityError(RuntimeError):
     """A collective/loss integrity guard tripped: the step's numbers cannot
     be trusted and must not reach (or have been gated out of) the
     optimizer."""
+
+
+def state_buffers_alive(state: Any) -> bool:
+    """True when every device buffer in a state pytree is still live —
+    the gate between the two recovery tiers (parallel.elastic): a
+    preemption detected BEFORE the step dispatched leaves the in-memory
+    state intact, so it can be migrated to the surviving mesh shape by
+    collective redistribution (parallel.reshard); one detected at the
+    wait boundary may have DONATED the state's buffers into the failed
+    attempt, and only a checkpoint restore can reconstruct it."""
+    import jax
+    for leaf in jax.tree_util.tree_leaves(state):
+        if isinstance(leaf, jax.Array) and leaf.is_deleted():
+            return False
+    return True
 
 
 @dataclass(frozen=True)

@@ -116,8 +116,24 @@ class RecoveryStats:
     recoveries: int = 0
     failed_recoveries: int = 0
     checkpoint_restores: int = 0
+    # live mesh-reshard recoveries (parallel.reshard): the first-tier
+    # path that migrates the in-memory state to the surviving mesh shape
+    # instead of restoring a checkpoint — tracked with its OWN MTTR
+    # aggregates so the reshard-vs-restore claim is measurable from the
+    # same stats dump
+    reshards: int = 0
     mttr_sum_s: float = 0.0
     mttr_max_s: float = 0.0
+    # single-tier recoveries only (see record_recovery): the *_n counts
+    # are the matching mean denominators, NOT the occurrence counters
+    # above (a reshard-then-restore recovery increments both occurrence
+    # counters but neither MTTR aggregate)
+    mttr_reshard_sum_s: float = 0.0
+    mttr_reshard_max_s: float = 0.0
+    mttr_reshard_n: int = 0
+    mttr_restore_sum_s: float = 0.0
+    mttr_restore_max_s: float = 0.0
+    mttr_restore_n: int = 0
     # bounded event log: [{step, kind, site, error, recovered_in_s}]
     events: List[Dict] = field(default_factory=list)
     max_events: int = 128
@@ -139,15 +155,37 @@ class RecoveryStats:
         return ev
 
     def record_recovery(self, seconds: float, *, restored: bool = False,
+                        resharded: bool = False,
                         event: Dict = None) -> None:
+        # per-tier MTTR aggregates attribute the wall clock to the tier
+        # that ALONE performed the recovery: a step that resharded and
+        # then still needed a restore books its (multi-tier) duration
+        # into neither — crediting it to both would corrupt exactly the
+        # reshard-vs-restore comparison these aggregates exist to make.
+        # The occurrence counters still count every tier that fired.
         with self._lock:
             self.recoveries += 1
             if restored:
                 self.checkpoint_restores += 1
+                if not resharded:
+                    self.mttr_restore_sum_s += seconds
+                    self.mttr_restore_max_s = max(self.mttr_restore_max_s,
+                                                  seconds)
+                    self.mttr_restore_n += 1
+            if resharded:
+                self.reshards += 1
+                if not restored:
+                    self.mttr_reshard_sum_s += seconds
+                    self.mttr_reshard_max_s = max(self.mttr_reshard_max_s,
+                                                  seconds)
+                    self.mttr_reshard_n += 1
             self.mttr_sum_s += seconds
             self.mttr_max_s = max(self.mttr_max_s, seconds)
         if event is not None:
             event["recovered_in_s"] = round(seconds, 4)
+            event["tier"] = ("reshard+restore" if resharded and restored
+                             else "reshard" if resharded
+                             else "restore" if restored else "retry")
 
     def record_failed_recovery(self) -> None:
         with self._lock:
@@ -156,14 +194,22 @@ class RecoveryStats:
     def as_dict(self) -> Dict:
         with self._lock:
             n = self.recoveries
+            nrs, nre = self.mttr_reshard_n, self.mttr_restore_n
             return {
                 "faults": dict(self.faults),
                 "faults_total": sum(self.faults.values()),
                 "recoveries": n,
                 "failed_recoveries": self.failed_recoveries,
                 "checkpoint_restores": self.checkpoint_restores,
+                "reshards": self.reshards,
                 "mttr_mean_s": (self.mttr_sum_s / n) if n else 0.0,
                 "mttr_max_s": self.mttr_max_s,
+                "mttr_reshard_mean_s": (self.mttr_reshard_sum_s / nrs)
+                                       if nrs else 0.0,
+                "mttr_reshard_max_s": self.mttr_reshard_max_s,
+                "mttr_restore_mean_s": (self.mttr_restore_sum_s / nre)
+                                       if nre else 0.0,
+                "mttr_restore_max_s": self.mttr_restore_max_s,
                 "events": list(self.events),
                 "events_dropped": self.events_dropped,
             }

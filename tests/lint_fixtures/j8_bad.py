@@ -1,0 +1,46 @@
+"""J8 bad fixture: a reshard lowering that ppermutes WHOLE SOURCE CHUNKS
+for every segment instead of the segment's exact length — the padded
+payload "simplification" that silently moves ~2x the bytes the
+intersection table declares (and what a naive all-gather-then-slice
+lowering degenerates to).  The plan's declared wire_bytes stays the
+honest table figure, so the traced program's ppermute operand bytes no
+longer match it and J8 must fire with the moved-vs-declared numbers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build():
+    from fpga_ai_nic_tpu.parallel import reshard as reshard_lib
+
+    live, n_src, n_tgt = 5000, 8, 3
+    pad_src = live + (-live) % n_src
+    pad_tgt = live + (-live) % n_tgt
+    plan = reshard_lib.make_plan(live, n_src, pad_src, n_tgt, pad_tgt,
+                                 n_flat_leaves=1, residual=False)
+    fp = plan.flat
+    mesh = Mesh(np.array(jax.devices()[:fp.n_union]), ("dp",))
+
+    def body(chunk):
+        idx = lax.axis_index("dp")
+        out = jnp.zeros((fp.chunk_tgt,), chunk.dtype)
+        for t in fp.table:
+            # BAD: ship the whole source chunk per segment, slice at the
+            # receiver — wire bytes balloon past the declared table
+            payload = chunk
+            if t.src != t.dst:
+                payload = lax.ppermute(payload, "dp", [(t.src, t.dst)])
+            seg = lax.dynamic_slice_in_dim(payload, t.src_off, t.length)
+            upd = lax.dynamic_update_slice_in_dim(out, seg, t.dst_off, 0)
+            out = jnp.where(idx == t.dst, upd, out)
+        return out
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P("dp"),
+                               out_specs=P("dp"), check_vma=False),
+                 donate_argnums=(0,))
+    jx = jax.make_jaxpr(fn)(
+        jax.ShapeDtypeStruct((fp.seed_len,), jnp.float32))
+    return jx, plan.wire_bytes(), 1

@@ -398,11 +398,74 @@ class TestJ7GradScale:
         assert "ratio 2" in fs[0].message and "ratio 4" in fs[1].message
 
     def test_exit_code_with_fixture_env(self):
+        # one subprocess pays for the full sweep, so BOTH value-level
+        # fixture hooks ride it: J7 (grad scale) and J8 (reshard wire
+        # accounting) must each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
-                   GRAFTLINT_J7_FIXTURE=self.FIXTURE)
+                   GRAFTLINT_J7_FIXTURE=self.FIXTURE,
+                   GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
             text=True, timeout=600)
         assert proc.returncode != 0, proc.stdout + proc.stderr
         assert "J7:" in proc.stdout
+        assert "J8:" in proc.stdout
+
+
+class TestJ8Reshard:
+    """J8: the live-reshard transfer program (parallel.reshard) must be
+    callback-free, donate its sources, and move EXACTLY the bytes the
+    intersection table declares — the wire-accounting contract behind
+    the reshard-vs-restore MTTR claim (docs/RESHARD.md)."""
+
+    FIXTURE = os.path.join(FIXTURES, "j8_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j8
+        findings = run_j8()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_with_byte_delta(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j8_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_reshard_program
+        fs = check_reshard_program("j8_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J8"}
+        # the finding must carry the moved-vs-declared numbers
+        assert "declares" in fs[0].message and "move" in fs[0].message
+
+    def test_callback_in_program_fires(self):
+        """A host round-trip smuggled into the transfer program is a
+        checkpoint restore wearing a costume — J8 must name it."""
+        import jax
+        import jax.numpy as jnp
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_reshard_program
+
+        def build():
+            def prog(x):
+                return jax.pure_callback(
+                    lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            jx = jax.make_jaxpr(jax.jit(prog, donate_argnums=(0,)))(
+                jax.ShapeDtypeStruct((64,), jnp.float32))
+            return jx, 0, 1
+
+        fs = check_reshard_program("callback", build)
+        assert any("callback" in f.message for f in fs), fs
+
+    def test_surface_failure_lands_as_j8_finding(self, monkeypatch):
+        """A surface that cannot even trace must fail LOUDLY as a J8
+        finding (run_j8 wraps it), never a silent skip."""
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j8_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j8()
+        assert len(fs) == 1 and fs[0].code == "J8"
+        assert "boom" in fs[0].message

@@ -1,0 +1,401 @@
+"""Live mesh resharding (parallel.reshard) — the spec-enforcement layer.
+
+The contract under test (docs/RESHARD.md, ROADMAP item 5):
+
+- the intersection table PARTITIONS the live range (nothing moved twice,
+  nothing dropped) for divisor and non-divisor mesh moves alike, and its
+  wire accounting counts exactly the owner-changing bytes;
+- ``fused_update.repad_flat`` is value-exact across non-divisor mesh
+  moves and codec-unit padding interactions (dp8 -> dp3, dp2 -> dp8);
+- BIT-PARITY: a TrainState resharded dp8 -> dp4 produces the same
+  next-step update as the same logical state constructed natively on the
+  dp4 mesh — per trainer, per codec, fused-optimizer moments included;
+- EF residuals REDISTRIBUTE (bit-exact vs the numpy golden twin, mass
+  conserved) instead of re-zeroing like checkpoint restore — the
+  topk/int8 error-feedback fixed point survives the migration;
+- the elastic loop's shrinkable tier recovers a preemption by live
+  reshard (no checkpoint touched) and falls back to restore when the
+  state's buffers were donated into the failed attempt.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fpga_ai_nic_tpu import compress
+from fpga_ai_nic_tpu.models import mlp
+from fpga_ai_nic_tpu.ops import fused_update
+from fpga_ai_nic_tpu.parallel import (DPTrainer, FSDPTrainer, make_mesh,
+                                      ReshardPolicy)
+from fpga_ai_nic_tpu.parallel import reshard as rs
+from fpga_ai_nic_tpu.parallel.elastic import ElasticConfig, ElasticTrainer
+from fpga_ai_nic_tpu.runtime import chaos
+from fpga_ai_nic_tpu.utils.config import (CollectiveConfig, MeshConfig,
+                                          MLPConfig, OptimizerConfig,
+                                          TrainConfig)
+from fpga_ai_nic_tpu.utils.observability import Profiler
+
+MCFG = MLPConfig(layer_sizes=(32, 64, 10), dtype="float32")
+
+
+def _loss(params, batch):
+    return mlp.loss_fn(params, batch, MCFG)
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.standard_normal((n, 32)).astype(np.float32)
+    y = r.integers(0, 10, n).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+def _trainer(n, codec=None, codec_opts=(), fused=False, kind="adamw",
+             cls=DPTrainer, axis=None):
+    axis = axis or ("fsdp" if cls is FSDPTrainer else "dp")
+    cfg = TrainConfig(
+        iters=4, global_batch=64, mesh=MeshConfig(**{axis: n}),
+        collective=CollectiveConfig(impl="ring", codec=codec,
+                                    codec_opts=tuple(codec_opts),
+                                    fused_optimizer=fused),
+        optimizer=OptimizerConfig(kind=kind, learning_rate=3e-3,
+                                  weight_decay=0.01))
+    return cls(_loss, make_mesh(cfg.mesh), cfg)
+
+
+def _params():
+    return mlp.init(jax.random.PRNGKey(0), MCFG)
+
+
+# ---------------------------------------------------------------------------
+# planner: intersection table + wire accounting (pure host)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("live,c_src,c_tgt", [
+    (5000, 625, 1250),      # dp8 -> dp4 aligned
+    (5000, 625, 1667),      # dp8 -> dp3 non-divisor: boundary splits
+    (5000, 2500, 625),      # dp2 -> dp8 grow
+    (4999, 717, 1009),      # nothing divides anything
+])
+def test_intersection_table_partitions_live_range(live, c_src, c_tgt):
+    table = rs.intersection_table(live, c_src, c_tgt)
+    # exact partition: segments tile [0, live) in order
+    off = 0
+    for t in table:
+        assert t.src * c_src + t.src_off == off
+        assert t.dst * c_tgt + t.dst_off == off
+        assert t.length >= 1
+        # a segment never crosses a chunk boundary on either side
+        assert t.src_off + t.length <= c_src
+        assert t.dst_off + t.length <= c_tgt
+        off += t.length
+    assert off == live
+    # segment count is bounded by the cut points of both layouts
+    assert len(table) <= -(-live // c_src) + -(-live // c_tgt)
+
+
+def test_plan_wire_accounting_counts_only_owner_changes():
+    plan = rs.make_plan(5000, 8, 5000, 4, 5000, n_flat_leaves=3,
+                        residual=True)
+    fp = plan.flat
+    assert fp.wire_elems + fp.local_elems == fp.live
+    assert fp.seed_elems == 0           # shrink: no seeding
+    by_hand = sum(t.length for t in fp.table if t.src != t.dst)
+    assert plan.wire_bytes() == 4 * (3 * by_hand
+                                     + plan.residual.wire_elems)
+    # dp8->dp4 residual assignment moves 7 of 8 device residuals
+    assert plan.residual.wire_elems == 5000 * 7
+
+
+def test_residual_owners_assignment():
+    for n_src, n_tgt in ((8, 4), (8, 3), (2, 8), (7, 7)):
+        owners = rs.residual_owners(n_src, n_tgt)
+        assert len(owners) == n_src
+        assert all(0 <= o < n_tgt for o in owners)
+        assert list(owners) == sorted(owners)      # contiguous groups
+    assert rs.residual_owners(8, 8) == tuple(range(8))  # identity = free
+
+
+def test_grow_plan_records_seed_bytes():
+    plan = rs.make_plan(5000, 2, 5000, 8, 5000, n_flat_leaves=1)
+    assert plan.flat.n_union == 8
+    # dp2 -> dp8 seed: only old device 0's first new-chunk (625 elems)
+    # stays put; everything else changes device during the re-layout
+    assert plan.seed_bytes() == 4 * (5000 - 625)
+    # the union chunking still partitions the live range
+    assert sum(t.length for t in plan.flat.table) == 5000
+
+
+# ---------------------------------------------------------------------------
+# repad_flat: non-divisor mesh moves x codec pad_elems (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("codec,n_from,n_to", [
+    (None, 8, 3), (None, 2, 8),
+    ("bfp", 8, 3), ("topk", 2, 8), ("int8", 8, 3),
+])
+def test_repad_flat_non_divisor_mesh_moves(codec, n_from, n_to):
+    """A flat master written under one mesh width re-fits value-exactly
+    onto another, including non-divisor moves where the codec-unit
+    padding multiple (pad_elems x n) changes the tail length in both
+    directions."""
+    coll = CollectiveConfig(impl="ring", codec=codec)
+    params = _params()
+    meta_a = fused_update.flat_meta(params, coll, n_from)
+    meta_b = fused_update.flat_meta(params, coll, n_to)
+    live = sum(meta_a.sizes)
+    assert sum(meta_b.sizes) == live
+    if codec is not None:
+        unit = compress.get_codec(codec).pad_elems
+        assert meta_a.padded_len % (n_from * unit) == 0
+        assert meta_b.padded_len % (n_to * unit) == 0
+    r = np.random.default_rng(3)
+    v = np.zeros(meta_a.padded_len, np.float32)
+    v[:live] = r.standard_normal(live).astype(np.float32)
+    out = fused_update.repad_flat(jnp.asarray(v), meta_b)
+    assert out.shape == (meta_b.padded_len,)
+    np.testing.assert_array_equal(np.asarray(out)[:live], v[:live])
+    if meta_b.padded_len > live:
+        assert float(jnp.abs(out[live:]).max()) == 0.0
+    # and back: the round trip is the identity on the live elements
+    back = fused_update.repad_flat(out, meta_a)
+    np.testing.assert_array_equal(np.asarray(back), v)
+
+
+# ---------------------------------------------------------------------------
+# bit-parity: resharded dp8->dp4 == natively constructed dp4 state
+# ---------------------------------------------------------------------------
+
+def _native_state(tr_tgt, state_src, tr_src):
+    """The dp4 'ghost': the same logical state constructed through the
+    established (value-exact) restore path — repad_flat for the flat
+    leaves, the golden residual redistribution for codec_state."""
+    payload = {"w_own": np.asarray(state_src.w_own),
+               "opt_state": {k: np.asarray(v)
+                             for k, v in state_src.opt_state.items()},
+               "step": int(state_src.step)}
+    native = tr_tgt.restore_state(
+        payload,
+        params_like=fused_update.params_like_from_meta(tr_src._meta))
+    if state_src.codec_state is not None:
+        live = sum(tr_src._meta.sizes)
+        g = rs.golden_redistribute_residual(
+            np.asarray(state_src.codec_state).reshape(tr_src.n, -1),
+            live, tr_tgt.n, tr_tgt._meta.padded_len)
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        native = native._replace(codec_state=jax.device_put(
+            jnp.asarray(g.reshape(-1)),
+            NamedSharding(tr_tgt.mesh, P(tr_tgt.ax))))
+    return native
+
+
+_PARITY_CELLS = [
+    # (cls, codec, codec_opts, fused)
+    (DPTrainer, None, (), True),
+    (DPTrainer, "bfp", (), True),
+    (DPTrainer, "topk", (), True),
+    (DPTrainer, "int8", (("error_feedback", True),), False),
+    (FSDPTrainer, None, (), False),
+    (FSDPTrainer, "topk", (), False),
+]
+
+
+@pytest.mark.parametrize(
+    "cls,codec,opts,fused", _PARITY_CELLS,
+    ids=[f"{c.__name__}-{k or 'none'}{'-fused' if f else ''}"
+         for c, k, _, f in _PARITY_CELLS])
+def test_bit_parity_resharded_vs_native_dp8_to_dp4(cls, codec, opts,
+                                                   fused):
+    """THE acceptance criterion: train 2 steps at width 8, reshard the
+    live state to width 4, and compare against the same logical state
+    constructed natively on the width-4 mesh — every state leaf bitwise,
+    then ONE more step on each, outputs bitwise (same trainer, same
+    batch, so any divergence is the reshard's)."""
+    tr8 = _trainer(8, codec=codec, codec_opts=opts, fused=fused, cls=cls)
+    state = tr8.init_state(_params())
+    batch8 = tr8.shard_batch(_data())
+    for _ in range(2):
+        state, _m = tr8.step(state, batch8)
+
+    tr4 = _trainer(4, codec=codec, codec_opts=opts, fused=fused, cls=cls)
+    # the reshard consumes the source, so the native ghost is built from
+    # host copies first
+    host = jax.device_get(state)
+    native = _native_state(tr4, host, tr8)
+    resharded = rs.reshard_state(tr8, tr4, state)
+
+    assert int(resharded.step) == int(native.step) == 2
+    assert tr8._meta.padded_len % 8 == 0
+    assert tr4._meta.padded_len % 4 == 0
+    for k in ("w_own",):
+        np.testing.assert_array_equal(np.asarray(getattr(resharded, k)),
+                                      np.asarray(getattr(native, k)))
+    for k in native.opt_state:
+        np.testing.assert_array_equal(np.asarray(resharded.opt_state[k]),
+                                      np.asarray(native.opt_state[k]))
+    if native.codec_state is not None:
+        # restore re-zeros the residual; reshard must NOT — the
+        # redistributed carry is bitwise the golden sum
+        np.testing.assert_array_equal(np.asarray(resharded.codec_state),
+                                      np.asarray(native.codec_state))
+        assert float(jnp.abs(resharded.codec_state).max()) > 0.0
+    if hasattr(native, "params"):
+        for a, b in zip(jax.tree_util.tree_leaves(resharded.params),
+                        jax.tree_util.tree_leaves(native.params)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    # the next-step update is bit-identical: the fixed point (weights,
+    # moments, EF residual) survived the migration
+    batch4 = tr4.shard_batch(_data())
+    s_r, m_r = tr4.step(resharded, batch4)
+    s_n, m_n = tr4.step(native, batch4)
+    lr = m_r["loss"] if isinstance(m_r, dict) else m_r
+    ln = m_n["loss"] if isinstance(m_n, dict) else m_n
+    assert float(lr) == float(ln)
+    np.testing.assert_array_equal(np.asarray(s_r.w_own),
+                                  np.asarray(s_n.w_own))
+    if s_n.codec_state is not None:
+        np.testing.assert_array_equal(np.asarray(s_r.codec_state),
+                                      np.asarray(s_n.codec_state))
+
+
+def test_grow_dp2_to_dp8_value_exact():
+    tr2 = _trainer(2, kind="momentum")
+    state = tr2.init_state(_params())
+    state, _ = tr2.step(state, tr2.shard_batch(_data()))
+    host = {k: np.asarray(v)
+            for k, v in tr2.reshard_leaves(state).items()}
+    live = sum(tr2._meta.sizes)
+    tr8 = _trainer(8, kind="momentum")
+    grown = rs.reshard_state(tr2, tr8, state)
+    for k, v in tr8.reshard_leaves(grown).items():
+        np.testing.assert_array_equal(np.asarray(v)[:live],
+                                      host[k][:live])
+    s2, loss = tr8.step(grown, tr8.shard_batch(_data()))
+    assert np.isfinite(float(loss))
+
+
+def test_residual_mass_conserved_and_summed_in_golden_order():
+    r = np.random.default_rng(7)
+    res = r.standard_normal((8, 96)).astype(np.float32)
+    out = rs.golden_redistribute_residual(res, live=80, n_tgt=4,
+                                          pad_tgt=112)
+    assert out.shape == (4, 112)
+    # mass conserved exactly per coordinate (f64 check over f32 sums)
+    np.testing.assert_allclose(out[:, :80].sum(0), res[:, :80].sum(0),
+                               rtol=1e-6)
+    # pad coordinates stay zero; group assignment is pairs for 8->4
+    assert np.abs(out[:, 80:]).max() == 0.0
+    np.testing.assert_array_equal(
+        out[0, :80], (res[0, :80] + res[1, :80]).astype(np.float32))
+
+
+def test_plan_for_rejects_mismatches():
+    tr8 = _trainer(8)
+    tr8._ensure_meta(_params())
+    tr4_other_codec = _trainer(4, codec="topk")
+    with pytest.raises(ValueError, match="wire format"):
+        rs.plan_for(tr8, tr4_other_codec)
+    fs4 = _trainer(4, cls=FSDPTrainer)
+    with pytest.raises(ValueError, match="trainer kinds"):
+        rs.plan_for(tr8, fs4)
+    # SAME codec name, different options: an int8+EF source onto an
+    # int8 no-EF target would move the residual and silently never
+    # consume it — the guard must compare the whole wire format
+    tr8_ef = _trainer(8, codec="int8",
+                      codec_opts=(("error_feedback", True),))
+    tr8_ef._ensure_meta(_params())
+    tr4_no_ef = _trainer(4, codec="int8")
+    with pytest.raises(ValueError, match="wire format"):
+        rs.plan_for(tr8_ef, tr4_no_ef)
+
+
+# ---------------------------------------------------------------------------
+# elastic loop: the shrinkable recovery tier
+# ---------------------------------------------------------------------------
+
+_ECFG = ElasticConfig(step_timeout_s=4.0, stall_after_s=60.0,
+                      max_retries=3, backoff_s=0.01, ckpt_every=1)
+
+
+def test_elastic_preemption_recovers_by_live_reshard(tmp_path):
+    """A preemption at the issue boundary (state intact) with a
+    ReshardPolicy armed must recover via the reshard tier: run completes
+    on the dp4 trainer with ZERO checkpoint restores, the fault is
+    classified shrinkable, and the tier + MTTR land in the stats dump
+    and the event stream."""
+    tr8 = _trainer(8, kind="sgd")
+    state = tr8.init_state(_params())
+    host_batch = _data()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=2)], seed=11)
+    with chaos.activate(plan):
+        et = ElasticTrainer(
+            tr8, str(tmp_path), _ECFG, plan=plan,
+            reshard=ReshardPolicy(
+                lambda n: _trainer(n, kind="sgd"), shrink_to=4))
+        et.prewarm_reshard(state, host_batch)
+        state, metrics = et.run(state, lambda i: host_batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert int(state.step) == 5
+    assert np.isfinite(float(metrics["loss"]))
+    assert et.trainer.n == 4
+    assert rec["faults"] == {"shrinkable": 1}
+    assert rec["reshards"] == 1
+    assert rec["checkpoint_restores"] == 0
+    assert rec["mttr_reshard_mean_s"] > 0
+    assert rec["events"][0]["tier"] == "reshard"
+    # the policy is single-shot: disarmed after firing
+    assert et.reshard_policy is None
+    names = {e["name"] for e in et.profiler.events.snapshot()}
+    assert {"reshard.transfer", "reshard.done"} <= names
+
+
+def test_classify_falls_back_when_state_buffers_dead():
+    """A preemption whose state was donated into the failed attempt is
+    NOT shrinkable — there is nothing live to migrate; the ladder must
+    take the restore tier."""
+    tr8 = _trainer(8, kind="sgd")
+    state = tr8.init_state(_params())
+    et = ElasticTrainer(tr8, "/tmp/unused-ckpt-dir", _ECFG,
+                        reshard=ReshardPolicy(
+                            lambda n: _trainer(n, kind="sgd"),
+                            shrink_to=4))
+    err = chaos.InjectedPreemption(
+        chaos.FaultSpec("preemption", "queue.wait", step=0))
+    assert et._classify(err, state) == "shrinkable"
+    # kill one buffer the way donation does
+    state.w_own.delete()
+    assert not chaos.state_buffers_alive(state)
+    assert et._classify(err, state) == "preemption"
+    # and without a policy the class never appears
+    et2 = ElasticTrainer(tr8, "/tmp/unused-ckpt-dir", _ECFG)
+    assert et2._classify(err, None) == "preemption"
+
+
+def test_recovery_stats_tier_accounting():
+    p = Profiler()
+    ev1 = p.recovery.record_fault("shrinkable", 3, site="queue.issue")
+    p.recovery.record_recovery(0.2, resharded=True, event=ev1)
+    ev2 = p.recovery.record_fault("preemption", 4, site="queue.wait")
+    p.recovery.record_recovery(1.0, restored=True, event=ev2)
+    d = p.recovery.as_dict()
+    assert d["reshards"] == 1 and d["checkpoint_restores"] == 1
+    assert d["mttr_reshard_mean_s"] == pytest.approx(0.2)
+    assert d["mttr_restore_mean_s"] == pytest.approx(1.0)
+    assert d["mttr_mean_s"] == pytest.approx(0.6)
+    assert ev1["tier"] == "reshard" and ev2["tier"] == "restore"
+    # a recovery that used BOTH tiers (reshard, then the retry still
+    # needed a restore) counts both occurrences but books its multi-tier
+    # wall clock into NEITHER per-tier MTTR aggregate — crediting it to
+    # either would corrupt the reshard-vs-restore comparison
+    ev3 = p.recovery.record_fault("shrinkable", 5)
+    p.recovery.record_recovery(5.0, resharded=True, restored=True,
+                               event=ev3)
+    d = p.recovery.as_dict()
+    assert ev3["tier"] == "reshard+restore"
+    assert d["reshards"] == 2 and d["checkpoint_restores"] == 2
+    assert d["mttr_reshard_mean_s"] == pytest.approx(0.2)
+    assert d["mttr_restore_mean_s"] == pytest.approx(1.0)
+    assert d["mttr_mean_s"] == pytest.approx((0.2 + 1.0 + 5.0) / 3)

@@ -67,6 +67,16 @@ FUSED_OPT_BYTE_KEYS = ("moment_state_bytes", "standalone_hbm_bytes")
 TOL_FUSED_OPT_TIME = 0.35
 TOL_EXACT = 0.01
 
+# reshard-vs-restore MTTR rows (RESHARD_BENCH_r*.json): recovery times
+# gate lower-is-better, the speedup higher-is-better; the plan's
+# wire-byte accounting is exact (a change means the intersection table
+# or the state layout changed — J8 territory, not timing noise).  Dryrun
+# (CPU-mesh) artifacts gate ONLY the bytes, same honesty rule as the
+# fused-opt rows.
+RESHARD_GATE_KEYS = ("mttr_reshard_s", "mttr_restore_s", "mttr_speedup")
+RESHARD_BYTE_KEYS = ("reshard_wire_bytes",)
+TOL_RESHARD_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -78,6 +88,10 @@ def sweep_metric(size_mb, arm: str) -> str:
 
 def fused_opt_metric(kind: str, key: str) -> str:
     return f"fused_opt.{kind}.{key}"
+
+
+def reshard_metric(trainer: str, codec: str, key: str) -> str:
+    return f"reshard.{trainer}.{codec}.{key}"
 
 
 def _load(path):
@@ -168,6 +182,29 @@ def build_banked_summary() -> dict:
                     m = _metric(v, src, higher=False,
                                 tol=TOL_FUSED_OPT_TIME)
                 metrics[fused_opt_metric(row["kind"], key)] = m
+
+    # -- reshard MTTR bench -------------------------------------------------
+    p = (_newest("artifacts/reshard_bench_*.json")
+         or _newest("RESHARD_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (RESHARD_BYTE_KEYS if d.get("dryrun")
+                else RESHARD_BYTE_KEYS + RESHARD_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in RESHARD_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                elif key == "mttr_speedup":
+                    m = _metric(v, src, tol=TOL_RESHARD_TIME)
+                else:
+                    m = _metric(v, src, higher=False,
+                                tol=TOL_RESHARD_TIME)
+                metrics[reshard_metric(row["trainer"], row["codec"],
+                                       key)] = m
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
