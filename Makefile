@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench obs-gate lint lint-fixtures
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench obs-gate lint lint-fixtures
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -100,6 +100,17 @@ zoo-validate:
 # vs checkpoint-restore MTTR, side by side.
 chaos-bench:
 	python tools/chaos_bench.py --fast
+
+# autotune matrix (docs/TUNING.md): the tuned plan vs every fixed
+# (codec, depth, bucket, topology) config per payload regime, scored by
+# the calibrated ring_cost model and measured on the live mesh; snapshot
+# the newest artifact as the round's committed record (obs-gate consumes
+# it — dryrun CPU rows gate only the exact plan accounting, tune.* keys)
+tune-bench:
+	python bench_collective.py --autotune-matrix
+	@latest=$$(ls -t artifacts/tune_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest TUNE_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> TUNE_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

@@ -358,8 +358,15 @@ def child_main() -> None:
             enc_g, dec_g = fe, fd
             src = (f"fused-kernel stage ablation, skeleton-corrected "
                    f"({staged['mib']} MiB loopback row)")
-    report["break_even"] = ring_cost.break_even(enc_g, dec_g, r_fused, r,
-                                                source=src)
+    # link-rate candidates routed through the calibration loader: the
+    # measured wire rate (when banked) joins the documented fallback
+    # constants, and the table carries calibrated so model-only rows
+    # can be badged (docs/TUNING.md)
+    lr = ring_cost.link_rate_candidates()
+    report["break_even"] = ring_cost.break_even(
+        enc_g, dec_g, r_fused, r, link_rates=lr["rates"], source=src,
+        calibrated=lr["calibrated"])
+    report["break_even"]["link_rates_source"] = lr["source"]
 
     # -- ring sweep (needs a multi-device axis) -----------------------------
     if n_dev >= 2:
@@ -503,6 +510,10 @@ def codec_matrix_child() -> None:
     def sync(tree):
         return float(_scalar(tree))
 
+    # one calibration load for the whole matrix (it re-reads the banked
+    # artifact globs; identical for every row of this run)
+    lr = ring_cost.link_rate_candidates()
+
     for name in compress.available_codecs():
         codec = compress.get_codec(name, dict(CODEC_MATRIX_OPTS.get(name,
                                                                     ())))
@@ -569,8 +580,10 @@ def codec_matrix_child() -> None:
             dec_g = row.get("decode_gbps") or 0.0
             if klass == "streaming" and enc_g and dec_g:
                 row["break_even"] = ring_cost.codec_break_even(
-                    codec, enc_g, dec_g,
-                    source=f"{klass} slope chains ({platform})")
+                    codec, enc_g, dec_g, link_rates=lr["rates"],
+                    source=f"{klass} slope chains ({platform})",
+                    calibrated=lr["calibrated"])
+                row["break_even"]["link_rates_source"] = lr["source"]
             report["rows"].append(row)
 
     phase("done")
@@ -611,6 +624,219 @@ def codec_matrix_main() -> None:
     if errors:
         result["failed_attempts"] = errors
     save_artifact("codec_bench", result)
+    print(json.dumps(result), flush=True)
+
+
+# ---------------------------------------------------------------------------
+# autotune matrix (`make tune-bench`): the tuned plan vs every fixed
+# (codec, depth, bucket, topology) config per payload regime
+# ---------------------------------------------------------------------------
+
+# payload regimes mirror SparCML's size-switched strategy space: small
+# (latency/dispatch-bound), medium (the codec break-even neighborhood),
+# large (stream-bound)
+TUNE_REGIMES = (("small", 1), ("medium", 16), ("large", 64))
+TUNE_INTRA_SIZE = 2           # declared fast/slow factorization of the
+                              # bench mesh (8 = 2 intra x 4 inter)
+
+
+def autotune_child() -> None:
+    """Per payload regime: run the tuner (calibrated from the banked
+    artifacts), score EVERY fixed candidate with the same model, check
+    the argmin property (tuned <= every fixed config), and measure the
+    tuned plan against the fixed flat-default ring on the live mesh.
+    Wire bytes are exact plan declarations (obs-gate keys tune.*);
+    measured times are dryrun-class off TPU, same honesty rule as the
+    fused-opt bench.  One JSON line on stdout; merged/saved by the
+    parent."""
+    t0 = time.time()
+
+    def phase(name):
+        log(f"phase={name} t={time.time() - t0:.1f}s")
+
+    phase("import")
+    import jax
+    enable_compile_cache(jax)
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fpga_ai_nic_tpu import tune as tune_lib
+    from fpga_ai_nic_tpu.ops import fused_update
+    from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+
+    platform = jax.default_backend()
+    n_dev = jax.device_count()
+    on_tpu = is_tpu_platform(platform)
+    calib = tune_lib.load_calibration()
+    report = {
+        "metric": "tune_bench",
+        "platform": platform,
+        "n_devices": n_dev,
+        "intra_size": TUNE_INTRA_SIZE,
+        "calibration": calib.describe(),
+        "method": ("per payload regime: tuner argmin over the full "
+                   "(codec x depth x bucket x topology) grid under the "
+                   "calibrated ring_cost model; tuned_vs_best_fixed is "
+                   "the modeled ratio (<= 1 by construction — gated "
+                   "exactly, so a scoring/grid change cannot slip by); "
+                   "measured arms time the tuned plan vs the fixed flat "
+                   "bfp ring on the live mesh"),
+        "rows": [],
+    }
+
+    _scalar = jax.jit(lambda t: sum(
+        jnp.sum(l.astype(jnp.float32))
+        for l in jax.tree_util.tree_leaves(t)))
+
+    def sync(tree):
+        return float(_scalar(tree))
+
+    mesh = Mesh(jax.devices(), ("dp",)) if n_dev >= 2 else None
+
+    def measure_coll(coll, L):
+        """Wall time of one routed all-reduce of [L] f32 under coll."""
+        xs = jax.device_put(
+            jax.random.normal(jax.random.PRNGKey(1), (L,), jnp.float32),
+            jax.sharding.NamedSharding(mesh, P()))
+
+        fn = jax.jit(jax.shard_map(
+            lambda v: fused_update.ring_all_reduce_routed(
+                lax.pcast(v, "dp", to="varying"), "dp", coll, L // n_dev),
+            mesh=mesh, in_specs=P(), out_specs=P(), check_vma=False))
+        return _timeit(lambda: fn(xs), sync)
+
+    for regime, mib in TUNE_REGIMES:
+        phase(f"regime {regime} ({mib} MiB)")
+        L = mib * (1 << 20) // 4
+        L -= L % (n_dev * 2048)     # whole codec units for every codec
+        plan = tune_lib.tune(L, n_dev, intra_size=TUNE_INTRA_SIZE,
+                             calibration=calib)
+        cands = tune_lib.enumerate_candidates(n_dev, TUNE_INTRA_SIZE)
+        matrix = {}
+        best_fixed = None
+        for cand in cands:
+            s = tune_lib.score_candidate(L, n_dev, cand, calib)
+            key = f"{cand.codec or 'none'}/{cand.topology}"
+            cur = matrix.get(key)
+            if cur is None or s["exposed_s"] < cur["modeled_exposed_ms"] / 1e3:
+                matrix[key] = {
+                    "codec": cand.codec or "none",
+                    "topology": cand.topology,
+                    "pipeline_depth": cand.pipeline_depth,
+                    "bucket_elems": cand.bucket_elems,
+                    "modeled_exposed_ms": round(s["exposed_s"] * 1e3, 4),
+                    "modeled_collective_ms":
+                        round(s["collective_s"] * 1e3, 4),
+                    "wire_bytes": s["wire_bytes_per_device"],
+                }
+            if best_fixed is None or s["exposed_s"] < best_fixed:
+                best_fixed = s["exposed_s"]
+        row = {
+            "regime": regime,
+            "payload_mib": mib,
+            "payload_elems": L,
+            "tuned": {k: v for k, v in plan.describe().items()
+                      if k != "calibration"},
+            "tuned_modeled_ms": round(plan.modeled_exposed_s * 1e3, 4),
+            "best_fixed_modeled_ms": round(best_fixed * 1e3, 4),
+            "tuned_vs_best_fixed": round(
+                plan.modeled_exposed_s / best_fixed, 4),
+            "tuned_beats_all_fixed":
+                bool(plan.modeled_exposed_s <= best_fixed * (1 + 1e-9)),
+            "tuned_wire_bytes": plan.wire_bytes_per_device,
+            "n_candidates": plan.n_candidates,
+            "matrix": sorted(matrix.values(),
+                             key=lambda r: r["modeled_exposed_ms"]),
+        }
+        if mesh is not None:
+            c = plan.candidate
+            tuned_coll = CollectiveConfig(
+                impl="ring", codec=c.codec,
+                pipeline_depth=c.pipeline_depth,
+                bucket_elems=c.bucket_elems, topology=c.topology,
+                intra_size=c.intra_size if c.topology == "hier" else 0)
+            fixed_coll = CollectiveConfig(impl="ring", codec="bfp")
+            try:
+                row["tuned_measured_ms"] = round(
+                    measure_coll(tuned_coll, L) * 1e3, 3)
+                row["flat_fixed_measured_ms"] = round(
+                    measure_coll(fixed_coll, L) * 1e3, 3)
+                row["tuned_measured_speedup_vs_flat_bfp"] = round(
+                    row["flat_fixed_measured_ms"]
+                    / row["tuned_measured_ms"], 3)
+            except Exception as e:  # noqa: BLE001 — best-effort cell
+                row["measure_error"] = repr(e)[:300]
+        log(f"{regime}: tuned {row['tuned']['codec']}/"
+            f"{row['tuned']['topology']} D={row['tuned']['pipeline_depth']}"
+            f" B={row['tuned']['bucket_elems']} modeled "
+            f"{row['tuned_modeled_ms']} ms (best fixed "
+            f"{row['best_fixed_modeled_ms']}); measured tuned "
+            f"{row.get('tuned_measured_ms')} vs flat-bfp "
+            f"{row.get('flat_fixed_measured_ms')} ms")
+        report["rows"].append(row)
+
+    phase("done")
+    if not on_tpu:
+        # same honesty rule as the fused-opt/reshard benches: CPU-mesh
+        # timings are recorded for inspection, never gated; the exact
+        # plan declarations (wire bytes, modeled ratio) gate everywhere
+        report["dryrun"] = True
+        report["dryrun_note"] = (
+            "cpu mesh rung: measured arms carry oversubscription noise "
+            "~ the effect size, so `make obs-gate` gates only the exact "
+            "plan accounting (tuned_wire_bytes, tuned_vs_best_fixed); "
+            "re-run `make tune-bench` on a TPU surface for the gated "
+            "measured rows")
+    sys.path.insert(0, os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "tools"))
+    import obs_gate
+    gate_metrics = {}
+    gate_keys = (obs_gate.TUNE_BYTE_KEYS if report.get("dryrun")
+                 else obs_gate.TUNE_BYTE_KEYS + obs_gate.TUNE_GATE_KEYS)
+    for row in report["rows"]:
+        for key in gate_keys:
+            if row.get(key) is not None:
+                gate_metrics[obs_gate.tune_metric(row["regime"], key)] = \
+                    row[key]
+    report["gate_summary"] = gate_metrics
+    print(json.dumps(report), flush=True)
+
+
+def autotune_main() -> None:
+    """Parent for `make tune-bench`: same wedge-proof ladder as the codec
+    matrix — the deciding process never imports jax."""
+    from bench_common import probe_tpu
+    here = os.path.abspath(__file__)
+    attempts = [
+        {"name": "tpu", "cpu": False, "budget_s": 600.0,
+         "silence_s": 240.0},
+        {"name": "cpu_mesh", "cpu": True, "budget_s": 600.0,
+         "silence_s": 240.0},
+    ]
+    errors, result = [], None
+    for att in attempts:
+        if not att["cpu"] and not probe_tpu():
+            errors.append(f"{att['name']}: skipped, tunnel wedged at probe")
+            continue
+        env = cpu_env(8) if att["cpu"] else dict(os.environ)
+        try:
+            result = run_attempt(
+                att["name"],
+                [sys.executable, "-u", here, "--autotune-matrix-child"],
+                env=env, budget_s=att["budget_s"],
+                silence_s=att["silence_s"], cwd=os.path.dirname(here))
+            break
+        except Exception as e:  # noqa: BLE001 — one JSON line must happen
+            log(str(e))
+            errors.append(f"{att['name']}: {e}")
+    if result is None:
+        print(json.dumps({"metric": "tune_bench",
+                          "error": "; ".join(errors)[:800]}), flush=True)
+        sys.exit(1)
+    if errors:
+        result["failed_attempts"] = errors
+    save_artifact("tune_bench", result)
     print(json.dumps(result), flush=True)
 
 
@@ -963,5 +1189,9 @@ if __name__ == "__main__":
         fused_opt_child()
     elif len(sys.argv) >= 2 and sys.argv[1] == "--fused-optimizer":
         fused_opt_main()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--autotune-matrix-child":
+        autotune_child()
+    elif len(sys.argv) >= 2 and sys.argv[1] == "--autotune-matrix":
+        autotune_main()
     else:
         main()

@@ -29,11 +29,14 @@ RULE_DOCS: Dict[str, str] = {
     "J8": "reshard program: callback-free, sources donated, and ppermute "
           "operand bytes == exactly the bytes that change owner per the "
           "intersection table",
+    "J9": "hierarchical collective: intra-hop ppermutes must be codec-free "
+          "f32 and each hop class must move exactly the bytes the "
+          "HierarchicalPlan declares",
 }
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8")
+                                "J8", "J9")
 
 
 @dataclass(frozen=True)

@@ -573,6 +573,176 @@ def run_j8(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J9 — hierarchical (intra x inter) collectives (ops.ring_hier).  The
+# EQuARX-style claim — codec only on the SLOW hop — is a program
+# property, so it is checked on the program: every ppermute in the
+# lowered collective is classified by its permutation (intra = pairs
+# stay inside a group of n_intra consecutive ranks; inter = pairs keep
+# their intra position), and per class the operand bytes x static trip
+# counts must equal the HierarchicalPlan's declaration EXACTLY, with
+# every intra-hop operand a 4-byte float (a codec payload on the fast
+# hop is the regression this rule freezes out).  Permutations that are
+# neither class are findings: a flat collective smuggled into a
+# "hierarchical" program breaks the accounting the tuner banks.
+# ---------------------------------------------------------------------------
+
+def _collect_ppermutes(jaxpr) -> List[Dict[str, Any]]:
+    """Per-ppermute records: perm pairs, static trip multiplier (None =
+    unaccountable), operand bytes per execution, operand dtypes."""
+    out: List[Dict[str, Any]] = []
+    for eqn, mult in _iter_eqns(jaxpr):
+        if eqn.primitive.name != "ppermute":
+            continue
+        perm = tuple((int(s), int(d)) for s, d in eqn.params.get("perm", ()))
+        out.append({
+            "perm": perm,
+            "mult": mult,
+            "bytes": sum(_aval_bytes(v.aval) for v in eqn.invars),
+            "dtypes": sorted({str(v.aval.dtype) for v in eqn.invars
+                              if getattr(v, "aval", None) is not None}),
+            "f32_only": all(
+                getattr(v.aval.dtype, "kind", "") == "f"
+                and v.aval.dtype.itemsize == 4
+                for v in eqn.invars if getattr(v, "aval", None) is not None),
+        })
+    return out
+
+
+def _classify_perm(perm, n_intra: int) -> str:
+    if not perm:
+        return "other"
+    if all(s // n_intra == d // n_intra for s, d in perm):
+        return "intra"
+    if all(s % n_intra == d % n_intra for s, d in perm):
+        return "inter"
+    return "other"
+
+
+def check_hier_program(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J9 surface.  build() -> (closed jaxpr, plan, which)
+    where plan is an ops.ring_hier.HierarchicalPlan and which names the
+    collective ("reduce_scatter" / "all_gather" / "all_reduce")."""
+    findings: List[Finding] = []
+    jx, plan, which = build()
+    cell = f"jaxpr[hier {name}]"
+    perms = _collect_ppermutes(jx.jaxpr)
+    got = {"intra": 0, "inter": 0}
+    for p in perms:
+        klass = _classify_perm(p["perm"], plan.n_intra)
+        if klass == "other":
+            findings.append(Finding(
+                "J9", cell, 0,
+                f"ppermute whose permutation is neither intra nor inter "
+                f"for n_intra={plan.n_intra} (first pairs "
+                f"{p['perm'][:4]}) — a non-hierarchical collective inside "
+                "a declared-hierarchical program breaks the banked "
+                "accounting"))
+            continue
+        if p["mult"] is None:
+            findings.append(Finding(
+                "J9", cell, 0,
+                f"{klass} ppermute under a while_loop — hop bytes not "
+                "statically accountable (use fori_loop/scan with a "
+                "static trip count)"))
+            continue
+        got[klass] += p["mult"] * p["bytes"]
+        if klass == "intra" and not p["f32_only"]:
+            findings.append(Finding(
+                "J9", cell, 0,
+                f"intra-hop ppermute carries non-f32 operands "
+                f"{p['dtypes']} — the FAST hop must be codec-free (full "
+                "precision is free there; that is the whole point of the "
+                "hierarchical split)"))
+    declared = {"intra": plan.intra_bytes(which),
+                "inter": plan.inter_bytes(which)}
+    for klass in ("intra", "inter"):
+        if got[klass] != declared[klass]:
+            findings.append(Finding(
+                "J9", cell, 0,
+                f"{klass}-hop ppermute operands move {got[klass]} bytes "
+                f"but the HierarchicalPlan declares {declared[klass]} "
+                f"for {which} — the hierarchical wire accounting (tuner "
+                "scores, obs counters, bench ratios) is lying"))
+    return findings
+
+
+def _j9_build(codec_name: Optional[str], n_intra: int, which: str,
+              L: int = 8192):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ..compress import get_codec
+        from ..ops import ring_hier
+
+        codec = get_codec(codec_name) if codec_name else None
+        unit = _NDEV * (codec.pad_elems if codec else 1)
+        Lp = L + (-L) % unit
+        plan = ring_hier.plan_hier(Lp, _NDEV, n_intra, codec)
+        mesh = Mesh(np.array(jax.devices()[:_NDEV]), ("dp",))
+
+        def prog(x):
+            if which == "reduce_scatter":
+                return ring_hier.hier_reduce_scatter(
+                    x, "dp", n_intra, compression=codec)
+            if which == "all_gather":
+                return ring_hier.hier_all_gather(
+                    x, "dp", n_intra, compression=codec)
+            return ring_hier.hier_all_reduce(
+                x, "dp", n_intra, compression=codec)
+
+        shape = (Lp // _NDEV,) if which == "all_gather" else (Lp,)
+        jx = jax.make_jaxpr(jax.jit(jax.shard_map(
+            prog, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False)))(
+            jax.ShapeDtypeStruct((_NDEV * shape[0],), jnp.float32))
+        return jx, plan, which
+    return build
+
+
+def j9_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs covering codec x factorization x collective.
+    GRAFTLINT_J9_FIXTURE appends a surface from a module path exposing
+    ``build()`` — the bad-fixture / exit-code hook, same contract as
+    J7/J8's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("rs ni=2 bfp", _j9_build("bfp", 2, "reduce_scatter")),
+        ("ag ni=2 bfp", _j9_build("bfp", 2, "all_gather")),
+        ("rs ni=4 topk", _j9_build("topk", 4, "reduce_scatter")),
+        ("ar ni=2 int8", _j9_build("int8", 2, "all_reduce")),
+        ("ar ni=4 none", _j9_build(None, 4, "all_reduce")),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J9_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j9_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j9(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j9_surfaces():
+        try:
+            fs = check_hier_program(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J9", f"jaxpr[hier {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] hier {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -667,4 +837,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_fused_opt_cells(verbose=verbose))
     findings.extend(run_j7(verbose=verbose))
     findings.extend(run_j8(verbose=verbose))
+    findings.extend(run_j9(verbose=verbose))
     return findings

@@ -1,6 +1,6 @@
 from . import (bfp, bfp_golden, bfp_pallas, bucketed, flash_pallas,
                fused_update, moe, ring, ring_attention, ring_cost,
-               ring_golden, ring_pallas)  # noqa: F401
+               ring_golden, ring_hier, ring_pallas)  # noqa: F401
 
 # explicit export surface (the codec subsystem made the implicit one
 # stale: fused_update now also owns codec resolution / error feedback;
@@ -8,5 +8,5 @@ from . import (bfp, bfp_golden, bfp_pallas, bucketed, flash_pallas,
 __all__ = [
     "bfp", "bfp_golden", "bfp_pallas", "bucketed", "flash_pallas",
     "fused_update", "moe", "ring", "ring_attention", "ring_cost",
-    "ring_golden", "ring_pallas",
+    "ring_golden", "ring_hier", "ring_pallas",
 ]

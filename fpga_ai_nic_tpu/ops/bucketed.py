@@ -179,9 +179,9 @@ def all_reduce_bucketed_flat(grads, axis_name: str, coll: CollectiveConfig,
 def bucket_wire_bytes(plan: BucketPlan, n: int,
                       coll: CollectiveConfig) -> int:
     """Total per-device ring bytes for one bucketed all-reduce (flit-counter
-    observability, hw/bfp_adapter.sv:705-729)."""
-    from .fused_update import resolve_codec
-    codec = resolve_codec(coll)
+    observability, hw/bfp_adapter.sv:705-729) — topology-aware, so the
+    declaration matches the routed collective (flat or hierarchical)."""
+    from .fused_update import wire_bytes_for
     return sum(
-        ring_ops.wire_bytes_per_device(b.padded_len, n, codec)
+        wire_bytes_for(coll, b.padded_len, n)
         for b in plan.buckets)

@@ -68,6 +68,23 @@ def pad_multiple(coll: CollectiveConfig, n: int) -> int:
     return n
 
 
+def wire_bytes_for(coll: CollectiveConfig, L: int, n: int,
+                   codec="__resolve__") -> int:
+    """Topology-aware per-device wire bytes for one all-reduce of an
+    [L]-element flat f32 vector under this config — the flit-counter
+    arithmetic every consumer (obs statics, queued telemetry, bucket
+    accounting) must share so the declaration can never drift from the
+    routing.  ``codec`` defaults to the config's own resolution; pass
+    None explicitly for the raw-f32 accounting."""
+    if codec == "__resolve__":
+        codec = resolve_codec(coll)
+    if getattr(coll, "topology", "flat") == "hier":
+        from . import ring_hier
+        return ring_hier.wire_bytes_per_device(L, n, coll.intra_size,
+                                               codec)
+    return ring_ops.wire_bytes_per_device(L, n, codec)
+
+
 def flat_meta(tree, coll: CollectiveConfig, n: int) -> FlatMeta:
     """Static flattening metadata from a pytree of arrays (or shape structs)
     without touching device memory."""
@@ -184,10 +201,15 @@ def _fused_bfp_cfg(coll: CollectiveConfig):
 def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
                            coll: CollectiveConfig,
                            chunk_len: int) -> jax.Array:
-    """Explicit-ring all-reduce respecting the fused_kernel routing (one
-    definition shared by all_reduce_mean and ops.bucketed so the
-    fallback/slice policy cannot drift between call sites)."""
+    """Explicit-ring all-reduce respecting the fused_kernel AND topology
+    routing (one definition shared by all_reduce_mean and ops.bucketed so
+    the fallback/slice/topology policy cannot drift between call sites)."""
     codec = resolve_codec(coll)
+    if getattr(coll, "topology", "flat") == "hier":
+        from . import ring_hier
+        return ring_hier.hier_all_reduce(
+            flat, axis_name, coll.intra_size, compression=codec,
+            slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
     if coll.fused_kernel:
         from . import ring_pallas
         bcfg = _fused_bfp_cfg(coll)
@@ -196,7 +218,8 @@ def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
         if ring_pallas._is_tpu():
             return ring_pallas.ring_all_reduce_fused(
                 flat, axis_name, compression=bcfg,
-                slice_elems=slice_e)
+                slice_elems=slice_e,
+                pipeline_depth=coll.pipeline_depth)
         _warn_fused_fallback()
         return ring_ops.ring_all_reduce(
             flat, axis_name, compression=codec,
@@ -213,6 +236,11 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
         return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
                                 tiled=True)
     codec = resolve_codec(coll)
+    if getattr(coll, "topology", "flat") == "hier":
+        from . import ring_hier
+        return ring_hier.hier_reduce_scatter(
+            flat_g, axis_name, coll.intra_size, compression=codec,
+            slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
     if coll.fused_kernel:
         from . import ring_pallas
         n = lax.axis_size(axis_name)
@@ -222,7 +250,8 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
         if ring_pallas._is_tpu():
             return ring_pallas.ring_reduce_scatter_fused(
                 flat_g, axis_name, compression=bcfg,
-                slice_elems=slice_e)
+                slice_elems=slice_e,
+                pipeline_depth=coll.pipeline_depth)
         # off-TPU: the separate-op ring with the CONFIGURED codec (see
         # _warn_fused_fallback); the kernel's own bit-exactness story
         # lives in tests/test_ring_pallas.py
@@ -261,7 +290,12 @@ def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
     spec = OptimizerSpec.from_optimizer(opt_cfg)
     n = lax.axis_size(axis_name)
     hyper = optim.fused_hyperparams(opt_cfg, step)
-    if coll.fused_kernel and n > 1:
+    # topology='hier' always takes the shared-formula route below: the
+    # hierarchical reduce_scatter carries the codec only on the slow
+    # inter hop and the update fuses right after the reduce — identical
+    # golden contract, zero exposed optimizer pass either way
+    if coll.fused_kernel and n > 1 \
+            and getattr(coll, "topology", "flat") == "flat":
         from . import ring_pallas
         if ring_pallas._is_tpu():
             bcfg = _fused_bfp_cfg(coll)
@@ -269,7 +303,8 @@ def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
                 flat_g.shape[0] // n, coll.slice_elems, bcfg.block_size)
             return ring_pallas.ring_reduce_scatter_update_fused(
                 flat_g, w_own, opt_state, hyper, axis_name,
-                opt_kind=spec.kind, compression=bcfg, slice_elems=slice_e)
+                opt_kind=spec.kind, compression=bcfg, slice_elems=slice_e,
+                pipeline_depth=coll.pipeline_depth)
         # off-TPU: reduce_scatter itself warns and routes to the
         # separate-op ring; the update below stays the shared formula
     g_own = reduce_scatter(flat_g, axis_name, coll)
@@ -283,6 +318,11 @@ def all_gather_flat(owned: jax.Array, axis_name: str,
     if coll.impl == "xla":
         return lax.all_gather(owned, axis_name, tiled=True)
     codec = resolve_codec(coll)
+    if getattr(coll, "topology", "flat") == "hier":
+        from . import ring_hier
+        return ring_hier.hier_all_gather(
+            owned, axis_name, coll.intra_size, compression=codec,
+            unroll=coll.unroll_hops)
     if coll.fused_kernel:
         from . import ring_pallas
         if ring_pallas._is_tpu():

@@ -101,10 +101,15 @@ def _as_codec(compression):
 
 
 def _send(payload: jax.Array, axis_name: str, n: int,
-          codec, slice_elems: Optional[int] = None) -> jax.Array:
+          codec, slice_elems: Optional[int] = None,
+          perm=None) -> jax.Array:
     """One ring hop, optionally codec-compressed on the wire.  ``codec``
-    is an already-normalized compress.Codec (or None)."""
-    perm = _next_neighbor_perm(n)
+    is an already-normalized compress.Codec (or None).  ``perm``
+    overrides the next-neighbor permutation — the seam `ops.ring_hier`
+    drives its intra/inter SUBRING hops through, so the sliced
+    double-buffered codec stream below is written exactly once."""
+    if perm is None:
+        perm = _next_neighbor_perm(n)
     if codec is None:
         return lax.ppermute(payload, axis_name, perm)
     C = payload.shape[0]

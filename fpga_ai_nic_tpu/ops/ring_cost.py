@@ -194,14 +194,80 @@ def codec_rates(stages: Mapping[str, Mapping[str, float]],
 
 # candidate per-direction link rates (GB/s): DCN-class multi-host, the
 # reference's own 100GbE wire (hw/bfp_adapter.sv sat on a 100G MAC), and
-# the ICI classes
+# the ICI classes.  These are the DOCUMENTED FALLBACK — break-even
+# tables and the autotuner route through `link_rate_candidates`, which
+# prepends the MEASURED rate harvested from banked artifacts
+# (tune.calibration) whenever one exists, and the outputs carry a
+# `calibrated` flag so model-only rows can be badged (docs/TUNING.md).
 DEFAULT_LINK_RATES = (5.0, 12.5, 45.0, 90.0, 180.0)
+
+
+def link_rate_candidates(calibration=None) -> dict:
+    """Per-direction link-rate candidates for break-even tables, routed
+    through the calibration loader: the measured inter-axis rate (when a
+    banked artifact carries one) joins the documented DEFAULT_LINK_RATES
+    constants.  Returns {"rates", "calibrated", "measured_gbps",
+    "source"}; with no banked measurement the rates are exactly the
+    fallback constants and calibrated is False."""
+    if calibration is None:
+        try:
+            from ..tune.calibration import load_calibration
+            calibration = load_calibration()
+        except Exception:  # noqa: BLE001 — the model must degrade, not die
+            calibration = None
+    if calibration is None or not calibration.inter_calibrated:
+        return {"rates": tuple(DEFAULT_LINK_RATES), "calibrated": False,
+                "measured_gbps": None,
+                "source": "DEFAULT_LINK_RATES (documented fallback)"}
+    w = round(float(calibration.inter_gbps), 3)
+    rates = tuple(sorted(set(DEFAULT_LINK_RATES) | {w}))
+    return {"rates": rates, "calibrated": True, "measured_gbps": w,
+            "source": calibration.inter_source}
+
+
+def hop_cost(raw_bytes: float, wire_bytes: float, link_gbps: float,
+             encode_gbps: float = 0.0, decode_gbps: float = 0.0) -> dict:
+    """Modeled seconds for one pipelined collective phase moving
+    ``wire_bytes`` over a ``link_gbps`` wire while the VPU encodes AND
+    decodes ``raw_bytes`` of f32 payload (serial — the stages share the
+    VPU, module docstring): t = max(t_wire, t_vpu).  encode/decode <= 0
+    means no codec on this hop (t_vpu = 0, the raw fast-hop case)."""
+    t_wire = wire_bytes / (link_gbps * 1e9) if link_gbps > 0 else 0.0
+    t_vpu = 0.0
+    if encode_gbps and encode_gbps > 0 and encode_gbps != float("inf"):
+        t_vpu += raw_bytes / (encode_gbps * 1e9)
+    if decode_gbps and decode_gbps > 0 and decode_gbps != float("inf"):
+        t_vpu += raw_bytes / (decode_gbps * 1e9)
+    t = max(t_wire, t_vpu)
+    return {"t_s": t, "t_wire_s": t_wire, "t_vpu_s": t_vpu,
+            "binding": "wire" if t_wire >= t_vpu else "vpu"}
+
+
+def hier_phase_bytes(payload_elems: int, n: int, n_intra: int,
+                     wire_bytes_per_elems=None) -> dict:
+    """Exact per-device elements/bytes per phase of one hierarchical
+    ALL-REDUCE (RS + AG) of a [payload_elems] f32 vector: the topology
+    terms of the cost model (ops.ring_hier owns the authoritative
+    per-collective accounting via HierarchicalPlan; this is the model's
+    float-friendly view).  ``wire_bytes_per_elems(elems) -> bytes``
+    prices the inter hop (None = raw f32)."""
+    ni = max(1, int(n_intra))
+    ng = n // ni
+    intra_elems = 2 * (ni - 1) * (payload_elems // ni)
+    inter_elems = 2 * (ng - 1) * (payload_elems // n)
+    price = wire_bytes_per_elems or (lambda e: e * 4)
+    return {"n_intra": ni, "n_inter": ng,
+            "intra_elems": intra_elems, "intra_bytes": intra_elems * 4,
+            "inter_elems": inter_elems,
+            "inter_raw_bytes": inter_elems * 4,
+            "inter_wire_bytes": int(price(inter_elems)),
+            "hops": 2 * (ni - 1) + 2 * (ng - 1)}
 
 
 def break_even(encode_gbps: float, decode_gbps: float,
                wire_ratio_fused: float, wire_ratio_xla: float,
                link_rates: Sequence[float] = DEFAULT_LINK_RATES,
-               source: str = "") -> dict:
+               source: str = "", calibrated: bool = False) -> dict:
     """Per-link-rate verdict: does the BFP wire path beat a bf16 psum?
 
     Per f32 payload byte and hop: the BFP ring pays the wire
@@ -233,6 +299,10 @@ def break_even(encode_gbps: float, decode_gbps: float,
                   "at all), and the max speedup is r_fused/2 (fused wire "
                   "ratio includes the 8-row RDMA tile padding; the XLA "
                   "ring's unpadded ratio is wire_ratio_vs_f32)"),
+        # False = every link rate below is a documented fallback
+        # constant, not a measurement (gen_perf_md badges such rows
+        # model-only; route rates through link_rate_candidates)
+        "calibrated": bool(calibrated),
         "codec_rates_source": source,
         "encode_gbps": round(encode_gbps, 2),
         "decode_gbps": round(decode_gbps, 2),
@@ -245,7 +315,7 @@ def break_even(encode_gbps: float, decode_gbps: float,
 
 def codec_break_even(codec, encode_gbps: float, decode_gbps: float,
                      link_rates: Sequence[float] = DEFAULT_LINK_RATES,
-                     source: str = "") -> dict:
+                     source: str = "", calibrated: bool = False) -> dict:
     """`break_even` parameterized by a registered compress.Codec: the wire
     ratio comes from the codec's own byte accounting instead of the
     hard-wired BFP frame math, so the per-link verdict table extends to
@@ -254,7 +324,8 @@ def codec_break_even(codec, encode_gbps: float, decode_gbps: float,
     their per-byte costs add."""
     r = float(codec.compression_ratio_vs_f32)
     out = break_even(encode_gbps, decode_gbps, r, r, link_rates,
-                     source=source or f"codec '{codec.name}' slope chains")
+                     source=source or f"codec '{codec.name}' slope chains",
+                     calibrated=calibrated)
     out["codec"] = codec.describe()
     return out
 

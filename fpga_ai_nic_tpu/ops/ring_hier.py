@@ -1,0 +1,313 @@
+"""Hierarchical (intra x inter) 2-stage ring collectives — codec only on
+the slow hop.
+
+The flat ring (ops.ring) pays the codec on EVERY hop, including hops that
+cross a fast boundary where full precision is free — the ICI links inside
+a pod versus the DCN links between pods, or the tp axis versus the dp
+axis of a dp x tp mesh.  EQuARX (arXiv:2506.17615) shows the right shape:
+quantize only the slow phase of a hierarchical all-reduce.  This module
+is that shape on our machinery:
+
+  phase A (intra, FAST hop, codec-free):  ring reduce-scatter inside
+      each group of ``n_intra`` consecutive ranks, full-precision f32 —
+      after ni-1 hops, member j of every group holds the group-partial
+      sums of the chunks whose intra index is j.
+  phase B (inter, SLOW hop, codec ring):  ring reduce-scatter across
+      groups (members with equal intra position form the inter rings),
+      with the configured compress.Codec on the wire — the existing
+      sliced double-buffered hop (`ops.ring._send`), so every codec that
+      rides the flat ring rides the slow hop unchanged.
+
+The all-gather runs the phases in reverse (inter codec gather of the
+owned chunk — encoded once, forwarded verbatim, the ops.ring contract —
+then the raw intra gather), so updated weights also cross the slow
+boundary exactly once, quantized.
+
+Device mapping over ONE flat mesh axis of n = ni * ng devices (the
+"declared intra/inter factorization" of a flat dp axis; a dp x tp mesh
+flattened major-to-minor has the same layout): device d is group
+``d // ni``, intra position ``d % ni``.  Chunk ownership stays NATURAL
+ORDER — device d ends with chunk d, exactly like the flat ring, so the
+ZeRO-1 shard <-> device mapping is topology-invariant and a trainer can
+switch topology without resharding.
+
+Numerics contract: phase A's add order is the flat-ring schedule inside
+the group; phase B's is the flat-ring schedule across groups.  For
+codec=None the result is the same SUM as the flat ring under a different
+association — bit-identical whenever the additions are exact (integer-
+valued payloads; tests/test_ring_hier.py pins this), and spec'd bit-for-
+bit by the numpy golden twin (`compress.golden.hier_reduce_scatter`) for
+every codec.  Wire accounting is exact per hop and phase
+(`HierarchicalPlan.wire_bytes`), pinned statically by graftlint J9:
+intra ppermutes must move f32 and exactly the declared raw bytes, inter
+ppermutes exactly the declared codec bytes.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from . import ring as ring_ops
+
+
+# ---------------------------------------------------------------------------
+# static plan / wire accounting
+# ---------------------------------------------------------------------------
+
+class HierarchicalPlan(NamedTuple):
+    """Static shape + exact byte accounting of one hierarchical
+    all-reduce (reduce-scatter and/or all-gather) of an [L]-element f32
+    payload over n = n_intra * n_inter devices."""
+
+    L: int                 # flat payload elements (padded, L % n == 0)
+    n: int
+    n_intra: int           # fast-hop group size (ni)
+    n_inter: int           # slow-hop ring length (ng)
+    codec_name: Optional[str]        # inter-hop wire format (None = f32)
+    # exact per-device bytes on the wire, per phase and collective:
+    rs_intra_bytes: int
+    rs_inter_bytes: int
+    ag_intra_bytes: int
+    ag_inter_bytes: int
+
+    def wire_bytes(self, which: str = "all_reduce") -> int:
+        """Exact per-device wire bytes: "reduce_scatter", "all_gather" or
+        "all_reduce" (= RS + AG).  The declaration graftlint J9 pins the
+        lowered program's ppermute operands to."""
+        rs = self.rs_intra_bytes + self.rs_inter_bytes
+        ag = self.ag_intra_bytes + self.ag_inter_bytes
+        return {"reduce_scatter": rs, "all_gather": ag,
+                "all_reduce": rs + ag}[which]
+
+    def intra_bytes(self, which: str = "all_reduce") -> int:
+        return {"reduce_scatter": self.rs_intra_bytes,
+                "all_gather": self.ag_intra_bytes,
+                "all_reduce": self.rs_intra_bytes + self.ag_intra_bytes
+                }[which]
+
+    def inter_bytes(self, which: str = "all_reduce") -> int:
+        return {"reduce_scatter": self.rs_inter_bytes,
+                "all_gather": self.ag_inter_bytes,
+                "all_reduce": self.rs_inter_bytes + self.ag_inter_bytes
+                }[which]
+
+    def describe(self) -> Dict[str, Any]:
+        return {
+            "topology": "hier",
+            "n": self.n, "n_intra": self.n_intra, "n_inter": self.n_inter,
+            "codec": self.codec_name or "none",
+            "payload_elems": self.L,
+            "rs_intra_bytes": self.rs_intra_bytes,
+            "rs_inter_bytes": self.rs_inter_bytes,
+            "ag_intra_bytes": self.ag_intra_bytes,
+            "ag_inter_bytes": self.ag_inter_bytes,
+            "wire_bytes_all_reduce": self.wire_bytes("all_reduce"),
+        }
+
+
+def check_factorization(n: int, n_intra: int) -> int:
+    """Validate the declared factorization; returns n_inter."""
+    if n_intra < 1 or n % n_intra != 0:
+        raise ValueError(
+            f"intra_size={n_intra} does not factor the {n}-device axis "
+            "(need 1 <= intra_size dividing n)")
+    return n // n_intra
+
+
+def plan_hier(L: int, n: int, n_intra: int,
+              compression=None) -> HierarchicalPlan:
+    """Exact wire accounting for a hierarchical all-reduce of [L] f32.
+
+    Per device: phase A sends (ni-1) raw-f32 units of L/ni elements each
+    (reduce-scatter) and the same again for the gather; phase B sends
+    (ng-1) codec payloads of the final chunk C = L/n per collective.
+    ``compression`` is a Codec or (legacy) BFPConfig — same normalization
+    as ops.ring."""
+    ng = check_factorization(n, n_intra)
+    if L % n != 0:
+        raise ValueError(f"need L divisible by n={n}, got {L}")
+    codec = ring_ops._as_codec(compression)
+    C = L // n
+    unit_a = L // n_intra                   # ng * C raw f32 elements
+    inter_payload = (codec.wire_bytes(C) if codec is not None else C * 4)
+    return HierarchicalPlan(
+        L=L, n=n, n_intra=n_intra, n_inter=ng,
+        codec_name=codec.name if codec is not None else None,
+        rs_intra_bytes=(n_intra - 1) * unit_a * 4,
+        rs_inter_bytes=(ng - 1) * inter_payload,
+        ag_intra_bytes=(n_intra - 1) * unit_a * 4,
+        ag_inter_bytes=(ng - 1) * inter_payload)
+
+
+def wire_bytes_per_device(L: int, n: int, n_intra: int,
+                          compression=None) -> int:
+    """Hierarchical analogue of ops.ring.wire_bytes_per_device: exact
+    per-device bytes for one ALL-REDUCE (RS + AG), both phases."""
+    return plan_hier(L, n, n_intra, compression).wire_bytes("all_reduce")
+
+
+# ---------------------------------------------------------------------------
+# subring permutations
+# ---------------------------------------------------------------------------
+
+def _intra_perm(n: int, ni: int):
+    """Next-neighbor inside each group of ni consecutive ranks."""
+    return [(g * ni + j, g * ni + (j + 1) % ni)
+            for g in range(n // ni) for j in range(ni)]
+
+
+def _inter_perm(n: int, ni: int):
+    """Next-group, same intra position: the inter rings."""
+    ng = n // ni
+    return [(g * ni + j, ((g + 1) % ng) * ni + j)
+            for g in range(ng) for j in range(ni)]
+
+
+# ---------------------------------------------------------------------------
+# collectives (inside shard_map over the flat axis)
+# ---------------------------------------------------------------------------
+
+def _split_idx(axis_name: str, ni: int):
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    return n, idx // ni, idx % ni
+
+
+def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
+                        compression=None,
+                        slice_elems: Optional[int] = None,
+                        unroll: bool = False) -> jax.Array:
+    """2-stage ring reduce-scatter of a flat per-device vector: raw f32
+    over the fast intra hop, the codec ring over the slow inter hop.
+
+    x: [L] with L % n == 0.  Returns [L // n]: this device's fully
+    reduced chunk, chunk index == device index (the flat ring's natural
+    ownership, so callers are topology-agnostic).
+    """
+    codec = ring_ops._as_codec(compression)
+    ni = int(n_intra)
+    n, g, j = _split_idx(axis_name, ni)
+    ng = check_factorization(n, ni)
+    if x.ndim != 1 or x.shape[0] % n != 0:
+        raise ValueError(f"need flat length divisible by {n}, got {x.shape}")
+    if n == 1:
+        return x
+    C = x.shape[0] // n
+    x = ring_ops._tap(x, "ring_hier.reduce_scatter")
+
+    # phase A — intra ring over units [j'] = concat_g'(chunk g'*ni + j'),
+    # raw f32 (the whole point: full precision is free on the fast hop)
+    units = x.reshape(ng, ni, C).transpose(1, 0, 2).reshape(ni, ng * C)
+    if ni > 1:
+        perm_a = _intra_perm(n, ni)
+
+        def hop_a(s, u):
+            send = jnp.take(u, ((j - s - 1) % ni)[None], axis=0)[0]
+            recv = ring_ops._send(send, axis_name, n, None, perm=perm_a)
+            return u.at[(j - s - 2) % ni].add(recv)
+
+        units = lax.fori_loop(0, ni - 1, hop_a, units, unroll=unroll)
+    # own[q] = sum over this group's members of chunk q*ni + j
+    own = jnp.take(units, j[None], axis=0)[0].reshape(ng, C)
+
+    # phase B — inter ring over the ng group-partial chunks, codec wire
+    if ng > 1:
+        perm_b = _inter_perm(n, ni)
+
+        def hop_b(s, u):
+            send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
+            recv = ring_ops._send(send, axis_name, n, codec, slice_elems,
+                                  perm=perm_b)
+            return u.at[(g - s - 2) % ng].add(recv)
+
+        own = lax.fori_loop(0, ng - 1, hop_b, own, unroll=unroll)
+    # final ownership: chunk g*ni + j == this device's index
+    return jnp.take(own, g[None], axis=0)[0]
+
+
+def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
+                    compression=None, unroll: bool = False) -> jax.Array:
+    """2-stage ring all-gather: the codec inter gather first (each chunk
+    crosses the slow boundary exactly once, encoded at first send and
+    forwarded verbatim — the ops.ring replica-identity contract), then
+    the raw intra gather.  owned: [C], device d contributes chunk d;
+    returns [n * C] in natural chunk order."""
+    codec = ring_ops._as_codec(compression)
+    ni = int(n_intra)
+    n, g, j = _split_idx(axis_name, ni)
+    ng = check_factorization(n, ni)
+    owned = ring_ops._tap(owned, "ring_hier.all_gather")
+    if n == 1:
+        if codec is not None:
+            return codec.roundtrip(owned).astype(owned.dtype)
+        return owned
+    C = owned.shape[0]
+
+    # phase B' — inter all-gather of the owned chunk across groups
+    blocks = jnp.zeros((ng, C), owned.dtype)
+    if ng > 1:
+        perm_b = _inter_perm(n, ni)
+        if codec is None:
+            blocks = blocks.at[g].set(owned)
+
+            def hop_b(s, carry):
+                out_, pay = carry
+                pay = lax.ppermute(pay, axis_name, perm_b)
+                return out_.at[(g - s - 1) % ng].set(pay), pay
+
+            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, owned),
+                                      unroll=unroll)
+        else:
+            pay = codec.encode(owned)
+            # the contributor stores the same quantized bytes it sends:
+            # every replica sees wire-identical values for every chunk
+            blocks = blocks.at[g].set(codec.decode(pay, C, owned.dtype))
+
+            def hop_b(s, carry):
+                out_, pay = carry
+                pay = tuple(lax.ppermute(p, axis_name, perm_b)
+                            for p in pay)
+                return (out_.at[(g - s - 1) % ng].set(
+                    codec.decode(pay, C, owned.dtype)), pay)
+
+            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, pay),
+                                      unroll=unroll)
+    else:
+        # no slow boundary to cross: nothing is quantized (the flat
+        # ring's n == 1 quantize exists for replica identity, which the
+        # raw intra hops below preserve by construction)
+        blocks = blocks.at[g].set(owned)
+    # member j now holds blocks[q] = chunk q*ni + j for every group q
+
+    # phase A' — raw intra all-gather of the [ng * C] block
+    flat_block = blocks.reshape(ng * C)
+    out = jnp.zeros((ni, ng * C), owned.dtype).at[j].set(flat_block)
+    if ni > 1:
+        perm_a = _intra_perm(n, ni)
+
+        def hop_a(s, carry):
+            out_, pay = carry
+            pay = lax.ppermute(pay, axis_name, perm_a)
+            return out_.at[(j - s - 1) % ni].set(pay), pay
+
+        out, _ = lax.fori_loop(0, ni - 1, hop_a, (out, flat_block),
+                               unroll=unroll)
+    # out[p] = blocks of member p = chunks {q*ni + p}; restore natural
+    # chunk order (inverse of the reduce-scatter's regrouping)
+    return out.reshape(ni, ng, C).transpose(1, 0, 2).reshape(n * C)
+
+
+def hier_all_reduce(x: jax.Array, axis_name: str, n_intra: int, *,
+                    compression=None,
+                    slice_elems: Optional[int] = None,
+                    unroll: bool = False) -> jax.Array:
+    """Full hierarchical all-reduce (sum) = 2-stage RS + 2-stage AG."""
+    owned = hier_reduce_scatter(x, axis_name, n_intra,
+                                compression=compression,
+                                slice_elems=slice_elems, unroll=unroll)
+    return hier_all_gather(owned, axis_name, n_intra,
+                           compression=compression, unroll=unroll)

@@ -1688,12 +1688,14 @@ def ring_reduce_scatter_update_fused(
 def ring_all_reduce_fused(x: jax.Array, axis_name: str, *,
                           compression: Optional[BFPConfig] = None,
                           slice_elems: int = 8192,
-                          interpret: Optional[bool] = None) -> jax.Array:
+                          interpret: Optional[bool] = None,
+                          pipeline_depth: Optional[int] = None) -> jax.Array:
     """Fused all-reduce = fused reduce-scatter + fused all-gather."""
     owned = ring_reduce_scatter_fused(x, axis_name,
                                       compression=compression,
                                       slice_elems=slice_elems,
-                                      interpret=interpret)
+                                      interpret=interpret,
+                                      pipeline_depth=pipeline_depth)
     return ring_all_gather_fused(owned, axis_name, compression=compression,
                                  interpret=interpret)
 

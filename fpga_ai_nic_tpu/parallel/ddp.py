@@ -63,21 +63,60 @@ class DDPTrainer:
         self.n = mesh.shape[axis_name]
         self._meta = None
         self._plan = None
+        # codec="auto": the tuner owns codec / bucket_elems / depth /
+        # topology, resolved once at the first _ensure_meta (same
+        # contract as DPTrainer) — in THIS trainer bucket_elems is the
+        # knob that actually bites (it sizes the bucketed collective
+        # plan, non-uniform last bucket included)
+        self._tuned_plan = None
 
     # -- init ---------------------------------------------------------------
+
+    def _resolve_auto(self, params_like) -> None:
+        from .. import tune as tune_lib
+        cfg, plan, _calib = tune_lib.resolve_train_config(
+            self.cfg, self.n, params_like)
+        if plan is None:
+            return
+        self.cfg = cfg
+        self._tuned_plan = plan
 
     def _ensure_meta(self, params_like) -> None:
         """Flat layout + bucket plan from a params tree or ShapeDtypeStructs
         (no device work — restore paths use jax.eval_shape output)."""
+        self._resolve_auto(params_like)
         coll = self.cfg.collective
         self._meta = fused_update.flat_meta(params_like,
                                             _unbucketed_meta(coll), 1)
         self._plan = bucketed.plan_buckets(params_like, coll, self.n)
         self.__dict__.pop("step_fn", None)
 
+    def obs_static_metrics(self) -> dict:
+        """Telemetry statics for the bucketed trainer: per-plan wire
+        accounting (the flit-counter arithmetic summed over buckets) plus
+        the banked tuning decision when codec='auto' resolved one."""
+        plan, coll = self._plan, self.cfg.collective
+        assert plan is not None, "call init_state first"
+        codec = fused_update.resolve_codec(coll)
+        d = {"n_devices": self.n, "impl": coll.impl,
+             "topology": coll.topology,
+             "n_buckets": len(plan.buckets),
+             "bucket_elems": coll.bucket_elems,
+             "wire_bytes_per_allreduce":
+                 bucketed.bucket_wire_bytes(plan, self.n, coll),
+             "raw_bytes_per_allreduce": sum(
+                 fused_update.wire_bytes_for(coll, b.padded_len, self.n,
+                                             codec=None)
+                 for b in plan.buckets)}
+        if codec is not None:
+            d["codec"] = codec.name
+        if self._tuned_plan is not None:
+            d["tune"] = self._tuned_plan.describe()
+        return d
+
     def init_state(self, params) -> DDPState:
+        self._ensure_meta(params)    # resolves codec='auto' first
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
-        self._ensure_meta(params)
 
         def _init(p):
             flat, _ = fused_update.flatten_tree(p, _unbucketed_meta(coll), 1)

@@ -48,7 +48,7 @@ from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..obs import metrics as obs_metrics
-from ..ops import fused_update, ring as ring_ops
+from ..ops import fused_update
 from ..utils.config import OptimizerSpec, TrainConfig
 
 
@@ -77,23 +77,60 @@ class FSDPTrainer:
         self.ax = axis_name
         self.n = mesh.shape[axis_name]
         self._meta = None
-        codec = fused_update.resolve_codec(cfg.collective)
-        self._codec = codec
-        self._ef = (cfg.collective.impl == "ring" and codec is not None
-                    and codec.error_feedback)
+        # codec="auto" resolves at the first _ensure_meta — same
+        # autotune contract as DPTrainer (_resolve_auto below)
+        self._tuned_plan = None
+        self._tune_calib = None
+        self._set_codec_flags()
         if cfg.collective.fused_optimizer \
                 and cfg.optimizer.clip_norm is not None:
             raise ValueError(
                 "fused_optimizer cannot honor clip_norm (same contract "
                 "as DPTrainer: no barrier between reduce and update)")
 
+    def _set_codec_flags(self) -> None:
+        coll = self.cfg.collective
+        from .. import tune as tune_lib
+        if tune_lib.needs_autotune(coll):
+            self._codec, self._ef = None, False
+            return
+        codec = fused_update.resolve_codec(coll)
+        self._codec = codec
+        self._ef = (coll.impl == "ring" and codec is not None
+                    and codec.error_feedback)
+
+    def _resolve_auto(self, params_like) -> None:
+        """One-shot autotune resolution (no-op for concrete configs) —
+        deterministic in the banked artifacts; the plan is banked into
+        obs_static_metrics().  Shared implementation:
+        tune.resolve_train_config."""
+        from .. import tune as tune_lib
+        cfg, plan, calib = tune_lib.resolve_train_config(
+            self.cfg, self.n, params_like)
+        if plan is None:
+            return
+        self.cfg = cfg
+        self._tuned_plan, self._tune_calib = plan, calib
+        self._set_codec_flags()
+
     # -- init ---------------------------------------------------------------
 
     def _ensure_meta(self, params_like) -> None:
         """Flat layout from a params tree or ShapeDtypeStructs (no device
         work — same restore contract as the other trainers)."""
+        self._resolve_auto(params_like)
         self._meta = fused_update.flat_meta(params_like,
                                             self.cfg.collective, self.n)
+        if self._tuned_plan is not None \
+                and self._tuned_plan.payload_elems != self._meta.padded_len:
+            # exact wire declaration needs the padded length, priced
+            # under the SAME calibration/slice plan as the argmin (see
+            # DPTrainer._ensure_meta)
+            from .. import tune as tune_lib
+            self._tuned_plan = tune_lib.rescore(
+                self._tuned_plan, self._meta.padded_len,
+                calibration=self._tune_calib,
+                slice_elems=self.cfg.collective.slice_elems)
         self.__dict__.pop("step_fn", None)
 
     @property
@@ -105,8 +142,8 @@ class FSDPTrainer:
     def init_state(self, params) -> FSDPState:
         """Shard replicated init params into the persistent master shards
         (the only copy that survives the call — the ZeRO-3 memory claim)."""
+        self._ensure_meta(params)    # resolves codec='auto' first
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
-        self._ensure_meta(params)
 
         def _init(p):
             w_own, opt_state, _ = fused_update.init_master_shard(
@@ -264,14 +301,17 @@ class FSDPTrainer:
         the 2*(n-1)/n formula accounts, so the same arithmetic applies."""
         meta = self._meta
         assert meta is not None, "call init_state first"
+        coll = self.cfg.collective
         d = {"padded_len": meta.padded_len, "n_devices": self.n,
-             "impl": self.cfg.collective.impl}
+             "impl": coll.impl, "topology": coll.topology}
         d.update(obs_metrics.codec_static_metrics(self._codec,
                                                   meta.padded_len))
-        d["wire_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
-            meta.padded_len, self.n, self._codec)
-        d["raw_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
-            meta.padded_len, self.n, None)
+        d["wire_bytes_per_allreduce"] = fused_update.wire_bytes_for(
+            coll, meta.padded_len, self.n)
+        d["raw_bytes_per_allreduce"] = fused_update.wire_bytes_for(
+            coll, meta.padded_len, self.n, codec=None)
+        if self._tuned_plan is not None:
+            d["tune"] = self._tuned_plan.describe()
         return d
 
     # -- materialization (eval / checkpoint restore) ------------------------

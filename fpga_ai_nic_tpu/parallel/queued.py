@@ -39,7 +39,7 @@ from . import accum
 from .ddp import DDPState, DDPTrainer
 from .. import optim
 from ..obs import metrics as obs_metrics
-from ..ops import bucketed, fused_update, ring as ring_ops
+from ..ops import bucketed, fused_update
 from ..runtime.queue import CollectiveQueue
 from ..utils.config import TrainConfig
 from ..utils.observability import Profiler
@@ -97,15 +97,23 @@ class QueuedDDPTrainer(DDPTrainer):
         jitted function, recompiled per bucket shape by jax.jit's own
         cache."""
         coll, ax, n = self.cfg.collective, self.ax, self.n
-        codec = fused_update.resolve_codec(coll)
+        # route through the shared definition (flat ring / hierarchical)
+        # but PIN the separate-op path: the fused Pallas kernel's RDMA
+        # frames carry tile padding beyond wire_bytes_per_device, so
+        # letting fused_kernel ride here would silently break the exact
+        # per-bucket declarations this trainer's telemetry banks
+        if coll.fused_kernel:
+            import dataclasses
+            coll_r = dataclasses.replace(coll, fused_kernel=False)
+        else:
+            coll_r = coll
 
         def shard_reduce(g):
             if coll.impl == "xla":
                 red = lax.pcast(lax.psum(g, ax), ax, to="varying")
             else:
-                red = ring_ops.ring_all_reduce(
-                    g, ax, compression=codec,
-                    slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
+                red = fused_update.ring_all_reduce_routed(
+                    g, ax, coll_r, g.shape[0] // n)
             return red / n
 
         return jax.jit(jax.shard_map(shard_reduce, mesh=self.mesh,
@@ -141,12 +149,11 @@ class QueuedDDPTrainer(DDPTrainer):
         with self.profiler.bucket("grads"):
             bucket_g, loss = self.grads_fn(state.params, batch)
         tickets = []
-        codec = fused_update.resolve_codec(coll)
         with self.profiler.bucket("issue"):
             for i, (b, g) in enumerate(zip(plan.buckets, bucket_g)):
-                raw = ring_ops.wire_bytes_per_device(b.padded_len, n, None)
-                wire = ring_ops.wire_bytes_per_device(b.padded_len, n,
-                                                      codec)
+                raw = fused_update.wire_bytes_for(coll, b.padded_len, n,
+                                                  codec=None)
+                wire = fused_update.wire_bytes_for(coll, b.padded_len, n)
                 if not self._bucket_telemetry_done:
                     # per-bucket wire accounting, once (static per plan):
                     # the flit-counter view the reference exposes per

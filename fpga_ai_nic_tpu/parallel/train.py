@@ -37,7 +37,7 @@ from . import accum
 from . import mesh as mesh_lib
 from .. import optim
 from ..obs import metrics as obs_metrics
-from ..ops import fused_update, ring as ring_ops
+from ..ops import fused_update
 from ..runtime import chaos
 from ..utils.config import TrainConfig
 
@@ -70,12 +70,14 @@ class DPTrainer:
         self.ax = axis_name
         self.n = mesh.shape[axis_name]
         self._meta = None
-        # error-feedback residual carry (compress codecs that declare it,
-        # e.g. top-k): threaded through TrainState.codec_state
-        codec = fused_update.resolve_codec(cfg.collective)
-        self._codec = codec
-        self._ef = (cfg.collective.impl == "ring" and codec is not None
-                    and codec.error_feedback)
+        # codec="auto": codec / pipeline_depth / bucket_elems / topology
+        # resolve ONCE at the first _ensure_meta (the payload size is
+        # known there), from the ring_cost model under calibrated rates
+        # (fpga_ai_nic_tpu.tune) — static thereafter, R2-clean, and the
+        # plan lands in obs_static_metrics() for obs-gate to diff
+        self._tuned_plan = None
+        self._tune_calib = None
+        self._set_codec_flags()
         if cfg.collective.fused_optimizer \
                 and cfg.optimizer.clip_norm is not None:
             raise ValueError(
@@ -85,14 +87,58 @@ class DPTrainer:
                 "optimizer time the fused path removes; clip before the "
                 "collective or run unfused")
 
+    def _set_codec_flags(self) -> None:
+        """(Re)derive the codec object + error-feedback flag from the
+        CURRENT collective config — called at construction and again
+        after autotune resolution replaces the config."""
+        coll = self.cfg.collective
+        from .. import tune as tune_lib
+        if tune_lib.needs_autotune(coll):
+            # unresolved "auto": no codec to instantiate yet (resolution
+            # happens at _ensure_meta, where the payload size is known)
+            self._codec, self._ef = None, False
+            return
+        # error-feedback residual carry (compress codecs that declare it,
+        # e.g. top-k): threaded through TrainState.codec_state
+        codec = fused_update.resolve_codec(coll)
+        self._codec = codec
+        self._ef = (coll.impl == "ring" and codec is not None
+                    and codec.error_feedback)
+
+    def _resolve_auto(self, params_like) -> None:
+        """One-shot autotune resolution of a codec='auto' template (no-op
+        otherwise): deterministic in the banked artifacts, done in plain
+        Python before any tracing.  The calibration is kept so the
+        padded-length rescore prices with the SAME artifacts."""
+        from .. import tune as tune_lib
+        cfg, plan, calib = tune_lib.resolve_train_config(
+            self.cfg, self.n, params_like)
+        if plan is None:
+            return
+        self.cfg = cfg
+        self._tuned_plan, self._tune_calib = plan, calib
+        self._set_codec_flags()
+
     # -- init ---------------------------------------------------------------
 
     def _ensure_meta(self, params_like) -> None:
         """Flat-master layout from a params tree or ShapeDtypeStructs —
         meta is static, derived without touching device memory; invalidate
         any step_fn cached against a previous model's meta."""
+        self._resolve_auto(params_like)
         self._meta = fused_update.flat_meta(params_like,
                                             self.cfg.collective, self.n)
+        if self._tuned_plan is not None \
+                and self._tuned_plan.payload_elems != self._meta.padded_len:
+            # re-price the chosen plan at the PADDED length (padding
+            # depends on the resolved codec) so the banked wire-byte
+            # declaration matches the collective bit for bit — under the
+            # SAME calibration and slice plan the argmin scored with
+            from .. import tune as tune_lib
+            self._tuned_plan = tune_lib.rescore(
+                self._tuned_plan, self._meta.padded_len,
+                calibration=self._tune_calib,
+                slice_elems=self.cfg.collective.slice_elems)
         self.__dict__.pop("step_fn", None)
         self.__dict__.pop("_gather_fn", None)
 
@@ -100,14 +146,15 @@ class DPTrainer:
         """Split replicated params into ZeRO-1 master shards (the analogue
         of the first-iteration weight download to FPGA DDR, flags=1 path,
         sw/mlp_mpi_example_f32.cpp:700; hw/weight_update.sv MEM_INIT)."""
+        # _ensure_meta FIRST: it resolves a codec='auto' template into
+        # the concrete config _init must close over
+        self._ensure_meta(params)
         coll, opt_cfg = self.cfg.collective, self.cfg.optimizer
 
         def _init(params):
             w_own, opt_state, meta = fused_update.init_master_shard(
                 params, self.ax, coll, opt_cfg)
             return w_own, opt_state
-
-        self._ensure_meta(params)
 
         w_own, opt_state = jax.jit(jax.shard_map(
             _init, mesh=self.mesh, in_specs=P(),
@@ -287,14 +334,25 @@ class DPTrainer:
         (the flit-counter arithmetic of hw/bfp_adapter.sv:705-729)."""
         meta = self._meta
         assert meta is not None, "call init_state first"
+        coll = self.cfg.collective
         d = {"padded_len": meta.padded_len, "n_devices": self.n,
-             "impl": self.cfg.collective.impl}
+             "impl": coll.impl, "topology": coll.topology}
         d.update(obs_metrics.codec_static_metrics(self._codec,
                                                   meta.padded_len))
-        d["wire_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
-            meta.padded_len, self.n, self._codec)
-        d["raw_bytes_per_allreduce"] = ring_ops.wire_bytes_per_device(
-            meta.padded_len, self.n, None)
+        d["wire_bytes_per_allreduce"] = fused_update.wire_bytes_for(
+            coll, meta.padded_len, self.n)
+        d["raw_bytes_per_allreduce"] = fused_update.wire_bytes_for(
+            coll, meta.padded_len, self.n, codec=None)
+        if coll.topology == "hier":
+            from ..ops import ring_hier
+            d["hier_plan"] = ring_hier.plan_hier(
+                meta.padded_len, self.n, coll.intra_size,
+                self._codec).describe()
+        if self._tuned_plan is not None:
+            # the banked tuning decision: obs-gate diffs the declared
+            # wire bytes (tune.* keys) across PRs, so a silent change of
+            # plan or accounting fails CI, not a doc
+            d["tune"] = self._tuned_plan.describe()
         return d
 
     # -- restore ------------------------------------------------------------

@@ -1,0 +1,24 @@
+"""Trace-time collective autotuning: the ring_cost roofline, fed by
+measured rates harvested from banked artifacts, picks ``codec``,
+``pipeline_depth``, ``bucket_elems`` and the (flat vs hierarchical)
+topology per payload — ``CollectiveConfig(codec="auto")`` resolved once
+at trainer construction, static thereafter.  docs/TUNING.md.
+
+  tune.calibration   artifact harvesting + provenance (no jax import)
+  tune.autotune      candidate enumeration, scoring, argmin, config
+                     resolution
+"""
+
+from .calibration import (Calibration, CodecRates,  # noqa: F401
+                          load_calibration)
+from .autotune import (Candidate, TunedPlan, enumerate_candidates,  # noqa: F401
+                       needs_autotune, payload_class, rescore,
+                       resolve_collective, resolve_train_config,
+                       score_candidate, tune)
+
+__all__ = [
+    "Calibration", "CodecRates", "load_calibration",
+    "Candidate", "TunedPlan", "enumerate_candidates", "needs_autotune",
+    "payload_class", "rescore", "resolve_collective",
+    "resolve_train_config", "score_candidate", "tune",
+]

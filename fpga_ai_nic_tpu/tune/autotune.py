@@ -1,0 +1,368 @@
+"""Trace-time collective autotuner — close the ring_cost <-> telemetry
+loop.
+
+Every run used to hand-pick ``codec``, ``pipeline_depth``,
+``bucket_elems`` and (now) the collective topology.  This module picks
+them by ARGMIN over an enumerated candidate set, scored with the
+`ops.ring_cost` roofline parameterized by MEASURED rates harvested from
+the banked benchmark artifacts (`tune.calibration`) — SparCML's
+switch-strategy-by-payload-regime (arXiv:1802.08021) on EQuARX's
+quantize-only-the-slow-hop topology (arXiv:2506.17615), driven by our
+own telemetry instead of a datasheet.
+
+Static by construction (R2-clean): resolution happens ONCE in Python at
+trainer construction — `resolve_collective` maps a
+``CollectiveConfig(codec="auto")`` template to a concrete frozen config
+plus a `TunedPlan` record; nothing about the tuner is visible to jax
+tracing, and the plan (choice + calibration provenance) is banked into
+``obs_static_metrics()`` so obs-gate diffs tuning decisions across PRs.
+
+Scoring model (docs/TUNING.md carries the full derivation; all terms in
+seconds, per training-step collective of an E-element f32 payload over n
+devices):
+
+  stream (codec-dependent):
+    flat:  t_stream = max(wire_bytes / W_inter, raw_bytes * (1/enc + 1/dec))
+           over the 2(n-1)/n * E elements each device moves (RS + AG);
+           encode and decode SHARE the VPU, so their costs ADD
+           (ring_cost.hop_cost — the serial-VPU model).
+    hier:  t_intra (raw f32 at W_intra, codec-FREE) + t_inter (the same
+           max() on the slow hop's 2(ng-1)/ng * E/ni elements only).
+  overhead (codec-INDEPENDENT, so the codec argmin is provably monotone
+  in the link rate — halving W_inter can only move the choice toward
+  cheaper wire formats):
+    dispatch   n_buckets * dispatch_s
+    latency    n_buckets * hops * rtt_s / D     (depth-D amortization)
+    fill       n_buckets * (D - 1) * slice_raw_bytes / W_inter
+
+  exposed_s    = overhead + t_stream * (E_last / E): the DDP premise —
+                 every bucket but the LAST overlaps backward compute, so
+                 the exposure a step pays is the tail bucket's stream
+                 plus per-collective overheads.  This is the argmin
+                 objective (it is what bucket_elems trades off).
+  collective_s = overhead + t_stream: the full collective wall time (the
+                 bench-measurable quantity; reported alongside).
+
+Determinism: candidates are enumerated in sorted order and scores are
+pure arithmetic over the calibration record — same artifacts in, same
+plan out (tests/test_tune.py pins it).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .calibration import Calibration, load_calibration
+from ..ops import ring_cost
+
+# candidate grids (sorted; determinism depends on stable ordering)
+DEPTH_CANDIDATES = (1, 2, 4, 8)
+BUCKET_CANDIDATES = (1 << 18, 1 << 20, 1 << 22, 4 * 1024 * 1024)
+# payload-class split mirrors the codec matrix's residency classes
+VMEM_CLASS_MAX_BYTES = 4 * (1 << 20)
+
+
+@dataclass(frozen=True)
+class Candidate:
+    codec: Optional[str]
+    pipeline_depth: int
+    bucket_elems: int
+    topology: str               # "flat" | "hier"
+    intra_size: int             # 1 for flat
+
+    def key(self) -> tuple:
+        """Deterministic sort/tie-break key (codec name with the
+        uncompressed candidate first, then topology and the smaller
+        schedule knobs) — determinism is the contract; relative merit on
+        ties is the scoring model's job, not the sort's."""
+        return (self.codec or "", self.topology, self.intra_size,
+                self.pipeline_depth, self.bucket_elems)
+
+
+@dataclass(frozen=True)
+class TunedPlan:
+    """The resolved choice + everything needed to audit it."""
+    candidate: Candidate
+    modeled_exposed_s: float
+    modeled_collective_s: float
+    wire_bytes_per_device: int      # exact, one all-reduce of the payload
+    raw_bytes_per_device: int
+    payload_elems: int
+    n: int
+    payload_class: str              # "vmem" | "streaming"
+    calibrated: bool
+    dryrun: bool
+    n_candidates: int
+    calibration: Dict[str, Any]     # provenance record (sha + artifacts)
+
+    def describe(self) -> Dict[str, Any]:
+        c = self.candidate
+        return {
+            "codec": c.codec or "none",
+            "pipeline_depth": c.pipeline_depth,
+            "bucket_elems": c.bucket_elems,
+            "topology": c.topology,
+            "intra_size": c.intra_size,
+            "payload_elems": self.payload_elems,
+            "payload_class": self.payload_class,
+            "n_devices": self.n,
+            "modeled_exposed_ms": round(self.modeled_exposed_s * 1e3, 4),
+            "modeled_collective_ms":
+                round(self.modeled_collective_s * 1e3, 4),
+            "wire_bytes_per_device": self.wire_bytes_per_device,
+            "raw_bytes_per_device": self.raw_bytes_per_device,
+            "calibrated": self.calibrated,
+            "dryrun": self.dryrun,
+            "n_candidates": self.n_candidates,
+            "calibration": self.calibration,
+        }
+
+
+def needs_autotune(coll) -> bool:
+    """Does this CollectiveConfig defer choices to the tuner?"""
+    return getattr(coll, "codec", None) == "auto"
+
+
+def payload_class(payload_elems: int) -> str:
+    return ("vmem" if payload_elems * 4 <= VMEM_CLASS_MAX_BYTES
+            else "streaming")
+
+
+def _codec_obj(name: Optional[str]):
+    if name is None:
+        return None
+    from ..compress import get_codec          # lazy: needs jax
+    return get_codec(name)
+
+
+def _hier_intra_candidates(n: int, intra_size: int,
+                           topology: Optional[str]) -> List[int]:
+    """Admissible fast-hop group sizes.  A DECLARED intra_size > 1
+    dividing n is used as-is (ni == n — the degenerate all-intra ring —
+    only when "hier" is explicitly pinned); intra_size == 0 with "hier"
+    pinned delegates the factorization to the tuner: every proper
+    divisor becomes a candidate."""
+    if topology not in (None, "hier"):
+        return []
+    if intra_size > 1 and n % intra_size == 0:
+        if intra_size < n or topology == "hier":
+            return [intra_size]
+        return []
+    if intra_size == 0 and topology == "hier":
+        return [d for d in range(2, n) if n % d == 0]
+    return []
+
+
+def enumerate_candidates(n: int, intra_size: int = 0,
+                         codecs: Optional[Sequence[Optional[str]]] = None,
+                         topology: Optional[str] = None,
+                         depths: Optional[Sequence[int]] = None
+                         ) -> List[Candidate]:
+    """The full fixed-config grid the tuner argmins over (and the bench
+    matrix compares against).  ``intra_size`` > 1 (dividing n) admits
+    the hierarchical topology at that declared factorization —
+    ``topology="hier"`` with intra_size == 0 lets the tuner own the
+    factorization (every proper divisor of n is a candidate);
+    ``topology`` pins one topology ("flat"/"hier") instead of comparing
+    both.  ``depths`` restricts the pipeline-depth grid (trainer
+    resolution passes (1,): the separate-op ring cannot consume a
+    launch-ahead depth, and an unrealizable amortization term would
+    skew the bucket argmin)."""
+    if codecs is None:
+        from ..compress import available_codecs   # lazy: needs jax
+        codecs = (None,) + tuple(available_codecs())
+    topologies: List[Tuple[str, int]] = []
+    if topology in (None, "flat"):
+        topologies.append(("flat", 1))
+    topologies += [("hier", ni)
+                   for ni in _hier_intra_candidates(n, intra_size,
+                                                    topology)]
+    if not topologies:
+        raise ValueError(
+            f"no admissible topology: topology={topology!r} with "
+            f"intra_size={intra_size} over n={n} (hier needs "
+            "intra_size > 1 dividing n, or intra_size=0 with "
+            "topology='hier' to delegate the factorization)")
+    out = []
+    for codec in sorted(codecs, key=lambda c: c or ""):
+        for topo, ni in topologies:
+            for depth in (depths or DEPTH_CANDIDATES):
+                for bucket in BUCKET_CANDIDATES:
+                    out.append(Candidate(codec, depth, bucket, topo, ni))
+    return sorted(out, key=Candidate.key)
+
+
+def score_candidate(payload_elems: int, n: int, cand: Candidate,
+                    calib: Calibration,
+                    slice_elems: int = 8192) -> Dict[str, Any]:
+    """Modeled seconds for one training-step all-reduce (RS + AG) of an
+    [payload_elems] f32 payload under ``cand`` — the formula in the
+    module docstring.  Pure arithmetic: no jax, no device."""
+    E = int(payload_elems)
+    klass = payload_class(E)
+    enc, dec, rates_measured = calib.codec_stage_rates(cand.codec, klass)
+    codec = _codec_obj(cand.codec)
+
+    def wire_bytes(elems: int) -> int:
+        if codec is None:
+            return elems * 4
+        pe = codec.pad_elems
+        return codec.wire_bytes(elems + (-elems) % pe)
+
+    if cand.topology == "hier":
+        ph = ring_cost.hier_phase_bytes(E, n, cand.intra_size, wire_bytes)
+        intra = ring_cost.hop_cost(ph["intra_bytes"], ph["intra_bytes"],
+                                   calib.intra_gbps)
+        inter = ring_cost.hop_cost(ph["inter_raw_bytes"],
+                                   ph["inter_wire_bytes"],
+                                   calib.inter_gbps, enc, dec)
+        t_stream = intra["t_s"] + inter["t_s"]
+        hops = ph["hops"]
+        wire_total = ph["intra_bytes"] + ph["inter_wire_bytes"]
+        raw_total = ph["intra_bytes"] + ph["inter_raw_bytes"]
+        stream_detail = {"intra": intra, "inter": inter}
+    else:
+        e_wire = 2 * (n - 1) * (E // n)
+        raw_total = e_wire * 4
+        wire_total = wire_bytes(e_wire)
+        hop = ring_cost.hop_cost(raw_total, wire_total,
+                                 calib.inter_gbps, enc, dec)
+        t_stream = hop["t_s"]
+        hops = 2 * (n - 1)
+        stream_detail = {"flat": hop}
+
+    nb = max(1, math.ceil(E / cand.bucket_elems))
+    e_last = E - (nb - 1) * cand.bucket_elems
+    tail_frac = e_last / E if E else 1.0
+    D = cand.pipeline_depth
+    # codec-INDEPENDENT overheads (see module docstring: this keeps the
+    # codec argmin provably monotone in the link rate)
+    t_overhead = nb * (calib.dispatch_s
+                       + hops * calib.rtt_s / D
+                       + (D - 1) * slice_elems * 4
+                       / (calib.inter_gbps * 1e9))
+    return {
+        "exposed_s": t_overhead + t_stream * tail_frac,
+        "collective_s": t_overhead + t_stream,
+        "stream_s": t_stream,
+        "overhead_s": t_overhead,
+        "n_buckets": nb,
+        "last_bucket_elems": e_last,
+        "wire_bytes_per_device": int(wire_total),
+        "raw_bytes_per_device": int(raw_total),
+        "payload_class": klass,
+        "rates_measured": rates_measured,
+        "stream_detail": stream_detail,
+    }
+
+
+def tune(payload_elems: int, n: int, *, intra_size: int = 0,
+         topology: Optional[str] = None,
+         codecs: Optional[Sequence[Optional[str]]] = None,
+         calibration: Optional[Calibration] = None,
+         slice_elems: int = 8192,
+         depths: Optional[Sequence[int]] = None) -> TunedPlan:
+    """Argmin over the candidate grid — deterministic: candidates are
+    scored in sorted order and ties break on the sort key, so the same
+    calibration artifacts always produce the same plan."""
+    calib = calibration if calibration is not None else load_calibration()
+    cands = enumerate_candidates(n, intra_size, codecs, topology, depths)
+    best: Optional[Tuple[float, Candidate, Dict[str, Any]]] = None
+    for cand in cands:
+        s = score_candidate(payload_elems, n, cand, calib, slice_elems)
+        if best is None or s["exposed_s"] < best[0]:
+            best = (s["exposed_s"], cand, s)
+    assert best is not None
+    _, cand, s = best
+    return TunedPlan(
+        candidate=cand,
+        modeled_exposed_s=s["exposed_s"],
+        modeled_collective_s=s["collective_s"],
+        wire_bytes_per_device=s["wire_bytes_per_device"],
+        raw_bytes_per_device=s["raw_bytes_per_device"],
+        payload_elems=int(payload_elems), n=int(n),
+        payload_class=s["payload_class"],
+        calibrated=calib.calibrated,
+        dryrun=calib.dryrun,
+        n_candidates=len(cands),
+        calibration=calib.describe())
+
+
+def rescore(plan: TunedPlan, payload_elems: int,
+            calibration: Optional[Calibration] = None,
+            slice_elems: int = 8192) -> TunedPlan:
+    """Re-price the CHOSEN candidate at the final payload length.  The
+    flat layout pads to a multiple of the resolved codec's unit (which
+    is only known after resolution), so the EXACT wire-byte declaration
+    the obs-gate tune.* keys pin is computed here, against the padded
+    length the collective actually moves.  Pass the SAME calibration
+    and slice_elems tune() scored with — a silently different
+    parameterization between argmin and banked plan is exactly the
+    drift this subsystem exists to prevent."""
+    import dataclasses
+    calib = calibration if calibration is not None else load_calibration()
+    s = score_candidate(payload_elems, plan.n, plan.candidate, calib,
+                        slice_elems)
+    return dataclasses.replace(
+        plan,
+        modeled_exposed_s=s["exposed_s"],
+        modeled_collective_s=s["collective_s"],
+        wire_bytes_per_device=s["wire_bytes_per_device"],
+        raw_bytes_per_device=s["raw_bytes_per_device"],
+        payload_elems=int(payload_elems),
+        payload_class=s["payload_class"])
+
+
+def resolve_collective(coll, n: int, payload_elems: int,
+                       calibration: Optional[Calibration] = None):
+    """Map a ``CollectiveConfig(codec="auto", ...)`` template to the
+    concrete frozen config the trainer runs on, plus the TunedPlan
+    record.  Called ONCE at trainer construction (parallel.train /
+    parallel.ddp / parallel.fsdp `_ensure_meta`) — static thereafter.
+
+    A non-auto config passes through unchanged with plan=None."""
+    import dataclasses
+    if not needs_autotune(coll):
+        return coll, None
+    # an explicit flat topology with no declared factorization stays
+    # flat; a declared intra_size admits hier; topology="hier" pins it
+    # (with intra_size=0 the tuner owns the factorization)
+    topology = "hier" if coll.topology == "hier" else None
+    # depth grid pinned to 1: codec="auto" runs the separate-op ring
+    # (fused_kernel rejected at construction), which cannot consume a
+    # launch-ahead depth — scoring an unrealizable rtt/D amortization
+    # would skew the bucket argmin against reality
+    plan = tune(payload_elems, n, intra_size=coll.intra_size,
+                topology=topology, calibration=calibration,
+                slice_elems=coll.slice_elems, depths=(1,))
+    c = plan.candidate
+    resolved = dataclasses.replace(
+        coll, codec=c.codec, codec_opts=(),
+        pipeline_depth=c.pipeline_depth,
+        bucket_elems=c.bucket_elems,
+        topology=c.topology,
+        intra_size=c.intra_size if c.topology == "hier" else coll.intra_size)
+    return resolved, plan
+
+
+def resolve_train_config(cfg, n: int, params_like,
+                         calibration: Optional[Calibration] = None):
+    """The shared trainer-side resolution step (DP / FSDP / DDP /
+    QueuedDDP all call exactly this): payload size from the params tree
+    (or ShapeDtypeStructs), one calibration load shared by resolution
+    AND the later padded-length rescore, the collective replaced inside
+    the frozen TrainConfig.  Returns ``(new_cfg, plan, calibration)`` —
+    ``(cfg, None, None)`` when nothing is deferred."""
+    import dataclasses
+    if not needs_autotune(cfg.collective):
+        return cfg, None, None
+    import jax
+    import numpy as np
+    calib = calibration if calibration is not None else load_calibration()
+    leaves = jax.tree_util.tree_leaves(params_like)
+    total = sum(int(np.prod(l.shape)) if l.shape else 1 for l in leaves)
+    coll, plan = resolve_collective(cfg.collective, n, total,
+                                    calibration=calib)
+    return dataclasses.replace(cfg, collective=coll), plan, calib

@@ -124,8 +124,40 @@ class CollectiveConfig:
     # (still fully supported); codec="bfp" may combine with compression=
     # to reuse a BFPConfig.  Unknown names fail HERE, at construction,
     # with the registered list — not at first collective trace.
+    #
+    # codec="auto" defers the choice to the trace-time autotuner
+    # (fpga_ai_nic_tpu.tune): the trainer resolves codec, pipeline_depth,
+    # bucket_elems and topology ONCE at construction from the ring_cost
+    # model parameterized by calibrated (banked-artifact) rates, then
+    # trains on the resolved static config — no trace-time capture, and
+    # the chosen plan is banked into obs_static_metrics() for obs-gate
+    # to diff across PRs.  See docs/TUNING.md.
     codec: Optional[str] = None
     codec_opts: Tuple[Tuple[str, Any], ...] = ()
+    # launch-ahead depth D of the fused Pallas ring's slice schedule
+    # (ops.ring_pallas pipeline_depth: encode slice g+D while D RDMAs are
+    # in flight).  None = the kernel's default (_PIPE_DEPTH, capped by
+    # the slice plan); the autotuner owns it under codec="auto".  A
+    # schedule choice, never a numerics choice.
+    pipeline_depth: Optional[int] = None
+    # collective topology over the (flat) axis:
+    #   "flat":  the 1-D ring (the reference's only shape).
+    #   "hier":  2-stage hierarchical (intra x inter) collectives
+    #            (ops.ring_hier): full-precision reduce over the declared
+    #            FAST intra factor first, then the codec ring only on the
+    #            SLOW inter hop — EQuARX's quantize-only-the-slow-phase
+    #            trick (arXiv:2506.17615).  Requires impl="ring" and
+    #            intra_size > 1 dividing the axis size; codec applies to
+    #            the inter hop ONLY (graftlint J9 pins the intra hop
+    #            codec-free and both hops' bytes to the plan).
+    topology: str = "flat"
+    # declared intra/inter factorization of the flat axis for
+    # topology="hier": the axis's n devices are ni = intra_size
+    # consecutive ranks per fast group (device d -> group d // ni,
+    # position d % ni), matching a dp x tp-style mesh flattened
+    # major-to-minor.  0 = undeclared (required for "hier" unless the
+    # autotuner owns the choice under codec="auto").
+    intra_size: int = 0
     # run the compressed ring through the single fused Pallas kernel
     # (ops.ring_pallas: encode-into-hop with RDMA overlap) instead of the
     # separate encode/ppermute/decode XLA ops.  Implies the lane-layout
@@ -190,6 +222,41 @@ class CollectiveConfig:
                 and self.impl != "ring"):
             raise ValueError("gradient compression requires impl='ring' "
                              "(XLA collectives cannot compress on the wire)")
+        assert self.topology in ("flat", "hier"), self.topology
+        assert self.pipeline_depth is None or self.pipeline_depth >= 1
+        assert self.intra_size >= 0, self.intra_size
+        if self.topology == "hier":
+            if self.impl != "ring":
+                raise ValueError(
+                    "topology='hier' requires impl='ring': the 2-stage "
+                    "intra/inter schedule is an explicit-ring program "
+                    "(ops.ring_hier); XLA owns its own psum topology")
+            if self.fused_kernel:
+                raise ValueError(
+                    "topology='hier' cannot ride fused_kernel yet: the "
+                    "Pallas ring kernels drive the FULL axis's neighbor "
+                    "permutation; run the separate-op hierarchical ring "
+                    "(fused_kernel=False — fused_optimizer still works "
+                    "through the shared update formula)")
+            if self.intra_size <= 1 and self.codec != "auto":
+                raise ValueError(
+                    "topology='hier' needs a declared intra/inter "
+                    "factorization: set intra_size > 1 (the fast-hop "
+                    "group size; must divide the axis size), or use "
+                    "codec='auto' and let the autotuner own it")
+        if self.codec == "auto":
+            # deferred to the trace-time autotuner (fpga_ai_nic_tpu.tune,
+            # resolved once at trainer construction); nothing to validate
+            # against the codec registry yet
+            if self.fused_kernel:
+                raise ValueError(
+                    "codec='auto' cannot combine with fused_kernel=True: "
+                    "the fused-capability check needs a concrete codec — "
+                    "pick one, or let the tuner run the separate-op ring")
+            if self.compression is not None:
+                raise ValueError(
+                    "codec='auto' conflicts with compression= (a "
+                    "BFPConfig parameterizes the 'bfp' codec only)")
         if self.fused_optimizer and self.integrity_check:
             raise ValueError(
                 "fused_optimizer is incompatible with integrity_check: the "
@@ -206,6 +273,8 @@ class CollectiveConfig:
                     f"codec={self.codec!r} conflicts with compression= "
                     "(a BFPConfig): the BFPConfig parameterizes the 'bfp' "
                     "codec only")
+        if self.codec == "auto":
+            return      # registry resolution happens at autotune time
         if self.codec is not None or self.fused_kernel:
             if self.fused_kernel and (self.impl != "ring"
                                       or (self.compression is None

@@ -1,0 +1,62 @@
+"""J9 bad fixture: a "hierarchical" collective that runs the codec on
+the FAST intra hop — exactly the regression the rule freezes out (the
+EQuARX split exists to keep full precision free where the wire is fast).
+
+The program reduces over the intra subrings WITH the int8 codec on the
+wire while declaring the standard codec-free-intra HierarchicalPlan, so
+check_hier_program must report BOTH the non-f32 intra operands and the
+intra/inter byte mismatches.
+"""
+
+N = 8
+NI = 2
+L = 8192
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fpga_ai_nic_tpu.compress import get_codec
+    from fpga_ai_nic_tpu.ops import ring as ring_ops, ring_hier
+
+    codec = get_codec("int8")
+    Lp = L + (-L) % (N * codec.pad_elems)
+    # the DECLARATION is the honest plan (codec only on the slow hop) —
+    # the program below violates it
+    plan = ring_hier.plan_hier(Lp, N, NI, codec)
+    mesh = Mesh(np.array(jax.devices()[:N]), ("dp",))
+    ng, C = N // NI, Lp // N
+
+    def prog(x):
+        idx = lax.axis_index("dp")
+        g, j = idx // NI, idx % NI
+        perm_a = ring_hier._intra_perm(N, NI)
+        units = x.reshape(ng, NI, C).transpose(1, 0, 2).reshape(NI, ng * C)
+
+        def hop_a(s, u):
+            send = jnp.take(u, ((j - s - 1) % NI)[None], axis=0)[0]
+            # BAD: the codec rides the FAST hop
+            recv = ring_ops._send(send, "dp", N, codec, perm=perm_a)
+            return u.at[(j - s - 2) % NI].add(recv)
+
+        units = lax.fori_loop(0, NI - 1, hop_a, units)
+        own = jnp.take(units, j[None], axis=0)[0].reshape(ng, C)
+        perm_b = ring_hier._inter_perm(N, NI)
+
+        def hop_b(s, u):
+            send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
+            recv = ring_ops._send(send, "dp", N, codec, perm=perm_b)
+            return u.at[(g - s - 2) % ng].add(recv)
+
+        own = lax.fori_loop(0, ng - 1, hop_b, own)
+        return jnp.take(own, g[None], axis=0)[0]
+
+    jx = jax.make_jaxpr(jax.jit(jax.shard_map(
+        prog, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False)))(
+        jax.ShapeDtypeStruct((N * Lp,), jnp.float32))
+    return jx, plan, "reduce_scatter"

@@ -398,12 +398,14 @@ class TestJ7GradScale:
         assert "ratio 2" in fs[0].message and "ratio 4" in fs[1].message
 
     def test_exit_code_with_fixture_env(self):
-        # one subprocess pays for the full sweep, so BOTH value-level
-        # fixture hooks ride it: J7 (grad scale) and J8 (reshard wire
-        # accounting) must each fire and fail the CLI
+        # one subprocess pays for the full sweep, so ALL value-level
+        # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
+        # accounting) and J9 (hierarchical hop accounting) must each
+        # fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
-                   GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE)
+                   GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
+                   GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -411,6 +413,7 @@ class TestJ7GradScale:
         assert proc.returncode != 0, proc.stdout + proc.stderr
         assert "J7:" in proc.stdout
         assert "J8:" in proc.stdout
+        assert "J9:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -468,4 +471,59 @@ class TestJ8Reshard:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j8()
         assert len(fs) == 1 and fs[0].code == "J8"
+        assert "boom" in fs[0].message
+
+
+class TestJ9Hier:
+    """J9: hierarchical collectives (ops.ring_hier) must keep the fast
+    intra hop codec-free and move EXACTLY the bytes the
+    HierarchicalPlan declares, per hop class — the program property the
+    EQuARX-style quantize-only-the-slow-hop claim rests on."""
+
+    FIXTURE = os.path.join(FIXTURES, "j9_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j9
+        findings = run_j9()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_codec_on_fast_hop(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j9_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_hier_program
+        fs = check_hier_program("j9_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J9"}
+        # the finding must name BOTH violations: non-f32 payloads on the
+        # fast hop and the declared-vs-moved byte mismatch
+        assert any("non-f32" in f.message for f in fs)
+        assert any("declares" in f.message for f in fs)
+
+    def test_flat_collective_in_hier_program_is_other(self):
+        """A full-ring permutation inside a declared-hierarchical
+        program must classify as 'other' (neither hop class) — the
+        smuggled-flat-collective case."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import _classify_perm
+        n, ni = 8, 2
+        flat = tuple((i, (i + 1) % n) for i in range(n))
+        assert _classify_perm(flat, ni) == "other"
+        intra = tuple((g * ni + j, g * ni + (j + 1) % ni)
+                      for g in range(n // ni) for j in range(ni))
+        inter = tuple((g * ni + j, ((g + 1) % (n // ni)) * ni + j)
+                      for g in range(n // ni) for j in range(ni))
+        assert _classify_perm(intra, ni) == "intra"
+        assert _classify_perm(inter, ni) == "inter"
+
+    def test_surface_failure_lands_as_j9_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j9_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j9()
+        assert len(fs) == 1 and fs[0].code == "J9"
         assert "boom" in fs[0].message

@@ -89,6 +89,58 @@ def test_queued_profiler_counters_are_live(rng):
     assert rep["collectives"]["compression_ratio"] > 3.0
 
 
+@pytest.mark.parametrize("coll", [
+    # tuner-style sizing: NOT the 4Mi default, deliberately producing a
+    # non-uniform last bucket for this model's 7.2k-element tree
+    CollectiveConfig(impl="ring", codec="bfp", bucket_elems=3000),
+    CollectiveConfig(impl="ring", codec="topk", bucket_elems=1536),
+    CollectiveConfig(impl="ring", codec="bfp", bucket_elems=3000,
+                     topology="hier", intra_size=2),
+], ids=["bfp", "topk", "bfp_hier"])
+def test_tuner_sized_buckets_wire_accounting_exact(rng, coll):
+    """ISSUE-8 satellite: when the tuner owns bucket_elems, the queued
+    trainer's per-bucket wire accounting must stay EXACT — every bucket's
+    declared bytes equal what its traced reduce program's ppermutes move
+    (the J4 methodology applied per bucket), non-uniform last bucket
+    included, under flat AND hierarchical topologies."""
+    from fpga_ai_nic_tpu.lint.jaxpr_sweep import _collect
+    from fpga_ai_nic_tpu.ops import fused_update
+
+    cfg = _cfg(collective=coll)
+    tr = QueuedDDPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(mlp.init(jax.random.PRNGKey(0), MCFG))
+    buckets = tr._plan.buckets
+    assert len(buckets) >= 2, "sizing must produce multiple buckets"
+    assert buckets[-1].padded_len != buckets[0].padded_len, \
+        "the last bucket must be non-uniform for this test to bite"
+    n = tr.n
+    for b in buckets:
+        declared = fused_update.wire_bytes_for(coll, b.padded_len, n)
+        g_sds = jax.ShapeDtypeStruct((n * b.padded_len,), jnp.float32)
+        jx = jax.make_jaxpr(lambda g: tr.reduce_fn(g))(g_sds)
+        c = _collect(jx.jaxpr)
+        assert not c["wire_unknown"]
+        assert c["wire_bytes"] == declared, (b, declared, c["wire_bytes"])
+    # ...and the step's live counters sum exactly the same declarations
+    state, _ = tr.step(state, _data(rng, cfg))
+    st = tr.profiler.collectives
+    assert st.wire_bytes == sum(
+        fused_update.wire_bytes_for(coll, b.padded_len, n)
+        for b in buckets)
+
+
+def test_auto_bucket_elems_owned_by_tuner(rng):
+    """codec='auto': the resolved bucket_elems comes from the tuner's
+    grid (not the 4Mi config default), and the plan it banks names it."""
+    cfg = _cfg(collective=CollectiveConfig(impl="ring", codec="auto"))
+    tr = QueuedDDPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+    tr.init_state(mlp.init(jax.random.PRNGKey(0), MCFG))
+    from fpga_ai_nic_tpu.tune.autotune import BUCKET_CANDIDATES
+    assert tr.cfg.collective.bucket_elems in BUCKET_CANDIDATES
+    assert tr._tuned_plan.describe()["bucket_elems"] == \
+        tr.cfg.collective.bucket_elems
+
+
 def test_queued_window_bounds_inflight(rng):
     cfg = _cfg(collective=CollectiveConfig(bucket_elems=256, max_inflight=2))
     tr = QueuedDDPTrainer(_loss, make_mesh(cfg.mesh), cfg)

@@ -1,0 +1,235 @@
+"""Hierarchical (intra x inter) 2-stage ring collectives (ops.ring_hier).
+
+Contracts under test (docs/TUNING.md "hierarchical topology contract"):
+
+- bit-exact vs the codec-generic numpy golden twin
+  (compress.golden.hier_reduce_scatter / hier_all_gather) for every
+  registered codec and every factorization of the 8-device mesh;
+- bit-IDENTICAL to the flat ring for codec=None whenever the additions
+  are exact (integer-valued payloads — f32 association is the only
+  difference, so exact adds erase it), allclose on generic floats;
+- the codec rides ONLY the slow inter hop (asserted statically by the
+  jaxpr classification the J9 lint rule uses);
+- HierarchicalPlan.wire_bytes is EXACTLY what the lowered program's
+  ppermutes move (the same accounting the tuner banks and obs-gate
+  pins);
+- trainer integration: DPTrainer(topology="hier") trains and matches
+  the flat trainer's master shards.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fpga_ai_nic_tpu.compress import get_codec, golden as cgold
+from fpga_ai_nic_tpu.ops import ring_hier, ring as ring_ops
+
+N = 8
+CODECS = (None, "bfp", "topk", "int8")
+FACTORS = (1, 2, 4, 8)
+
+
+def _mesh():
+    return Mesh(np.array(jax.devices()[:N]), ("dp",))
+
+
+def _run(fn, per_dev):
+    """shard_map a per-device collective over the dp mesh; per_dev is
+    [n, k] numpy (device-major)."""
+    out = jax.jit(jax.shard_map(
+        fn, mesh=_mesh(), in_specs=P("dp"), out_specs=P("dp"),
+        check_vma=False))(jnp.asarray(per_dev.reshape(-1)))
+    return np.asarray(out).reshape(N, -1)
+
+
+def _payload(rng, codec, l_unit=64):
+    unit = N * (codec.pad_elems if codec else 1) * 2
+    L = l_unit * unit
+    return rng.standard_normal((N, L)).astype(np.float32), L
+
+
+class TestGoldenParity:
+    @pytest.mark.parametrize("codec_name", CODECS)
+    @pytest.mark.parametrize("ni", FACTORS)
+    def test_reduce_scatter_matches_golden(self, rng, codec_name, ni):
+        codec = get_codec(codec_name) if codec_name else None
+        rt = cgold.roundtrip_fn(codec) if codec else None
+        shards, L = _payload(rng, codec)
+        out = _run(lambda v: ring_hier.hier_reduce_scatter(
+            v, "dp", ni, compression=codec), shards)
+        gold = cgold.hier_reduce_scatter(shards, ni, rt)
+        np.testing.assert_array_equal(out, gold)
+
+    @pytest.mark.parametrize("codec_name", CODECS)
+    @pytest.mark.parametrize("ni", (2, 4))
+    def test_all_gather_matches_golden(self, rng, codec_name, ni):
+        codec = get_codec(codec_name) if codec_name else None
+        rt = cgold.roundtrip_fn(codec) if codec else None
+        shards, L = _payload(rng, codec)
+        owned = cgold.hier_reduce_scatter(shards, ni, rt)
+        out = _run(lambda v: ring_hier.hier_all_gather(
+            v, "dp", ni, compression=codec), owned)
+        gold = cgold.hier_all_gather(owned, ni, rt)
+        np.testing.assert_array_equal(out, gold)
+        # replica identity: every device reassembled the same vector
+        assert np.array_equal(out, np.broadcast_to(out[0], out.shape))
+
+    @pytest.mark.parametrize("ni", (2, 4))
+    def test_sliced_inter_hop_is_bit_identical(self, rng, ni):
+        """slice_elems on the slow hop changes the schedule, never the
+        bits (the Codec.sliceable contract, inherited from the flat
+        ring)."""
+        codec = get_codec("bfp")
+        shards, L = _payload(rng, codec)
+        whole = _run(lambda v: ring_hier.hier_reduce_scatter(
+            v, "dp", ni, compression=codec), shards)
+        C = L // N
+        sliced = _run(lambda v: ring_hier.hier_reduce_scatter(
+            v, "dp", ni, compression=codec, slice_elems=C // 2), shards)
+        np.testing.assert_array_equal(whole, sliced)
+
+
+class TestFlatParity:
+    @pytest.mark.parametrize("ni", (2, 4))
+    def test_bit_identical_to_flat_ring_on_exact_payloads(self, rng, ni):
+        """codec=None: the hierarchical schedule computes the same SUM
+        under a different association; integer-valued payloads make
+        every f32 add exact, so the results must be bit-identical."""
+        L = N * 256
+        shards = rng.integers(-64, 64, (N, L)).astype(np.float32)
+        flat = _run(lambda v: ring_ops.ring_reduce_scatter(v, "dp"),
+                    shards)
+        hier = _run(lambda v: ring_hier.hier_reduce_scatter(v, "dp", ni),
+                    shards)
+        np.testing.assert_array_equal(flat, hier)
+        fg = _run(lambda v: ring_ops.ring_all_gather(v, "dp"), flat)
+        hg = _run(lambda v: ring_hier.hier_all_gather(v, "dp", ni), flat)
+        np.testing.assert_array_equal(fg, hg)
+
+    def test_float_payloads_allclose_to_flat(self, rng):
+        L = N * 512
+        shards = rng.standard_normal((N, L)).astype(np.float32)
+        flat = _run(lambda v: ring_ops.ring_reduce_scatter(v, "dp"),
+                    shards)
+        hier = _run(lambda v: ring_hier.hier_reduce_scatter(v, "dp", 4),
+                    shards)
+        np.testing.assert_allclose(flat, hier, rtol=1e-5, atol=1e-5)
+
+    def test_degenerate_factorizations_reduce_to_flat(self, rng):
+        """ni=1 (all inter) runs the codec ring across everyone; ni=n
+        (all intra) is the raw ring — both are the flat schedules."""
+        codec = get_codec("bfp")
+        shards, L = _payload(rng, codec)
+        h1 = _run(lambda v: ring_hier.hier_reduce_scatter(
+            v, "dp", 1, compression=codec), shards)
+        f1 = _run(lambda v: ring_ops.ring_reduce_scatter(
+            v, "dp", compression=codec), shards)
+        np.testing.assert_array_equal(h1, f1)
+        hn = _run(lambda v: ring_hier.hier_reduce_scatter(v, "dp", N),
+                  shards)
+        fn = _run(lambda v: ring_ops.ring_reduce_scatter(v, "dp"),
+                  shards)
+        np.testing.assert_array_equal(hn, fn)
+
+
+class TestPlanAccounting:
+    @pytest.mark.parametrize("codec_name", CODECS)
+    @pytest.mark.parametrize("ni", (2, 4))
+    def test_lowered_bytes_equal_plan_declaration(self, codec_name, ni):
+        """The J9 invariant, asserted here per cell: classify every
+        ppermute in the traced program and compare per-hop-class bytes
+        against HierarchicalPlan — and the intra hop must be f32."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import (_classify_perm,
+                                                      _collect_ppermutes)
+        codec = get_codec(codec_name) if codec_name else None
+        L = N * (codec.pad_elems if codec else 1) * 128
+        plan = ring_hier.plan_hier(L, N, ni, codec)
+
+        def prog(x):
+            owned = ring_hier.hier_reduce_scatter(
+                x, "dp", ni, compression=codec)
+            return ring_hier.hier_all_gather(
+                owned, "dp", ni, compression=codec)
+
+        jx = jax.make_jaxpr(jax.jit(jax.shard_map(
+            prog, mesh=_mesh(), in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False)))(
+            jax.ShapeDtypeStruct((N * L,), jnp.float32))
+        got = {"intra": 0, "inter": 0}
+        for p in _collect_ppermutes(jx.jaxpr):
+            klass = _classify_perm(p["perm"], ni)
+            assert klass != "other", p["perm"][:4]
+            assert p["mult"] is not None
+            got[klass] += p["mult"] * p["bytes"]
+            if klass == "intra":
+                assert p["f32_only"], p["dtypes"]
+        assert got["intra"] == plan.intra_bytes("all_reduce")
+        assert got["inter"] == plan.inter_bytes("all_reduce")
+        assert got["intra"] + got["inter"] == \
+            plan.wire_bytes("all_reduce") == \
+            ring_hier.wire_bytes_per_device(L, N, ni, codec)
+
+    def test_bad_factorization_fails_loudly(self):
+        with pytest.raises(ValueError):
+            ring_hier.plan_hier(N * 16, N, 3, None)   # 3 does not divide 8
+        with pytest.raises(ValueError):
+            ring_hier.plan_hier(N * 16 + 1, N, 2, None)
+
+
+class TestTrainerIntegration:
+    def _train(self, coll, steps=2):
+        from fpga_ai_nic_tpu.models import mlp
+        from fpga_ai_nic_tpu.parallel import mesh as mesh_lib
+        from fpga_ai_nic_tpu.parallel.train import DPTrainer
+        from fpga_ai_nic_tpu.utils.config import (MeshConfig, MLPConfig,
+                                                  TrainConfig)
+        mcfg = MLPConfig(layer_sizes=(64, 64, 32))
+        cfg = TrainConfig(mesh=MeshConfig(dp=N), collective=coll,
+                          global_batch=64)
+        mesh = mesh_lib.make_mesh(cfg.mesh)
+        tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg), mesh, cfg)
+        st = tr.init_state(mlp.init(jax.random.PRNGKey(0), mcfg))
+        r = np.random.default_rng(0)
+        x = r.standard_normal((64, 64)).astype(np.float32)
+        y = r.integers(0, 32, (64,)).astype(np.int32)
+        batch = tr.shard_batch((jnp.asarray(x), jnp.asarray(y)))
+        for _ in range(steps):
+            st, loss = tr.step(st, batch)
+        return tr, np.asarray(st.w_own), float(loss)
+
+    def test_hier_trainer_matches_flat(self):
+        from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+        trh, wh, lh = self._train(
+            CollectiveConfig(impl="ring", topology="hier", intra_size=4))
+        trf, wf, lf = self._train(CollectiveConfig(impl="ring"))
+        assert np.isfinite(lh) and np.isfinite(lf)
+        np.testing.assert_allclose(wh, wf, rtol=1e-5, atol=1e-6)
+        sm = trh.obs_static_metrics()
+        assert sm["topology"] == "hier"
+        assert sm["hier_plan"]["n_intra"] == 4
+        # the statics' declaration is the plan's, not a re-derivation
+        assert sm["wire_bytes_per_allreduce"] == \
+            sm["hier_plan"]["wire_bytes_all_reduce"]
+
+    def test_hier_with_codec_and_fused_optimizer(self):
+        """The EQuARX shape end to end: codec on the slow hop only, the
+        ZeRO-1 update fused after the reduce (the PR-6 shared-formula
+        decode path)."""
+        from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+        tr, w, loss = self._train(CollectiveConfig(
+            impl="ring", codec="bfp", topology="hier", intra_size=2,
+            fused_optimizer=True))
+        assert np.isfinite(loss)
+
+    def test_hier_config_validation(self):
+        from fpga_ai_nic_tpu.utils.config import CollectiveConfig
+        with pytest.raises(ValueError):
+            CollectiveConfig(impl="xla", topology="hier", intra_size=2)
+        with pytest.raises(ValueError):
+            CollectiveConfig(impl="ring", topology="hier")  # no intra
+        with pytest.raises(ValueError):
+            CollectiveConfig(impl="ring", codec="bfp", topology="hier",
+                             intra_size=2, fused_kernel=True)

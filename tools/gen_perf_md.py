@@ -71,6 +71,12 @@ _WATCH = {
                 "fpga_ai_nic_tpu/ops/fused_update.py",
                 "fpga_ai_nic_tpu/runtime/chaos.py",
                 "fpga_ai_nic_tpu/utils/checkpoint.py"],
+    "tune": ["bench_collective.py", "bench_common.py",
+             "fpga_ai_nic_tpu/tune/",
+             "fpga_ai_nic_tpu/ops/ring_cost.py",
+             "fpga_ai_nic_tpu/ops/ring_hier.py",
+             "fpga_ai_nic_tpu/ops/ring.py",
+             "fpga_ai_nic_tpu/compress/"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -382,6 +388,15 @@ def main():
         be = d.get("break_even")
         if be:
             L += ["### Break-even: can the BFP wire path win?", ""]
+            if "calibrated" in be:
+                L += [("Link rates include the **measured** wire rate "
+                       f"({be.get('link_rates_source', '')})."
+                       if be["calibrated"] else
+                       "**[MODEL-ONLY]** every link rate below is a "
+                       "documented fallback constant "
+                       "(`ring_cost.DEFAULT_LINK_RATES`), not a "
+                       "measurement — `make tune-bench` banks a "
+                       "calibrated rate."), ""]
             if "codec_measurement" not in d:
                 L += ["**UNPROVEN (r04 measurement): the codec rates "
                       "feeding this table are dispatch-floored** — the "
@@ -514,6 +529,72 @@ def main():
                         f"efficiency {r.get('pipeline_efficiency')}")
             if lb:
                 L.append("")
+
+    # -- autotuned collectives (tuned plan vs fixed-config matrix) -----------
+    tb_art = (_newest("artifacts/tune_bench_*.json")
+              or _newest("TUNE_BENCH_r*.json"))
+    if tb_art:
+        d = _load(tb_art)
+        rows = d.get("rows", [])
+        cal = d.get("calibration") or {}
+        if rows:
+            dry = bool(d.get("dryrun"))
+            L += ["## Autotuned collectives (tuned plan vs every fixed "
+                  "config)", "",
+                  f"Source: `{_rel(tb_art)}`{_badge(d, 'tune')} "
+                  f"(platform: {d.get('platform')}; `make tune-bench`).  "
+                  "Per payload regime the autotuner "
+                  "(`fpga_ai_nic_tpu.tune`, docs/TUNING.md) argmins the "
+                  "calibrated `ring_cost` model over the full (codec x "
+                  "depth x bucket x topology) grid — `tuned vs best "
+                  "fixed` <= 1 is the self-consistency gate (`make "
+                  "obs-gate` pins it exactly, with the plan's declared "
+                  "wire bytes).", ""]
+            cal_bits = []
+            if cal.get("inter_calibrated"):
+                cal_bits.append(f"inter {cal.get('inter_gbps')} GB/s "
+                                f"({cal.get('inter_source')})")
+            else:
+                cal_bits.append("inter rate = fallback constant "
+                                "[MODEL-ONLY]")
+            if not cal.get("intra_calibrated", False):
+                cal_bits.append("intra rate = fallback constant "
+                                "[MODEL-ONLY]")
+            L += ["Calibration: " + "; ".join(cal_bits) + ".  "
+                  "Codec stage rates from "
+                  + str(len(cal.get("artifacts", [])))
+                  + " banked artifact(s); dryrun-class rows flagged in "
+                  "the artifact's provenance record.", ""]
+            if dry:
+                L += ["**Dryrun measured arms** (virtual CPU mesh): "
+                      "wall times recorded for inspection only — the "
+                      "gated facts are the exact plan declarations.", ""]
+            L += ["| regime | payload | tuned plan | modeled ms | best "
+                  "fixed ms | tuned/best | measured tuned ms | measured "
+                  "flat-bfp ms | wire bytes |",
+                  "|---|---|---|---|---|---|---|---|---|"]
+            for r in rows:
+                t = r.get("tuned", {})
+                plan_s = (f"{t.get('codec')}/{t.get('topology')}"
+                          f" D={t.get('pipeline_depth')}"
+                          f" B={t.get('bucket_elems')}")
+                badge = "" if t.get("calibrated") else " [MODEL-ONLY]"
+                L.append(
+                    f"| {r['regime']} | {r.get('payload_mib')} MiB "
+                    f"| {plan_s}{badge} "
+                    f"| {r.get('tuned_modeled_ms', '—')} "
+                    f"| {r.get('best_fixed_modeled_ms', '—')} "
+                    f"| {r.get('tuned_vs_best_fixed', '—')} "
+                    f"| {r.get('tuned_measured_ms', '—')} "
+                    f"| {r.get('flat_fixed_measured_ms', '—')} "
+                    f"| {r.get('tuned_wire_bytes', '—')} |")
+            L.append("")
+            beats = sum(1 for r in rows if r.get("tuned_beats_all_fixed"))
+            L += [f"Tuned plan met or beat every fixed config (modeled) "
+                  f"on **{beats}/{len(rows)}** regimes; the hierarchical "
+                  "(intra x inter) topology carries the codec only on "
+                  "the slow hop (graftlint J9 pins both hops' bytes and "
+                  "the codec-free intra contract).", ""]
 
     # -- live mesh resharding (reshard vs checkpoint-restore MTTR) -----------
     rb_art = (_newest("artifacts/reshard_bench_*.json")

@@ -77,6 +77,17 @@ RESHARD_GATE_KEYS = ("mttr_reshard_s", "mttr_restore_s", "mttr_speedup")
 RESHARD_BYTE_KEYS = ("reshard_wire_bytes",)
 TOL_RESHARD_TIME = 0.40
 
+# autotune matrix rows (TUNE_BENCH_r*.json): the tuned plan's DECLARED
+# per-device wire bytes gate exactly (a drift means the plan, the codec
+# accounting, or the topology terms changed — J9 territory, not noise);
+# measured collective times gate only on non-dryrun artifacts, the
+# fused-opt honesty rule.  tuned_vs_best_fixed (modeled ratio, <= 1 by
+# argmin construction) gates two-sided-exact too: it moving at all means
+# the scoring model or the candidate grid changed.
+TUNE_GATE_KEYS = ("tuned_measured_ms", "flat_fixed_measured_ms")
+TUNE_BYTE_KEYS = ("tuned_wire_bytes", "tuned_vs_best_fixed")
+TOL_TUNE_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -92,6 +103,10 @@ def fused_opt_metric(kind: str, key: str) -> str:
 
 def reshard_metric(trainer: str, codec: str, key: str) -> str:
     return f"reshard.{trainer}.{codec}.{key}"
+
+
+def tune_metric(regime: str, key: str) -> str:
+    return f"tune.{regime}.{key}"
 
 
 def _load(path):
@@ -205,6 +220,25 @@ def build_banked_summary() -> dict:
                                 tol=TOL_RESHARD_TIME)
                 metrics[reshard_metric(row["trainer"], row["codec"],
                                        key)] = m
+
+    # -- autotune matrix ------------------------------------------------------
+    p = (_newest("artifacts/tune_bench_*.json")
+         or _newest("TUNE_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (TUNE_BYTE_KEYS if d.get("dryrun")
+                else TUNE_BYTE_KEYS + TUNE_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in TUNE_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                else:
+                    m = _metric(v, src, higher=False, tol=TOL_TUNE_TIME)
+                metrics[tune_metric(row["regime"], key)] = m
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
