@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench obs-gate lint lint-fixtures
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -28,6 +28,18 @@ obs-gate:
 lint:
 	python tools/graftlint.py
 
+# graftmc protocol model check (docs/MODELCHECK.md): exhaustive
+# explicit-state exploration of all four collective op streams (flat,
+# streaming, hier, reshard) for n<=6, S<=6, D<=4 — deadlock freedom,
+# slot overwrite, decode ordering, credit safety, termination, DMA
+# discipline — plus the n=8 randomized fuzz sweep and the H1
+# happens-before/lockset pass.  Plain-Python state exploration, no jax
+# APIs, <60 s, CPU-platform env pinned before import (wedged-tunnel
+# safe); violations leave pretty-printed + Perfetto counterexamples
+# under artifacts/.  Runs BETWEEN lint and obs-gate in `make ci`.
+modelcheck:
+	python tools/graftlint.py --mc
+
 # fast fixture-corpus loop (<30 s, CPU-only): every rule fires on its bad
 # fixture / stays silent on the good one, suppression hygiene, and the
 # copied-into-the-package exit-code demonstration — without the jaxpr grid
@@ -35,7 +47,7 @@ lint-fixtures:
 	env JAX_PLATFORMS=cpu python -m pytest tests/test_lint.py -q \
 	    -k "not Jaxpr" -p no:cacheprovider
 
-ci: codec test lint obs-gate
+ci: codec test lint modelcheck obs-gate
 
 bench:
 	python bench.py

@@ -32,9 +32,15 @@ RULE_DOCS: Dict[str, str] = {
     "J9": "hierarchical collective: intra-hop ppermutes must be codec-free "
           "f32 and each hop class must move exactly the bytes the "
           "HierarchicalPlan declares",
+    "H1": "happens-before/lockset: an instance attribute written from two "
+          "threads (trainer / watchdog worker / callback) needs a common "
+          "lock — R1 generalized to cross-thread order",
+    "M1": "graftmc: a protocol model-check cell (or fixture) violated — "
+          "deadlock, slot overwrite, ordering, credit safety, "
+          "termination or DMA discipline",
 }
 
-AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5")
+AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
                                 "J8", "J9")
 

@@ -202,30 +202,13 @@ def _sub_rows(R: int, block_size: int) -> int:
 
 
 def _rs_plan(n: int, S: int, depth: Optional[int]):
-    """(D, n_slots, launch_first) for the deep-pipelined RS schedule.
-
-    D (launch-ahead / pipeline depth) and the comm-slot window n_slots are
-    bound by three schedule invariants (the RS analogue of _ag_schedule's
-    P1/P2; checked for every plan by tests/test_ring_pallas.py's protocol
-    simulator):
-
-      RAW   send q's source rows are finalized by consume q-S.  Launching
-            q BEFORE consume(g) at step g needs q-S <= g-1, i.e.
-            D <= S-1; launching AFTER consume(g) relaxes it to D <= S.
-      SLOT  emission q overwrites wire slot q % n_slots; its downstream
-            decode of arrival q - n_slots must come first.  Emission q
-            runs at step q-D, the decode at step q-n_slots, so
-            n_slots >= D+1 makes the overwrite strictly later in lockstep
-            program order (discharge interpreter) AND makes every credit
-            edge point to a strictly earlier downstream step (hardware:
-            the wait-for graph is acyclic for arbitrary n, S).
-      CAP   no more emissions than total = (n-1)*S.
-    """
-    total = (n - 1) * S
-    D = max(1, min(_PIPE_DEPTH if depth is None else depth, S, total))
-    launch_first = D < S              # RAW: ahead-of-consume needs D<=S-1
-    n_slots = min(total, D + 1)
-    return D, n_slots, launch_first
+    """(D, n_slots, launch_first) for the deep-pipelined RS schedule —
+    a delegate to THE plan definition in `verify.opstream.rs_plan`, so
+    the emitted kernels and the graftmc model checker derive from one
+    source (the three schedule invariants — RAW, SLOT, CAP — are stated
+    there and exhaustively verified per plan by `make modelcheck`)."""
+    from ..verify import opstream as _opstream
+    return _opstream.rs_plan(n, S, depth, default_depth=_PIPE_DEPTH)
 
 
 def _rs_offsets(ids, n: int, S: int, slice_rows: int):
@@ -1723,38 +1706,12 @@ def pick_slice_elems(C: int, target: int, block_size: int) -> int:
 def _rs_op_stream(n: int, S: int, depth: Optional[int]):
     """The per-node op stream of the deep-pipelined RS schedule, as data —
     the exact wait/signal/transfer order _rs_kernel executes (every node
-    runs the identical program).  Consumed by simulate_rs_protocol."""
-    total = (n - 1) * S
-    D, n_slots, launch_first = _rs_plan(n, S, depth)
-    ops = [("barrier",)]
-    for q in range(D):                    # prologue: fill the pipe
-        ops.append(("send", q))
-
-    def launch(q):
-        if q >= total:
-            return
-        if q >= n_slots:
-            ops.append(("wait_send", q - n_slots))
-        if q >= n_slots:
-            ops.append(("credit_wait",))
-        ops.append(("send", q))
-
-    def consume(g):
-        ops.append(("wait_recv", g))
-        ops.append(("decode", g))
-        ops.append(("credit_signal",))
-
-    for g in range(total):
-        if launch_first:
-            launch(g + D)
-            consume(g)
-        else:
-            consume(g)
-            launch(g + D)
-    for j in range(max(0, total - n_slots), total):
-        ops.append(("wait_send", j))
-    ops.append(("credit_drain", min(total, n_slots)))
-    return ops, n_slots
+    runs the identical program).  A delegate to the shared protocol IR
+    (`verify.opstream.rs_op_stream`), so the randomized simulator below,
+    the exhaustive model checker (`make modelcheck`) and this kernel's
+    schedule all derive from ONE definition."""
+    from ..verify import opstream as _opstream
+    return _opstream.rs_op_stream(n, S, depth, default_depth=_PIPE_DEPTH)
 
 
 def simulate_rs_protocol(n: int, S: int, depth: Optional[int] = None,
@@ -1773,90 +1730,27 @@ def simulate_rs_protocol(n: int, S: int, depth: Optional[int] = None,
       - ordering corruption: a decode finds a different emission than the
         schedule expects.
 
-    Returns the number of scheduler events on success.  This is the
-    strongest protocol evidence this container admits at n = 8: the
-    threaded TPU interpreter (the real-kernel check, TestFlowControl)
+    Returns the number of scheduler events on success.  This is now the
+    RANDOMIZED mode of the graftmc protocol checker (`verify.mc`): the
+    op stream and the small-step semantics are the shared definitions
+    the exhaustive checker explores completely for n <= 6, S <= 6,
+    D <= 4 (`make modelcheck`); this entry point remains the seed-sweep
+    fuzz beyond that envelope (n = 8 here: the threaded TPU interpreter
     needs a jaxlib newer than this one AND convoys on 1 core at n = 8 —
-    the model checks the same wait-for graph without either limit."""
-    import random
-    rng = random.Random(seed)
+    the model checks the same wait-for graph without either limit)."""
+    from ..verify import mc as _mc
+    from ..verify import opstream as _opstream
     ops, n_slots = _rs_op_stream(n, S, depth)
-    pc = [0] * n
-    arrived = [False] * n                 # neighbor barrier
-    credits = [0] * n                     # credit_sem counters
-    sent_done = [set() for _ in range(n)]     # emissions with drained send
-    slot_frames = [dict() for _ in range(n)]  # slot -> landed emission
-    transfers = []                        # in-flight: (src, emission)
-
-    def runnable(i):
-        if pc[i] >= len(ops):
-            return False
-        op = ops[pc[i]]
-        kind = op[0]
-        if kind == "barrier":
-            # two phases: signal own arrival (always possible), then block
-            # until both neighbors signaled
-            return (not arrived[i]) or (arrived[(i - 1) % n]
-                                        and arrived[(i + 1) % n])
-        if kind == "wait_send":
-            return op[1] in sent_done[i]
-        if kind == "credit_wait":
-            return credits[i] >= 1
-        if kind == "wait_recv":
-            return slot_frames[i].get(op[1] % n_slots) == op[1]
-        if kind == "credit_drain":
-            return credits[i] >= op[1]
-        return True                       # send / decode / credit_signal
-
-    events = 0
-    while True:
-        ready = [("node", i) for i in range(n) if runnable(i)]
-        ready += [("wire", t) for t in range(len(transfers))]
-        if not ready:
-            if all(p >= len(ops) for p in pc):
-                return events
-            raise AssertionError(
-                f"protocol deadlock: n={n} S={S} depth={depth} seed={seed} "
-                f"pc={pc} next={[ops[p] if p < len(ops) else None for p in pc]} "
-                f"credits={credits} in_flight={transfers}")
-        events += 1
-        assert events <= max_events, "scheduler did not terminate"
-        kind, which = ready[rng.randrange(len(ready))]
-        if kind == "wire":                # a started RDMA lands downstream
-            src, q = transfers.pop(which)
-            dst = (src + 1) % n
-            slot = q % n_slots
-            assert slot not in slot_frames[dst], (
-                f"recv-slot overwrite: emission {q} landed on undecoded "
-                f"frame {slot_frames[dst][slot]} (n={n} S={S} "
-                f"depth={depth} seed={seed})")
-            slot_frames[dst][slot] = q
-            sent_done[src].add(q)
-            continue
-        i = which
-        op = ops[pc[i]]
-        if op[0] == "barrier":
-            arrived[i] = True             # signal phase
-            if not (arrived[(i - 1) % n] and arrived[(i + 1) % n]):
-                continue                  # signaled; wait phase blocks
-        elif op[0] == "send":
-            q = op[1]
-            assert not any(s == i and t % n_slots == q % n_slots
-                           for s, t in transfers), (
-                f"send-slot overwrite: emission {q} encoded over an "
-                f"in-flight frame (n={n} S={S} depth={depth} seed={seed})")
-            transfers.append((i, q))
-        elif op[0] == "decode":
-            g = op[1]
-            got = slot_frames[i].pop(g % n_slots)
-            assert got == g, f"ordering corruption: got {got}, want {g}"
-        elif op[0] == "credit_signal":
-            credits[(i - 1) % n] += 1     # free the slot for upstream
-        elif op[0] == "credit_wait":
-            credits[i] -= 1
-        elif op[0] == "credit_drain":
-            credits[i] -= op[1]
-        pc[i] += 1
+    model = _opstream.RingModel(
+        n, ops, n_slots,
+        meta={"n": n, "S": S, "depth": depth, "seed": seed})
+    # legacy fuzz semantics: no credit-bound assert and no at-exit
+    # strictness (the exhaustive checker owns boundedness/leaks; a
+    # mutated stream under this entry point must keep failing with the
+    # overwrite/deadlock wording its callers match on)
+    model.credit_bound = len(ops)
+    model.strict_terminal = False
+    return _mc.run_random(model, seed=seed, max_events=max_events)
 
 
 def flow_control_selftest(n: int = 8, *, streaming: bool = False,

@@ -55,13 +55,13 @@ the elastic loop's first recovery tier calls (docs/RESHARD.md).
 from __future__ import annotations
 
 import functools
-from typing import Any, Dict, NamedTuple, Optional, Tuple
+from typing import Any, Callable, Dict, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 from ..ops import fused_update
@@ -74,7 +74,9 @@ __all__ = [
 ]
 
 
-def pack_state_leaves(w_own, opt_state) -> Dict[str, Any]:
+def pack_state_leaves(w_own: jax.Array,
+                      opt_state: Optional[Dict[str, jax.Array]]
+                      ) -> Dict[str, Any]:
     """THE flat-leaf naming convention of a live move (w_own + sorted
     ``opt.<k>`` moments) — one definition shared by every trainer's
     ``reshard_leaves`` so the transfer set cannot drift between trainer
@@ -84,7 +86,8 @@ def pack_state_leaves(w_own, opt_state) -> Dict[str, Any]:
     return d
 
 
-def split_state_leaves(leaves: Dict[str, Any]):
+def split_state_leaves(leaves: Dict[str, Any]
+                       ) -> Tuple[Any, Dict[str, Any]]:
     """Inverse of ``pack_state_leaves``: (w_own, opt_state)."""
     return leaves["w_own"], {k[len("opt."):]: v for k, v in leaves.items()
                              if k.startswith("opt.")}
@@ -309,8 +312,8 @@ def _move_residual(plan: ResidualPlan, ax: str, resid: jax.Array,
     return out
 
 
-def lower_apply(plan: ReshardPlan, union_mesh, ax: str, *,
-                donate: bool = True):
+def lower_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str, *,
+                donate: bool = True) -> Callable[..., Tuple[jax.Array, ...]]:
     """The plan as ONE jitted transfer program over the union mesh.
 
     Positional args: ``n_flat_leaves`` flat vectors in the union-source
@@ -323,7 +326,7 @@ def lower_apply(plan: ReshardPlan, union_mesh, ax: str, *,
     fp = plan.flat
     n_ops = plan.n_flat_leaves + (1 if plan.residual is not None else 0)
 
-    def body(*chunks):
+    def body(*chunks: jax.Array) -> Tuple[jax.Array, ...]:
         idx = lax.axis_index(ax)
         outs = [_move_chunk(fp, ax, c, idx)
                 for c in chunks[:plan.n_flat_leaves]]
@@ -338,7 +341,8 @@ def lower_apply(plan: ReshardPlan, union_mesh, ax: str, *,
 
 
 @functools.lru_cache(maxsize=32)
-def _cached_apply(plan: ReshardPlan, union_mesh, ax: str, donate: bool):
+def _cached_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str,
+                  donate: bool) -> Callable[..., Tuple[jax.Array, ...]]:
     """Memoized ``lower_apply``: a supervisor reshards against a handful
     of (plan, mesh) pairs at most, and reusing the jitted callable lets a
     prewarmed transfer hit the compile cache at fault time — the MTTR
@@ -348,7 +352,8 @@ def _cached_apply(plan: ReshardPlan, union_mesh, ax: str, donate: bool):
 
 
 def abstract_operands(plan: ReshardPlan,
-                      dtype=jnp.float32) -> Tuple[jax.ShapeDtypeStruct, ...]:
+                      dtype: Any = jnp.float32
+                      ) -> Tuple[jax.ShapeDtypeStruct, ...]:
     """ShapeDtypeStructs matching ``lower_apply``'s positional args — the
     zero-device-work handle the graftlint J8 sweep traces the program
     through."""
@@ -384,7 +389,7 @@ def golden_redistribute_residual(res: np.ndarray, live: int, n_tgt: int,
 # the one-stop API: reshard a live trainer state between mesh shapes
 # ---------------------------------------------------------------------------
 
-def _wire_format(trainer):
+def _wire_format(trainer: Any) -> Tuple[Any, ...]:
     """Everything that parameterizes the trainer's wire format — name
     AND options AND the legacy BFPConfig.  A name-only comparison would
     let e.g. an int8+error_feedback source reshard onto an int8 no-EF
@@ -395,7 +400,7 @@ def _wire_format(trainer):
             bool(getattr(trainer, "_ef", False)))
 
 
-def plan_for(src_trainer, tgt_trainer) -> ReshardPlan:
+def plan_for(src_trainer: Any, tgt_trainer: Any) -> ReshardPlan:
     """Build the ReshardPlan for a src->tgt trainer pair (both metas must
     be known — the source trained, the target gets its layout derived
     from the source's via ``fused_update.params_like_from_meta``)."""
@@ -432,7 +437,8 @@ def plan_for(src_trainer, tgt_trainer) -> ReshardPlan:
                      n_flat_leaves=n_flat, residual=ef)
 
 
-def _to_union(v: jax.Array, plan: FlatPlan, sharding) -> jax.Array:
+def _to_union(v: jax.Array, plan: FlatPlan,
+              sharding: NamedSharding) -> jax.Array:
     """Source-layout [padded_src] -> union-source layout [seed_len] on
     the union mesh.  Shrink: identity layout, free placement.  Grow: the
     seed device_put (plan.seed_bytes) — XLA's resharding, counted apart
@@ -444,8 +450,8 @@ def _to_union(v: jax.Array, plan: FlatPlan, sharding) -> jax.Array:
     return jax.device_put(v, sharding)
 
 
-def reshard_state(src_trainer, tgt_trainer, state, *, events=None,
-                  donate: bool = True):
+def reshard_state(src_trainer: Any, tgt_trainer: Any, state: Any, *,
+                  events: Any = None, donate: bool = True) -> Any:
     """Move a live TrainState/FSDPState from ``src_trainer``'s mesh to
     ``tgt_trainer``'s in one collective transfer program (see module
     docstring).  Returns the target trainer's state, step preserved,
@@ -491,7 +497,7 @@ def reshard_state(src_trainer, tgt_trainer, state, *, events=None,
     # union's scratch and are dropped)
     t_shard = NamedSharding(tgt_trainer.mesh, P(ax))
 
-    def land(v):
+    def land(v: jax.Array) -> jax.Array:
         if fp.n_union > fp.n_tgt:
             v = v[:fp.padded_tgt]
         return jax.device_put(v, t_shard)

@@ -120,7 +120,7 @@ class TunedPlan:
         }
 
 
-def needs_autotune(coll) -> bool:
+def needs_autotune(coll: Any) -> bool:
     """Does this CollectiveConfig defer choices to the tuner?"""
     return getattr(coll, "codec", None) == "auto"
 
@@ -130,7 +130,7 @@ def payload_class(payload_elems: int) -> str:
             else "streaming")
 
 
-def _codec_obj(name: Optional[str]):
+def _codec_obj(name: Optional[str]) -> Any:
     if name is None:
         return None
     from ..compress import get_codec          # lazy: needs jax
@@ -315,8 +315,9 @@ def rescore(plan: TunedPlan, payload_elems: int,
         payload_class=s["payload_class"])
 
 
-def resolve_collective(coll, n: int, payload_elems: int,
-                       calibration: Optional[Calibration] = None):
+def resolve_collective(coll: Any, n: int, payload_elems: int,
+                       calibration: Optional[Calibration] = None
+                       ) -> Tuple[Any, "TunedPlan"]:
     """Map a ``CollectiveConfig(codec="auto", ...)`` template to the
     concrete frozen config the trainer runs on, plus the TunedPlan
     record.  Called ONCE at trainer construction (parallel.train /
@@ -347,8 +348,10 @@ def resolve_collective(coll, n: int, payload_elems: int,
     return resolved, plan
 
 
-def resolve_train_config(cfg, n: int, params_like,
-                         calibration: Optional[Calibration] = None):
+def resolve_train_config(cfg: Any, n: int, params_like: Any,
+                         calibration: Optional[Calibration] = None
+                         ) -> Tuple[Any, Optional["TunedPlan"],
+                                    Optional[Calibration]]:
     """The shared trainer-side resolution step (DP / FSDP / DDP /
     QueuedDDP all call exactly this): payload size from the params tree
     (or ShapeDtypeStructs), one calibration load shared by resolution

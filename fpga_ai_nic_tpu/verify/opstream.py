@@ -1,0 +1,849 @@
+"""The shared protocol IR: one op-stream definition per collective route.
+
+An *op stream* is the per-node wait/signal/transfer order of a protocol,
+as plain data — the exact program the emitted kernel executes, factored
+out of the kernel so the checked model and the shipped schedule cannot
+drift (`ops.ring_pallas._rs_op_stream` and `._rs_plan` are now thin
+delegates to this module).  Four routes are extracted:
+
+  flat       the depth-D pipelined ring reduce-scatter
+             (`ops.ring_pallas._rs_kernel`): barrier, prologue sends,
+             per-step launch/consume with the (D+1)-slot credit window.
+  streaming  the HBM-streaming variant (`_rs_stream_kernel`): the same
+             wire protocol plus the slice-load prefetch window (ld),
+             the recv-side store-load/writeback pair (st/wb) with the
+             single-wait discipline, and — with a fused optimizer — the
+             w/m/v 2-deep state window (optld/optwb per tensor).
+  hier       `ops.ring_hier`'s two-hop schedule: the raw intra subring
+             hops, the program-order intra->inter handoff, then the
+             sliced double-buffered codec hops across groups
+             (`ops.ring._send`'s scan), RS then AG.
+  reshard    `parallel.reshard`'s transfer program: one exact-length
+             single-pair ppermute per owner-changing intersection
+             segment, in table order, plus the EF-residual ownership
+             moves.
+
+Two execution models give the streams small-step semantics shared by the
+exhaustive checker (`verify.mc.check`) and the randomized fuzz backend
+(`verify.mc.run_random`, which IS `simulate_rs_protocol` now):
+
+  RingModel  neighbor wire slots cycling mod n_slots with blocking
+             semaphores and asynchronous landings — a started RDMA
+             lands at an arbitrary later scheduler event, exactly the
+             freedom real hardware has.
+  PairModel  tag-matched directed sends (the XLA ppermute hop): a send
+             never blocks, a recv blocks until its (src, tag) payload
+             landed.
+
+Local DMA discipline (the ld/st/wb/opt windows) is *deterministic per
+node* — no cross-node event can reorder it — so it is checked statically
+by `check_dma_discipline` (single-wait per DMA, wait-after-start,
+window/RAW predecessors waited, full drain at exit: the two
+hardware-only semaphore deadlock classes round 3 caught by review are
+mechanical checks here), keeping the interleaving state space to the
+events that are actually concurrent.
+
+No jax import or jax API anywhere in this module (the parent package's
+``__init__`` does pull jax — the graftlint CLI pins the CPU platform
+env before importing, so the checker never waits on a TPU tunnel).
+"""
+
+from __future__ import annotations
+
+from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
+                    Set, Tuple)
+
+Op = Tuple[Any, ...]
+Action = Tuple[Any, ...]
+
+# fused-optimizer state-tensor counts (w rides as tensor 0 on top):
+# mirrors optim.OptimizerSpec.n_state without importing jax —
+# tests/test_verify.py pins the equivalence.
+OPT_N_STATE: Dict[str, int] = {"sgd": 0, "momentum": 1, "adamw": 2}
+
+# default launch-ahead depth — mirrors ops.ring_pallas._PIPE_DEPTH
+# (the delegate passes its own constant explicitly; the equivalence is
+# pinned by tests/test_verify.py).
+DEFAULT_PIPE_DEPTH = 2
+
+
+class ProtocolError(Exception):
+    """A protocol violation raised by a model's apply/terminal check.
+    ``kind`` is one of: deadlock, recv_overwrite, send_overwrite,
+    ordering, credit, dma, termination — or ``budget``, which is NOT a
+    protocol verdict: the exploration hit its state cap and is
+    inconclusive (CheckResult.inconclusive)."""
+
+    def __init__(self, kind: str, message: str) -> None:
+        super().__init__(message)
+        self.kind = kind
+        self.message = message
+
+
+# ---------------------------------------------------------------------------
+# plan + op-stream extraction: flat ring RS
+# ---------------------------------------------------------------------------
+
+def rs_plan(n: int, S: int, depth: Optional[int],
+            default_depth: int = DEFAULT_PIPE_DEPTH
+            ) -> Tuple[int, int, bool]:
+    """(D, n_slots, launch_first) for the deep-pipelined RS schedule —
+    THE plan definition (`ops.ring_pallas._rs_plan` delegates here).
+
+    D (launch-ahead / pipeline depth) and the comm-slot window n_slots
+    are bound by three schedule invariants (checked for every plan by
+    the model checker and stated in ops.ring_pallas):
+
+      RAW   send q's source rows are finalized by consume q-S.
+            Launching q BEFORE consume(g) at step g needs q-S <= g-1,
+            i.e. D <= S-1; launching AFTER consume(g) relaxes to D <= S.
+      SLOT  emission q overwrites wire slot q % n_slots; its downstream
+            decode of arrival q - n_slots must come first: n_slots >=
+            D+1 makes every credit edge point to a strictly earlier
+            downstream step (acyclic wait-for graph).
+      CAP   no more emissions than total = (n-1)*S.
+    """
+    total = (n - 1) * S
+    D = max(1, min(default_depth if depth is None else depth, S, total))
+    launch_first = D < S              # RAW: ahead-of-consume needs D<=S-1
+    n_slots = min(total, D + 1)
+    return D, n_slots, launch_first
+
+
+def rs_op_stream(n: int, S: int, depth: Optional[int],
+                 default_depth: int = DEFAULT_PIPE_DEPTH
+                 ) -> Tuple[List[Op], int]:
+    """The per-node op stream of the deep-pipelined (VMEM-resident) RS
+    schedule — the exact wait/signal/transfer order `_rs_kernel`
+    executes (every node runs the identical program)."""
+    total = (n - 1) * S
+    D, n_slots, launch_first = rs_plan(n, S, depth, default_depth)
+    ops: List[Op] = [("barrier",)]
+    for q in range(D):                    # prologue: fill the pipe
+        ops.append(("send", q))
+
+    def launch(q: int) -> None:
+        if q >= total:
+            return
+        if q >= n_slots:
+            ops.append(("wait_send", q - n_slots))
+        if q >= n_slots:
+            ops.append(("credit_wait",))
+        ops.append(("send", q))
+
+    def consume(g: int) -> None:
+        ops.append(("wait_recv", g))
+        ops.append(("decode", g))
+        ops.append(("credit_signal",))
+
+    for g in range(total):
+        if launch_first:
+            launch(g + D)
+            consume(g)
+        else:
+            consume(g)
+            launch(g + D)
+    for j in range(max(0, total - n_slots), total):
+        ops.append(("wait_send", j))
+    ops.append(("credit_drain", min(total, n_slots)))
+    return ops, n_slots
+
+
+# ---------------------------------------------------------------------------
+# op-stream extraction: HBM-streaming RS (+ fused-optimizer state window)
+# ---------------------------------------------------------------------------
+
+def rs_stream_op_stream(n: int, S: int, depth: Optional[int],
+                        opt_kind: Optional[str] = None,
+                        default_depth: int = DEFAULT_PIPE_DEPTH
+                        ) -> Tuple[List[Op], int]:
+    """The per-node op stream of `_rs_stream_kernel`: the flat-ring wire
+    protocol plus the streaming-only DMA windows —
+
+      ld      send-side slice load, 2-deep, prefetched ONE emission
+              ahead when ``launch_first and D + 2 <= S`` (the prefetch
+              RAW gate stated in the kernel);
+      st/wb   recv-side store-load + writeback pair, 2-deep, single-wait
+              discipline (1-lag head wait when launch_first, in-loop
+              wait at D == S);
+      optld/optwb<t>  with ``opt_kind``: the w/m/v state window — each
+              final-hop consume streams 1 + n_state tensor slices
+              through a 2-deep VMEM window with its own DMA pairs.
+
+    DMA ops carry their static hazard predecessors:
+    ``("dma_start", chan, i, ((chan', j), ...))`` asserts each (chan',
+    j) was *waited* before this start (VMEM slot reuse + the wb->ld RAW)
+    — `check_dma_discipline` verifies the discipline per node.
+    """
+    total = (n - 1) * S
+    D, n_slots, launch_first = rs_plan(n, S, depth, default_depth)
+    final_g0 = (n - 2) * S
+    prefetch = launch_first and D + 2 <= S
+    n_t = 0 if opt_kind is None else 1 + OPT_N_STATE[opt_kind]
+    ops: List[Op] = [("barrier",)]
+
+    def dma_start(chan: str, i: int, *conf: Tuple[str, int]) -> None:
+        ops.append(("dma_start", chan, i,
+                    tuple((c, j) for c, j in conf if j >= 0)))
+
+    def dma_wait(chan: str, i: int) -> None:
+        ops.append(("dma_wait", chan, i))
+
+    def ld_start(i: int) -> None:
+        # window: ld(i-2) drained; RAW: ld reads what wb(i-S) wrote
+        dma_start("ld", i, ("ld", i - 2), ("wb", i - S))
+
+    # prologue: fill the pipeline with emissions 0..D-1
+    if prefetch:
+        ld_start(0)
+    for q in range(D):
+        if prefetch:
+            if q + 1 < total:
+                ld_start(q + 1)
+        else:
+            ld_start(q)
+        dma_wait("ld", q)
+        ops.append(("encode", q))
+        ops.append(("send", q))
+
+    def launch(q: int) -> None:
+        if q >= total:
+            return
+        if prefetch:
+            if q + 1 < total:
+                ld_start(q + 1)       # hide the next HBM read
+        else:
+            ld_start(q)
+        if q >= n_slots:
+            ops.append(("wait_send", q - n_slots))
+        dma_wait("ld", q)
+        ops.append(("encode", q))
+        if q >= n_slots:
+            ops.append(("credit_wait",))
+        ops.append(("send", q))
+
+    def consume(g: int) -> None:
+        if opt_kind is not None and g >= final_g0 + 2:
+            for t in range(n_t):      # VMEM window slot reuse guard
+                dma_wait(f"optwb{t}", g - 2)
+        if opt_kind is not None and g >= final_g0:
+            for t in range(n_t):      # hide the state read under the
+                dma_start(f"optld{t}", g,     # wire wait + decode
+                          (f"optld{t}", g - 2), (f"optwb{t}", g - 2))
+        dma_start("st", g, ("st", g - 2), ("wb", g - 2))
+        ops.append(("wait_recv", g))
+        dma_wait("st", g)
+        ops.append(("decode", g))
+        ops.append(("credit_signal",))
+        dma_start("wb", g, ("wb", g - 2))
+        if opt_kind is not None and g >= final_g0:
+            for t in range(n_t):
+                dma_wait(f"optld{t}", g)
+            ops.append(("update", g))
+            for t in range(n_t):
+                dma_start(f"optwb{t}", g, (f"optwb{t}", g - 2))
+
+    if launch_first:
+        for g in range(total):
+            if g >= 1:                # single wait, 1-iteration lag
+                dma_wait("wb", g - 1)
+            launch(g + D)
+            consume(g)
+    else:
+        for g in range(total):        # RAW is immediate at D == S
+            consume(g)
+            dma_wait("wb", g)
+            launch(g + D)
+
+    if launch_first:
+        dma_wait("wb", total - 1)
+    if opt_kind is not None:
+        for gg in range(max(final_g0, total - 2), total):
+            for t in range(n_t):
+                dma_wait(f"optwb{t}", gg)
+    for j in range(max(0, total - n_slots), total):
+        ops.append(("wait_send", j))
+    ops.append(("credit_drain", min(total, n_slots)))
+    return ops, n_slots
+
+
+# ---------------------------------------------------------------------------
+# static DMA discipline (deterministic per node — no interleaving needed)
+# ---------------------------------------------------------------------------
+
+def check_dma_discipline(ops: Sequence[Op]) -> List[str]:
+    """Verify the per-node DMA discipline of an op stream: every wait
+    follows its start, every DMA is waited exactly ONCE (two waits on
+    one signal deadlock real hardware — invisibly to the lockstep
+    interpreter), every start's declared hazard predecessors (VMEM slot
+    reuse, wb->ld RAW) were waited first, and nothing is left in flight
+    at exit.  Returns violation messages (empty = clean)."""
+    started: Set[Tuple[str, int]] = set()
+    waited: Set[Tuple[str, int]] = set()
+    out: List[str] = []
+    for pos, op in enumerate(ops):
+        if op[0] == "dma_start":
+            _, chan, i, conf = op
+            key = (chan, i)
+            if key in started and key not in waited:
+                out.append(f"op {pos}: DMA {chan}[{i}] restarted while "
+                           "still in flight")
+            for c in conf:
+                if c in started and c not in waited:
+                    out.append(
+                        f"op {pos}: DMA slot/RAW hazard — {chan}[{i}] "
+                        f"starts before required wait of {c[0]}[{c[1]}]")
+            started.add(key)
+        elif op[0] == "dma_wait":
+            _, chan, i = op
+            key = (chan, i)
+            if key not in started:
+                out.append(f"op {pos}: wait on never-started DMA "
+                           f"{chan}[{i}] (hardware deadlock)")
+            elif key in waited:
+                out.append(f"op {pos}: second wait on DMA {chan}[{i}] — "
+                           "one signal per DMA (hardware deadlock)")
+            waited.add(key)
+    for key in sorted(started - waited):
+        out.append(f"exit: DMA {key[0]}[{key[1]}] started but never "
+                   "waited (unsynchronized buffer at kernel exit)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# op-stream extraction: hierarchical two-hop schedule
+# ---------------------------------------------------------------------------
+
+def hier_op_stream(n: int, ni: int, s_inter: int = 1,
+                   include_ag: bool = True) -> List[List[Op]]:
+    """Per-node op streams of `ops.ring_hier`'s two-hop schedule over a
+    flat axis of n = ni * ng devices (device d: group d // ni, intra
+    position d % ni).
+
+    RS: (ni-1) raw intra subring hops -> program-order handoff -> (ng-1)
+    inter codec hops, each sliced into ``s_inter`` double-buffered
+    payloads (`ops.ring._send`'s scan: send slice k, encode k+1, recv
+    k).  AG (``include_ag``): the phases in reverse — (ng-1) inter
+    gather hops (encode once, forward verbatim: one payload per hop)
+    then (ni-1) raw intra gather hops."""
+    if ni < 1 or n % ni:
+        raise ValueError(f"intra size {ni} does not factor n={n}")
+    ng = n // ni
+    streams: List[List[Op]] = []
+    for d in range(n):
+        g, j = d // ni, d % ni
+        ops: List[Op] = []
+        # phase A — raw intra reduce-scatter hops
+        for s in range(ni - 1):
+            dst = g * ni + (j + 1) % ni
+            src = g * ni + (j - 1) % ni
+            ops.append(("send_to", dst, ("rs_intra", s)))
+            ops.append(("recv_from", src, ("rs_intra", s)))
+            ops.append(("local", "accumulate", ("rs_intra", s)))
+        ops.append(("local", "handoff", ("intra->inter",)))
+        # phase B — sliced double-buffered codec hops across groups
+        for s in range(ng - 1):
+            dst = ((g + 1) % ng) * ni + j
+            src = ((g - 1) % ng) * ni + j
+            ops.append(("local", "encode", ("rs_inter", s, 0)))
+            for k in range(s_inter):
+                ops.append(("send_to", dst, ("rs_inter", s, k)))
+                if k + 1 < s_inter:   # encode k+1 while k is on the wire
+                    ops.append(("local", "encode", ("rs_inter", s, k + 1)))
+                ops.append(("recv_from", src, ("rs_inter", s, k)))
+                ops.append(("local", "decode", ("rs_inter", s, k)))
+        if include_ag:
+            # phase B' — inter all-gather (encode once, forward verbatim)
+            for s in range(ng - 1):
+                dst = ((g + 1) % ng) * ni + j
+                src = ((g - 1) % ng) * ni + j
+                ops.append(("send_to", dst, ("ag_inter", s)))
+                ops.append(("recv_from", src, ("ag_inter", s)))
+            # phase A' — raw intra all-gather
+            for s in range(ni - 1):
+                dst = g * ni + (j + 1) % ni
+                src = g * ni + (j - 1) % ni
+                ops.append(("send_to", dst, ("ag_intra", s)))
+                ops.append(("recv_from", src, ("ag_intra", s)))
+        streams.append(ops)
+    return streams
+
+
+# ---------------------------------------------------------------------------
+# op-stream extraction: reshard transfer program
+# ---------------------------------------------------------------------------
+
+class Seg(NamedTuple):
+    """One intersection-table segment (mirrors parallel.reshard.Transfer
+    without importing jax; tests pin the equivalence)."""
+
+    src: int
+    dst: int
+    src_off: int
+    dst_off: int
+    length: int
+
+
+def reshard_segments(live: int, chunk_src: int,
+                     chunk_tgt: int) -> Tuple[Seg, ...]:
+    """Source->target shard intersections of a [live] flat vector: cut
+    [0, live) at every chunk boundary of either layout.  The jax-free
+    twin of `parallel.reshard.intersection_table` — the segments
+    PARTITION the live range (asserted)."""
+    assert live > 0 and chunk_src > 0 and chunk_tgt > 0
+    cuts = {0, live}
+    cuts.update(range(chunk_src, live, chunk_src))
+    cuts.update(range(chunk_tgt, live, chunk_tgt))
+    edges = sorted(cuts)
+    table = []
+    for a, b in zip(edges, edges[1:]):
+        src, dst = a // chunk_src, a // chunk_tgt
+        table.append(Seg(src=src, dst=dst, src_off=a - src * chunk_src,
+                         dst_off=a - dst * chunk_tgt, length=b - a))
+    assert sum(t.length for t in table) == live
+    return tuple(table)
+
+
+def reshard_owners(n_src: int, n_tgt: int) -> Tuple[int, ...]:
+    """EF-residual old-device -> new-owner map (jax-free twin of
+    `parallel.reshard.residual_owners`)."""
+    assert n_src > 0 and n_tgt > 0
+    return tuple(i * n_tgt // n_src for i in range(n_src))
+
+
+def reshard_op_stream(live: int, chunk_src: int, chunk_tgt: int,
+                      n_union: int,
+                      residual_owners_map: Optional[Sequence[int]] = None
+                      ) -> List[List[Op]]:
+    """Per-node op streams of the lowered reshard program
+    (`parallel.reshard.lower_apply`): the intersection segments in table
+    order — an exact-length single-pair send/recv when the owner
+    changes, a resident copy when it does not — then the EF-residual
+    ownership moves in ascending-source order (the golden twin's sum
+    order)."""
+    segs = reshard_segments(live, chunk_src, chunk_tgt)
+    streams: List[List[Op]] = [[] for _ in range(n_union)]
+    for si, t in enumerate(segs):
+        if t.src == t.dst:
+            if t.src < n_union:
+                streams[t.src].append(("local", "copy", ("seg", si)))
+            continue
+        assert t.src < n_union and t.dst < n_union, (t, n_union)
+        streams[t.src].append(("send_to", t.dst, ("seg", si)))
+        streams[t.dst].append(("recv_from", t.src, ("seg", si)))
+    if residual_owners_map is not None:
+        for i, owner in enumerate(residual_owners_map):
+            if i == owner:
+                streams[i].append(("local", "resid_keep", ("resid", i)))
+                continue
+            streams[i].append(("send_to", owner, ("resid", i)))
+            streams[owner].append(("recv_from", i, ("resid", i)))
+    return streams
+
+
+# ---------------------------------------------------------------------------
+# execution model 1: the ring credit-window protocol
+# ---------------------------------------------------------------------------
+
+class RingState:
+    """Mutable interleaving state of a RingModel run.  Cloned only at
+    branch points; the counterexample trace is a shared linked list so
+    clones are O(state), not O(history)."""
+
+    __slots__ = ("pc", "arrived", "slots", "credits", "flight",
+                 "inflight_slots", "trace")
+
+    def __init__(self, n: int, n_slots: int) -> None:
+        self.pc = [0] * n
+        self.arrived = [False] * n
+        self.slots = [[-1] * n_slots for _ in range(n)]
+        self.credits = [0] * n
+        self.flight: Set[Tuple[int, int]] = set()
+        # (dst, wire slot) -> number of in-flight transfers targeting it
+        self.inflight_slots: Dict[Tuple[int, int], int] = {}
+        self.trace: Optional[Tuple[Any, Any]] = None
+
+    def clone(self) -> "RingState":
+        st = RingState.__new__(RingState)
+        st.pc = list(self.pc)
+        st.arrived = list(self.arrived)
+        st.slots = [list(s) for s in self.slots]
+        st.credits = list(self.credits)
+        st.flight = set(self.flight)
+        st.inflight_slots = dict(self.inflight_slots)
+        st.trace = self.trace
+        return st
+
+    def key(self) -> Tuple[Any, ...]:
+        return (tuple(self.pc), tuple(self.arrived),
+                tuple(map(tuple, self.slots)), tuple(self.credits),
+                frozenset(self.flight))
+
+
+class RingModel:
+    """Small-step semantics of the ring credit-window protocol: n nodes
+    running the IDENTICAL op stream, wire slots cycling mod n_slots,
+    blocking semaphores, asynchronous landings.  Violations raised as
+    ProtocolError; message wording is stable API (the fuzz backend's
+    callers match on it)."""
+
+    route = "ring"
+
+    def __init__(self, n: int, ops: Sequence[Op], n_slots: int,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        self.n = n
+        self.ops = list(ops)
+        self.n_slots = n_slots
+        self.meta = dict(meta or {})
+        self.total_sends = sum(1 for op in self.ops if op[0] == "send")
+        self.credit_bound = min(self.total_sends, n_slots) \
+            if self.total_sends else n_slots
+        # strict_terminal adds the at-exit checks (no undecoded frame
+        # left in a window, no leaked credits) on top of the legacy
+        # simulator semantics; simulate_rs_protocol turns it off to
+        # keep its published failure wording exact
+        self.strict_terminal = True
+        self.send_pos: Dict[int, int] = {
+            op[1]: i for i, op in enumerate(self.ops) if op[0] == "send"}
+        # emissions whose decode is NOT preceded by its wait_recv in
+        # program order: landing q then commutes with NOTHING — the
+        # decode-before-landing interleaving is realizable and must be
+        # branched on, never resolved by an eager landing (in a correct
+        # stream every decode is guarded and this set is empty; a
+        # mutated stream that drops a wait_recv lands here — the POR
+        # soundness hole the review's mutation sweep caught)
+        first_wait: Dict[int, int] = {}
+        self.unguarded_decodes: Set[int] = set()
+        for i, op in enumerate(self.ops):
+            if op[0] == "wait_recv" and op[1] not in first_wait:
+                first_wait[op[1]] = i
+            elif op[0] == "decode" and op[1] not in self.unguarded_decodes:
+                if first_wait.get(op[1]) is None:
+                    self.unguarded_decodes.add(op[1])
+
+    # -- helpers -----------------------------------------------------------
+
+    def _ctx(self) -> str:
+        return " ".join(f"{k}={v}" for k, v in self.meta.items())
+
+    def init_state(self) -> RingState:
+        return RingState(self.n, self.n_slots)
+
+    def node_count(self) -> int:
+        return self.n
+
+    def _landed(self, st: RingState, i: int, q: int) -> bool:
+        pos = self.send_pos.get(q)
+        return pos is not None and st.pc[i] > pos and (i, q) not in st.flight
+
+    def _runnable(self, st: RingState, i: int) -> bool:
+        if st.pc[i] >= len(self.ops):
+            return False
+        op = self.ops[st.pc[i]]
+        kind = op[0]
+        if kind == "barrier":
+            return (not st.arrived[i]) or (st.arrived[(i - 1) % self.n]
+                                           and st.arrived[(i + 1) % self.n])
+        if kind == "wait_send":
+            return self._landed(st, i, op[1])
+        if kind == "credit_wait":
+            return st.credits[i] >= 1
+        if kind == "wait_recv":
+            return st.slots[i][op[1] % self.n_slots] == op[1]
+        if kind == "credit_drain":
+            return st.credits[i] >= op[1]
+        return True       # send / decode / credit_signal / dma / local
+
+    def enabled(self, st: RingState) -> List[Action]:
+        acts: List[Action] = [("node", i) for i in range(self.n)
+                              if self._runnable(st, i)]
+        acts.extend(("wire", s, q) for (s, q) in st.flight)
+        return acts
+
+    # -- transition --------------------------------------------------------
+
+    def apply(self, st: RingState, act: Action) -> None:
+        if act[0] == "wire":
+            _, src, q = act
+            dst = (src + 1) % self.n
+            slot = q % self.n_slots
+            st.trace = (("wire", src, q, dst, slot), st.trace)
+            if st.slots[dst][slot] != -1:
+                raise ProtocolError(
+                    "recv_overwrite",
+                    f"recv-slot overwrite: emission {q} landed on "
+                    f"undecoded frame {st.slots[dst][slot]} in node "
+                    f"{dst}'s slot {slot} ({self._ctx()})")
+            st.slots[dst][slot] = q
+            st.flight.discard((src, q))
+            k = (dst, slot)
+            c = st.inflight_slots.get(k, 0) - 1
+            if c:
+                st.inflight_slots[k] = c
+            else:
+                st.inflight_slots.pop(k, None)
+            return
+        i = act[1]
+        op = self.ops[st.pc[i]]
+        kind = op[0]
+        st.trace = (("node", i, op), st.trace)
+        if kind == "barrier":
+            st.arrived[i] = True          # signal phase
+            if not (st.arrived[(i - 1) % self.n]
+                    and st.arrived[(i + 1) % self.n]):
+                return                    # signaled; wait phase blocks
+        elif kind == "send":
+            q = op[1]
+            slot = q % self.n_slots
+            if any(s == i and t % self.n_slots == slot
+                   for (s, t) in st.flight):
+                raise ProtocolError(
+                    "send_overwrite",
+                    f"send-slot overwrite: emission {q} encoded over an "
+                    f"in-flight frame in slot {slot} ({self._ctx()})")
+            st.flight.add((i, q))
+            k = ((i + 1) % self.n, slot)
+            st.inflight_slots[k] = st.inflight_slots.get(k, 0) + 1
+        elif kind == "decode":
+            g = op[1]
+            slot = g % self.n_slots
+            got = st.slots[i][slot]
+            if got != g:
+                raise ProtocolError(
+                    "ordering",
+                    f"ordering corruption: decode of emission {g} found "
+                    f"{'empty slot' if got == -1 else got} "
+                    f"({self._ctx()})")
+            st.slots[i][slot] = -1
+        elif kind == "credit_signal":
+            left = (i - 1) % self.n
+            st.credits[left] += 1
+            if st.credits[left] > self.credit_bound:
+                raise ProtocolError(
+                    "credit",
+                    f"credit overflow: node {left} holds "
+                    f"{st.credits[left]} credits for a {self.credit_bound}"
+                    f"-slot window ({self._ctx()})")
+        elif kind == "credit_wait":
+            st.credits[i] -= 1
+        elif kind == "credit_drain":
+            st.credits[i] -= op[1]
+        # wait_send / wait_recv / dma_* / encode / update / local:
+        # guard already checked in _runnable; pc advance only
+        st.pc[i] += 1
+
+    # -- termination -------------------------------------------------------
+
+    def finished(self, st: RingState) -> bool:
+        return (not st.flight
+                and all(p >= len(self.ops) for p in st.pc))
+
+    def check_terminal(self, st: RingState) -> None:
+        if not self.strict_terminal:
+            return
+        for i in range(self.n):
+            for slot, got in enumerate(st.slots[i]):
+                if got != -1:
+                    raise ProtocolError(
+                        "termination",
+                        f"undecoded frame {got} left in node {i}'s slot "
+                        f"{slot} at termination ({self._ctx()})")
+        for i, c in enumerate(st.credits):
+            if c != 0:
+                raise ProtocolError(
+                    "credit",
+                    f"credit leak: node {i} terminates holding {c} "
+                    f"credits ({self._ctx()})")
+
+    def deadlock_message(self, st: RingState) -> str:
+        nxt = [self.ops[p] if p < len(self.ops) else None for p in st.pc]
+        return (f"protocol deadlock: {self._ctx()} pc={st.pc} next={nxt} "
+                f"credits={st.credits} in_flight={sorted(st.flight)}")
+
+    # -- partial-order reduction -------------------------------------------
+
+    def pick_action(self, st: RingState,
+                    acts: Sequence[Action]) -> Optional[Action]:
+        """Singleton persistent set: an action that commutes with every
+        other enabled action (and cannot race a future one — in-flight
+        landings stay enabled until executed, so every latent conflict
+        has an enabled witness).  An action whose violation condition is
+        already live is returned too: the schedule freedom that makes it
+        fire exists, so exploring it first IS the counterexample.
+        Returns None when only mutually-dependent actions remain (full
+        branch)."""
+        for act in acts:
+            if act[0] == "wire":
+                _, src, q = act
+                dst = (src + 1) % self.n
+                slot = q % self.n_slots
+                if st.slots[dst][slot] != -1:
+                    return act            # violation live: explore it
+                if q in self.unguarded_decodes:
+                    continue              # decode(q) may run BEFORE this
+                                          # landing (no wait_recv guard):
+                                          # both orders must be explored
+                if st.inflight_slots.get((dst, slot), 0) > 1:
+                    continue              # racing same-slot landing
+                if self._slot_sensitive(st, dst, slot):
+                    continue              # dst decode of this slot pending
+                if self._send_pending(st, src, slot):
+                    continue              # src send-overwrite race
+                return act
+            i = act[1]
+            op = self.ops[st.pc[i]]
+            kind = op[0]
+            if kind == "send":
+                slot = op[1] % self.n_slots
+                if any(s == i and t % self.n_slots == slot
+                       for (s, t) in st.flight):
+                    return act            # violation live: explore it
+                return act
+            if kind in ("decode", "wait_recv"):
+                slot = op[1] % self.n_slots
+                if st.inflight_slots.get((i, slot), 0) > 0:
+                    continue              # landing may race this slot
+                return act
+            if kind == "credit_signal":
+                left = (i - 1) % self.n
+                if st.credits[left] >= self.credit_bound:
+                    return act            # overflow live: explore it
+                return act
+            # barrier / credit_wait / credit_drain / wait_send / dma /
+            # encode / update / local: commute with everything enabled
+            return act
+        return None
+
+    def _slot_sensitive(self, st: RingState, dst: int, slot: int) -> bool:
+        # only an ENABLED partner can conflict: decode is always
+        # enabled, but a wait_recv blocked on this slot is not a
+        # partner — the landing merely enables it (they commute)
+        if st.pc[dst] >= len(self.ops):
+            return False
+        op = self.ops[st.pc[dst]]
+        return op[0] == "decode" and op[1] % self.n_slots == slot
+
+    def _send_pending(self, st: RingState, src: int, slot: int) -> bool:
+        if st.pc[src] >= len(self.ops):
+            return False
+        op = self.ops[st.pc[src]]
+        return op[0] == "send" and op[1] % self.n_slots == slot
+
+
+# ---------------------------------------------------------------------------
+# execution model 2: tag-matched pair transfers (the XLA ppermute hop)
+# ---------------------------------------------------------------------------
+
+class PairState:
+    """Mutable interleaving state of a PairModel run."""
+
+    __slots__ = ("pc", "flight", "landed", "trace")
+
+    def __init__(self, n: int) -> None:
+        self.pc = [0] * n
+        self.flight: Set[Tuple[int, int, Any]] = set()
+        self.landed: Set[Tuple[int, int, Any]] = set()
+        self.trace: Optional[Tuple[Any, Any]] = None
+
+    def clone(self) -> "PairState":
+        st = PairState.__new__(PairState)
+        st.pc = list(self.pc)
+        st.flight = set(self.flight)
+        st.landed = set(self.landed)
+        st.trace = self.trace
+        return st
+
+    def key(self) -> Tuple[Any, ...]:
+        return (tuple(self.pc), frozenset(self.flight),
+                frozenset(self.landed))
+
+
+class PairModel:
+    """Small-step semantics of directed tag-matched transfers: a send
+    never blocks (the payload is in flight until its landing event), a
+    recv blocks until its exact (src, tag) payload has landed and then
+    consumes it.  Models the lowered single-pair ppermute programs
+    (reshard) and the subring hop chains (hier), where the failure modes
+    are mismatched program orders (deadlock) and orphaned payloads
+    (ordering)."""
+
+    route = "pair"
+
+    def __init__(self, streams: Sequence[Sequence[Op]],
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        self.streams = [list(s) for s in streams]
+        self.n = len(self.streams)
+        self.meta = dict(meta or {})
+
+    def _ctx(self) -> str:
+        return " ".join(f"{k}={v}" for k, v in self.meta.items())
+
+    def init_state(self) -> PairState:
+        return PairState(self.n)
+
+    def node_count(self) -> int:
+        return self.n
+
+    def _runnable(self, st: PairState, i: int) -> bool:
+        if st.pc[i] >= len(self.streams[i]):
+            return False
+        op = self.streams[i][st.pc[i]]
+        if op[0] == "recv_from":
+            return (op[1], i, op[2]) in st.landed
+        return True
+
+    def enabled(self, st: PairState) -> List[Action]:
+        acts: List[Action] = [("node", i) for i in range(self.n)
+                              if self._runnable(st, i)]
+        acts.extend(("wire",) + t for t in st.flight)
+        return acts
+
+    def apply(self, st: PairState, act: Action) -> None:
+        if act[0] == "wire":
+            t = (act[1], act[2], act[3])
+            st.trace = (("wire",) + t, st.trace)
+            st.flight.discard(t)
+            st.landed.add(t)
+            return
+        i = act[1]
+        op = self.streams[i][st.pc[i]]
+        st.trace = (("node", i, op), st.trace)
+        if op[0] == "send_to":
+            t = (i, op[1], op[2])
+            if t in st.flight or t in st.landed:
+                raise ProtocolError(
+                    "send_overwrite",
+                    f"duplicate emission: payload {op[2]!r} {i}->{op[1]} "
+                    f"sent while a previous copy is outstanding "
+                    f"({self._ctx()})")
+            st.flight.add(t)
+        elif op[0] == "recv_from":
+            st.landed.discard((op[1], i, op[2]))
+        st.pc[i] += 1
+
+    def finished(self, st: PairState) -> bool:
+        return (not st.flight
+                and all(st.pc[i] >= len(self.streams[i])
+                        for i in range(self.n)))
+
+    def check_terminal(self, st: PairState) -> None:
+        if st.landed:
+            orphan = sorted(st.landed)[0]
+            raise ProtocolError(
+                "termination",
+                f"orphan payload (ordering corruption): {orphan[2]!r} "
+                f"{orphan[0]}->{orphan[1]} landed but never consumed "
+                f"({self._ctx()}; {len(st.landed)} total)")
+
+    def deadlock_message(self, st: PairState) -> str:
+        nxt = [self.streams[i][p] if p < len(self.streams[i]) else None
+               for i, p in enumerate(st.pc)]
+        return (f"protocol deadlock: {self._ctx()} pc={st.pc} next={nxt} "
+                f"in_flight={sorted(st.flight)}")
+
+    def pick_action(self, st: PairState,
+                    acts: Sequence[Action]) -> Optional[Action]:
+        # every action commutes with every other: tags are unique per
+        # payload, sends never block, landings only enable — so the
+        # first enabled action is always a singleton persistent set
+        return acts[0] if acts else None

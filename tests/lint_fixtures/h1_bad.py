@@ -1,0 +1,26 @@
+"""H1 bad fixture: an instance counter written from a Thread target AND
+from a public main-thread method with no common lock — the unordered
+cross-thread write the happens-before/lockset pass must flag."""
+
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.processed = 0
+        self.last_note = ""
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self.processed += 1           # worker write, no lock
+            time.sleep(0.01)
+
+    def note(self, msg):
+        self.processed += 1               # main write, no lock -> H1
+        with self._lock:
+            self.last_note = msg          # main-only: not shared, silent

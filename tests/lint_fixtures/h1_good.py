@@ -1,0 +1,28 @@
+"""H1 good fixture: the same cross-thread counter as h1_bad.py, but
+both writes routed through the SAME lock — the lockset pass must stay
+silent (the R1 record_* pattern generalized)."""
+
+import threading
+import time
+
+
+class Worker:
+    def __init__(self):
+        self.processed = 0
+        self._lock = threading.Lock()
+
+    def start(self):
+        threading.Thread(target=self._loop, daemon=True).start()
+
+    def _loop(self):
+        while True:
+            self.record_done()
+            time.sleep(0.01)
+
+    def record_done(self):
+        with self._lock:
+            self.processed += 1
+
+    def note(self):
+        with self._lock:
+            self.processed += 1

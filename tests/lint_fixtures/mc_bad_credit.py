@@ -1,0 +1,17 @@
+"""graftmc bad fixture: the flat-ring op stream with every
+``credit_signal`` dropped — downstream consumes never release their
+slots, so the first launch past the window blocks at ``credit_wait``
+forever and the ring deadlocks.  `make modelcheck` with
+GRAFTMC_FIXTURE pointing here MUST fail with a deadlock counterexample
+(tests/test_verify.py rides the subprocess exit-code pattern)."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+
+def build():
+    ops, n_slots = opstream.rs_op_stream(4, 2, 2)
+    mutated = [op for op in ops if op[0] != "credit_signal"]
+    return opstream.RingModel(
+        4, mutated, n_slots,
+        meta={"route": "fixture", "n": 4, "S": 2, "depth": 2,
+              "mutation": "dropped-credit-signal"})

@@ -1,0 +1,22 @@
+"""graftmc bad fixture: the flat-ring op stream with the credit
+handshake deleted (``credit_wait`` / ``credit_signal`` /
+``credit_drain``) while ``wait_send`` stays — the send side is still
+ordered by its own drain, so the sender's emission horizon is bounded
+only by LANDING, not by decode: the receiver's slot window is overrun
+and a frame lands on an undecoded predecessor.  `make modelcheck` with
+GRAFTMC_FIXTURE pointing here MUST fail with a recv-slot-overwrite
+counterexample — specifically the RECV side, which is exactly the
+failure the credit window exists to exclude."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+_CREDIT_OPS = ("credit_wait", "credit_signal", "credit_drain")
+
+
+def build():
+    ops, n_slots = opstream.rs_op_stream(4, 2, 2)
+    mutated = [op for op in ops if op[0] not in _CREDIT_OPS]
+    return opstream.RingModel(
+        4, mutated, n_slots,
+        meta={"route": "fixture", "n": 4, "S": 2, "depth": 2,
+              "mutation": "credit-window-removed"})

@@ -8,9 +8,16 @@ Planes (docs/LINT.md):
             abstractly on the 8-device virtual CPU mesh (no TPU)
   --ext     ruff + mypy on the strict core, when installed (skipped with a
             notice otherwise — the container may not carry them)
+  --mc      graftmc (docs/MODELCHECK.md): the exhaustive protocol model
+            checker over the flat/streaming/hier/reshard op streams
+            (n<=6, S<=6, D<=4 per route + n=8 fuzz; violations export
+            Perfetto counterexamples to artifacts/) plus the H1
+            happens-before/lockset pass.  Pure Python — no jax.  This is
+            `make modelcheck`, NOT part of the default plane set (CI runs
+            it as its own step between lint and obs-gate).
 
-Default is all three.  Exit status: nonzero iff any unsuppressed finding
-(or external linter failure) is present.
+Default is ast+ext+jaxpr.  Exit status: nonzero iff any unsuppressed
+finding (or external linter failure) is present.
 
 CPU-only by construction: the jaxpr plane must never wait on a TPU
 window, so the environment is pinned before jax ever loads.
@@ -41,7 +48,10 @@ from fpga_ai_nic_tpu.lint import default_targets, lint_paths  # noqa: E402
 # pyproject [tool.mypy] files= — invoked bare so the two cannot drift)
 STRICT_CORE = ["fpga_ai_nic_tpu/compress", "fpga_ai_nic_tpu/obs",
                "fpga_ai_nic_tpu/utils/config.py",
-               "fpga_ai_nic_tpu/runtime/queue.py"]
+               "fpga_ai_nic_tpu/runtime/queue.py",
+               "fpga_ai_nic_tpu/parallel/reshard.py",
+               "fpga_ai_nic_tpu/tune",
+               "fpga_ai_nic_tpu/verify"]
 
 
 def run_ast(paths) -> int:
@@ -98,17 +108,53 @@ def run_ext() -> int:
     return rc
 
 
+def run_mc() -> int:
+    """graftmc: the exhaustive protocol corpus + the H1 lockset pass
+    (`make modelcheck`).  GRAFTMC_FIXTURE names a mutated-model fixture
+    module whose violation MUST surface (the J7-style anti-vacuity
+    hook); any violation leaves a pretty-printed + Perfetto
+    counterexample pair under artifacts/."""
+    from fpga_ai_nic_tpu.verify import mc as graftmc
+    from fpga_ai_nic_tpu.verify.lockset import run_lockset
+    cdir = os.path.join(REPO, "artifacts")
+    findings, stats = graftmc.run_corpus(emit=print,
+                                         counterexample_dir=cdir)
+    fixture = os.environ.get("GRAFTMC_FIXTURE")
+    if fixture:
+        findings += graftmc.run_fixture(fixture, counterexample_dir=cdir)
+    h1 = run_lockset(repo_root=REPO)
+    findings += h1
+    for f in findings:
+        print(f.format())
+    live = [f for f in findings
+            if not getattr(f, "suppressed", False)]
+    for cmp in stats.compare:
+        print(f"[graftmc] POR reduction on flat{cmp['cell']}: "
+              f"{cmp['reduction']:.1f}x ({cmp['por_states']} vs "
+              f"{cmp['naive_states']} states), verdicts "
+              f"{'agree' if cmp['agree'] else 'DISAGREE'}")
+    print(f"[graftmc] {stats.cells} cells exhaustive "
+          f"({stats.states} states, {stats.branch_points} branch "
+          f"points), {stats.fuzz_runs} fuzz runs, "
+          f"{len(h1)} lockset findings, {len(live)} findings total")
+    return 1 if live else 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--ast", action="store_true", help="AST plane only")
     ap.add_argument("--jaxpr", action="store_true", help="jaxpr plane only")
     ap.add_argument("--ext", action="store_true",
                     help="external linters (ruff/mypy) only")
+    ap.add_argument("--mc", action="store_true",
+                    help="graftmc protocol model check + lockset pass "
+                         "(= make modelcheck; not in the default set)")
     ap.add_argument("paths", nargs="*",
                     help="explicit files for the AST plane (default: the "
                          "package + tools + bench drivers + examples)")
     args = ap.parse_args(argv)
-    planes = {p for p in ("ast", "jaxpr", "ext") if getattr(args, p)}
+    planes = {p for p in ("ast", "jaxpr", "ext", "mc")
+              if getattr(args, p)}
     if not planes:
         planes = {"ast", "jaxpr", "ext"}
     rc = 0
@@ -117,6 +163,8 @@ def main(argv=None) -> int:
         rc |= run_ast(paths)
     if "ext" in planes:
         rc |= run_ext()
+    if "mc" in planes:
+        rc |= run_mc()
     if "jaxpr" in planes:
         rc |= run_jaxpr()
     print("[graftlint] " + ("FAIL" if rc else "OK"))
