@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -123,6 +123,19 @@ tune-bench:
 	@latest=$$(ls -t artifacts/tune_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest TUNE_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> TUNE_BENCH_$(ROUND).json"
+
+# serving bench (docs/SERVING.md): throughput-vs-latency curve over the
+# paged continuous-batching engine at increasing concurrency, the
+# contiguous-init_cache-vs-paged-pool HBM comparison, token-exactness
+# under batching, and the zero-recompile gate; snapshot the newest
+# artifact as the round's committed record (obs-gate consumes it —
+# dryrun CPU rows gate only the exact byte accounting + recompiles==0,
+# serve.* keys)
+serve-bench:
+	python tools/serve_bench.py
+	@latest=$$(ls -t artifacts/serve_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest SERVE_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> SERVE_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

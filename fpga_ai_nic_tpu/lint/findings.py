@@ -32,6 +32,9 @@ RULE_DOCS: Dict[str, str] = {
     "J9": "hierarchical collective: intra-hop ppermutes must be codec-free "
           "f32 and each hop class must move exactly the bytes the "
           "HierarchicalPlan declares",
+    "J10": "serving decode plane: the jitted prefill/decode steps must "
+           "trace exactly once across any admit/evict schedule — slot "
+           "occupancy and page assignment are VALUES, never shapes",
     "H1": "happens-before/lockset: an instance attribute written from two "
           "threads (trainer / watchdog worker / callback) needs a common "
           "lock — R1 generalized to cross-thread order",
@@ -42,7 +45,7 @@ RULE_DOCS: Dict[str, str] = {
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8", "J9")
+                                "J8", "J9", "J10")
 
 
 @dataclass(frozen=True)

@@ -743,6 +743,115 @@ def run_j9(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J10 — the serving decode plane (serve.engine) must be recompile-free
+# across (active-set, page-assignment) changes.  The continuous-batching
+# contract is that admissions, evictions, slot churn and page recycling
+# change operand VALUES only; a step whose jaxpr depends on scheduler
+# state (e.g. batching only the active slots, so the batch dim tracks
+# the active count) retraces on every transition and the serving tail
+# latency grows a compile spike.  Like J7, this rule runs CONCRETELY: a
+# tiny engine serves a scripted two-wave schedule sized to force
+# eviction + readmission + page recycling, and each jitted program's
+# counted traces (serve.engine.counted_jit) must equal exactly 1.  A
+# schedule that fails to exercise eviction is itself a finding — the
+# check must not rot into vacuity.
+# ---------------------------------------------------------------------------
+
+def _j10_engine_build() -> Callable:
+    def run() -> Dict[str, int]:
+        import jax
+        import numpy as np
+        from ..models import llama
+        from ..serve import ServeConfig, ServeEngine
+
+        cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=1, ffn_dim=64)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=5,
+                           max_pages_per_seq=4, prefill_chunk=4)
+        eng = ServeEngine(params, cfg, scfg)
+        rng = np.random.default_rng(11)
+        for _ in range(5):
+            eng.submit(rng.integers(0, cfg.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=int(rng.integers(2, 6)))
+        eng.run()
+        for i in range(4):
+            eng.submit(rng.integers(0, cfg.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=3, not_before_s=0.01 * i)
+        eng.run()
+        counts = dict(eng.trace_counts())
+        counts["_exercised"] = int(eng.batcher.evictions > 0
+                                   and eng.stats.as_dict()["completed"] == 9)
+        return counts
+    return run
+
+
+def check_serve_trace(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J10 surface.  ``build()`` returns a zero-arg runner
+    executing the scripted schedule and returning {phase: traces}
+    (optionally ``_exercised``: falsy = the schedule proved nothing)."""
+    findings: List[Finding] = []
+    cell = f"jaxpr[serve {name}]"
+    counts = dict(build()())
+    exercised = counts.pop("_exercised", 1)
+    if not exercised:
+        findings.append(Finding(
+            "J10", cell, 0,
+            "the scripted admit/evict schedule exercised no eviction/"
+            "readmission (or lost requests) — the recompile check is "
+            "vacuous; widen the schedule"))
+    for phase, n in sorted(counts.items()):
+        if n > 1:
+            findings.append(Finding(
+                "J10", cell, 0,
+                f"serving '{phase}' step traced {n}x across the scripted "
+                "admit/evict schedule — the decode plane's jaxpr depends "
+                "on scheduler state (slot occupancy / page assignment / "
+                "active-set size); those must be operand VALUES under "
+                "static ServeConfig shapes so steady-state serving "
+                "records 0 recompiles"))
+    return findings
+
+
+def j10_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs.  GRAFTLINT_J10_FIXTURE appends a surface from
+    a module path exposing ``build()`` — the bad-fixture / exit-code
+    hook, same contract as J7/J8/J9's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("engine admit/evict schedule", _j10_engine_build),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J10_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j10_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j10(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j10_surfaces():
+        try:
+            fs = check_serve_trace(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J10", f"jaxpr[serve {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] serve {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -838,4 +947,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_j7(verbose=verbose))
     findings.extend(run_j8(verbose=verbose))
     findings.extend(run_j9(verbose=verbose))
+    findings.extend(run_j10(verbose=verbose))
     return findings

@@ -37,18 +37,32 @@ from . import llama
 from .llama import LlamaConfig
 
 
+def kv_local_heads(cfg: LlamaConfig, tp_size: int = 1) -> int:
+    """Per-rank KV head count: n_kv/tp, or 1 under kv-head replication
+    (tp > n_kv — each rank slices the ONE head serving its query group)."""
+    if cfg.n_kv_heads % tp_size == 0:
+        return cfg.n_kv_heads // tp_size
+    if tp_size % cfg.n_kv_heads == 0:
+        return 1                      # replicated-kv: one sliced head/rank
+    raise ValueError(
+        f"tp={tp_size} must divide n_kv_heads={cfg.n_kv_heads}, or be "
+        f"a multiple of it (kv-head replication)")
+
+
 def init_cache(cfg: LlamaConfig, batch: int, max_seq: int, *,
                tp_size: int = 1, dtype=None) -> List[Dict]:
     """Per-layer K/V cache [B, kv_local, max_seq, head_dim], zero-filled;
-    kv_local = n_kv/tp, or 1 under kv-head replication (tp > n_kv)."""
-    if cfg.n_kv_heads % tp_size == 0:
-        kv_local = cfg.n_kv_heads // tp_size
-    elif tp_size % cfg.n_kv_heads == 0:
-        kv_local = 1                  # replicated-kv: one sliced head/rank
-    else:
-        raise ValueError(
-            f"tp={tp_size} must divide n_kv_heads={cfg.n_kv_heads}, or be "
-            f"a multiple of it (kv-head replication)")
+    kv_local = n_kv/tp, or 1 under kv-head replication (tp > n_kv).
+
+    HBM cost caveat: the WHOLE [B, kv_local, max_seq, hd] extent is
+    allocated and zero-filled up front, per layer, per K and V — a batch
+    of short sequences pays for max_seq anyway, and B concurrent
+    sequences cannot share a byte.  That is the right trade for a single
+    fixed-shape generate() call; it is the wrong one for a serving plane
+    multiplexing thousands of requests (see `serve.paged.init_pool` +
+    `forward_paged`: one shared page pool, per-sequence page tables,
+    docs/PERF.md "Serving" for the measured comparison)."""
+    kv_local = kv_local_heads(cfg, tp_size)
     dt = jnp.dtype(dtype or cfg.dtype)
     shape = (batch, kv_local, max_seq, cfg.head_dim)
     return [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
@@ -59,7 +73,13 @@ def _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale):
     """q: [B,H,T,hd] (T = tokens this call, ending at position pos+T-1);
     ck/cv: [B,Hkv,Smax,hd] cache AFTER this call's keys were written.
     Scores the full static cache with a two-sided mask: key j visible to
-    query t iff j <= pos + t (causal) and j < pos + T (written)."""
+    query t iff j <= pos + t (causal) and j < pos + T (written).
+
+    ``pos`` is a scalar (whole batch at one position — the generate()
+    path) or a [B] vector (each sequence at its own position — the
+    serving plane's continuous-batching decode, where slots advance
+    independently).  The scalar path is untouched: a uniform [B] vector
+    computes the identical mask, so the two agree bitwise."""
     B, H, T, hd = q.shape
     Smax = ck.shape[2]
     # GQA via a grouped einsum — the cache is read ONCE per kv head
@@ -73,8 +93,13 @@ def _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale):
                    preferred_element_type=jnp.float32) * sm_scale
     j = lax.broadcasted_iota(jnp.int32, (T, Smax), 1)
     t = lax.broadcasted_iota(jnp.int32, (T, Smax), 0)
-    visible = j <= (pos + t)                       # causal + written bound
-    s = jnp.where(visible[None, None, None], s, jnp.float32(-1e30))
+    pos = jnp.asarray(pos, jnp.int32)
+    if pos.ndim == 0:
+        visible = j <= (pos + t)                   # causal + written bound
+        s = jnp.where(visible[None, None, None], s, jnp.float32(-1e30))
+    else:                                          # per-sequence positions
+        visible = j[None] <= (pos[:, None, None] + t[None])  # [B,T,Smax]
+        s = jnp.where(visible[:, None, None], s, jnp.float32(-1e30))
     p = jax.nn.softmax(s, axis=-1)
     out = jnp.einsum("bkgtj,bkjd->bkgtd", p, cv.astype(jnp.float32))
     return out.reshape(B, H, T, hd)
@@ -142,6 +167,142 @@ def forward(params: Dict, tokens: jax.Array, cache: List[Dict],
     if tp_axis is not None:
         logits = lax.all_gather(logits, tp_axis, axis=2, tiled=True)
     return logits, new_cache
+
+
+def _rope_rows(x: jax.Array, pos: jax.Array, cfg: LlamaConfig) -> jax.Array:
+    """Rotate-half rope with PER-SEQUENCE positions: x [B,H,T,dh],
+    pos [B,T] global positions.  Same formula as llama._rope (which
+    takes one shared [T] vector); a row-constant grid runs the identical
+    elementwise ops, so the two agree bitwise — the parity seam between
+    generate()'s uniform batch and the serving plane's mixed-position
+    decode."""
+    dh = x.shape[-1]
+    half = dh // 2
+    freqs = llama._rope_freqs(cfg, half)
+    ang = pos.astype(jnp.float32)[:, :, None] * freqs[None, None, :]
+    cos, sin = jnp.cos(ang)[:, None], jnp.sin(ang)[:, None]  # [B,1,T,half]
+    x1 = x[..., :half].astype(jnp.float32)
+    x2 = x[..., half:].astype(jnp.float32)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x1 * sin + x2 * cos], -1)
+    return out.astype(x.dtype)
+
+
+def forward_paged(params: Dict, tokens: jax.Array, pool: List[Dict],
+                  page_table: jax.Array, pos: jax.Array, cfg: LlamaConfig,
+                  *, page_size: int, tp_axis: Optional[str] = None,
+                  active: Optional[jax.Array] = None
+                  ) -> Tuple[jax.Array, List[Dict]]:
+    """Paged-KV forward — the serving plane's decode path.
+
+    ``tokens [R, T]``: R request slots, T tokens each (T == 1 for decode,
+    T == chunk for chunked prefill); ``pos [R]``: each slot's global
+    position for its first token this call; ``pool``: per-layer
+    ``{"k","v"}`` pages ``[n_pages, kv_local, page_size, hd]`` shared by
+    every slot (``serve.paged.init_pool``); ``page_table [R, P]`` int32:
+    ``page_table[r, i]`` is the pool page holding slot r's positions
+    ``[i*page_size, (i+1)*page_size)``; ``active [R]`` bool (None = all)
+    gates K/V writes — empty slots write zeros into the reserved null
+    page 0 and their logits are garbage the host ignores.
+
+    Bit-parity contract (pinned by tests/test_serve.py): for the same
+    token stream and chunk schedule, with Smax == P*page_size, logits
+    are BITWISE identical to ``forward()`` over the contiguous
+    ``init_cache`` — for ANY page assignment, even into a dirty
+    (recycled) pool.  Unwritten/garbage positions sit behind the same
+    -1e30 mask in both paths; their exact-zero softmax weights multiply
+    the garbage away in f32 (0 * finite == ±0, and a ±0 term never moves
+    an f32 sum).
+
+    Every shape is static in (R, T, P, page_size): admissions, evictions
+    and page re-assignments change VALUES only, so a jitted step is
+    trace-stable across any admit/evict schedule (frozen as graftlint
+    J10)."""
+    R, T = tokens.shape
+    Hd = cfg.head_dim
+    P = page_table.shape[1]
+    n_heads, n_kv = llama._shard_counts(cfg, tp_axis)
+    kv_rep = n_kv == 0
+    if kv_rep:
+        n_kv = 1
+    sm_scale = Hd ** -0.5
+    pos = jnp.asarray(pos, jnp.int32)
+    pos_grid = pos[:, None] + jnp.arange(T, dtype=jnp.int32)[None, :]
+    # scatter coordinates for this call's K/V rows: (page, in-page
+    # offset) per (slot, token); the page index is clamped defensively —
+    # the scheduler's bound is pos + T <= P*page_size for active slots,
+    # and inactive slots sit at pos 0 in the null page
+    page_of = jnp.take_along_axis(
+        page_table, jnp.minimum(pos_grid // page_size, P - 1), axis=1)
+    if active is None:
+        act = jnp.ones((R,), bool)
+    else:
+        act = jnp.asarray(active, bool)
+    # two classes of writes must be REDIRECTED to the null page, not
+    # merely value-masked — their clamped/aliased page index would land
+    # in a LIVE page otherwise: (a) inactive slots, whose table row may
+    # hold a co-resident's pages; (b) positions beyond the table's range
+    # (a final prefill chunk's zero-padding when pos+T overruns
+    # P*page_size — the clamp above would alias them onto the LAST live
+    # page and corrupt its K/V at the same in-page offsets)
+    in_range = pos_grid < P * page_size
+    page_of = jnp.where(act[:, None] & in_range, page_of, 0)
+    flat_pages = page_of.reshape(-1)
+    flat_offs = (pos_grid % page_size).reshape(-1)
+    gate = act[:, None, None, None]
+
+    x = params["tok_emb"][tokens]
+    new_pool: List[Dict] = []
+    for lyr, pl in zip(params["layers"], pool):
+        if kv_rep:
+            wk, wv = llama._kv_rep_slice(lyr, cfg, tp_axis)
+        else:
+            wk, wv = lyr["wk"], lyr["wv"]
+        h = llama._rmsnorm(x, lyr["attn_norm"], cfg.norm_eps)
+        q = (h @ lyr["wq"]).reshape(R, T, n_heads, Hd).transpose(0, 2, 1, 3)
+        k = (h @ wk).reshape(R, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        v = (h @ wv).reshape(R, T, n_kv, Hd).transpose(0, 2, 1, 3)
+        q = _rope_rows(q, pos_grid, cfg)
+        k = _rope_rows(k, pos_grid, cfg)
+        dt = pl["k"].dtype
+        # inactive slots write zeros (all aimed at the null page, so the
+        # duplicate scatter indices all carry the same value and the
+        # result is deterministic regardless of write order)
+        kw = jnp.where(gate, k, 0).astype(dt).transpose(0, 2, 1, 3)
+        vw = jnp.where(gate, v, 0).astype(dt).transpose(0, 2, 1, 3)
+        pk = pl["k"].at[flat_pages, :, flat_offs, :].set(
+            kw.reshape(R * T, n_kv, Hd))
+        pv = pl["v"].at[flat_pages, :, flat_offs, :].set(
+            vw.reshape(R * T, n_kv, Hd))
+        new_pool.append({"k": pk, "v": pv})
+        # gather each slot's paged view [R, kv, P*page_size, hd] — the
+        # array forward() reads straight out of the contiguous cache.
+        # XLA materializes it (the portable reference path); a Pallas
+        # gather-attend that never forms it is the on-hardware follow-up
+        # (docs/SERVING.md).
+        ck = pk[page_table].transpose(0, 2, 1, 3, 4).reshape(
+            R, n_kv, P * page_size, Hd)
+        cv = pv[page_table].transpose(0, 2, 1, 3, 4).reshape(
+            R, n_kv, P * page_size, Hd)
+        att = _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale)
+        att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
+            R, T, n_heads * Hd)
+        x = x + llama._psum_if(att @ lyr["wo"], tp_axis)
+
+        h = llama._rmsnorm(x, lyr["mlp_norm"], cfg.norm_eps)
+        if "moe" in lyr:
+            from ..ops import moe as moe_ops
+            ff, _ = moe_ops.moe_ffn(lyr["moe"], h, cfg.moe)
+        else:
+            gate_act = jax.nn.silu((h @ lyr["w1"]).astype(jnp.float32)
+                                   ).astype(x.dtype)
+            ff = (gate_act * (h @ lyr["w3"])) @ lyr["w2"]
+        x = x + llama._psum_if(ff, tp_axis)
+
+    x = llama._rmsnorm(x, params["final_norm"], cfg.norm_eps)
+    logits = x @ params["lm_head"]                  # [R, T, V/tp]
+    if tp_axis is not None:
+        logits = lax.all_gather(logits, tp_axis, axis=2, tiled=True)
+    return logits, new_pool
 
 
 def generate(params: Dict, prompt: jax.Array, n_new: int,

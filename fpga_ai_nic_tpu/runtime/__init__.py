@@ -1,6 +1,8 @@
 from . import chaos, native, staging  # noqa: F401
 from .queue import CollectiveQueue, Ticket
+from .requests import Request, RequestQueue, ServeStats
 from .watchdog import DeviceHangError, Heartbeat, Watchdog, run_with_recovery
 
 __all__ = ["CollectiveQueue", "Ticket", "native", "staging", "Watchdog",
-           "Heartbeat", "DeviceHangError", "run_with_recovery", "chaos"]
+           "Heartbeat", "DeviceHangError", "run_with_recovery", "chaos",
+           "Request", "RequestQueue", "ServeStats"]

@@ -57,7 +57,7 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 __all__ = [
-    "FAULT_KINDS", "SITES", "CORRUPTION_MODES",
+    "FAULT_KINDS", "SITES", "TRAIN_SITES", "CORRUPTION_MODES",
     "InjectedFault", "InjectedPreemption", "IntegrityError",
     "FaultSpec", "FaultPlan", "NormDriftGuard",
     "chunk_checksums", "collective_integrity", "integrity_tol",
@@ -66,7 +66,13 @@ __all__ = [
 ]
 
 FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
-SITES = ("queue.issue", "queue.wait", "staging", "collective")
+# "serve.step" is the serving plane's tick boundary (serve.engine): a
+# host site like queue.*, fired once per engine tick inside the
+# watchdog-bounded device work.  The TRAINING matrix/soak in
+# tools/chaos_bench.py iterates TRAIN_SITES — a serve.step spec never
+# fires in a training run.
+TRAIN_SITES = ("queue.issue", "queue.wait", "staging", "collective")
+SITES = TRAIN_SITES + ("serve.step",)
 CORRUPTION_MODES = ("nan", "bitflip", "scale")
 
 # faults that can run inside an XLA callback (no raising in there)
@@ -169,7 +175,7 @@ class FaultPlan:
     @classmethod
     def random(cls, seed: int, n_steps: int, *, rate: float = 0.25,
                kinds: Sequence[str] = FAULT_KINDS,
-               sites: Sequence[str] = SITES,
+               sites: Sequence[str] = TRAIN_SITES,
                duration_s: float = 0.25) -> "FaultPlan":
         """Seeded random plan: each step draws one fault with probability
         ``rate``; kind/site/mode are drawn uniformly from the legal

@@ -1,0 +1,25 @@
+"""Serving plane: continuous batching + paged KV cache on the llama
+decode path (docs/SERVING.md).
+
+  - `paged` — the shared page pool, allocator and exact byte accounting
+  - `scheduler` — admit / evict / prefill-decode interleave policy
+  - `engine` — the tick loop: two trace-stable jitted programs, request
+    telemetry, chaos/watchdog recovery
+
+The device-side paged forward itself lives with the model
+(`models.llama_decode.forward_paged`), bit-parity-pinned against the
+contiguous cache.
+"""
+
+from .engine import ServeEngine, counted_jit
+from .paged import (NULL_PAGE, PageAllocator, ServeConfig,
+                    contiguous_cache_bytes, init_pool, page_table_bytes,
+                    pool_bytes)
+from .scheduler import ContinuousBatcher
+
+__all__ = [
+    "ServeEngine", "counted_jit",
+    "NULL_PAGE", "PageAllocator", "ServeConfig", "init_pool",
+    "pool_bytes", "contiguous_cache_bytes", "page_table_bytes",
+    "ContinuousBatcher",
+]

@@ -1,0 +1,367 @@
+"""Serving engine — continuous batching over the paged decode path.
+
+A genuinely different execution model from the trainers: request-level
+async over the step-level substrate.  The host loop runs discrete TICKS;
+each tick the scheduler (`scheduler.ContinuousBatcher`) decides which
+requests occupy the static decode slots and where their KV pages live,
+then AT MOST TWO jitted programs run — one prefill chunk
+(``[1, prefill_chunk]`` tokens, oldest prefilling request) interleaved
+with one decode step (``[max_reqs, 1]`` tokens, every decoding slot,
+empty slots masked).  Both programs' jaxprs are invariant to which
+requests occupy which slots: admissions, evictions, page re-assignments
+and position churn all change operand VALUES only, never shapes — traced
+once at warmup, never again (counted by `counted_jit`, frozen as
+graftlint J10, asserted by the serve bench's ``recompiles_steady == 0``).
+
+Failure story (the chaos serving cell): each tick's device work runs
+under the `runtime.watchdog` bound when ``step_timeout_s`` is set, with
+`runtime.chaos` firing at the ``serve.step`` site.  Recovery is
+replay-tier: the pool is rebuilt, every live request loses its pages and
+re-queues with its generated tokens kept host-side, and re-admission
+replays prompt + generated[:-1] as ordinary prefill chunks — greedy
+decode is deterministic, so the post-recovery token stream is identical
+to the fault-free one (the request-level SLO `tools/chaos_bench.py`
+gates).  MTTR (detection -> engine serviceable) lands in the same
+`RecoveryStats` the elastic trainer reports through.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..models import llama_decode
+from ..models.llama import LlamaConfig
+from ..obs.metrics import RequestSpans
+from ..runtime import chaos as chaos_lib
+from ..runtime.requests import DECODE, Request, RequestQueue, ServeStats
+from ..runtime.watchdog import DeviceHangError, Watchdog
+from ..utils.observability import Profiler
+from .paged import (PageAllocator, ServeConfig, contiguous_cache_bytes,
+                    init_pool, page_table_bytes, pool_bytes)
+from .scheduler import ContinuousBatcher
+
+__all__ = ["ServeEngine", "counted_jit"]
+
+Pool = List[Dict[str, jax.Array]]
+PrefillWork = Tuple[Request, int, int]
+
+
+def counted_jit(fn: Callable[..., Any], **jit_kwargs: Any
+                ) -> Tuple[Any, Callable[[], int]]:
+    """``jax.jit(fn)`` plus a trace counter: the wrapped Python body runs
+    once per TRACE (cache miss), so the counter reads exactly the
+    recompiles J10 and the serve bench hold at zero in steady state."""
+    count = {"n": 0}
+
+    def wrapped(*args: Any, **kwargs: Any) -> Any:
+        count["n"] += 1
+        return fn(*args, **kwargs)
+
+    return jax.jit(wrapped, **jit_kwargs), lambda: count["n"]
+
+
+class ServeEngine:
+    """Continuous-batching inference engine over `forward_paged`.
+
+    Greedy (argmax) sampling — determinism is what makes eviction replay
+    and preemption recovery token-exact, and what the chaos cell's SLO
+    verdict pins.  Single-threaded host loop; `runtime.requests` holds
+    the thread-safe seams (intake queue, stats)."""
+
+    def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
+                 scfg: ServeConfig, *,
+                 profiler: Optional[Profiler] = None,
+                 chaos: Optional[chaos_lib.FaultPlan] = None,
+                 dtype: Optional[str] = None) -> None:
+        self.params = params
+        self.cfg = cfg
+        self.scfg = scfg
+        self.dtype = dtype
+        self.profiler = profiler or Profiler()
+        self.stats = ServeStats()
+        self.queue = RequestQueue(events=self.profiler.events,
+                                  stats=self.stats)
+        self.spans = RequestSpans(self.profiler.events)
+        self.chaos = chaos
+        self.watchdog = (Watchdog(scfg.step_timeout_s)
+                         if scfg.step_timeout_s is not None else None)
+        self.alloc = PageAllocator(scfg.n_pages)
+        self.batcher = ContinuousBatcher(scfg, self.alloc,
+                                         stats=self.stats)
+        self.pool: Pool = init_pool(cfg, scfg, dtype=dtype)
+        self.ticks = 0
+        self._wall_s = 0.0
+        self._consec_failures = 0
+        self._pages_peak = 0         # survives allocator rebuilds
+        self._decode_fn, self._decode_traces = counted_jit(
+            self._decode_impl, donate_argnums=(0,))
+        self._prefill_fn, self._prefill_traces = counted_jit(
+            self._prefill_impl, donate_argnums=(0,))
+
+    # -- the two jitted programs (shapes fixed by ServeConfig) ---------------
+
+    def _decode_impl(self, pool: Pool, params: Dict[str, Any],
+                     tokens: jax.Array, table: jax.Array, pos: jax.Array,
+                     active: jax.Array) -> Tuple[jax.Array, Pool]:
+        logits, pool = llama_decode.forward_paged(
+            params, tokens, pool, table, pos, self.cfg,
+            page_size=self.scfg.page_size, active=active)
+        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), pool
+
+    def _prefill_impl(self, pool: Pool, params: Dict[str, Any],
+                      tokens: jax.Array, row: jax.Array, pos0: jax.Array,
+                      last: jax.Array) -> Tuple[jax.Array, Pool]:
+        logits, pool = llama_decode.forward_paged(
+            params, tokens, pool, row, pos0, self.cfg,
+            page_size=self.scfg.page_size)
+        # the sampled continuation at the chunk's last TRUE token — only
+        # consumed when this chunk completes a FRESH prefill
+        nxt = jnp.argmax(logits[0, last], axis=-1).astype(jnp.int32)
+        return nxt, pool
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, max_new: int, *,
+               eos_id: Optional[int] = None,
+               not_before_s: float = 0.0) -> Request:
+        """Validate against the static budget, then queue (thread-safe)."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        self.batcher.validate_shape(int(p.shape[0]), int(max_new))
+        return self.queue.submit(p, max_new, eos_id=eos_id,
+                                 not_before_s=not_before_s)
+
+    # -- the loop ------------------------------------------------------------
+
+    def run(self, *, max_ticks: int = 1_000_000) -> Dict[str, Any]:
+        """Serve until every submitted request finishes; returns
+        `summary()`."""
+        t0 = time.perf_counter()
+        while (self.queue.pending or self.batcher.waiting
+               or self.batcher.live):
+            if self.ticks >= max_ticks:
+                raise RuntimeError(
+                    f"serve loop exceeded max_ticks={max_ticks} with "
+                    f"{len(self.batcher.live)} live / "
+                    f"{len(self.batcher.waiting)} waiting requests")
+            if not self._tick():
+                wait = self.queue.next_arrival_in()
+                time.sleep(min(0.01, wait if wait is not None else 0.001))
+        self._wall_s += time.perf_counter() - t0
+        return self.summary()
+
+    def _tick(self) -> bool:
+        for req in self.queue.pop_arrived():
+            self.batcher.enqueue(req)
+        now = time.perf_counter()
+        for req in self.batcher.admit():
+            self.stats.record_admitted()
+            if math.isnan(req.t_admit):
+                req.t_admit = now
+        # decode first, then prefill: prefill's page demand may evict the
+        # NEWEST decoder, so the batch is re-filtered before dispatch
+        dec = self.batcher.decode_batch()
+        pre = self.batcher.prefill_work()
+        dec = [r for r in dec if r.state == DECODE and r.slot >= 0]
+        if pre is None and not dec:
+            return False
+        with self.profiler.events.span("serve.tick", lane="serve",
+                                       n_decode=len(dec),
+                                       prefill=pre is not None):
+            try:
+                pool, out = self._device_tick(pre, dec)
+            except Exception as err:  # noqa: BLE001 — the recovery boundary
+                self._recover(err)
+                return True
+        self.pool = pool
+        self._consec_failures = 0
+        self._apply(pre, dec, out)
+        self.ticks += 1
+        return True
+
+    def _device_tick(self, pre: Optional[PrefillWork], dec: List[Request]
+                     ) -> Tuple[Pool, Dict[str, Any]]:
+        """All device work of one tick as a closure the watchdog can
+        bound.  NO engine-state read OR mutation inside the closure: the
+        pool/table are snapshotted HERE, on the engine thread, before the
+        watchdog worker starts — a timed-out zombie that wakes after
+        recovery must dispatch against the ABANDONED pool (harmless; its
+        donated buffers are never touched again), never against the
+        rebuilt one it would otherwise read off ``self.pool`` and
+        consume."""
+        scfg = self.scfg
+        table = self.batcher.table.copy()
+        pool_in = self.pool
+        # EVERYTHING the closure needs is snapshotted here, on the engine
+        # thread — including slot/last, which _recover() rewrites on the
+        # live Request (a zombie reading req.slot == -1 post-recovery
+        # would slice an empty table row and retrace a fresh shape)
+        pre_snap: Optional[Tuple[np.ndarray, int, int, int]] = None
+        if pre is not None:
+            req, start, n_true = pre
+            full = np.concatenate(
+                [req.prompt, np.asarray(req.generated, np.int32)])
+            pre_tokens = np.zeros((1, scfg.prefill_chunk), np.int32)
+            pre_tokens[0, :n_true] = full[start:start + n_true]
+            final = start + n_true >= req.replay_len
+            last = (req.replay_len - 1 - start) if final else 0
+            pre_snap = (pre_tokens, req.slot, start, last)
+        dec_snap = [(r.slot, r.generated[-1], r.n_tokens) for r in dec]
+
+        def work() -> Tuple[Pool, Dict[str, Any]]:
+            if self.chaos is not None:
+                self.chaos.begin_step(self.ticks)
+                self.chaos.fire("serve.step")      # may sleep or raise
+            pool = pool_in
+            out: Dict[str, Any] = {}
+            if pre_snap is not None:
+                pre_tokens, slot, start, last = pre_snap
+                tok, pool = self._prefill_fn(
+                    pool, self.params, jnp.asarray(pre_tokens),
+                    jnp.asarray(table[slot:slot + 1]),
+                    jnp.asarray([start], jnp.int32),
+                    jnp.asarray(last, jnp.int32))
+                out["prefill_tok"] = int(tok)              # blocks
+            if dec_snap:
+                R = scfg.max_reqs
+                toks = np.zeros((R, 1), np.int32)
+                pos = np.zeros((R,), np.int32)
+                act = np.zeros((R,), bool)
+                for slot, tok_in, n_tok in dec_snap:
+                    toks[slot, 0] = tok_in
+                    pos[slot] = n_tok
+                    act[slot] = True
+                ntok, pool = self._decode_fn(
+                    pool, self.params, jnp.asarray(toks),
+                    jnp.asarray(table), jnp.asarray(pos),
+                    jnp.asarray(act))
+                out["decode_toks"] = np.asarray(ntok)      # blocks
+            return pool, out
+
+        if self.watchdog is not None:
+            result: Tuple[Pool, Dict[str, Any]] = self.watchdog.run(work)
+            return result
+        return work()
+
+    def _apply(self, pre: Optional[PrefillWork], dec: List[Request],
+               out: Dict[str, Any]) -> None:
+        now = time.perf_counter()
+        if pre is not None:
+            req, start, n_true = pre
+            req.prefill_done = start + n_true
+            if req.prefill_done >= req.replay_len:
+                req.state = DECODE
+                if not req.generated:
+                    # fresh prefill: the chunk's sample IS the first new
+                    # token; a replay re-derives generated[-1] instead
+                    # (greedy determinism) and the host copy wins
+                    self._append_token(req, int(out["prefill_tok"]), now)
+        if dec:
+            toks = out["decode_toks"]
+            for r in dec:
+                self._append_token(r, int(toks[r.slot]), now)
+
+    def _append_token(self, req: Request, tok: int, now: float) -> None:
+        req.generated.append(tok)
+        if math.isnan(req.t_first):
+            req.t_first = now
+            self.profiler.events.instant("serve.first_token", uid=req.uid)
+        if (len(req.generated) >= req.max_new
+                or (req.eos_id is not None and tok == req.eos_id)):
+            req.t_done = now
+            self.batcher.finish(req)
+            self.stats.record_completed(len(req.generated))
+            self.spans.record(req.uid, t_submit=req.t_submit,
+                              t_admit=req.t_admit, t_first=req.t_first,
+                              t_done=req.t_done,
+                              n_tokens=len(req.generated))
+
+    # -- recovery ------------------------------------------------------------
+
+    def _recover(self, err: Exception) -> None:
+        """Replay-tier recovery: fresh pool + allocator, every live
+        request requeued with generated tokens kept.  MTTR = detection ->
+        engine serviceable (the replayed prefills are ordinary serving
+        work and land in request latency, not MTTR)."""
+        self._consec_failures += 1
+        if self._consec_failures > self.scfg.max_retries:
+            raise err
+        if isinstance(err, chaos_lib.InjectedPreemption):
+            kind = "preemption"
+        elif isinstance(err, DeviceHangError):
+            kind = "hang"
+        else:
+            kind = getattr(err, "kind", type(err).__name__)
+        ev = self.profiler.recovery.record_fault(
+            kind, step=self.ticks, site="serve.step", error=repr(err))
+        t0 = time.perf_counter()
+        self._pages_peak = max(self._pages_peak, self.alloc.peak_in_use)
+        self.batcher.release_all()
+        self.alloc = PageAllocator(self.scfg.n_pages)
+        self.batcher.rebind(self.alloc)
+        self.pool = init_pool(self.cfg, self.scfg, dtype=self.dtype)
+        jax.block_until_ready(self.pool)
+        self.profiler.recovery.record_recovery(
+            time.perf_counter() - t0, event=ev)
+        self.stats.record_recovery()
+        self.profiler.events.instant("serve.recovered", tick=self.ticks,
+                                     kind=kind)
+        time.sleep(self.scfg.backoff_s * (2 ** (self._consec_failures - 1)))
+
+    # -- introspection -------------------------------------------------------
+
+    def trace_counts(self) -> Dict[str, int]:
+        """Traces per jitted program — each must be exactly 1 after
+        warmup, for ANY admit/evict schedule (graftlint J10)."""
+        return {"prefill": self._prefill_traces(),
+                "decode": self._decode_traces()}
+
+    def recompiles_steady(self) -> int:
+        return sum(max(0, n - 1) for n in self.trace_counts().values())
+
+    def obs_static_metrics(self) -> Dict[str, Any]:
+        """Trace-time-constant serving facts for the obs gate — byte
+        accounting is EXACT (two-sided in tools/obs_gate.py), the same
+        honesty rule as the collective wire bytes."""
+        scfg = self.scfg
+        return {"serve": {
+            "max_reqs": scfg.max_reqs,
+            "page_size": scfg.page_size,
+            "n_pages": scfg.n_pages,
+            "max_pages_per_seq": scfg.max_pages_per_seq,
+            "prefill_chunk": scfg.prefill_chunk,
+            "page_table_bytes": page_table_bytes(scfg),
+            "pool_bytes": pool_bytes(self.cfg, scfg, dtype=self.dtype),
+            "contiguous_cache_bytes": contiguous_cache_bytes(
+                self.cfg, scfg.max_reqs, scfg.max_seq, dtype=self.dtype),
+        }}
+
+    def summary(self) -> Dict[str, Any]:
+        rec = self.profiler.recovery.as_dict()
+        stats = self.stats.as_dict()
+        wall = self._wall_s
+        usable = self.scfg.usable_pages
+        return {
+            "ticks": self.ticks,
+            "wall_s": round(wall, 4),
+            **stats,
+            "evictions": self.batcher.evictions,
+            "pages_in_use_peak": max(self._pages_peak,
+                                     self.alloc.peak_in_use),
+            "page_util_peak": round(
+                max(self._pages_peak, self.alloc.peak_in_use) / usable, 4),
+            "throughput_tok_s": (round(stats["tokens_out"] / wall, 2)
+                                 if wall > 0 else None),
+            "trace_counts": self.trace_counts(),
+            "recompiles_steady": self.recompiles_steady(),
+            "requests": self.spans.summary(),
+            "recovery": {"faults": rec["faults"],
+                         "recoveries": rec["recoveries"],
+                         "mttr_mean_s": rec["mttr_mean_s"]},
+            **self.obs_static_metrics(),
+        }

@@ -1,0 +1,25 @@
+"""J10 bad fixture: a serving decode step whose batch dimension tracks
+the ACTIVE request count.
+
+This is the tempting-but-wrong way to write continuous batching — "why
+pay for empty slots?" — and it retraces on EVERY admit/evict transition:
+the jaxpr's shape is scheduler state.  The counted-trace check must flag
+it (the real engine keeps the batch dim at max_reqs and masks)."""
+
+
+def build():
+    def run():
+        import jax.numpy as jnp
+
+        from fpga_ai_nic_tpu.serve.engine import counted_jit
+
+        def decode(tokens):            # [n_active] — shape-dependent!
+            return (tokens * 2 + 1).sum()
+
+        step, traces = counted_jit(decode)
+        # the same admit/evict churn the real schedule exercises: the
+        # active-set size moves, and every new size is a fresh trace
+        for n_active in (1, 2, 3, 2, 1, 3):
+            step(jnp.zeros((n_active,), jnp.int32))
+        return {"decode": traces(), "_exercised": 1}
+    return run

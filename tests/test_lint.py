@@ -404,12 +404,13 @@ class TestJ7GradScale:
     def test_exit_code_with_fixture_env(self):
         # one subprocess pays for the full sweep, so ALL value-level
         # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
-        # accounting) and J9 (hierarchical hop accounting) must each
-        # fire and fail the CLI
+        # accounting), J9 (hierarchical hop accounting) and J10 (serve
+        # recompile-freedom) must each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
                    GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
-                   GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE)
+                   GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE,
+                   GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -418,6 +419,7 @@ class TestJ7GradScale:
         assert "J7:" in proc.stdout
         assert "J8:" in proc.stdout
         assert "J9:" in proc.stdout
+        assert "J10:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -530,4 +532,56 @@ class TestJ9Hier:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j9()
         assert len(fs) == 1 and fs[0].code == "J9"
+        assert "boom" in fs[0].message
+
+
+class TestJ10ServeRecompile:
+    """J10: the serving decode plane (serve.engine) must be
+    recompile-free across (active-set, page-assignment) changes — a
+    counted-trace check over a scripted admit/evict schedule that
+    forces eviction, readmission and page recycling."""
+
+    FIXTURE = os.path.join(FIXTURES, "j10_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j10
+        findings = run_j10()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_with_trace_count(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j10_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_serve_trace
+        fs = check_serve_trace("j10_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J10"}
+        # the finding must carry the observed trace count and name the
+        # class (shape-dependent scheduler state)
+        assert "traced 3x" in fs[0].message
+        assert "scheduler state" in fs[0].message
+
+    def test_vacuous_schedule_is_a_finding(self):
+        """A surface whose schedule exercised nothing must fail loudly,
+        not pass an empty check."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_serve_trace
+
+        def build():
+            return lambda: {"decode": 1, "_exercised": 0}
+
+        fs = check_serve_trace("lazy", build)
+        assert len(fs) == 1 and fs[0].code == "J10"
+        assert "vacuous" in fs[0].message
+
+    def test_surface_failure_lands_as_j10_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j10_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j10()
+        assert len(fs) == 1 and fs[0].code == "J10"
         assert "boom" in fs[0].message

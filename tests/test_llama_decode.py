@@ -108,6 +108,83 @@ def test_decode_under_kv_replication_matches_single_device(rng):
     np.testing.assert_array_equal(np.asarray(got), want)
 
 
+def test_incremental_decode_under_kv_replication(rng):
+    """tp=4 > n_kv=2, forward()-level (not just generate): per-token
+    decoding through the per-rank single-head cache reproduces the
+    unsharded full forward's logits at every position."""
+    params = _params()
+    S = 8
+    toks = jnp.asarray(rng.integers(0, CFG.vocab, (B, S)), jnp.int32)
+    want = np.asarray(llama.apply(params, toks, CFG), np.float32)
+
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    specs = llama.param_specs(CFG, tp_axis="tp", tp_size=4)
+
+    def fn(p, t):
+        cache = dec.init_cache(CFG, B, S, tp_size=4)
+        outs = []
+        for i in range(S):
+            logits, cache = dec.forward(p, t[:, i:i + 1], cache,
+                                        jnp.int32(i), CFG, tp_axis="tp")
+            outs.append(logits[:, 0])
+        return jnp.stack(outs, axis=1)
+
+    got = jax.jit(jax.shard_map(
+        fn, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+        check_vma=False))(params, toks)
+    np.testing.assert_allclose(np.asarray(got, np.float32), want,
+                               rtol=3e-4, atol=3e-4)
+
+
+def test_paged_decode_under_kv_replication_bitwise(rng):
+    """The kv-head-replication branch under the PAGED path: paged ==
+    contiguous bitwise inside the same tp=4 shard_map (each rank pages
+    its ONE sliced head).  The serving-plane twin lives in
+    tests/test_serve.py; this pin rides the decode battery so the model
+    file cannot regress it unnoticed."""
+    params = _params()
+    PS, PW, NP = 4, 2, 8
+    Smax = PS * PW
+    toks = jnp.asarray(rng.integers(0, CFG.vocab, (B, 6)), jnp.int32)
+    table = jnp.asarray(
+        np.random.default_rng(5).permutation(
+            np.arange(1, NP))[:B * PW].reshape(B, PW).astype(np.int32))
+    mesh = Mesh(np.array(jax.devices()[:4]), ("tp",))
+    specs = llama.param_specs(CFG, tp_axis="tp", tp_size=4)
+    kvl = dec.kv_local_heads(CFG, 4)
+    dt = jnp.dtype(CFG.dtype)
+
+    def contig(p, t):
+        cache = dec.init_cache(CFG, B, Smax, tp_size=4)
+        outs = []
+        for i in range(6):
+            lg, cache = dec.forward(p, t[:, i:i + 1], cache, jnp.int32(i),
+                                    CFG, tp_axis="tp")
+            outs.append(lg)
+        return jnp.stack(outs)
+
+    def paged(p, t):
+        shape = (NP, kvl, PS, CFG.head_dim)
+        pool = [{"k": jnp.zeros(shape, dt), "v": jnp.zeros(shape, dt)}
+                for _ in range(CFG.n_layers)]
+        outs = []
+        for i in range(6):
+            lg, pool = dec.forward_paged(
+                p, t[:, i:i + 1], pool, table,
+                jnp.full((B,), i, jnp.int32), CFG, page_size=PS,
+                tp_axis="tp")
+            outs.append(lg)
+        return jnp.stack(outs)
+
+    want = jax.jit(jax.shard_map(
+        contig, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+        check_vma=False))(params, toks)
+    got = jax.jit(jax.shard_map(
+        paged, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+        check_vma=False))(params, toks)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_moe_decode_runs(rng):
     import dataclasses
     mcfg = dataclasses.replace(

@@ -1,0 +1,676 @@
+"""Serving plane: paged-KV bit-parity, allocator/scheduler policy, the
+continuous-batching engine, and request-level fault recovery.
+
+THE acceptance pin: `forward_paged` over the shared page pool is BITWISE
+identical to `forward` over the contiguous `init_cache` — for the same
+token stream and chunk schedule, for any page assignment, into a dirty
+recycled pool, per tp config including the kv-head-replication branch —
+and the engine's two jitted programs trace exactly once across any
+admit/evict schedule (the J10 contract)."""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fpga_ai_nic_tpu.models import llama, llama_decode as dec
+from fpga_ai_nic_tpu.obs.metrics import RequestSpans, percentile
+from fpga_ai_nic_tpu.runtime import chaos
+from fpga_ai_nic_tpu.runtime.requests import (DECODE, WAITING, Request,
+                                              RequestQueue, ServeStats)
+from fpga_ai_nic_tpu.serve import (NULL_PAGE, ContinuousBatcher,
+                                   PageAllocator, ServeConfig, ServeEngine,
+                                   contiguous_cache_bytes, init_pool,
+                                   page_table_bytes, pool_bytes)
+
+CFG = llama.LlamaConfig.tiny()
+DT = jnp.dtype(CFG.dtype)
+
+
+def _params():
+    return llama.init(jax.random.PRNGKey(0), CFG)
+
+
+def _fresh_pool(n_pages, ps, kv_local=None, dirty_rng=None):
+    kvl = kv_local if kv_local is not None else CFG.n_kv_heads
+    shape = (n_pages, kvl, ps, CFG.head_dim)
+    pools = []
+    for _ in range(CFG.n_layers):
+        if dirty_rng is None:
+            k = jnp.zeros(shape, DT)
+            v = jnp.zeros(shape, DT)
+        else:
+            # recycled-page garbage, including huge magnitudes: parity
+            # must hold because the mask hides it, not because it is small
+            k = jnp.asarray(dirty_rng.standard_normal(shape) * 1e6, DT)
+            v = jnp.asarray(dirty_rng.standard_normal(shape) * 1e6, DT)
+        pools.append({"k": k, "v": v})
+    return pools
+
+
+def _table(rng, R, P_, n_pages):
+    """Unique random page assignment (never the null page)."""
+    pages = rng.permutation(np.arange(1, n_pages))[:R * P_]
+    assert pages.size == R * P_, "pool too small for a full table"
+    return pages.reshape(R, P_).astype(np.int32)
+
+
+def _schedule(toks, chunk):
+    """(tokens [B, chunk-or-1], pos) chunked-prefill + per-token decode
+    schedule over a teacher-forced stream ``toks [B, S]`` (pad chunks
+    with zeros — pad writes are always overwritten before visible)."""
+    B, S = toks.shape
+    n_pre = max(1, (S // 2) // chunk * chunk)   # prefill roughly half
+    out = []
+    for s in range(0, n_pre, chunk):
+        c = toks[:, s:s + chunk]
+        if c.shape[1] < chunk:
+            c = np.concatenate(
+                [c, np.zeros((B, chunk - c.shape[1]), np.int32)], axis=1)
+        out.append((c, s))
+    for s in range(n_pre, S):
+        out.append((toks[:, s:s + 1], s))
+    return out
+
+
+class TestPagedParity:
+    """forward_paged vs forward: bitwise, same schedule, same Smax."""
+
+    B, PS, NP = 3, 4, 16          # NP pool pages; table width from Smax
+    PW = 4                        # pages per sequence -> Smax 16
+
+    def _run_both(self, rng, table, dirty_rng=None):
+        params = _params()
+        Smax = self.PW * self.PS
+        toks = np.asarray(rng.integers(0, CFG.vocab, (self.B, 10)),
+                          np.int32)
+        cache = dec.init_cache(CFG, self.B, Smax)
+        pool = _fresh_pool(self.NP, self.PS, dirty_rng=dirty_rng)
+        outs_c, outs_p = [], []
+        for chunk, p0 in _schedule(toks, 4):
+            lc, cache = dec.forward(params, jnp.asarray(chunk), cache,
+                                    jnp.int32(p0), CFG)
+            lp, pool = dec.forward_paged(
+                params, jnp.asarray(chunk), pool, jnp.asarray(table),
+                jnp.full((self.B,), p0, jnp.int32), CFG,
+                page_size=self.PS)
+            outs_c.append(np.asarray(lc))
+            outs_p.append(np.asarray(lp))
+        return outs_c, outs_p
+
+    def test_bitwise_vs_contiguous(self, rng):
+        table = _table(rng, self.B, self.PW, self.NP)
+        outs_c, outs_p = self._run_both(rng, table)
+        for a, b in zip(outs_c, outs_p):
+            np.testing.assert_array_equal(a, b)
+
+    def test_bitwise_into_dirty_pool(self, rng):
+        """Recycled pages hold garbage (1e6-scale); the mask's exact-zero
+        softmax weights must kill it — parity stays BITWISE."""
+        table = _table(rng, self.B, self.PW, self.NP)
+        outs_c, outs_p = self._run_both(
+            rng, table, dirty_rng=np.random.default_rng(7))
+        for a, b in zip(outs_c, outs_p):
+            np.testing.assert_array_equal(a, b)
+
+    def test_page_assignment_invariance(self, rng):
+        """Two different page assignments (one into a dirty pool) produce
+        bitwise-identical logits: fragmentation is invisible."""
+        t1 = _table(np.random.default_rng(1), self.B, self.PW, self.NP)
+        t2 = _table(np.random.default_rng(2), self.B, self.PW, self.NP)
+        _, p1 = self._run_both(np.random.default_rng(0), t1)
+        _, p2 = self._run_both(np.random.default_rng(0), t2,
+                               dirty_rng=np.random.default_rng(9))
+        for a, b in zip(p1, p2):
+            np.testing.assert_array_equal(a, b)
+
+    def test_mixed_positions_slot_independence(self, rng):
+        """Slots at DIFFERENT positions: a slot's logits depend only on
+        its own row/pages — other slots' contents are invisible."""
+        params = _params()
+        R, PS, PW = 3, 4, 3
+        toks = np.asarray(rng.integers(0, CFG.vocab, (R, 8)), np.int32)
+        pool = _fresh_pool(24, PS)
+        table = _table(rng, R, PW, 24)
+        # prefill all slots to DIFFERENT lengths (4, 6, 8) via one padded
+        # chunk each, then a mixed-pos decode step
+        lens = np.array([4, 6, 8], np.int32)
+        chunk = toks.copy()
+        for r_, L in enumerate(lens):
+            chunk[r_, L:] = 0
+        _, pool = dec.forward_paged(
+            params, jnp.asarray(chunk), pool, jnp.asarray(table),
+            jnp.zeros((R,), jnp.int32), CFG, page_size=PS)
+        step_tok = jnp.asarray(toks[np.arange(R), lens - 1])[:, None]
+        got, _ = dec.forward_paged(
+            params, step_tok, pool, jnp.asarray(table),
+            jnp.asarray(lens - 1), CFG, page_size=PS)
+        # arm 2: same slot-0 content, different other slots
+        pool2 = _fresh_pool(24, PS, dirty_rng=np.random.default_rng(3))
+        toks2 = toks.copy()
+        toks2[1:] = np.asarray(
+            rng.integers(0, CFG.vocab, (R - 1, 8)), np.int32)
+        chunk2 = toks2.copy()
+        lens2 = np.array([4, 3, 5], np.int32)
+        lens2[0] = lens[0]
+        for r_, L in enumerate(lens2):
+            chunk2[r_, L:] = 0
+        _, pool2 = dec.forward_paged(
+            params, jnp.asarray(chunk2), pool2, jnp.asarray(table),
+            jnp.zeros((R,), jnp.int32), CFG, page_size=PS)
+        step2 = np.asarray(toks2[np.arange(R), lens2 - 1])[:, None]
+        step2[0] = np.asarray(step_tok)[0]
+        got2, _ = dec.forward_paged(
+            params, jnp.asarray(step2), pool2, jnp.asarray(table),
+            jnp.asarray(lens2 - 1), CFG, page_size=PS)
+        np.testing.assert_array_equal(np.asarray(got)[0],
+                                      np.asarray(got2)[0])
+
+    def test_inactive_slot_writes_are_redirected(self, rng):
+        """An inactive slot whose table row holds LIVE pages (a
+        prefilling co-resident) must not have them clobbered by the
+        masked decode write — the zero write lands in the null page."""
+        params = _params()
+        R, PS, PW = 2, 4, 2
+        pool = _fresh_pool(8, PS)
+        table = _table(rng, R, PW, 8)
+        chunk = np.asarray(rng.integers(1, CFG.vocab, (R, 4)), np.int32)
+        _, pool = dec.forward_paged(
+            params, jnp.asarray(chunk), pool, jnp.asarray(table),
+            jnp.zeros((R,), jnp.int32), CFG, page_size=PS)
+        before = [np.asarray(pl["k"]) for pl in pool]
+        act = jnp.asarray([True, False])
+        _, pool2 = dec.forward_paged(
+            params, jnp.asarray([[5], [9]], jnp.int32), pool,
+            jnp.asarray(table), jnp.asarray([4, 0], jnp.int32), CFG,
+            page_size=PS, active=act)
+        slot1_pages = table[1]
+        for pl_before, pl_after in zip(before, pool2):
+            after = np.asarray(pl_after["k"])
+            np.testing.assert_array_equal(after[slot1_pages],
+                                          pl_before[slot1_pages])
+
+    def test_bitwise_under_tp2(self, rng):
+        """Divisible branch (tp=2 | n_kv=2): paged == contiguous bitwise
+        INSIDE the same shard_map (same psum order on both arms)."""
+        self._tp_parity(rng, tp=2)
+
+    def test_bitwise_under_kv_replication_tp4(self, rng):
+        """kv-head replication branch (tp=4 > n_kv=2): each rank slices
+        ONE kv head and pages just that head — paged == contiguous
+        bitwise per rank.  (The replication branch previously had no
+        paged-path coverage.)"""
+        self._tp_parity(rng, tp=4)
+
+    def _tp_parity(self, rng, tp):
+        params = _params()
+        B, PS, PW, NP = 2, 4, 3, 8
+        Smax = PW * PS
+        kvl = dec.kv_local_heads(CFG, tp)
+        toks = np.asarray(rng.integers(0, CFG.vocab, (B, 8)), np.int32)
+        table = jnp.asarray(_table(rng, B, PW, NP))
+        sched = _schedule(toks, 4)
+        mesh = Mesh(np.array(jax.devices()[:tp]), ("tp",))
+        specs = llama.param_specs(CFG, tp_axis="tp", tp_size=tp)
+
+        def contig(p, t):
+            cache = dec.init_cache(CFG, B, Smax, tp_size=tp)
+            outs = []
+            for chunk, p0 in sched:
+                lg, cache = dec.forward(p, jnp.asarray(chunk), cache,
+                                        jnp.int32(p0), CFG, tp_axis="tp")
+                outs.append(lg)
+            return jnp.stack(outs[len(outs) - 4:])
+
+        def paged(p, t):
+            shape = (NP, kvl, PS, CFG.head_dim)
+            pool = [{"k": jnp.zeros(shape, DT), "v": jnp.zeros(shape, DT)}
+                    for _ in range(CFG.n_layers)]
+            outs = []
+            for chunk, p0 in sched:
+                lg, pool = dec.forward_paged(
+                    p, jnp.asarray(chunk), pool, table,
+                    jnp.full((B,), p0, jnp.int32), CFG, page_size=PS,
+                    tp_axis="tp")
+                outs.append(lg)
+            return jnp.stack(outs[len(outs) - 4:])
+
+        toks_j = jnp.asarray(toks)
+        want = jax.jit(jax.shard_map(
+            contig, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+            check_vma=False))(params, toks_j)
+        got = jax.jit(jax.shard_map(
+            paged, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+            check_vma=False))(params, toks_j)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestAllocator:
+    def test_null_page_reserved_and_alloc_order(self):
+        a = PageAllocator(6)
+        got = a.alloc(5)
+        assert got == [1, 2, 3, 4, 5] and NULL_PAGE not in got
+        assert a.alloc(1) is None and a.free == 0
+
+    def test_free_recycles_lifo_and_peak(self):
+        a = PageAllocator(6)
+        first = a.alloc(3)
+        assert a.peak_in_use == 3
+        a.free_pages(first)
+        assert a.in_use == 0 and a.peak_in_use == 3
+        again = a.alloc(2)
+        assert set(again) <= set(first)      # recycled (dirty by design)
+
+    def test_never_partial(self):
+        a = PageAllocator(4)
+        a.alloc(2)
+        assert a.alloc(2) is None and a.free == 1
+
+    def test_double_free_detected(self):
+        a = PageAllocator(4)
+        pages = a.alloc(2)
+        a.free_pages(pages)
+        with pytest.raises(RuntimeError, match="double-free"):
+            a.free_pages(pages)
+
+    def test_out_of_pool_page_rejected(self):
+        a = PageAllocator(4)
+        with pytest.raises(ValueError):
+            a.free_pages([0])
+
+
+class TestServeConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            ServeConfig(n_pages=1)
+        with pytest.raises(ValueError):
+            ServeConfig(max_reqs=0)
+
+    def test_derived(self):
+        s = ServeConfig(page_size=4, max_pages_per_seq=3, n_pages=10)
+        assert s.max_seq == 12 and s.usable_pages == 9
+        assert s.pages_for(0) == 0 and s.pages_for(1) == 1
+        assert s.pages_for(4) == 1 and s.pages_for(5) == 2
+
+
+def _req(uid, plen, max_new=4, rng=None):
+    r = rng or np.random.default_rng(uid)
+    return Request(uid=uid, prompt=r.integers(0, 64, plen).astype(np.int32),
+                   max_new=max_new)
+
+
+class TestBatcher:
+    def _mk(self, max_reqs=2, page_size=4, n_pages=7, width=4):
+        scfg = ServeConfig(max_reqs=max_reqs, page_size=page_size,
+                           n_pages=n_pages, max_pages_per_seq=width,
+                           prefill_chunk=4)
+        return scfg, ContinuousBatcher(scfg, PageAllocator(n_pages))
+
+    def test_validate_rejects_oversize(self):
+        scfg, b = self._mk()
+        with pytest.raises(ValueError, match="max_seq"):
+            b.enqueue(_req(1, 14, max_new=4))
+        # fits one table row but not the usable pool (3 pages < 4)
+        scfg2, b2 = self._mk(n_pages=4)
+        with pytest.raises(ValueError, match="usable"):
+            b2.enqueue(_req(1, 12, max_new=4))
+
+    def test_admit_fifo_and_watermark(self):
+        scfg, b = self._mk(max_reqs=2, n_pages=5)
+        b.enqueue(_req(1, 8))          # needs 3 pages for replay+1
+        b.enqueue(_req(2, 8))
+        admitted = b.admit()
+        assert [r.uid for r in admitted] == [1]   # watermark blocks #2
+        assert b.slots[admitted[0].slot] is admitted[0]
+
+    def test_ensure_pages_grows_table(self):
+        scfg, b = self._mk()
+        b.enqueue(_req(1, 6))
+        (req,) = b.admit()
+        assert b.ensure_pages(req, 6)
+        assert (b.table[req.slot, :2] > 0).all()
+        assert b.table[req.slot, 2] == NULL_PAGE
+        assert b.pages_in_use() == 2
+
+    def test_eviction_picks_newest_and_requeues_front(self):
+        scfg, b = self._mk(max_reqs=2, n_pages=5)   # 4 usable pages
+        b.enqueue(_req(1, 6))
+        b.enqueue(_req(2, 6))
+        r1, r2 = b.admit()                          # 2 pages committed each
+        assert b.ensure_pages(r1, 6)                # 2 pages
+        assert b.ensure_pages(r2, 6)                # 2 pages, pool dry
+        r2.generated.extend([7, 8])
+        # r1 now needs a third page: r2 (newest) must be evicted
+        assert b.ensure_pages(r1, 9)
+        assert r2.state == WAITING and r2.slot == -1
+        assert b.waiting and b.waiting[0] is r2
+        assert r2.generated == [7, 8]               # kept for replay
+        assert r2.replay_len == r2.prompt_len + 1   # replays all but last
+        assert b.evictions == 1
+
+    def test_lone_request_never_self_evicts(self):
+        scfg, b = self._mk(max_reqs=1, n_pages=4)
+        b.enqueue(_req(1, 6, max_new=2))
+        (req,) = b.admit()
+        assert b.ensure_pages(req, 8)               # uses 2 of 3 pages
+        # pool exhausted and no OTHER request to evict: ensure returns
+        # False (starved this tick) instead of self-evicting/deadlocking
+        assert b.ensure_pages(req, 13) is False
+        assert req.state != WAITING and b.evictions == 0
+
+    def test_release_all_orders_by_uid(self):
+        scfg, b = self._mk(max_reqs=2, n_pages=9)
+        b.enqueue(_req(2, 4))
+        b.enqueue(_req(3, 4))
+        for r in b.admit():
+            b.ensure_pages(r, 4)
+        live = b.release_all()
+        assert [r.uid for r in b.waiting] == sorted(r.uid for r in live)
+        assert (b.table == NULL_PAGE).all() and not b.live
+
+
+class TestRequestQueue:
+    def test_arrival_gating(self):
+        q = RequestQueue()
+        q.submit(np.array([1, 2], np.int32), 2)
+        q.submit(np.array([3], np.int32), 2, not_before_s=30.0)
+        got = q.pop_arrived()
+        assert [r.uid for r in got] == [1]
+        assert q.pending == 1
+        assert 0.0 < q.next_arrival_in() <= 30.0
+
+    def test_validation(self):
+        q = RequestQueue()
+        with pytest.raises(ValueError):
+            q.submit(np.array([], np.int32), 2)
+        with pytest.raises(ValueError):
+            q.submit(np.array([1], np.int32), 0)
+
+    def test_threaded_submit_unique_uids(self):
+        q = RequestQueue()
+
+        def worker():
+            for _ in range(50):
+                q.submit(np.array([1], np.int32), 1)
+
+        ts = [threading.Thread(target=worker) for _ in range(4)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        got = q.pop_arrived()
+        uids = [r.uid for r in got]
+        assert len(uids) == 200 == len(set(uids))
+        assert q.stats.as_dict()["submitted"] == 200
+
+
+class TestRequestSpans:
+    def test_summary_percentiles(self):
+        spans = RequestSpans()
+        for i in range(20):
+            spans.record(i, t_submit=0.0, t_admit=0.1, t_first=0.2 + i,
+                         t_done=1.2 + i, n_tokens=5)
+        s = spans.summary()
+        assert s["completed"] == 20 and s["samples_dropped"] == 0
+        assert s["queue_wait_mean_s"] == pytest.approx(0.1)
+        assert s["ttft_p95_s"] >= s["ttft_p50_s"]
+        assert s["tpot_mean_s"] == pytest.approx(0.25)
+
+    def test_bounded_with_drop_accounting(self):
+        spans = RequestSpans(max_samples=4)
+        for i in range(6):
+            spans.record(i, t_submit=0.0, t_admit=0.0, t_first=1.0,
+                         t_done=2.0, n_tokens=2)
+        s = spans.summary()
+        assert s["completed"] == 6 and s["samples_dropped"] == 2
+
+    def test_span_lands_on_stream(self):
+        from fpga_ai_nic_tpu.obs.events import EventStream
+        ev = EventStream()
+        spans = RequestSpans(ev)
+        spans.record(9, t_submit=1.0, t_admit=1.1, t_first=1.5,
+                     t_done=2.0, n_tokens=3)
+        recs = [e for e in ev.snapshot() if e["name"] == "serve.request"]
+        assert len(recs) == 1
+        assert recs[0]["attrs"]["uid"] == 9
+        assert recs[0]["attrs"]["lane"] == "serve"
+
+    def test_percentile_nearest_rank(self):
+        assert percentile([1.0, 2.0, 3.0, 4.0], 0.0) == 1.0
+        assert percentile([1.0, 2.0, 3.0, 4.0], 100.0) == 4.0
+        assert percentile([1.0], 50.0) == 1.0
+
+
+def _mk_engine(scfg, plan=None):
+    params = _params()
+    return ServeEngine(params, CFG, scfg, chaos=plan), params
+
+
+def _reference(params, prompts, max_new):
+    out = []
+    for p in prompts:
+        full = np.asarray(dec.generate(
+            params, jnp.asarray(p, jnp.int32)[None], max_new, CFG))[0]
+        out.append(full[len(p):].tolist())
+    return out
+
+
+@pytest.fixture(scope="module")
+def serve_world():
+    """Shared prompts + greedy reference continuations (module-scoped:
+    the reference generate() compile is paid once)."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+               for n in rng.integers(4, 14, 6)]
+    params = _params()
+    return params, prompts, _reference(params, prompts, 5)
+
+
+class TestEngine:
+    SCFG = ServeConfig(max_reqs=4, page_size=4, n_pages=40,
+                       max_pages_per_seq=6, prefill_chunk=6)
+
+    def test_end_to_end_matches_generate(self, serve_world):
+        params, prompts, ref = serve_world
+        eng = ServeEngine(params, CFG, self.SCFG)
+        reqs = [eng.submit(p, max_new=5) for p in prompts]
+        s = eng.run()
+        assert s["completed"] == len(prompts)
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+        assert s["recompiles_steady"] == 0
+        assert s["trace_counts"] == {"prefill": 1, "decode": 1}
+
+    def test_tight_pool_evicts_but_stays_token_exact(self, serve_world):
+        params, prompts, ref = serve_world
+        scfg = ServeConfig(max_reqs=4, page_size=4, n_pages=9,
+                           max_pages_per_seq=6, prefill_chunk=6)
+        eng = ServeEngine(params, CFG, scfg)
+        reqs = [eng.submit(p, max_new=5) for p in prompts]
+        s = eng.run()
+        assert s["evictions"] > 0
+        # the cross-thread ServeStats counter must agree with the
+        # batcher's own count (review regression: record_evicted was
+        # never wired, so artifacts carried a contradictory zero)
+        assert s["evicted"] == s["evictions"]
+        assert s["recompiles_steady"] == 0
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_staggered_arrivals_and_queue_wait(self, serve_world):
+        params, prompts, ref = serve_world
+        eng = ServeEngine(params, CFG, self.SCFG)
+        reqs = [eng.submit(p, max_new=5, not_before_s=0.02 * i)
+                for i, p in enumerate(prompts)]
+        s = eng.run()
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+        assert s["requests"]["queue_wait_mean_s"] >= 0.0
+
+    def test_eos_stops_early(self, serve_world):
+        params, prompts, ref = serve_world
+        eng = ServeEngine(params, CFG, self.SCFG)
+        eos = ref[0][1]                      # second greedy token
+        req = eng.submit(prompts[0], max_new=5, eos_id=int(eos))
+        eng.run()
+        assert req.generated == ref[0][:2]   # stopped AT the eos token
+
+    def test_prefill_pad_overrun_cannot_corrupt_live_pages(self):
+        """Review regression: a final prefill chunk whose zero-padding
+        overruns max_seq used to have its pad positions CLAMPED onto the
+        last live page (corrupting real K/V at the same offsets); they
+        must be redirected to the null page.  Exact repro config: chunk 5
+        over replay_len 6 pads positions 6..9 with 8,9 out of range."""
+        params = _params()
+        rng = np.random.default_rng(3)
+        prompt = rng.integers(0, CFG.vocab, 6).astype(np.int32)
+        want = _reference(params, [prompt], 2)[0]
+        scfg = ServeConfig(max_reqs=1, page_size=4, n_pages=4,
+                           max_pages_per_seq=2, prefill_chunk=5)
+        eng = ServeEngine(params, CFG, scfg)
+        req = eng.submit(prompt, max_new=2)
+        eng.run()
+        assert req.generated == want
+
+    def test_submit_validates_against_budget(self, serve_world):
+        params, _, _ = serve_world
+        eng = ServeEngine(params, CFG, self.SCFG)
+        with pytest.raises(ValueError, match="max_seq"):
+            eng.submit(np.arange(30, dtype=np.int32), max_new=10)
+
+    def test_static_byte_accounting_is_exact(self, serve_world):
+        """pool_bytes / page_table_bytes / contiguous_cache_bytes must
+        equal the ACTUAL array sizes — they feed the two-sided obs
+        gate."""
+        params, _, _ = serve_world
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=11,
+                           max_pages_per_seq=5, prefill_chunk=4)
+        eng = ServeEngine(params, CFG, scfg)
+        m = eng.obs_static_metrics()["serve"]
+        actual_pool = sum(int(pl[k].size) * pl[k].dtype.itemsize
+                          for pl in eng.pool for k in ("k", "v"))
+        assert m["pool_bytes"] == actual_pool
+        assert m["page_table_bytes"] == eng.batcher.table.nbytes
+        cache = dec.init_cache(CFG, scfg.max_reqs, scfg.max_seq)
+        actual_contig = sum(int(c[k].size) * c[k].dtype.itemsize
+                            for c in cache for k in ("k", "v"))
+        assert m["contiguous_cache_bytes"] == actual_contig
+        # the point of paging: the pool is smaller than the contiguous
+        # worst case for the same concurrency
+        assert m["pool_bytes"] < m["contiguous_cache_bytes"]
+
+    def test_request_spans_on_event_stream(self, serve_world):
+        params, prompts, _ = serve_world
+        eng = ServeEngine(params, CFG, self.SCFG)
+        for p in prompts[:3]:
+            eng.submit(p, max_new=3)
+        eng.run()
+        names = [e["name"] for e in eng.profiler.events.snapshot()]
+        assert names.count("serve.request") == 3
+        assert "serve.submit" in names and "serve.tick" in names
+
+
+class TestEngineChaos:
+    """Request-level SLO under fault: recovery must reproduce the EXACT
+    fault-free token stream (greedy determinism is the SLO's teeth)."""
+
+    SCFG = ServeConfig(max_reqs=3, page_size=4, n_pages=24,
+                       max_pages_per_seq=6, prefill_chunk=6,
+                       step_timeout_s=2.0)
+
+    def _run(self, plan, scfg=None):
+        params = _params()
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+                   for n in rng.integers(4, 10, 4)]
+        ref = _reference(params, prompts, 4)
+        eng = ServeEngine(params, CFG, scfg or self.SCFG, chaos=plan)
+        reqs = [eng.submit(p, max_new=4) for p in prompts]
+        with chaos.activate(plan):
+            s = eng.run()
+        return s, reqs, ref
+
+    def test_preemption_recovers_token_exact(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("preemption", "serve.step", step=3)])
+        s, reqs, ref = self._run(plan)
+        assert s["serve_recoveries"] == 1
+        assert s["recovery"]["faults"] == {"preemption": 1}
+        assert s["recompiles_steady"] == 0
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_hang_detected_by_watchdog(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("hang", "serve.step", step=2,
+                             duration_s=2.0)])
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=24,
+                           max_pages_per_seq=6, prefill_chunk=6,
+                           step_timeout_s=0.8)
+        s, reqs, ref = self._run(plan, scfg)
+        assert s["recovery"]["faults"].get("hang", 0) >= 1
+        assert s["serve_recoveries"] >= 1
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_slowdown_absorbed_without_recovery(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("slowdown", "serve.step", step=1,
+                             duration_s=0.1)])
+        s, reqs, ref = self._run(plan)
+        assert s["serve_recoveries"] == 0
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_transient_exception_retried(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("exception", "serve.step", step=1)])
+        s, reqs, ref = self._run(plan)
+        assert s["serve_recoveries"] == 1
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_retry_budget_exhausts_loudly(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("preemption", "serve.step", step=0)
+             for _ in range(4)])
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=24,
+                           max_pages_per_seq=6, prefill_chunk=6,
+                           max_retries=1, backoff_s=0.0)
+        params = _params()
+        eng = ServeEngine(params, CFG, scfg, chaos=plan)
+        eng.submit(np.arange(1, 6, dtype=np.int32), max_new=3)
+        with chaos.activate(plan), \
+                pytest.raises(chaos.InjectedPreemption):
+            eng.run()
+
+
+class TestTraceStability:
+    """The J10 pytest twin: one engine, a churny scripted schedule
+    (admissions, evictions, mixed prefill/decode, page recycling) —
+    each jitted program must trace exactly once."""
+
+    def test_trace_once_across_churn(self):
+        params = _params()
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=5,
+                           max_pages_per_seq=4, prefill_chunk=4)
+        eng = ServeEngine(params, CFG, scfg)
+        rng = np.random.default_rng(11)
+        # two waves with different lengths/arrival patterns
+        for i in range(5):
+            eng.submit(rng.integers(0, CFG.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=int(rng.integers(2, 6)))
+        eng.run()
+        for i in range(4):
+            eng.submit(rng.integers(0, CFG.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=3, not_before_s=0.01 * i)
+        s = eng.run()
+        assert s["evictions"] > 0, "schedule failed to exercise eviction"
+        assert eng.trace_counts() == {"prefill": 1, "decode": 1}
+        assert s["recompiles_steady"] == 0

@@ -459,7 +459,9 @@ class TestLockset:
 # ---------------------------------------------------------------------------
 
 NEW_STRICT = ["fpga_ai_nic_tpu/parallel/reshard.py",
-              "fpga_ai_nic_tpu/tune", "fpga_ai_nic_tpu/verify"]
+              "fpga_ai_nic_tpu/tune", "fpga_ai_nic_tpu/verify",
+              "fpga_ai_nic_tpu/serve",
+              "fpga_ai_nic_tpu/runtime/requests.py"]
 
 
 class TestStrictAnnotations:

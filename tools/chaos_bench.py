@@ -133,7 +133,9 @@ def _data(n=64):
 
 
 def _legal_cells():
-    for site in chaos.SITES:
+    # the TRAINING matrix: serve.step is the serving plane's site and has
+    # its own cell battery (run_serve_cells) with a request-level verdict
+    for site in chaos.TRAIN_SITES:
         for kind in chaos.FAULT_KINDS:
             if site == "collective" and kind in ("exception", "preemption"):
                 continue
@@ -330,6 +332,113 @@ def run_shrink_cell(rig: WireRig, ecfg: ElasticConfig, n_steps: int,
     return cell
 
 
+# ---------------------------------------------------------------------------
+# serving cells: request-level SLO under fault (docs/SERVING.md)
+# ---------------------------------------------------------------------------
+
+SERVE_FAULTS = ("hang", "slowdown", "exception", "preemption")
+SERVE_FAULT_TICK = 3        # mid-run: prefill and decode both in flight
+
+
+class ServeRig:
+    """One serving workload + its fault-free reference token streams.
+    Greedy decode is deterministic, so the reference run IS the SLO: a
+    faulted run must complete every request with the IDENTICAL tokens —
+    recovery that loses or corrupts a request cannot hide behind
+    latency."""
+
+    def __init__(self):
+        from fpga_ai_nic_tpu.models import llama as llama_lib
+        self.llama_cfg = llama_lib.LlamaConfig.tiny()
+        self.params = llama_lib.init(jax.random.PRNGKey(0), self.llama_cfg)
+        rng = np.random.default_rng(SEED)
+        self.prompts = [rng.integers(0, self.llama_cfg.vocab,
+                                     int(n)).astype(np.int32)
+                        for n in rng.integers(4, 12, 6)]
+        self.max_new = 5
+        ref_eng, ref_reqs, _ = self.serve(None, None)
+        self.reference = [list(r.generated) for r in ref_reqs]
+
+    def scfg(self, timeout_s):
+        from fpga_ai_nic_tpu.serve import ServeConfig
+        return ServeConfig(max_reqs=3, page_size=4, n_pages=14,
+                           max_pages_per_seq=5, prefill_chunk=6,
+                           step_timeout_s=timeout_s, backoff_s=0.01)
+
+    def serve(self, plan, timeout_s):
+        from fpga_ai_nic_tpu.serve import ServeEngine
+        eng = ServeEngine(self.params, self.llama_cfg,
+                          self.scfg(timeout_s), chaos=plan)
+        reqs = [eng.submit(p, max_new=self.max_new) for p in self.prompts]
+        with chaos.activate(plan):
+            summary = eng.run()
+        return eng, reqs, summary
+
+
+def run_serve_cell(rig: ServeRig, kind: str, timeout_s: float,
+                   hang_s: float, slow_s: float) -> dict:
+    t0 = time.time()
+    dur = hang_s if kind == "hang" else slow_s
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec(kind, "serve.step", step=SERVE_FAULT_TICK,
+                         duration_s=dur)], seed=SEED)
+    cell = {"kind": kind, "site": "serve.step", "wire": "serve",
+            "requests": len(rig.prompts), "max_new": rig.max_new}
+    try:
+        eng, reqs, s = rig.serve(plan, timeout_s)
+    except Exception as err:  # noqa: BLE001 — the cell verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    completed = s["completed"] == len(rig.prompts)
+    token_exact = all(list(q.generated) == want
+                      for q, want in zip(reqs, rig.reference))
+    injected = len(plan.fired) >= 1
+    if kind == "slowdown":
+        # a straggler tick below the watchdog limit: absorb, no recovery
+        cell["absorbed"] = (completed and injected
+                            and s["serve_recoveries"] == 0)
+        ok = cell["absorbed"]
+    else:
+        cell["recovered"] = (completed and injected
+                             and s["serve_recoveries"] >= 1
+                             and s["recovery"]["faults"].get(
+                                 "preemption" if kind == "preemption"
+                                 else kind, 0) >= 1)
+        ok = cell["recovered"]
+    r = s["requests"]
+    cell.update(
+        ok=bool(ok and token_exact and s["recompiles_steady"] == 0),
+        token_exact=token_exact,
+        serve_recoveries=s["serve_recoveries"],
+        faults=s["recovery"]["faults"],
+        mttr_mean_s=round(s["recovery"]["mttr_mean_s"], 4),
+        recompiles_steady=s["recompiles_steady"],
+        evictions=s["evictions"],
+        ttft_p95_s=r.get("ttft_p95_s"),
+        latency_p95_s=r.get("latency_p95_s"),
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_serve_cells(timeout_s: float, hang_s: float,
+                    slow_s: float) -> list:
+    rig = ServeRig()
+    cells = []
+    for kind in SERVE_FAULTS:
+        cell = run_serve_cell(rig, kind, timeout_s, hang_s, slow_s)
+        verdict = ("recovered" if cell.get("recovered")
+                   else "absorbed" if cell.get("absorbed")
+                   else "FAILED")
+        log(f"cell serve {kind:10s} @ serve.step  : {verdict:9s} "
+            f"token_exact={cell.get('token_exact')} "
+            f"recoveries={cell.get('serve_recoveries')} "
+            f"({cell['wall_s']:.1f}s)")
+        cells.append(cell)
+    return cells
+
+
 RESHARD_CODECS = (None, "bfp", "topk", "int8")
 
 
@@ -450,6 +559,10 @@ def main() -> int:
                          "always full)")
     ap.add_argument("--wire", choices=sorted(WIRES), default=None,
                     help="restrict to one wire format (default: all)")
+    ap.add_argument("--serve-only", action="store_true",
+                    help="run ONLY the serving SLO-under-fault cells "
+                         "(the CI-sized gate; the full matrix also "
+                         "includes them)")
     ap.add_argument("--reshard-bench", action="store_true",
                     help="run the trainer x codec reshard-vs-restore MTTR "
                          "matrix instead of the fault matrix (banked as "
@@ -472,6 +585,30 @@ def main() -> int:
     plat = jax.devices()[0].platform
     log(f"platform={plat} devices={len(jax.devices())} fast={args.fast}")
     chaos.install_collective_tap()     # before any step is traced
+
+    if args.serve_only:
+        serve_cells = run_serve_cells(timeout_s, hang_s, slow_s)
+        result = {
+            "bench": "chaos_serve",
+            "fast": args.fast,
+            "platform": plat,
+            "n_devices": len(jax.devices()),
+            "dryrun": plat != "tpu",
+            "serve_cells": serve_cells,
+            "ok": all(c["ok"] for c in serve_cells),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+        if not args.no_artifact:
+            save_artifact("chaos_serve", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "serve_cells"} |
+                         {"serve_cells_ok":
+                          sum(c["ok"] for c in serve_cells),
+                          "serve_cells_total": len(serve_cells)},
+                         indent=1))
+        return 0 if result["ok"] else 1
 
     if args.reshard_bench:
         result = run_reshard_bench(ecfg, plat)
@@ -517,6 +654,10 @@ def main() -> int:
             f"({soak['wall_s']:.1f}s)")
         soaks.append(soak)
 
+    # the serving plane's cell battery: request-level SLO (completion +
+    # token-exactness + recovery class) under the same fault kinds
+    serve_cells = run_serve_cells(timeout_s, hang_s, slow_s)
+
     result = {
         "bench": "chaos_matrix",
         "fast": args.fast,
@@ -524,12 +665,15 @@ def main() -> int:
         "n_devices": len(jax.devices()),
         "dryrun": plat != "tpu",       # CPU-mesh evidence, marked as such
         "matrix": {"kinds": list(chaos.FAULT_KINDS),
-                   "sites": list(chaos.SITES), "wires": wires},
+                   "sites": list(chaos.TRAIN_SITES), "wires": wires,
+                   "serve_site": "serve.step"},
         "cells": cells,
         "shrink_cells": shrink_cells,
+        "serve_cells": serve_cells,
         "soak": soaks,
         "ok": (all(c["ok"] for c in cells)
                and all(c["ok"] for c in shrink_cells)
+               and all(c["ok"] for c in serve_cells)
                and all(s["ok"] for s in soaks)),
     }
     if args.out:

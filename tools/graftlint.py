@@ -51,7 +51,9 @@ STRICT_CORE = ["fpga_ai_nic_tpu/compress", "fpga_ai_nic_tpu/obs",
                "fpga_ai_nic_tpu/runtime/queue.py",
                "fpga_ai_nic_tpu/parallel/reshard.py",
                "fpga_ai_nic_tpu/tune",
-               "fpga_ai_nic_tpu/verify"]
+               "fpga_ai_nic_tpu/verify",
+               "fpga_ai_nic_tpu/serve",
+               "fpga_ai_nic_tpu/runtime/requests.py"]
 
 
 def run_ast(paths) -> int:

@@ -88,6 +88,19 @@ TUNE_GATE_KEYS = ("tuned_measured_ms", "flat_fixed_measured_ms")
 TUNE_BYTE_KEYS = ("tuned_wire_bytes", "tuned_vs_best_fixed")
 TOL_TUNE_TIME = 0.40
 
+# serving rows (SERVE_BENCH_r*.json, one per concurrency): latencies
+# gate lower-is-better, throughput higher; the byte accounting is exact
+# two-sided (pool / page-table / contiguous-equivalent bytes — a drift
+# means the pool layout or ServeConfig changed, J10/paged territory,
+# not noise) and ``recompiles_steady`` is exact against a banked 0, so
+# ANY steady-state recompile fails the gate.  Dryrun (CPU-mesh)
+# artifacts gate only the exact keys — the fused-opt honesty rule.
+SERVE_GATE_KEYS = ("throughput_tok_s", "ttft_mean_s", "ttft_p95_s",
+                   "tpot_mean_s", "pages_in_use_peak")
+SERVE_BYTE_KEYS = ("pool_bytes", "page_table_bytes",
+                   "contiguous_cache_bytes", "recompiles_steady")
+TOL_SERVE_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -107,6 +120,10 @@ def reshard_metric(trainer: str, codec: str, key: str) -> str:
 
 def tune_metric(regime: str, key: str) -> str:
     return f"tune.{regime}.{key}"
+
+
+def serve_metric(max_reqs, key: str) -> str:
+    return f"serve.c{max_reqs}.{key}"
 
 
 def _load(path):
@@ -239,6 +256,27 @@ def build_banked_summary() -> dict:
                 else:
                     m = _metric(v, src, higher=False, tol=TOL_TUNE_TIME)
                 metrics[tune_metric(row["regime"], key)] = m
+
+    # -- serving curve --------------------------------------------------------
+    p = (_newest("artifacts/serve_bench_*.json")
+         or _newest("SERVE_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (SERVE_BYTE_KEYS if d.get("dryrun")
+                else SERVE_BYTE_KEYS + SERVE_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in SERVE_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                elif key == "throughput_tok_s":
+                    m = _metric(v, src, tol=TOL_SERVE_TIME)
+                else:
+                    m = _metric(v, src, higher=False, tol=TOL_SERVE_TIME)
+                metrics[serve_metric(row["max_reqs"], key)] = m
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 

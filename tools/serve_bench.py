@@ -1,0 +1,200 @@
+#!/usr/bin/env python
+"""Serving bench: throughput-vs-latency over the paged continuous-batching
+engine, with the contiguous-cache HBM comparison and the recompile gate.
+
+One fixed request trace (deterministic: seeded prompts, all submitted at
+t0) served at increasing concurrency (`max_reqs` = decode slots): more
+slots batch more decode work per tick (throughput up) while each request
+shares the tick with more peers (TTFT/latency up) — the throughput-vs-
+latency CURVE a serving SLO is negotiated on.  Per row the bench banks:
+
+  - request latency stats (TTFT / TPOT / p95) + tokens/s throughput
+  - EXACT byte accounting: the paged pool + page table vs what
+    `init_cache` would zero-fill up front for the same concurrency at
+    max_seq — the measured version of the `[B, kv, max_seq, hd]`
+    up-front HBM cost documented in docs/PERF.md
+  - pool utilization (peak pages in use / usable pages) and evictions
+  - ``recompiles_steady`` — MUST be 0: the whole schedule (admissions,
+    evictions, page churn) runs on the warmup traces (graftlint J10)
+  - token-exactness: every request's greedy continuation equals the
+    isolated `generate()` reference (the correctness floor under
+    batching/eviction)
+
+CPU rows are dryrun-class: latencies carry oversubscription noise, so
+`make obs-gate` holds dryrun artifacts only to the exact byte accounting
+and the zero-recompile fact (tools/obs_gate.py SERVE_BYTE_KEYS); re-run
+on a TPU surface for a gated latency verdict.
+
+    python tools/serve_bench.py            # bank artifacts/serve_bench_*
+    make serve-bench ROUND=r10             # + snapshot SERVE_BENCH_r10.json
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+
+from bench_common import cpu_env, is_tpu_platform, log, save_artifact  # noqa: E402
+
+# CPU-mesh battery: re-exec once with the virtual CPU environment before
+# jax is imported (same discipline as chaos_bench — the container's
+# sitecustomize registers the TPU tunnel at interpreter start).
+if os.environ.get("_SERVE_BENCH_REEXEC") != "1":
+    env = cpu_env(8)
+    env["_SERVE_BENCH_REEXEC"] = "1"
+    os.execve(sys.executable,
+              [sys.executable, os.path.abspath(__file__)] + sys.argv[1:],
+              env)
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from fpga_ai_nic_tpu.models import llama, llama_decode as dec  # noqa: E402
+from fpga_ai_nic_tpu.serve import ServeConfig, ServeEngine  # noqa: E402
+
+CFG = llama.LlamaConfig.tiny()
+SEED = 17
+N_REQUESTS = 18
+MAX_NEW = 8
+PAGE_SIZE = 8
+PAGES_PER_SEQ = 8                      # max_seq 64: the ADDRESSABLE bound
+CONCURRENCIES = (1, 2, 4, 8)
+# pool provisioning per slot, in pages: the workload's worst request
+# (prompt 16 + 8 new = 24 positions) needs 3 pages, so 3/slot + slack
+# serves the whole trace eviction-free — while init_cache would zero-fill
+# the full max_seq=64 extent per slot.  THAT gap is the paging story.
+POOL_PAGES_PER_SLOT = 3
+
+
+def _workload():
+    rng = np.random.default_rng(SEED)
+    return [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+            for n in rng.integers(4, 17, N_REQUESTS)]
+
+
+def _reference(params, prompts):
+    """Greedy per-request reference continuations (isolated generate)."""
+    out = []
+    for p in prompts:
+        full = np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], MAX_NEW, CFG))[0]
+        out.append(full[len(p):].tolist())
+    return out
+
+
+def run_row(params, prompts, ref, max_reqs: int) -> dict:
+    t0 = time.time()
+    # pool sized to the WORKING SET (see POOL_PAGES_PER_SLOT), not the
+    # addressable worst case init_cache must provision
+    n_pages = max_reqs * POOL_PAGES_PER_SLOT + 3
+    scfg = ServeConfig(max_reqs=max_reqs, page_size=PAGE_SIZE,
+                       n_pages=n_pages, max_pages_per_seq=PAGES_PER_SEQ,
+                       prefill_chunk=PAGE_SIZE)
+    eng = ServeEngine(params, CFG, scfg)
+    reqs = [eng.submit(p, max_new=MAX_NEW) for p in prompts]
+    s = eng.run()
+    exact = all(q.generated == want for q, want in zip(reqs, ref))
+    r = s["requests"]
+    row = {
+        "max_reqs": max_reqs,
+        "n_requests": len(prompts),
+        "steps_total": s["ticks"],
+        "throughput_tok_s": s["throughput_tok_s"],
+        "ttft_mean_s": r.get("ttft_mean_s"),
+        "ttft_p95_s": r.get("ttft_p95_s"),
+        "tpot_mean_s": r.get("tpot_mean_s"),
+        "latency_p95_s": r.get("latency_p95_s"),
+        "queue_wait_mean_s": r.get("queue_wait_mean_s"),
+        "pages_in_use_peak": s["pages_in_use_peak"],
+        "page_util_peak": s["page_util_peak"],
+        "evictions": s["evictions"],
+        "pool_bytes": s["serve"]["pool_bytes"],
+        "page_table_bytes": s["serve"]["page_table_bytes"],
+        "contiguous_cache_bytes": s["serve"]["contiguous_cache_bytes"],
+        "hbm_vs_contiguous": round(
+            s["serve"]["contiguous_cache_bytes"]
+            / s["serve"]["pool_bytes"], 3),
+        "recompiles_steady": s["recompiles_steady"],
+        "trace_counts": s["trace_counts"],
+        "token_exact": exact,
+        "completed": s["completed"],
+        "wall_s": round(time.time() - t0, 2),
+    }
+    row["ok"] = bool(exact and s["completed"] == len(prompts)
+                     and s["recompiles_steady"] == 0)
+    return row
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--out", default=None,
+                    help="also write the result JSON to this path")
+    ap.add_argument("--no-artifact", action="store_true",
+                    help="skip the artifacts/ evidence write")
+    args = ap.parse_args()
+
+    plat = jax.devices()[0].platform
+    log(f"platform={plat} devices={len(jax.devices())}")
+    params = llama.init(jax.random.PRNGKey(0), CFG)
+    prompts = _workload()
+    log(f"phase=reference n={len(prompts)} max_new={MAX_NEW}")
+    ref = _reference(params, prompts)
+
+    rows = []
+    for c in CONCURRENCIES:
+        row = run_row(params, prompts, ref, c)
+        log(f"row max_reqs={c}: {row['throughput_tok_s']} tok/s "
+            f"ttft_p95={row['ttft_p95_s']}s evict={row['evictions']} "
+            f"recompiles={row['recompiles_steady']} "
+            f"hbm x{row['hbm_vs_contiguous']} "
+            f"{'ok' if row['ok'] else 'FAILED'} ({row['wall_s']}s)")
+        rows.append(row)
+
+    top = rows[len(rows) - 1]
+    result = {
+        "bench": "serve",
+        "platform": plat,
+        "n_devices": len(jax.devices()),
+        # CPU rows are dryrun-class: obs-gate holds them only to the
+        # exact byte accounting + zero recompiles (SERVE_BYTE_KEYS)
+        "dryrun": not is_tpu_platform(plat),
+        "model": {"dim": CFG.dim, "n_layers": CFG.n_layers,
+                  "n_heads": CFG.n_heads, "n_kv_heads": CFG.n_kv_heads,
+                  "vocab": CFG.vocab, "dtype": CFG.dtype},
+        "workload": {"n_requests": N_REQUESTS, "max_new": MAX_NEW,
+                     "prompt_lens": [int(p.shape[0]) for p in prompts],
+                     "page_size": PAGE_SIZE,
+                     "max_pages_per_seq": PAGES_PER_SEQ,
+                     "seed": SEED},
+        "rows": rows,
+        # the init_cache comparison at the curve's top concurrency: what
+        # the contiguous [B, kv, max_seq, hd] zero-fill would cost vs
+        # the shared pool actually allocated (docs/PERF.md "Serving")
+        "init_cache_comparison": {
+            "max_reqs": top["max_reqs"],
+            "contiguous_cache_bytes": top["contiguous_cache_bytes"],
+            "paged_pool_bytes": top["pool_bytes"],
+            "page_table_bytes": top["page_table_bytes"],
+            "savings_ratio": top["hbm_vs_contiguous"],
+        },
+        "ok": all(r["ok"] for r in rows),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    if not args.no_artifact:
+        save_artifact("serve_bench", result)
+    print(json.dumps({k: v for k, v in result.items() if k != "rows"} |
+                     {"rows_ok": sum(r["ok"] for r in rows),
+                      "rows_total": len(rows)}, indent=1))
+    return 0 if result["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())
