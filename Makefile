@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -136,6 +136,18 @@ serve-bench:
 	@latest=$$(ls -t artifacts/serve_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest SERVE_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> SERVE_BENCH_$(ROUND).json"
+
+# fleet bench (docs/SERVING.md "The fleet"): the disaggregated
+# prefill/KV-handoff/decode pipeline at steady state + the replica-kill
+# row (a decode replica preempted mid-run, surviving streams
+# byte-identical with zero replay); snapshot the newest artifact as the
+# round's committed record (obs-gate consumes it — dryrun CPU rows gate
+# only the exact handoff accounting, fleet.* keys)
+fleet-bench:
+	python tools/serve_bench.py --fleet
+	@latest=$$(ls -t artifacts/fleet_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest FLEET_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> FLEET_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

@@ -35,6 +35,9 @@ RULE_DOCS: Dict[str, str] = {
     "J10": "serving decode plane: the jitted prefill/decode steps must "
            "trace exactly once across any admit/evict schedule — slot "
            "occupancy and page assignment are VALUES, never shapes",
+    "J11": "KV handoff program: callback-free, source pools donated, and "
+           "ppermute operand bytes == exactly HandoffPlan.wire_bytes() — "
+           "the migrated pages and nothing else cross the pair wire",
     "H1": "happens-before/lockset: an instance attribute written from two "
           "threads (trainer / watchdog worker / callback) needs a common "
           "lock — R1 generalized to cross-thread order",
@@ -45,7 +48,7 @@ RULE_DOCS: Dict[str, str] = {
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8", "J9", "J10")
+                                "J8", "J9", "J10", "J11")
 
 
 @dataclass(frozen=True)

@@ -852,6 +852,110 @@ def run_j10(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J11 — the serving-plane KV handoff program (serve.handoff).  The
+# fleet's zero-replay claim rests on the migration being a pure
+# device-side transfer that moves EXACTLY the migrated pages: like J8
+# for the training reshard, the lowered pair-ppermute program is traced
+# abstractly and must be callback-free, donate every pool operand, and
+# move ppermute operand bytes == HandoffPlan.wire_bytes() precisely
+# (page ids / table rows / host tokens are declared as host_bytes,
+# never smuggled into the wire accounting).  Surfaces cover a single
+# page, a multi-page multi-layer move, and a GQA (kv_local > 1) pool.
+# ---------------------------------------------------------------------------
+
+def _j11_build(n_layers: int, kv_local: int, page_size: int,
+               head_dim: int, n_pages: int, n_move: int):
+    def build():
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..serve import handoff as handoff_lib
+
+        plan = handoff_lib.make_plan(
+            n_layers=n_layers, kv_local=kv_local, page_size=page_size,
+            head_dim=head_dim, n_pages=n_pages, n_move=n_move)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("rep",))
+        fn = handoff_lib.lower_apply(plan, mesh, "rep", donate=True)
+        jx = jax.make_jaxpr(fn)(*handoff_lib.abstract_operands(plan))
+        return jx, plan.wire_bytes(), 2 * n_layers
+    return build
+
+
+def check_handoff_program(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J11 surface: build() -> (closed jaxpr, declared wire
+    bytes, donated pool-operand count)."""
+    findings: List[Finding] = []
+    jx, declared, n_pool = build()
+    c = _collect(jx.jaxpr)
+    cell = f"jaxpr[handoff {name}]"
+    if c["callbacks"]:
+        findings.append(Finding(
+            "J11", cell, 0,
+            f"{c['callbacks']} callback primitive(s) in the handoff "
+            "program — a migration that round-trips the host is "
+            "replay-from-prompt wearing a costume"))
+    if c["wire_unknown"]:
+        findings.append(Finding(
+            "J11", cell, 0,
+            "ppermute under a while_loop — handoff bytes not statically "
+            "accountable (lower with static page counts, dynamic page "
+            "IDS as operands)"))
+    elif c["wire_bytes"] != declared:
+        findings.append(Finding(
+            "J11", cell, 0,
+            f"the lowered program's ppermute operands move "
+            f"{c['wire_bytes']} bytes but the HandoffPlan declares "
+            f"{declared} — the fleet's handoff wire accounting (MTTR "
+            "claims, FLEET_BENCH gate) is lying"))
+    donated = c["donated"] or ()
+    if sum(donated) < n_pool:
+        findings.append(Finding(
+            "J11", cell, 0,
+            f"expected all {n_pool} pool operands donated, pjit "
+            f"donated_invars shows {sum(donated)}/{len(donated)} — the "
+            "transfer holds two full pools in memory"))
+    return findings
+
+
+def j11_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs; GRAFTLINT_J11_FIXTURE appends a surface from
+    a module path exposing ``build()`` — the bad-fixture / exit-code
+    hook, same contract as J7–J10's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("1 page 2 layers", _j11_build(2, 2, 4, 8, 8, 1)),
+        ("5 pages 3 layers", _j11_build(3, 1, 8, 16, 12, 5)),
+        ("gqa kv=4 3 pages", _j11_build(2, 4, 4, 8, 10, 3)),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J11_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j11_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j11(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j11_surfaces():
+        try:
+            fs = check_handoff_program(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J11", f"jaxpr[handoff {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] handoff {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -948,4 +1052,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_j8(verbose=verbose))
     findings.extend(run_j9(verbose=verbose))
     findings.extend(run_j10(verbose=verbose))
+    findings.extend(run_j11(verbose=verbose))
     return findings

@@ -65,7 +65,7 @@ from __future__ import annotations
 
 import time
 from dataclasses import dataclass
-from typing import Any, Callable, Dict, Optional, Tuple, Union
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
 
 import jax
 import numpy as np
@@ -90,27 +90,50 @@ class RecoveryExhausted(RuntimeError):
 @dataclass
 class ReshardPolicy:
     """Arms the FIRST recovery tier: survive a preemption by migrating the
-    live TrainState to a smaller mesh (parallel.reshard) instead of a
-    checkpoint restore + replay.
+    live TrainState to a different mesh width (parallel.reshard) instead
+    of a checkpoint restore + replay.
 
     ``trainer_factory(n) -> trainer`` builds an API-compatible trainer of
-    axis width ``n`` over the surviving devices (same loss/model/codec —
-    reshard keeps the wire format fixed across the move).  ``shrink_to``
-    is the explicit target width: the caller knows its batch-divisibility
-    and capacity constraints; the supervisor does not guess.  With
-    ``prewarm`` (the spare-capacity discipline), ``ElasticTrainer.
-    prewarm_reshard`` compiles the transfer program and the target
-    trainer's step AHEAD of the fault on a zeros ghost state, so the
-    measured MTTR is the migration itself, not a compile.
+    axis width ``n`` (same loss/model/codec — reshard keeps the wire
+    format fixed across the move).  ``shrink_to`` is the explicit target
+    width, or a LADDER of widths (e.g. ``(4, 2)``): the caller knows its
+    batch-divisibility and capacity constraints; the supervisor does not
+    guess.  A target LARGER than the current width is a scale-OUT — the
+    grow path's union seeding (``plan.seed_bytes``) applies, the
+    recovery semantics are identical.  With ``prewarm`` (the
+    spare-capacity discipline), ``ElasticTrainer.prewarm_reshard``
+    compiles the transfer program and the target trainer's step AHEAD of
+    the fault on a zeros ghost state, so the measured MTTR is the
+    migration itself, not a compile.
 
-    The tier is single-shot per supervisor: after a reshard the policy is
-    disarmed (a second preemption falls back to checkpoint restore on the
-    already-shrunk mesh); re-arm by constructing a new policy against the
-    new width."""
+    After a *successful* tier-1 recovery the tier RE-ARMS onto the next
+    rung automatically (a second preemption in a long job must not
+    silently fall back to the slow restore tier), bounded by
+    ``max_reshards`` — at most that many reshards per supervisor (None =
+    the ladder length is the bound).  A rung equal to the CURRENT width
+    is skipped, not an error, so a ladder written as the full descent
+    ``(8, 4, 2)`` on a dp8 trainer works (8 is a no-op rung, 4 is the
+    first real target) — it must never silently wedge the tier.  When
+    the ladder (or the bound) is exhausted the policy disarms and the
+    next fault takes the restore tier."""
 
     trainer_factory: Callable[[int], Any]
-    shrink_to: int
+    shrink_to: Union[int, Sequence[int]]
     prewarm: bool = True
+    max_reshards: Optional[int] = None
+
+    def __post_init__(self) -> None:
+        if not self.rungs():
+            raise ValueError("shrink_to needs at least one target width")
+        bad = [n for n in self.rungs() if n <= 0]
+        if bad:
+            raise ValueError(f"non-positive target width(s) {bad} in "
+                             f"shrink_to={self.shrink_to}")
+
+    def rungs(self) -> Tuple[int, ...]:
+        if isinstance(self.shrink_to, int):
+            return (self.shrink_to,)
+        return tuple(int(n) for n in self.shrink_to)
 
 
 @dataclass(frozen=True)
@@ -163,7 +186,9 @@ class ElasticTrainer:
         self.plan = plan
         self.stage_fn = stage_fn
         self.reshard_policy = reshard
-        self._reshard_trainer = None     # built lazily from the factory
+        self._reshard_trainer = None     # (target_width, trainer), lazy
+        self._rung_idx = 0               # ladder position (skips no-ops)
+        self._reshards_done = 0          # ACTUAL moves (max_reshards)
         # set once a reshard moved the loop onto a different mesh: every
         # later batch may still be placed for the OLD mesh (callers'
         # batch_fn pre-shards), so step() re-places through the current
@@ -268,32 +293,56 @@ class ElasticTrainer:
 
     # -- tier 1: live mesh reshard ------------------------------------------
 
-    def _reshard_available(self, state) -> bool:
+    def _next_width(self) -> Optional[int]:
+        """The armed target width, or None when the ladder / bound is
+        exhausted (the next fault then takes the restore tier).  Rungs
+        equal to the CURRENT width are skipped — a no-op rung must
+        never wedge the tier into silent restore-only recovery."""
         pol = self.reshard_policy
-        return (pol is not None
-                and 0 < pol.shrink_to < self.trainer.n
+        if pol is None:
+            return None
+        if pol.max_reshards is not None \
+                and self._reshards_done >= pol.max_reshards:
+            return None
+        for w in pol.rungs()[self._rung_idx:]:
+            if w != self.trainer.n:
+                return w
+        return None
+
+    def _reshard_available(self, state) -> bool:
+        return (self._next_width() is not None
                 and state is not None
                 and chaos_lib.state_buffers_alive(state))
 
     def _ensure_reshard_trainer(self):
-        if self._reshard_trainer is None:
+        target = self._next_width()
+        assert target is not None, "no reshard rung armed"
+        if self._reshard_trainer is None \
+                or self._reshard_trainer[0] != target:
             pol = self.reshard_policy
-            assert pol is not None, "no ReshardPolicy armed"
-            self._reshard_trainer = pol.trainer_factory(pol.shrink_to)
-        return self._reshard_trainer
+            self._reshard_trainer = (target, pol.trainer_factory(target))
+        return self._reshard_trainer[1]
 
     def _do_reshard(self, state):
-        """Migrate the live state to the shrink target and swap the loop
-        onto the new trainer.  The queue's dispatch closure reads
+        """Migrate the live state to the armed target width and swap the
+        loop onto the new trainer.  The queue's dispatch closure reads
         ``self.trainer`` at call time, so the swap re-routes every
-        subsequent attempt; the policy disarms (single-shot)."""
+        subsequent attempt.  After a SUCCESSFUL move the tier re-arms
+        onto the next ladder rung (bounded by ``max_reshards``);
+        exhausting the ladder disarms the policy."""
         from . import reshard as reshard_lib
         tgt = self._ensure_reshard_trainer()
+        rungs = self.reshard_policy.rungs()
+        while rungs[self._rung_idx] == self.trainer.n:
+            self._rung_idx += 1          # the no-op rungs being skipped
         new_state = reshard_lib.reshard_state(
             self.trainer, tgt, state, events=self.profiler.events)
         self.trainer = tgt
-        self.reshard_policy = None
+        self._rung_idx += 1              # past the rung just used
+        self._reshards_done += 1
         self._reshard_trainer = None
+        if self._next_width() is None:
+            self.reshard_policy = None   # ladder/bound exhausted
         self._mesh_moved = True
         return new_state
 
@@ -444,6 +493,13 @@ class ElasticTrainer:
                     self.profiler.events.instant(
                         "recovered", step=step_i, restored=restored,
                         resharded=resharded)
+                if resharded and self.reshard_policy is not None:
+                    # the tier re-armed onto the next rung: compile that
+                    # path NOW, outside the measured recovery window —
+                    # the prewarm guarantee must hold for every rung,
+                    # not just the first (a second preemption's MTTR
+                    # must be the migration, never a fault-time compile)
+                    self.prewarm_reshard(new_state, batch)
                 self.heartbeat.beat()
                 return new_state, metrics
         raise AssertionError("unreachable")

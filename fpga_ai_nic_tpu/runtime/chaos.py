@@ -57,7 +57,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 __all__ = [
-    "FAULT_KINDS", "SITES", "TRAIN_SITES", "CORRUPTION_MODES",
+    "FAULT_KINDS", "SITES", "TRAIN_SITES", "SERVE_SITES",
+    "CORRUPTION_MODES",
     "InjectedFault", "InjectedPreemption", "IntegrityError",
     "FaultSpec", "FaultPlan", "NormDriftGuard",
     "chunk_checksums", "collective_integrity", "integrity_tol",
@@ -68,11 +69,16 @@ __all__ = [
 FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
 # "serve.step" is the serving plane's tick boundary (serve.engine): a
 # host site like queue.*, fired once per engine tick inside the
-# watchdog-bounded device work.  The TRAINING matrix/soak in
-# tools/chaos_bench.py iterates TRAIN_SITES — a serve.step spec never
-# fires in a training run.
+# watchdog-bounded device work.  "serve.handoff" fires at the fleet's
+# KV-migration boundary (serve.fleet._handoff — an exception there must
+# degrade to replay, never lose the request) and "fleet.membership" at
+# the fleet tick boundary (a preemption there IS a replica kill: the
+# victim's in-flight requests must migrate to survivors).  The TRAINING
+# matrix/soak in tools/chaos_bench.py iterates TRAIN_SITES — a serving
+# spec never fires in a training run.
 TRAIN_SITES = ("queue.issue", "queue.wait", "staging", "collective")
-SITES = TRAIN_SITES + ("serve.step",)
+SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
+SITES = TRAIN_SITES + SERVE_SITES
 CORRUPTION_MODES = ("nan", "bitflip", "scale")
 
 # faults that can run inside an XLA callback (no raising in there)

@@ -161,6 +161,8 @@ class ServeStats:
     evicted: int = 0
     tokens_out: int = 0
     serve_recoveries: int = 0
+    handoffs_in: int = 0         # requests adopted via fleet KV handoff
+    handoffs_out: int = 0        # requests migrated away (pages released)
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False)
 
@@ -185,6 +187,14 @@ class ServeStats:
         with self._lock:
             self.serve_recoveries += 1
 
+    def record_handoff_in(self) -> None:
+        with self._lock:
+            self.handoffs_in += 1
+
+    def record_handoff_out(self) -> None:
+        with self._lock:
+            self.handoffs_out += 1
+
     def as_dict(self) -> Dict[str, int]:
         with self._lock:
             return {"submitted": self.submitted,
@@ -192,4 +202,6 @@ class ServeStats:
                     "completed": self.completed,
                     "evicted": self.evicted,
                     "tokens_out": self.tokens_out,
-                    "serve_recoveries": self.serve_recoveries}
+                    "serve_recoveries": self.serve_recoveries,
+                    "handoffs_in": self.handoffs_in,
+                    "handoffs_out": self.handoffs_out}

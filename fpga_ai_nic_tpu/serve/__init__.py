@@ -5,6 +5,11 @@ decode path (docs/SERVING.md).
   - `scheduler` — admit / evict / prefill-decode interleave policy
   - `engine` — the tick loop: two trace-stable jitted programs, request
     telemetry, chaos/watchdog recovery
+  - `handoff` — live KV migration between replicas as a J11-accounted
+    pair-ppermute transfer program (the reshard discipline applied to
+    serving state)
+  - `fleet` — the elastic fleet: disaggregated prefill/decode replicas,
+    replica-kill recovery by KV handoff instead of replay
 
 The device-side paged forward itself lives with the model
 (`models.llama_decode.forward_paged`), bit-parity-pinned against the
@@ -12,6 +17,8 @@ contiguous cache.
 """
 
 from .engine import ServeEngine, counted_jit
+from .fleet import FleetConfig, Replica, ServeFleet
+from .handoff import HandoffPlan, apply_handoff
 from .paged import (NULL_PAGE, PageAllocator, ServeConfig,
                     contiguous_cache_bytes, init_pool, page_table_bytes,
                     pool_bytes)
@@ -22,4 +29,6 @@ __all__ = [
     "NULL_PAGE", "PageAllocator", "ServeConfig", "init_pool",
     "pool_bytes", "contiguous_cache_bytes", "page_table_bytes",
     "ContinuousBatcher",
+    "FleetConfig", "Replica", "ServeFleet",
+    "HandoffPlan", "apply_handoff",
 ]

@@ -78,8 +78,20 @@ class ServeEngine:
                  scfg: ServeConfig, *,
                  profiler: Optional[Profiler] = None,
                  chaos: Optional[chaos_lib.FaultPlan] = None,
-                 dtype: Optional[str] = None) -> None:
-        self.params = params
+                 dtype: Optional[str] = None,
+                 device: Optional[Any] = None,
+                 replica_id: int = 0,
+                 role: str = "both") -> None:
+        if role not in ("both", "prefill", "decode"):
+            raise ValueError(f"role must be both|prefill|decode: {role!r}")
+        # device pins THIS replica's pool + params (the fleet places each
+        # replica on its own device so the KV handoff is a real
+        # cross-device ppermute); None keeps the default placement
+        self.device = device
+        self.replica_id = int(replica_id)
+        self.role = role
+        self.params = (jax.device_put(params, device)
+                       if device is not None else params)
         self.cfg = cfg
         self.scfg = scfg
         self.dtype = dtype
@@ -94,7 +106,7 @@ class ServeEngine:
         self.alloc = PageAllocator(scfg.n_pages)
         self.batcher = ContinuousBatcher(scfg, self.alloc,
                                          stats=self.stats)
-        self.pool: Pool = init_pool(cfg, scfg, dtype=dtype)
+        self.pool: Pool = self._fresh_pool()
         self.ticks = 0
         self._wall_s = 0.0
         self._consec_failures = 0
@@ -104,26 +116,46 @@ class ServeEngine:
         self._prefill_fn, self._prefill_traces = counted_jit(
             self._prefill_impl, donate_argnums=(0,))
 
+    def _fresh_pool(self) -> Pool:
+        pool = init_pool(self.cfg, self.scfg, dtype=self.dtype)
+        if self.device is not None:
+            pool = jax.device_put(pool, self.device)
+        return pool
+
     # -- the two jitted programs (shapes fixed by ServeConfig) ---------------
+
+    def _logit_guard(self, logits: jax.Array) -> jax.Array:
+        """In-graph corrupted-tick tripwire: True when this tick's
+        logits are non-finite or past the garbage magnitude bound — the
+        host then GATES the tick (IntegrityError -> replay-tier
+        recovery) instead of emitting poisoned tokens to a stream."""
+        bad = ~jnp.isfinite(logits).all()
+        if self.scfg.logit_guard_abs is not None:
+            bad = bad | (jnp.max(jnp.abs(logits))
+                         > jnp.float32(self.scfg.logit_guard_abs))
+        return bad
 
     def _decode_impl(self, pool: Pool, params: Dict[str, Any],
                      tokens: jax.Array, table: jax.Array, pos: jax.Array,
-                     active: jax.Array) -> Tuple[jax.Array, Pool]:
+                     active: jax.Array
+                     ) -> Tuple[jax.Array, jax.Array, Pool]:
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, table, pos, self.cfg,
             page_size=self.scfg.page_size, active=active)
-        return jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32), pool
+        toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return toks, self._logit_guard(logits), pool
 
     def _prefill_impl(self, pool: Pool, params: Dict[str, Any],
                       tokens: jax.Array, row: jax.Array, pos0: jax.Array,
-                      last: jax.Array) -> Tuple[jax.Array, Pool]:
+                      last: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array, Pool]:
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, row, pos0, self.cfg,
             page_size=self.scfg.page_size)
         # the sampled continuation at the chunk's last TRUE token — only
         # consumed when this chunk completes a FRESH prefill
         nxt = jnp.argmax(logits[0, last], axis=-1).astype(jnp.int32)
-        return nxt, pool
+        return nxt, self._logit_guard(logits), pool
 
     # -- intake --------------------------------------------------------------
 
@@ -155,22 +187,33 @@ class ServeEngine:
         self._wall_s += time.perf_counter() - t0
         return self.summary()
 
+    def tick(self) -> bool:
+        """One public engine tick — the fleet scheduler's drive handle
+        (run() loops this for the standalone engine)."""
+        return self._tick()
+
     def _tick(self) -> bool:
         for req in self.queue.pop_arrived():
             self.batcher.enqueue(req)
         now = time.perf_counter()
-        for req in self.batcher.admit():
-            self.stats.record_admitted()
-            if math.isnan(req.t_admit):
-                req.t_admit = now
+        if self.role != "decode":
+            # decode-role replicas receive work ONLY via the fleet's KV
+            # handoff (batcher.adopt) — their waiting list is a replay
+            # surface the fleet drains back to prefill workers
+            for req in self.batcher.admit():
+                self.stats.record_admitted()
+                if math.isnan(req.t_admit):
+                    req.t_admit = now
         # decode first, then prefill: prefill's page demand may evict the
         # NEWEST decoder, so the batch is re-filtered before dispatch
-        dec = self.batcher.decode_batch()
-        pre = self.batcher.prefill_work()
+        dec = self.batcher.decode_batch() if self.role != "prefill" else []
+        pre = (self.batcher.prefill_work()
+               if self.role != "decode" else None)
         dec = [r for r in dec if r.state == DECODE and r.slot >= 0]
         if pre is None and not dec:
             return False
         with self.profiler.events.span("serve.tick", lane="serve",
+                                       replica=self.replica_id,
                                        n_decode=len(dec),
                                        prefill=pre is not None):
             try:
@@ -214,19 +257,25 @@ class ServeEngine:
         dec_snap = [(r.slot, r.generated[-1], r.n_tokens) for r in dec]
 
         def work() -> Tuple[Pool, Dict[str, Any]]:
+            pool = pool_in
             if self.chaos is not None:
                 self.chaos.begin_step(self.ticks)
                 self.chaos.fire("serve.step")      # may sleep or raise
-            pool = pool_in
+                # a corruption spec damages the tick's KV payload — the
+                # in-graph logit guard must catch it BEFORE any token
+                # reaches a stream (zero copies when nothing is pending)
+                pool = self.chaos.corrupt("serve.step", pool)
             out: Dict[str, Any] = {}
+            corrupted = False
             if pre_snap is not None:
                 pre_tokens, slot, start, last = pre_snap
-                tok, pool = self._prefill_fn(
+                tok, bad, pool = self._prefill_fn(
                     pool, self.params, jnp.asarray(pre_tokens),
                     jnp.asarray(table[slot:slot + 1]),
                     jnp.asarray([start], jnp.int32),
                     jnp.asarray(last, jnp.int32))
                 out["prefill_tok"] = int(tok)              # blocks
+                corrupted |= bool(bad)
             if dec_snap:
                 R = scfg.max_reqs
                 toks = np.zeros((R, 1), np.int32)
@@ -236,11 +285,19 @@ class ServeEngine:
                     toks[slot, 0] = tok_in
                     pos[slot] = n_tok
                     act[slot] = True
-                ntok, pool = self._decode_fn(
+                ntok, bad, pool = self._decode_fn(
                     pool, self.params, jnp.asarray(toks),
                     jnp.asarray(table), jnp.asarray(pos),
                     jnp.asarray(act))
                 out["decode_toks"] = np.asarray(ntok)      # blocks
+                corrupted |= bool(bad)
+            if corrupted:
+                # gated out BEFORE _apply: no poisoned token was emitted
+                raise chaos_lib.IntegrityError(
+                    f"serve tick {self.ticks} produced non-finite/"
+                    "garbage logits — corrupted decode tick gated before "
+                    "emission (recovery will rebuild the pool and "
+                    "replay)")
             return pool, out
 
         if self.watchdog is not None:
@@ -295,6 +352,8 @@ class ServeEngine:
             kind = "preemption"
         elif isinstance(err, DeviceHangError):
             kind = "hang"
+        elif isinstance(err, chaos_lib.IntegrityError):
+            kind = "corruption"
         else:
             kind = getattr(err, "kind", type(err).__name__)
         ev = self.profiler.recovery.record_fault(
@@ -304,7 +363,7 @@ class ServeEngine:
         self.batcher.release_all()
         self.alloc = PageAllocator(self.scfg.n_pages)
         self.batcher.rebind(self.alloc)
-        self.pool = init_pool(self.cfg, self.scfg, dtype=self.dtype)
+        self.pool = self._fresh_pool()
         jax.block_until_ready(self.pool)
         self.profiler.recovery.record_recovery(
             time.perf_counter() - t0, event=ev)
@@ -347,6 +406,8 @@ class ServeEngine:
         wall = self._wall_s
         usable = self.scfg.usable_pages
         return {
+            "replica_id": self.replica_id,
+            "role": self.role,
             "ticks": self.ticks,
             "wall_s": round(wall, 4),
             **stats,

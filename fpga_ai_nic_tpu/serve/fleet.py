@@ -1,0 +1,473 @@
+"""Elastic serving fleet — disaggregated prefill/decode replicas with
+live KV migration (docs/SERVING.md "The fleet").
+
+The single-engine serving plane (PR 10) has one recovery tier: replay
+from prompt with a fresh pool.  The fleet adds the tier the training
+side already has (parallel/elastic.py's reshard ladder): when a replica
+is preempted or drained, its in-flight requests' KV pages MIGRATE to
+survivors over the exact-accounted handoff program (serve/handoff.py),
+so the fleet loses zero prefill work.  On top of the same machinery the
+fleet splits roles:
+
+  prefill workers   run ONLY the chunked-prefill program; a completed
+                    prefill's KV pages hand off to a decode worker
+                    (prefill -> KV-handoff -> decode, the disaggregated
+                    pipeline — each replica compiles exactly one of the
+                    two jitted programs, asserted by tests).
+  decode workers    run ONLY the masked decode program; they receive
+                    work exclusively via ``ContinuousBatcher.adopt``
+                    (pages already resident — zero replay).
+
+Scheduling is deterministic (least-loaded with stable ties), so a
+seeded fleet run replays exactly — which is what makes the replica-kill
+chaos verdict BYTE-level: every surviving request's token stream must
+equal the fault-free fleet run's, because per-request chunk schedules
+are position-aligned and `forward_paged` is bitwise page-assignment-
+invariant.
+
+Failure story (chaos sites):
+
+  fleet.membership  a preemption here IS a replica kill signal.  The
+                    victim's pool buffers are still alive (the signal
+                    arrives at the tick boundary, before any dispatch —
+                    the same `state_buffers_alive` gate the training
+                    reshard tier uses), so every live request migrates:
+                    DECODE requests to decode survivors, mid-PREFILL
+                    requests (partial KV kept, prefill resumes at
+                    ``prefill_done``) and WAITING requests to prefill
+                    survivors.  MTTR = detection -> fleet serviceable.
+  serve.handoff     a fault inside a migration degrades that ONE
+                    request to the replay tier (generated tokens kept,
+                    re-prefill on a survivor) — counted in
+                    ``fleet_replays``, never lost.
+
+If a role loses its last replica, a survivor is promoted to
+``role="both"`` — the fleet degrades to the single-engine plane instead
+of wedging.
+"""
+
+from __future__ import annotations
+
+import math
+import time
+from dataclasses import dataclass
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+
+from ..models.llama import LlamaConfig
+from ..obs.metrics import RequestSpans
+from ..runtime import chaos as chaos_lib
+from ..runtime.requests import DECODE, FINISHED, PREFILL, Request
+from ..utils.observability import Profiler
+from . import handoff as handoff_lib
+from .engine import ServeEngine
+from .paged import ServeConfig
+
+__all__ = ["FleetConfig", "ServeFleet", "Replica"]
+
+
+@dataclass(frozen=True)
+class FleetConfig:
+    """Fleet topology: how many replicas hold which role.  Every replica
+    shares one (LlamaConfig, ServeConfig) pair — the handoff plan's
+    geometry precondition."""
+
+    n_prefill: int = 1
+    n_decode: int = 2
+
+    def __post_init__(self) -> None:
+        if self.n_prefill < 1 or self.n_decode < 1:
+            raise ValueError("need >= 1 prefill and >= 1 decode replica")
+
+    @property
+    def n_replicas(self) -> int:
+        return self.n_prefill + self.n_decode
+
+
+@dataclass
+class Replica:
+    """One fleet member: an engine pinned to its own device."""
+
+    idx: int
+    engine: ServeEngine
+    device: Any
+    alive: bool = True
+
+    @property
+    def role(self) -> str:
+        return self.engine.role
+
+    def load(self) -> int:
+        b = self.engine.batcher
+        return len(b.live) + len(b.waiting)
+
+
+class ServeFleet:
+    """The fleet scheduler: routes requests prefill -> KV-handoff ->
+    decode and rebalances on membership change.  Single-threaded drive
+    loop (one tick drives every alive replica once); the thread-safe
+    seams stay in `runtime.requests`."""
+
+    def __init__(self, params: Dict[str, Any], cfg: LlamaConfig,
+                 scfg: ServeConfig, fcfg: Optional[FleetConfig] = None, *,
+                 profiler: Optional[Profiler] = None,
+                 chaos: Optional[chaos_lib.FaultPlan] = None,
+                 dtype: Optional[str] = None,
+                 devices: Optional[Sequence[Any]] = None) -> None:
+        self.cfg = cfg
+        self.scfg = scfg
+        self.fcfg = fcfg or FleetConfig()
+        self.dtype = dtype
+        self.profiler = profiler or Profiler()
+        # fleet-level chaos only: engine ticks stay chaos-free here (the
+        # single-engine serve.step battery covers that surface) so a
+        # fleet fault plan's step counter tracks FLEET ticks
+        self.chaos = chaos
+        if chaos is not None and chaos.events is None:
+            chaos.events = self.profiler.events
+        devices = list(devices if devices is not None
+                       else jax.devices()[:self.fcfg.n_replicas])
+        if len(devices) < self.fcfg.n_replicas:
+            raise ValueError(
+                f"fleet needs {self.fcfg.n_replicas} devices, have "
+                f"{len(devices)}")
+        self.replicas: List[Replica] = []
+        for i in range(self.fcfg.n_replicas):
+            role = "prefill" if i < self.fcfg.n_prefill else "decode"
+            eng = ServeEngine(params, cfg, scfg, profiler=self.profiler,
+                              dtype=dtype, device=devices[i],
+                              replica_id=i, role=role)
+            self.replicas.append(Replica(idx=i, engine=eng,
+                                         device=devices[i]))
+        self.requests: List[Request] = []       # fleet submission order
+        self._arrivals: List[Request] = []
+        self._uid = 0
+        self._t0 = time.perf_counter()
+        self.ticks = 0
+        self._wall_s = 0.0
+        self.handoffs = 0
+        self.handoff_wire_bytes = 0
+        self.handoff_host_bytes = 0
+        self.fleet_replays = 0                   # replay-tier fallbacks
+        self.kills = 0
+
+    # -- membership ----------------------------------------------------------
+
+    def _alive(self, role: Optional[str] = None) -> List[Replica]:
+        out = [r for r in self.replicas if r.alive]
+        if role is not None:
+            out = [r for r in out if r.role in (role, "both")]
+        return out
+
+    # -- intake --------------------------------------------------------------
+
+    def submit(self, prompt: np.ndarray, max_new: int, *,
+               eos_id: Optional[int] = None,
+               not_before_s: float = 0.0) -> Request:
+        """Validate against the shared static budget, then queue for the
+        fleet router (arrival shaping as in `runtime.requests`)."""
+        p = np.asarray(prompt, np.int32).reshape(-1)
+        self.replicas[0].engine.batcher.validate_shape(int(p.shape[0]),
+                                                       int(max_new))
+        self._uid += 1
+        req = Request(uid=self._uid, prompt=p, max_new=int(max_new),
+                      eos_id=eos_id, not_before_s=float(not_before_s),
+                      t_submit=time.perf_counter())
+        self._arrivals.append(req)
+        self.requests.append(req)
+        self.profiler.events.instant("fleet.submit", uid=req.uid,
+                                     prompt_len=req.prompt_len,
+                                     max_new=req.max_new)
+        return req
+
+    def _pop_arrived(self) -> List[Request]:
+        now = time.perf_counter() - self._t0
+        out = [r for r in self._arrivals if r.not_before_s <= now]
+        self._arrivals = [r for r in self._arrivals
+                          if r.not_before_s > now]
+        return out
+
+    def _route_to_prefill(self, req: Request, *, front: bool = False
+                          ) -> None:
+        """Deterministic least-loaded routing with stable ties (list
+        order) — what makes a seeded fleet run replay exactly."""
+        tgt = min(self._alive("prefill"), key=lambda r: (r.load(), r.idx))
+        tgt.engine.batcher.enqueue(req, front=front)
+
+    # -- KV handoff ----------------------------------------------------------
+
+    def _pick_decode_target(self, n_pages: int) -> Optional[Replica]:
+        cands = [r for r in self._alive("decode")
+                 if r.engine.batcher.free_slots > 0
+                 and r.engine.alloc.free >= n_pages]
+        if not cands:
+            return None
+        return min(cands, key=lambda r: (r.load(), r.idx))
+
+    def _handoff(self, src: Replica, dst: Replica, req: Request, *,
+                 state: str) -> None:
+        """Migrate one request's KV pages src -> dst over the lowered
+        transfer program; on success the request continues on dst with
+        ZERO replay.  Raises on an injected handoff fault BEFORE any
+        state moved (the caller degrades that request to replay)."""
+        if self.chaos is not None:
+            self.chaos.fire("serve.handoff")     # may sleep or raise
+        src_eng, dst_eng = src.engine, dst.engine
+        src_pages = src_eng.batcher.pages_of(req)
+        n = len(src_pages)
+        assert n >= 1, "handoff of a pageless request"
+        dst_pages = dst_eng.alloc.alloc(n)
+        assert dst_pages is not None, "target picked without capacity"
+        plan = handoff_lib.plan_for(self.cfg, self.scfg, n,
+                                    dtype=self.dtype)
+        mesh = handoff_lib.pair_mesh(src.device, dst.device)
+        with self.profiler.events.span(
+                "fleet.handoff", lane="serve", uid=req.uid, src=src.idx,
+                dst=dst.idx, pages=n, wire_bytes=plan.wire_bytes()):
+            new_src, new_dst = handoff_lib.apply_handoff(
+                plan, mesh, src_eng.pool, dst_eng.pool, src_pages,
+                dst_pages)
+        src_eng.pool = new_src
+        dst_eng.pool = new_dst
+        src_eng.batcher.release(req)
+        slot = dst_eng.batcher.adopt(req, dst_pages, state=state)
+        assert slot is not None, "target lost its free slot mid-handoff"
+        src_eng.stats.record_handoff_out()
+        dst_eng.stats.record_handoff_in()
+        self.handoffs += 1
+        self.handoff_wire_bytes += plan.wire_bytes()
+        self.handoff_host_bytes += plan.host_bytes(
+            req.prompt_len + len(req.generated))
+
+    def _replay_fallback(self, src: Replica, req: Request) -> None:
+        """The degraded tier: release the request's pages (KV lost) and
+        requeue it front-of-line on a prefill survivor with its
+        generated tokens kept — replay-from-prompt for THIS request
+        only, counted honestly."""
+        if req.slot >= 0:
+            src.engine.batcher.release(req)
+        self.fleet_replays += 1
+        self.profiler.events.instant("fleet.replay", uid=req.uid,
+                                     src=src.idx)
+        self._route_to_prefill(req, front=True)
+
+    def _migrate_or_replay(self, src: Replica, req: Request, *,
+                           state: str, park_ok: bool = False) -> None:
+        """``park_ok`` distinguishes the two callers: the per-tick
+        prefill->decode drain may PARK a request on its (healthy)
+        prefill worker when the decode fleet is transiently full — the
+        handoff simply retries next tick, no prefill work is thrown
+        away (backpressure, not replay).  The kill path cannot park
+        (the source replica is dying) and degrades to replay instead."""
+        role = "decode" if state == DECODE else "prefill"
+        n = len(src.engine.batcher.pages_of(req))
+        if role == "decode":
+            dst = self._pick_decode_target(n)
+        else:
+            cands = [r for r in self._alive("prefill") if r is not src
+                     and r.engine.batcher.free_slots > 0
+                     and r.engine.alloc.free >= n]
+            dst = min(cands, key=lambda r: (r.load(), r.idx)) \
+                if cands else None
+        if dst is None and park_ok:
+            return                       # retry next tick; pages stay
+        if dst is None or n == 0:
+            self._replay_fallback(src, req)
+            return
+        try:
+            self._handoff(src, dst, req, state=state)
+        except chaos_lib.InjectedFault as err:
+            ev = self.profiler.recovery.record_fault(
+                err.kind, step=self.ticks, site="serve.handoff",
+                error=repr(err))
+            t0 = time.perf_counter()
+            self._replay_fallback(src, req)
+            self.profiler.recovery.record_recovery(
+                time.perf_counter() - t0, event=ev)
+
+    # -- membership change (the replica-kill tier) ---------------------------
+
+    def _pick_victim(self) -> Optional[Replica]:
+        """Deterministic kill target: the loaded-most decode replica
+        (maximum blast radius — 'kill a replica mid-decode'), stable
+        ties by index; any alive replica when no decode is left."""
+        if len(self._alive()) <= 1:
+            return None
+        cands = self._alive("decode") or self._alive()
+        return max(cands, key=lambda r: (r.load(), -r.idx))
+
+    def kill_replica(self, idx: int) -> None:
+        """Planned scale-down / drain of one replica: migrate everything
+        it holds to survivors, then remove it from membership.  The
+        chaos preemption at ``fleet.membership`` routes here."""
+        victim = self.replicas[idx]
+        assert victim.alive, f"replica {idx} already dead"
+        assert len(self._alive()) > 1, "cannot kill the last replica"
+        ev = self.profiler.recovery.record_fault(
+            "replica_kill", step=self.ticks, site="fleet.membership",
+            error=f"replica {idx} preempted")
+        t0 = time.perf_counter()
+        victim.alive = False            # no further routing to it
+        self.kills += 1
+        self._promote_if_role_lost()
+        eng = victim.engine
+        migratable = chaos_lib.state_buffers_alive(eng.pool)
+        live = sorted(eng.batcher.live, key=lambda r: r.admit_seq)
+        for req in live:
+            if migratable and req.state in (DECODE, PREFILL) \
+                    and eng.batcher.pages_of(req):
+                self._migrate_or_replay(victim, req, state=req.state)
+            elif not eng.batcher.pages_of(req):
+                # admitted but no KV written yet: re-routing loses zero
+                # work — NOT a replay
+                eng.batcher.release(req)
+                self._route_to_prefill(req, front=True)
+            else:
+                self._replay_fallback(victim, req)
+        while eng.batcher.waiting:
+            self._route_to_prefill(eng.batcher.waiting.pop(0))
+        self.profiler.recovery.record_recovery(
+            time.perf_counter() - t0, event=ev)
+        self.profiler.events.instant(
+            "fleet.membership", tick=self.ticks, victim=idx,
+            survivors=[r.idx for r in self._alive()],
+            migrated=sum(1 for _ in live))
+
+    def _promote_if_role_lost(self) -> None:
+        """A role with zero survivors promotes the least-loaded survivor
+        to role='both' — the fleet degrades to the single-engine plane
+        instead of wedging (its missing program traces once, a bounded
+        one-off)."""
+        for role in ("prefill", "decode"):
+            if not self._alive(role):
+                survivor = min(self._alive(),
+                               key=lambda r: (r.load(), r.idx))
+                survivor.engine.role = "both"
+                self.profiler.events.instant(
+                    "fleet.promote", replica=survivor.idx,
+                    lost_role=role)
+
+    # -- the drive loop ------------------------------------------------------
+
+    def tick(self) -> bool:
+        """One fleet tick: membership chaos, routing, prefill->decode
+        handoffs, one engine tick per alive replica, decode-side replay
+        drain.  Returns False when nothing progressed (idle)."""
+        if self.chaos is not None:
+            self.chaos.begin_step(self.ticks)
+            try:
+                self.chaos.fire("fleet.membership")
+            except chaos_lib.InjectedPreemption:
+                victim = self._pick_victim()
+                if victim is not None:
+                    self.kill_replica(victim.idx)
+            except chaos_lib.InjectedFault as err:
+                # a transient membership-plane error: note and continue
+                self.profiler.events.instant(
+                    "fleet.membership_error", tick=self.ticks,
+                    error=repr(err)[:120])
+        for req in self._pop_arrived():
+            self._route_to_prefill(req)
+        # completed prefills hand off BEFORE the next engine tick, so a
+        # prefill-role replica never decodes
+        for rep in list(self._alive("prefill")):
+            if rep.role == "both":
+                continue                 # degraded mode decodes locally
+            for req in [r for r in rep.engine.batcher.live
+                        if r.state == DECODE]:
+                self._migrate_or_replay(rep, req, state=DECODE,
+                                        park_ok=True)
+        progressed = False
+        for rep in self._alive():
+            progressed = rep.engine.tick() or progressed
+        # an eviction on a decode replica lands in ITS waiting list but
+        # must replay through a prefill worker
+        for rep in self._alive():
+            if rep.role != "decode":
+                continue
+            while rep.engine.batcher.waiting:
+                req = rep.engine.batcher.waiting.pop(0)
+                self._replay_fallback(rep, req)
+        self.ticks += 1
+        return progressed
+
+    def run(self, *, max_ticks: int = 1_000_000) -> Dict[str, Any]:
+        """Serve until every submitted request finishes; returns
+        `summary()`."""
+        t0 = time.perf_counter()
+        while (self._arrivals
+               or any(r.state != FINISHED for r in self.requests)):
+            if self.ticks >= max_ticks:
+                raise RuntimeError(
+                    f"fleet loop exceeded max_ticks={max_ticks} with "
+                    f"{sum(1 for r in self.requests if r.state != FINISHED)}"
+                    " unfinished requests")
+            if not self.tick():
+                time.sleep(0.001)
+        self._wall_s += time.perf_counter() - t0
+        return self.summary()
+
+    # -- introspection -------------------------------------------------------
+
+    def request_summary(self) -> Dict[str, Any]:
+        """Fleet-level latency percentiles computed from the request
+        timestamps themselves (TTFT spans replica boundaries and the
+        kill event — a migrated request's clock never resets)."""
+        spans = RequestSpans()
+        for r in self.requests:
+            if r.state == FINISHED and not math.isnan(r.t_done):
+                spans.record(r.uid, t_submit=r.t_submit,
+                             t_admit=r.t_admit, t_first=r.t_first,
+                             t_done=r.t_done, n_tokens=len(r.generated))
+        return spans.summary()
+
+    def obs_static_metrics(self) -> Dict[str, Any]:
+        return {"fleet": {
+            "n_prefill": self.fcfg.n_prefill,
+            "n_decode": self.fcfg.n_decode,
+            "n_replicas": self.fcfg.n_replicas,
+        }}
+
+    def summary(self) -> Dict[str, Any]:
+        per_replica = []
+        agg: Dict[str, int] = {}
+        recompiles = 0
+        for rep in self.replicas:
+            s = rep.engine.stats.as_dict()
+            for k, v in s.items():
+                agg[k] = agg.get(k, 0) + v
+            recompiles += rep.engine.recompiles_steady()
+            per_replica.append({
+                "replica": rep.idx, "role": rep.role,
+                "alive": rep.alive, "ticks": rep.engine.ticks,
+                "evictions": rep.engine.batcher.evictions,
+                "pages_in_use_peak": rep.engine.alloc.peak_in_use,
+                "trace_counts": rep.engine.trace_counts(), **s})
+        rec = self.profiler.recovery.as_dict()
+        wall = self._wall_s
+        return {
+            "ticks": self.ticks,
+            "wall_s": round(wall, 4),
+            "n_requests": len(self.requests),
+            "completed": agg.get("completed", 0),
+            "tokens_out": agg.get("tokens_out", 0),
+            "throughput_tok_s": (round(agg.get("tokens_out", 0) / wall, 2)
+                                 if wall > 0 else None),
+            "handoffs": self.handoffs,
+            "handoff_wire_bytes": self.handoff_wire_bytes,
+            "handoff_host_bytes": self.handoff_host_bytes,
+            "fleet_replays": self.fleet_replays,
+            "kills": self.kills,
+            "serve_recoveries": agg.get("serve_recoveries", 0),
+            "evictions": sum(r.engine.batcher.evictions
+                             for r in self.replicas),
+            "recompiles_steady": recompiles,
+            "replicas": per_replica,
+            "requests": self.request_summary(),
+            "recovery": {"faults": rec["faults"],
+                         "recoveries": rec["recoveries"],
+                         "mttr_mean_s": rec["mttr_mean_s"]},
+            **self.obs_static_metrics(),
+        }

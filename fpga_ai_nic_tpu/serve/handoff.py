@@ -1,0 +1,246 @@
+"""Live KV migration between serving replicas — the serving-plane reuse
+of the reshard discipline (parallel/reshard.py, docs/RESHARD.md).
+
+PR 7 proved that the fastest way to move *training* state off a dying
+replica is a static collective transfer program whose wire bytes are
+exactly accounted (rule J8).  The serving plane has the same problem
+with different state: a preempted or scaled-down replica holds live
+requests' KV pages, and the only recovery tier until now was
+replay-from-prompt — every in-flight request's prefill work thrown
+away.  This module expresses "move request r's page-pool pages from
+replica A to replica B" the same way reshard expresses a mesh move:
+
+  - a **HandoffPlan** is the static description: ``n_move`` pages (each
+    ``[kv_local, page_size, hd]`` per layer per K/V) crossing from the
+    pair's device 0 to device 1.  ``wire_bytes()`` is EXACTLY the pages'
+    bytes — the number graftlint rule J11 holds the lowered program's
+    ppermute operands to (page ids, table rows and the request's host
+    tokens move host-side and are declared separately as
+    ``host_bytes``, never smuggled into the wire accounting).
+  - **lower_apply** lowers the plan to ONE jitted shard_map over a
+    2-device "rep" pair mesh: gather the ``n_move`` pages out of the
+    source shard (page ids are int32 *operands*, so which pages move is
+    a VALUE — one trace serves every migration of the same size), one
+    single-pair ``lax.ppermute`` per layer per K/V with the gathered
+    block as the exact-length payload, scatter into the destination
+    shard's freshly allocated page ids.  Every pool operand is DONATED
+    (the reshard footprint rule: the transfer runs in ~one pool's
+    memory, not two).
+  - **apply_handoff** assembles the two replicas' single-device pools
+    into the pair-sharded operands ZERO-COPY
+    (``jax.make_array_from_single_device_arrays``) and hands the output
+    shards back as each replica's new pool.
+
+Because ``forward_paged`` is bitwise-invariant to page assignment
+(docs/SERVING.md's parity theorem), a migrated request's continuation on
+the destination replica is bitwise the continuation it would have
+produced at home — the fleet's replica-kill chaos cell pins exactly
+that.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import llama_decode
+from ..models.llama import LlamaConfig
+from .paged import ServeConfig
+
+__all__ = ["HandoffPlan", "make_plan", "plan_for", "lower_apply",
+           "abstract_operands", "apply_handoff", "pair_mesh"]
+
+Pool = List[Dict[str, jax.Array]]
+
+REP_AXIS = "rep"
+
+
+class HandoffPlan(NamedTuple):
+    """Static shape of one KV migration: ``n_move`` pool pages crossing
+    the pair axis, per layer, per K and V.  Page IDS are operands, not
+    plan fields — one plan (one trace) serves every migration of the
+    same page count over the same pool geometry."""
+
+    n_layers: int
+    kv_local: int
+    page_size: int
+    head_dim: int
+    n_pages: int                 # pool pages per replica (operand shape)
+    n_move: int                  # pages crossing the wire (static)
+    dtype: str = "float32"
+
+    @property
+    def itemsize(self) -> int:
+        return int(jnp.dtype(self.dtype).itemsize)
+
+    @property
+    def page_bytes(self) -> int:
+        """Bytes of ONE page of ONE layer's K or V."""
+        return self.kv_local * self.page_size * self.head_dim \
+            * self.itemsize
+
+    def wire_bytes(self) -> int:
+        """EXACTLY the bytes the ppermutes move (pages only — rule J11
+        holds the lowered program to this, two-sided)."""
+        return 2 * self.n_layers * self.n_move * self.page_bytes
+
+    def host_bytes(self, n_tokens: int) -> int:
+        """Bytes that move HOST-side per migrated request: the page-table
+        row (int32) and the request's prompt+generated token ids —
+        declared apart from the wire bytes, the seed_bytes honesty rule."""
+        return self.n_move * 4 + int(n_tokens) * 4
+
+    def describe(self) -> Dict[str, Any]:
+        return {"n_layers": self.n_layers, "kv_local": self.kv_local,
+                "page_size": self.page_size, "head_dim": self.head_dim,
+                "n_pages": self.n_pages, "n_move": self.n_move,
+                "dtype": self.dtype, "wire_bytes": self.wire_bytes()}
+
+
+def make_plan(*, n_layers: int, kv_local: int, page_size: int,
+              head_dim: int, n_pages: int, n_move: int,
+              dtype: str = "float32") -> HandoffPlan:
+    assert n_layers >= 1 and kv_local >= 1 and page_size >= 1
+    assert 1 <= n_move < n_pages, (n_move, n_pages)
+    return HandoffPlan(n_layers=n_layers, kv_local=kv_local,
+                       page_size=page_size, head_dim=head_dim,
+                       n_pages=n_pages, n_move=n_move,
+                       dtype=str(jnp.dtype(dtype)))
+
+
+def plan_for(cfg: LlamaConfig, scfg: ServeConfig, n_move: int, *,
+             tp_size: int = 1, dtype: Optional[str] = None) -> HandoffPlan:
+    """The plan for migrating ``n_move`` pages between two replicas of
+    the given model/serve geometry (both sides MUST share it — the
+    fleet constructs every replica from one (cfg, scfg) pair)."""
+    return make_plan(
+        n_layers=cfg.n_layers,
+        kv_local=llama_decode.kv_local_heads(cfg, tp_size),
+        page_size=scfg.page_size, head_dim=cfg.head_dim,
+        n_pages=scfg.n_pages, n_move=n_move,
+        dtype=str(jnp.dtype(dtype or cfg.dtype)))
+
+
+# ---------------------------------------------------------------------------
+# lowering: the plan as one jitted pair-ppermute program (donated pools)
+# ---------------------------------------------------------------------------
+
+def lower_apply(plan: HandoffPlan, mesh: Mesh, ax: str = REP_AXIS, *,
+                donate: bool = True) -> Any:
+    """The plan as ONE jitted transfer program over a 2-device pair mesh.
+
+    Positional args: ``2 * n_layers`` stacked pools
+    ``[2, n_pages, kv_local, page_size, hd]`` sharded ``P(ax)`` (layer
+    order, K then V), then ``src_idx [n_move]`` / ``dst_idx [n_move]``
+    int32 (replicated).  Returns the same pools with the gathered source
+    pages landed at the destination's page ids; the source shard passes
+    through untouched (its pages are freed host-side and recycled
+    dirty).  Every pool operand is donated by default."""
+    assert mesh.shape[ax] == 2, mesh.shape
+    n_pool = 2 * plan.n_layers
+
+    def body(*ops: jax.Array) -> Tuple[jax.Array, ...]:
+        pools = ops[:n_pool]
+        src_idx, dst_idx = ops[n_pool], ops[n_pool + 1]
+        i = lax.axis_index(ax)
+        outs = []
+        for p in pools:
+            # exact-length payload: ONLY the migrating pages cross —
+            # [n_move, kv_local, page_size, hd] per layer per K/V
+            payload = jnp.take(p[0], src_idx, axis=0)
+            payload = lax.ppermute(payload, ax, [(0, 1)])
+            landed = p.at[0, dst_idx].set(payload)
+            outs.append(jnp.where(i == 1, landed, p))
+        return tuple(outs)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P(ax),) * n_pool + (P(), P()),
+                       out_specs=(P(ax),) * n_pool, check_vma=False)
+    return jax.jit(sm, donate_argnums=(tuple(range(n_pool)) if donate
+                                       else ()))
+
+
+@functools.lru_cache(maxsize=64)
+def _cached_apply(plan: HandoffPlan, mesh: Mesh, ax: str,
+                  donate: bool) -> Any:
+    """Memoized ``lower_apply``: migrations of the same page count over
+    the same pair mesh hit the jit dispatch cache — the fleet's handoff
+    trace count is bounded by distinct (n_move, pair) values, not by
+    migration events."""
+    return lower_apply(plan, mesh, ax, donate=donate)
+
+
+def abstract_operands(plan: HandoffPlan
+                      ) -> Tuple[jax.ShapeDtypeStruct, ...]:
+    """ShapeDtypeStructs matching ``lower_apply``'s positional args —
+    the zero-device-work handle the graftlint J11 sweep traces the
+    program through."""
+    pool_sds = jax.ShapeDtypeStruct(
+        (2, plan.n_pages, plan.kv_local, plan.page_size, plan.head_dim),
+        jnp.dtype(plan.dtype))
+    idx = jax.ShapeDtypeStruct((plan.n_move,), jnp.int32)
+    return (pool_sds,) * (2 * plan.n_layers) + (idx, idx)
+
+
+# ---------------------------------------------------------------------------
+# runtime: zero-copy pair assembly + shard disassembly
+# ---------------------------------------------------------------------------
+
+@functools.lru_cache(maxsize=64)
+def pair_mesh(dev_src: Any, dev_dst: Any) -> Mesh:
+    """The 2-device transfer surface for one (src, dst) replica pair."""
+    assert dev_src != dev_dst, "handoff needs two distinct devices"
+    return Mesh(np.array([dev_src, dev_dst]), (REP_AXIS,))
+
+
+def _stacked(a: jax.Array, b: jax.Array, sharding: NamedSharding
+             ) -> jax.Array:
+    """[n_pages, ...] on dev0 + [n_pages, ...] on dev1 -> global
+    [2, n_pages, ...] sharded P(rep), zero cross-device copies."""
+    return jax.make_array_from_single_device_arrays(
+        (2,) + tuple(a.shape), sharding,
+        [a.reshape((1,) + tuple(a.shape)),
+         b.reshape((1,) + tuple(b.shape))])
+
+
+def _unstack(out: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    shards = sorted(out.addressable_shards,
+                    key=lambda s: s.index[0].start or 0)
+    assert len(shards) == 2
+    return shards[0].data[0], shards[1].data[0]
+
+
+def apply_handoff(plan: HandoffPlan, mesh: Mesh, src_pool: Pool,
+                  dst_pool: Pool, src_pages: Sequence[int],
+                  dst_pages: Sequence[int], *, ax: str = REP_AXIS,
+                  donate: bool = True) -> Tuple[Pool, Pool]:
+    """Run the transfer: source pages ``src_pages`` of ``src_pool`` land
+    at ``dst_pages`` of ``dst_pool``.  Returns (new_src_pool,
+    new_dst_pool); with ``donate`` the stacked inputs are consumed.  The
+    caller owns the host bookkeeping (allocator, table rows, request
+    state) — this is ONLY the device move."""
+    assert len(src_pages) == len(dst_pages) == plan.n_move
+    sharding = NamedSharding(mesh, P(ax))
+    ops = []
+    for ls, ld in zip(src_pool, dst_pool):
+        for key in ("k", "v"):
+            ops.append(_stacked(ls[key], ld[key], sharding))
+    run = _cached_apply(plan, mesh, ax, donate)
+    outs = run(*ops, jnp.asarray(np.asarray(src_pages, np.int32)),
+               jnp.asarray(np.asarray(dst_pages, np.int32)))
+    jax.block_until_ready(outs)
+    new_src: Pool = []
+    new_dst: Pool = []
+    it = iter(outs)
+    for _ in range(plan.n_layers):
+        sk, dk = _unstack(next(it))
+        sv, dv = _unstack(next(it))
+        new_src.append({"k": sk, "v": sv})
+        new_dst.append({"k": dk, "v": dv})
+    return new_src, new_dst

@@ -58,10 +58,20 @@ class ServeConfig:
     step_timeout_s: Optional[float] = None
     max_retries: int = 4
     backoff_s: float = 0.01
+    # corrupted-tick guard: a decode/prefill tick whose logits are
+    # non-finite OR exceed this magnitude is GATED (IntegrityError ->
+    # replay-tier recovery) before any token reaches a stream — the
+    # serving analogue of the collective integrity checksums.  Healthy
+    # logits are O(10); a NaN'd or scale-corrupted KV pool lands far
+    # past this.  None disables the magnitude half (non-finite always
+    # trips).
+    logit_guard_abs: Optional[float] = 1e6
 
     def __post_init__(self) -> None:
         if self.max_reqs < 1 or self.page_size < 1:
             raise ValueError("max_reqs and page_size must be >= 1")
+        if self.logit_guard_abs is not None and self.logit_guard_abs <= 0:
+            raise ValueError("logit_guard_abs must be positive (or None)")
         if self.n_pages < 2:
             raise ValueError("n_pages must be >= 2 (page 0 is reserved)")
         if self.max_pages_per_seq < 1:

@@ -193,6 +193,54 @@ class ContinuousBatcher:
         self._release_slot(req)
         req.state = FINISHED
 
+    # -- KV handoff (serve.fleet) --------------------------------------------
+
+    @property
+    def free_slots(self) -> int:
+        return sum(1 for r in self.slots if r is None)
+
+    def pages_of(self, req: Request) -> List[int]:
+        """The page ids a live request currently owns (copy) — the
+        fleet's migration set.  Empty for a slotless request."""
+        if req.slot < 0:
+            return []
+        return list(self._pages[req.slot])
+
+    def adopt(self, req: Request, pages: List[int], *,
+              state: str = DECODE) -> Optional[int]:
+        """Install a request DIRECTLY into a free slot with its KV pages
+        already resident — the fleet KV-handoff path: the pages were
+        migrated from another replica's pool (same values, new page
+        ids), so the request continues with ZERO replay.  ``pages`` must
+        have been allocated from THIS batcher's allocator by the caller
+        (accounting stays exact) and must cover every position the
+        request's cache holds.  Returns the slot, or None with no free
+        slot (caller keeps the request where it is)."""
+        if len(pages) > self.scfg.max_pages_per_seq:
+            raise ValueError(
+                f"adopting {len(pages)} pages > table width "
+                f"{self.scfg.max_pages_per_seq}")
+        slot = next((i for i, r in enumerate(self.slots) if r is None),
+                    None)
+        if slot is None:
+            return None
+        self.table[slot, :] = NULL_PAGE
+        self.table[slot, :len(pages)] = np.asarray(pages, np.int32)
+        self._pages[slot] = list(pages)
+        self.slots[slot] = req
+        req.slot = slot
+        req.state = state
+        self._admit_seq += 1
+        req.admit_seq = self._admit_seq
+        return slot
+
+    def release(self, req: Request) -> None:
+        """Free the slot + pages WITHOUT requeueing — the handoff SOURCE
+        side: the page bytes were already copied out by the transfer
+        program, and dirty recycling makes the freed pages immediately
+        reusable here."""
+        self._release_slot(req)
+
     # -- per-tick work selection ---------------------------------------------
 
     def prefill_work(self) -> Optional[Tuple[Request, int, int]]:

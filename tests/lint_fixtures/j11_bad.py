@@ -1,0 +1,44 @@
+"""J11 bad fixture: a KV-handoff lowering that ppermutes the WHOLE pool
+shard for the migration instead of the gathered pages — the tempting
+"just ship everything, scatter at the receiver" shortcut that moves
+n_pages/n_move times the bytes the HandoffPlan declares (and that a
+naive pool-swap rebalance degenerates to).  The plan's declared
+wire_bytes stays the honest per-page figure, so the traced program's
+ppermute operand bytes no longer match it and J11 must fire with the
+moved-vs-declared numbers."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+def build():
+    from fpga_ai_nic_tpu.serve import handoff as handoff_lib
+
+    plan = handoff_lib.make_plan(n_layers=2, kv_local=2, page_size=4,
+                                 head_dim=8, n_pages=8, n_move=2)
+    mesh = Mesh(np.array(jax.devices()[:2]), ("rep",))
+    n_pool = 2 * plan.n_layers
+
+    def body(*ops):
+        pools = ops[:n_pool]
+        src_idx, dst_idx = ops[n_pool], ops[n_pool + 1]
+        i = lax.axis_index("rep")
+        outs = []
+        for p in pools:
+            # BAD: ship the ENTIRE pool shard, gather at the receiver —
+            # wire bytes balloon past the declared per-page accounting
+            whole = lax.ppermute(p, "rep", [(0, 1)])
+            payload = jnp.take(whole[0], src_idx, axis=0)
+            landed = p.at[0, dst_idx].set(payload)
+            outs.append(jnp.where(i == 1, landed, p))
+        return tuple(outs)
+
+    sm = jax.shard_map(body, mesh=mesh,
+                       in_specs=(P("rep"),) * n_pool + (P(), P()),
+                       out_specs=(P("rep"),) * n_pool, check_vma=False)
+    fn = jax.jit(sm, donate_argnums=tuple(range(n_pool)))
+    jx = jax.make_jaxpr(fn)(*handoff_lib.abstract_operands(plan))
+    return jx, plan.wire_bytes(), n_pool

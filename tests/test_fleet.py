@@ -1,0 +1,386 @@
+"""Elastic serving fleet: KV handoff, disaggregated prefill/decode, and
+replica-kill recovery (serve/handoff.py + serve/fleet.py).
+
+THE acceptance pins:
+
+- the handoff transfer program moves EXACTLY the migrated pages (values
+  land at the destination's page ids, untouched pages keep theirs) and
+  its plan's wire accounting equals the actual page bytes (the J11
+  contract, also swept statically by graftlint);
+- a disaggregated fleet (prefill workers never trace the decode
+  program, decode workers never trace prefill) serves token-exact vs
+  the isolated generate() reference with ZERO replays — every request
+  rides one prefill->KV-handoff->decode pipeline;
+- killing a replica mid-decode under load migrates its in-flight
+  requests to survivors with BYTE-IDENTICAL post-fault token streams vs
+  the fault-free fleet run, zero replay-from-prompt (handoff tier used,
+  the `serve_recoveries` replay tier NOT fired);
+- a fault inside a handoff degrades that one request to the replay tier
+  (kept tokens, re-prefill) — counted, never lost;
+- a corrupted decode tick trips the NaN/garbage-logits guard and
+  recovers instead of emitting poisoned tokens.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from fpga_ai_nic_tpu.models import llama, llama_decode as dec
+from fpga_ai_nic_tpu.runtime import chaos
+from fpga_ai_nic_tpu.runtime.requests import DECODE, PREFILL
+from fpga_ai_nic_tpu.serve import (FleetConfig, ServeConfig, ServeEngine,
+                                   ServeFleet)
+from fpga_ai_nic_tpu.serve import handoff as handoff_lib
+
+CFG = llama.LlamaConfig.tiny()
+DT = jnp.dtype(CFG.dtype)
+
+
+@pytest.fixture(scope="module")
+def fleet_world():
+    """Shared params + prompts + isolated-generate references."""
+    params = llama.init(jax.random.PRNGKey(0), CFG)
+    rng = np.random.default_rng(7)
+    prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+               for n in rng.integers(4, 14, 6)]
+    ref = []
+    for p in prompts:
+        full = np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], 6, CFG))[0]
+        ref.append(full[len(p):].tolist())
+    return params, prompts, ref
+
+
+SCFG = ServeConfig(max_reqs=4, page_size=4, n_pages=40,
+                   max_pages_per_seq=6, prefill_chunk=6)
+
+
+class TestHandoffProgram:
+    """The device transfer in isolation: exact values, exact bytes."""
+
+    def test_pages_land_and_bystanders_survive(self):
+        devs = jax.devices()
+        plan = handoff_lib.make_plan(n_layers=2, kv_local=2, page_size=4,
+                                     head_dim=8, n_pages=6, n_move=3)
+        mesh = handoff_lib.pair_mesh(devs[0], devs[1])
+        rng = np.random.default_rng(0)
+
+        def mkpool(dev):
+            return [{k: jax.device_put(
+                jnp.asarray(rng.standard_normal((6, 2, 4, 8)),
+                            jnp.float32), dev) for k in ("k", "v")}
+                for _ in range(2)]
+
+        src, dst = mkpool(devs[0]), mkpool(devs[1])
+        src_host = [{k: np.asarray(l[k]) for k in l} for l in src]
+        dst_host = [{k: np.asarray(l[k]) for k in l} for l in dst]
+        ns, nd = handoff_lib.apply_handoff(plan, mesh, src, dst,
+                                           [1, 3, 5], [2, 4, 1])
+        for li in range(2):
+            for k in ("k", "v"):
+                got = np.asarray(nd[li][k])
+                np.testing.assert_array_equal(got[[2, 4, 1]],
+                                              src_host[li][k][[1, 3, 5]])
+                np.testing.assert_array_equal(got[[0, 3, 5]],
+                                              dst_host[li][k][[0, 3, 5]])
+                np.testing.assert_array_equal(np.asarray(ns[li][k]),
+                                              src_host[li][k])
+        # placement: each side stays on its own device
+        assert ns[0]["k"].devices() == {devs[0]}
+        assert nd[0]["k"].devices() == {devs[1]}
+
+    def test_plan_bytes_equal_actual_page_bytes(self):
+        plan = handoff_lib.plan_for(CFG, SCFG, 4)
+        one_page = np.zeros((CFG.n_kv_heads, SCFG.page_size,
+                             CFG.head_dim), DT)
+        assert plan.wire_bytes() == 2 * CFG.n_layers * 4 * one_page.nbytes
+
+    def test_make_plan_validation(self):
+        with pytest.raises(AssertionError):
+            handoff_lib.make_plan(n_layers=1, kv_local=1, page_size=4,
+                                  head_dim=8, n_pages=4, n_move=4)
+
+
+class TestDisaggregation:
+    """prefill -> KV-handoff -> decode, each role compiling exactly one
+    program."""
+
+    def test_token_exact_with_zero_replays(self, fleet_world):
+        params, prompts, ref = fleet_world
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 2))
+        reqs = [fleet.submit(p, max_new=6) for p in prompts]
+        s = fleet.run()
+        assert s["completed"] == len(prompts)
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+        assert s["fleet_replays"] == 0
+        assert s["handoffs"] == len(prompts)   # one per request
+        assert s["recompiles_steady"] == 0
+
+    def test_roles_trace_only_their_program(self, fleet_world):
+        params, prompts, _ = fleet_world
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 2))
+        for p in prompts:
+            fleet.submit(p, max_new=4)
+        s = fleet.run()
+        for r in s["replicas"]:
+            if r["role"] == "prefill":
+                assert r["trace_counts"] == {"prefill": 1, "decode": 0}
+            else:
+                assert r["trace_counts"]["prefill"] == 0
+                assert r["trace_counts"]["decode"] <= 1
+
+    def test_handoff_byte_accounting_is_exact(self, fleet_world):
+        """fleet.handoff_wire_bytes must equal the sum of the per-event
+        plan declarations on the event stream — the number FLEET_BENCH
+        banks and the obs gate holds two-sided."""
+        params, prompts, _ = fleet_world
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 2))
+        for p in prompts:
+            fleet.submit(p, max_new=4)
+        s = fleet.run()
+        ev_bytes = sum(e["attrs"]["wire_bytes"]
+                       for e in fleet.profiler.events.snapshot()
+                       if e["name"] == "fleet.handoff")
+        assert s["handoff_wire_bytes"] == ev_bytes > 0
+        # and each event's declaration is the plan formula for its pages
+        for e in fleet.profiler.events.snapshot():
+            if e["name"] != "fleet.handoff":
+                continue
+            plan = handoff_lib.plan_for(CFG, SCFG, e["attrs"]["pages"])
+            assert e["attrs"]["wire_bytes"] == plan.wire_bytes()
+
+    def test_staggered_arrivals(self, fleet_world):
+        params, prompts, ref = fleet_world
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 2))
+        reqs = [fleet.submit(p, max_new=6, not_before_s=0.01 * i)
+                for i, p in enumerate(prompts)]
+        s = fleet.run()
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+        assert s["requests"]["completed"] == len(prompts)
+        assert s["requests"]["ttft_p95_s"] is not None
+
+
+def _fleet_run(params, prompts, plan, *, fcfg=FleetConfig(1, 2),
+               max_new=6, scfg=SCFG):
+    fleet = ServeFleet(params, CFG, scfg, fcfg, chaos=plan)
+    reqs = [fleet.submit(p, max_new=max_new) for p in prompts]
+    with chaos.activate(plan):
+        s = fleet.run()
+    return fleet, reqs, s
+
+
+class TestReplicaKill:
+    """THE acceptance cell: kill a replica mid-decode under load —
+    byte-identical surviving streams, zero replay-from-prompt."""
+
+    def test_kill_migrates_with_byte_identical_streams(self, fleet_world):
+        params, prompts, _ = fleet_world
+        _, ref_reqs, ref_s = _fleet_run(params, prompts, None)
+        reference = [list(r.generated) for r in ref_reqs]
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("preemption", "fleet.membership", step=6)],
+            seed=11)
+        fleet, reqs, s = _fleet_run(params, prompts, plan)
+        assert len(plan.fired) == 1
+        assert s["kills"] == 1
+        assert s["recovery"]["faults"] == {"replica_kill": 1}
+        # zero replay-from-prompt: the handoff tier moved every live
+        # request; the engine replay tier NEVER fired
+        assert s["fleet_replays"] == 0
+        assert s["serve_recoveries"] == 0
+        assert s["handoffs"] > ref_s["handoffs"]   # the kill migrations
+        assert s["completed"] == len(prompts)
+        for q, want in zip(reqs, reference):
+            assert list(q.generated) == want       # byte-identical
+        assert s["recompiles_steady"] == 0
+        assert s["recovery"]["mttr_mean_s"] > 0
+        assert sum(1 for r in s["replicas"] if r["alive"]) == 2
+
+    def test_kill_last_decode_promotes_survivor(self, fleet_world):
+        """Losing the ONLY decode replica must promote a survivor to
+        role='both' (degrade to the single-engine plane) — requests
+        still finish token-exact."""
+        params, prompts, ref = fleet_world
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("preemption", "fleet.membership", step=5)],
+            seed=3)
+        fleet, reqs, s = _fleet_run(params, prompts[:4], plan,
+                                    fcfg=FleetConfig(1, 1))
+        assert s["kills"] == 1
+        assert s["completed"] == 4
+        for q, want in zip(reqs, ref[:4]):
+            assert q.generated == want
+        roles = {r["replica"]: r["role"] for r in s["replicas"]}
+        assert "both" in roles.values()
+
+    def test_mid_prefill_migration_keeps_partial_kv(self, fleet_world):
+        """Killing the PREFILL replica mid-prefill migrates the partial
+        KV (state=PREFILL, prefill resumes at prefill_done on the
+        promoted survivor) — zero replay."""
+        params, _, _ = fleet_world
+        rng = np.random.default_rng(5)
+        prompt = rng.integers(0, CFG.vocab, 20).astype(np.int32)
+        want = np.asarray(dec.generate(
+            params, jnp.asarray(prompt)[None], 4, CFG))[0][20:].tolist()
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 1))
+        req = fleet.submit(prompt, max_new=4)
+        # tick until the prompt is mid-prefill (chunk 6 over 20 pos)
+        while req.prefill_done == 0 or req.state != PREFILL:
+            fleet.tick()
+        assert 0 < req.prefill_done < req.replay_len
+        fleet.kill_replica(0)
+        assert req.state == PREFILL            # partial KV migrated
+        assert fleet.fleet_replays == 0
+        s = fleet.run()
+        assert req.generated == want
+        assert s["fleet_replays"] == 0
+
+    def test_planned_scale_down_via_kill_replica(self, fleet_world):
+        """kill_replica is also the planned drain path — no chaos plan
+        involved, same migration machinery."""
+        params, prompts, ref = fleet_world
+        fleet = ServeFleet(params, CFG, SCFG, FleetConfig(1, 2))
+        reqs = [fleet.submit(p, max_new=6) for p in prompts]
+        for _ in range(6):
+            fleet.tick()
+        victims = [r for r in fleet.replicas if r.role == "decode"]
+        fleet.kill_replica(victims[0].idx)
+        s = fleet.run()
+        assert s["completed"] == len(prompts)
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+        assert s["fleet_replays"] == 0 and s["serve_recoveries"] == 0
+
+
+class TestHandoffFault:
+    def test_exception_degrades_to_replay_not_loss(self, fleet_world):
+        params, _, _ = fleet_world
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+                   for n in rng.integers(4, 10, 4)]
+        ref = [np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], 4, CFG))[0][len(p):].tolist()
+            for p in prompts]
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=24,
+                           max_pages_per_seq=6, prefill_chunk=6)
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("exception", "serve.handoff", step=2)],
+            seed=2)
+        fleet, reqs, s = _fleet_run(params, prompts, plan, max_new=4,
+                                    scfg=scfg)
+        assert len(plan.fired) == 1
+        assert s["fleet_replays"] == 1         # degraded, counted
+        assert s["recovery"]["faults"] == {"exception": 1}
+        assert s["completed"] == 4             # ... and never lost
+        for q, want in zip(reqs, ref):
+            assert list(q.generated) == want
+
+
+class TestCorruptionGuard:
+    """Satellite: corruption at serve.step — the NaN/garbage-logits
+    guard gates the tick and recovery replays, token-exact."""
+
+    SCFG = ServeConfig(max_reqs=3, page_size=4, n_pages=24,
+                       max_pages_per_seq=6, prefill_chunk=6)
+
+    def _run(self, plan):
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+                   for n in rng.integers(4, 10, 4)]
+        ref = [np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], 4, CFG))[0][len(p):].tolist()
+            for p in prompts]
+        eng = ServeEngine(params, CFG, self.SCFG, chaos=plan)
+        reqs = [eng.submit(p, max_new=4) for p in prompts]
+        with chaos.activate(plan):
+            s = eng.run()
+        return s, reqs, ref
+
+    def test_nan_corruption_gated_and_recovered(self):
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "serve.step", step=3,
+                             mode="nan", fraction=0.5)], seed=1)
+        s, reqs, ref = self._run(plan)
+        assert len(plan.fired) == 1
+        assert s["serve_recoveries"] >= 1
+        assert s["recovery"]["faults"].get("corruption", 0) >= 1
+        for q, want in zip(reqs, ref):
+            assert q.generated == want         # no poisoned token leaked
+        assert s["recompiles_steady"] == 0
+
+    def test_magnitude_guard_trips_on_garbage_logits(self):
+        """The magnitude half of the guard, exercised directly: logits
+        past logit_guard_abs (a scale-corrupted VALUE path) trip; NaN
+        always trips; healthy logits never do.  (Finite wrong-KEY
+        corruption yields wrong-but-normal-magnitude logits no logit
+        guard can prove — the class the wire checksums exist for on the
+        training side; docs/SERVING.md states the boundary.)"""
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        eng = ServeEngine(params, CFG, self.SCFG)
+        ok = jnp.zeros((3, 1, 8), jnp.float32) + 2.5
+        assert not bool(eng._logit_guard(ok))
+        assert bool(eng._logit_guard(ok.at[0, 0, 0].set(jnp.nan)))
+        assert bool(eng._logit_guard(ok.at[1, 0, 3].set(2e6)))
+        # knob off: only non-finite trips
+        eng2 = ServeEngine(params, CFG, ServeConfig(
+            max_reqs=3, page_size=4, n_pages=24, max_pages_per_seq=6,
+            prefill_chunk=6, logit_guard_abs=None))
+        assert not bool(eng2._logit_guard(ok.at[1, 0, 3].set(2e6)))
+        assert bool(eng2._logit_guard(ok.at[0, 0, 0].set(jnp.inf)))
+
+    def test_clean_run_never_false_trips(self):
+        s, reqs, ref = self._run(None)
+        assert s["serve_recoveries"] == 0
+        for q, want in zip(reqs, ref):
+            assert q.generated == want
+
+    def test_guard_knob_validation(self):
+        with pytest.raises(ValueError, match="logit_guard_abs"):
+            ServeConfig(logit_guard_abs=0.0)
+
+
+class TestFleetConfig:
+    def test_validation(self):
+        with pytest.raises(ValueError):
+            FleetConfig(n_prefill=0)
+        with pytest.raises(ValueError):
+            FleetConfig(n_decode=0)
+        assert FleetConfig(2, 3).n_replicas == 5
+
+    def test_fleet_needs_devices(self, fleet_world):
+        params, _, _ = fleet_world
+        with pytest.raises(ValueError, match="devices"):
+            ServeFleet(params, CFG, SCFG, FleetConfig(1, 1),
+                       devices=jax.devices()[:1])
+
+
+class TestBackpressure:
+    def test_full_decode_fleet_parks_not_replays(self, fleet_world):
+        """Review regression: more completed prefills than decode
+        capacity must PARK on the prefill worker (handoff retried next
+        tick) — a fault-free run must never count a replay, because the
+        FLEET_BENCH/obs gates hold fleet_replays two-sided to 0."""
+        params, _, _ = fleet_world
+        rng = np.random.default_rng(9)
+        prompts = [rng.integers(0, CFG.vocab, 4).astype(np.int32)
+                   for _ in range(10)]
+        ref = [np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], 8, CFG))[0][4:].tolist()
+            for p in prompts]
+        # 1 prefill + 1 decode, 4 slots each: short prompts complete
+        # prefill far faster than the decode worker drains them
+        scfg = ServeConfig(max_reqs=4, page_size=4, n_pages=24,
+                           max_pages_per_seq=6, prefill_chunk=6)
+        fleet = ServeFleet(params, CFG, scfg, FleetConfig(1, 1))
+        reqs = [fleet.submit(p, max_new=8) for p in prompts]
+        s = fleet.run()
+        assert s["completed"] == 10
+        assert s["fleet_replays"] == 0        # parked, never replayed
+        assert s["serve_recoveries"] == 0
+        for q, want in zip(reqs, ref):
+            assert q.generated == want

@@ -404,13 +404,15 @@ class TestJ7GradScale:
     def test_exit_code_with_fixture_env(self):
         # one subprocess pays for the full sweep, so ALL value-level
         # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
-        # accounting), J9 (hierarchical hop accounting) and J10 (serve
-        # recompile-freedom) must each fire and fail the CLI
+        # accounting), J9 (hierarchical hop accounting), J10 (serve
+        # recompile-freedom) and J11 (KV-handoff wire accounting) must
+        # each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
                    GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
                    GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE,
-                   GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE)
+                   GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE,
+                   GRAFTLINT_J11_FIXTURE=TestJ11Handoff.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -420,6 +422,7 @@ class TestJ7GradScale:
         assert "J8:" in proc.stdout
         assert "J9:" in proc.stdout
         assert "J10:" in proc.stdout
+        assert "J11:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -584,4 +587,73 @@ class TestJ10ServeRecompile:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j10()
         assert len(fs) == 1 and fs[0].code == "J10"
+        assert "boom" in fs[0].message
+
+
+class TestJ11Handoff:
+    """J11: the serving KV-handoff program (serve.handoff) must be
+    callback-free, donate its pool operands, and move EXACTLY the
+    migrated pages' bytes — the wire-accounting contract behind the
+    fleet's zero-replay migration claim (docs/SERVING.md)."""
+
+    FIXTURE = os.path.join(FIXTURES, "j11_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j11
+        findings = run_j11()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_with_byte_delta(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j11_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_handoff_program
+        fs = check_handoff_program("j11_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J11"}
+        # the finding must carry the moved-vs-declared numbers
+        assert any("declares" in f.message and "move" in f.message
+                   for f in fs)
+
+    def test_callback_in_program_fires(self):
+        """A host round-trip smuggled into the migration is
+        replay-from-prompt wearing a costume — J11 must name it."""
+        import jax
+        import jax.numpy as jnp
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_handoff_program
+
+        def build():
+            def prog(x):
+                return jax.pure_callback(
+                    lambda v: v, jax.ShapeDtypeStruct(x.shape, x.dtype), x)
+            jx = jax.make_jaxpr(jax.jit(prog, donate_argnums=(0,)))(
+                jax.ShapeDtypeStruct((64,), jnp.float32))
+            return jx, 0, 1
+
+        fs = check_handoff_program("callback", build)
+        assert any("callback" in f.message for f in fs), fs
+
+    def test_plan_wire_bytes_is_exactly_the_pages(self):
+        """The declared accounting equals the pages' actual array bytes
+        — and host-side movement is declared APART from the wire."""
+        import jax.numpy as jnp
+        from fpga_ai_nic_tpu.serve import handoff as handoff_lib
+        plan = handoff_lib.make_plan(n_layers=3, kv_local=2, page_size=4,
+                                     head_dim=8, n_pages=16, n_move=5)
+        per_page = 2 * 4 * 8 * jnp.dtype("float32").itemsize
+        assert plan.wire_bytes() == 2 * 3 * 5 * per_page
+        # host bytes: the table row ids + the request's token ids
+        assert plan.host_bytes(n_tokens=11) == 5 * 4 + 11 * 4
+
+    def test_surface_failure_lands_as_j11_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j11_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j11()
+        assert len(fs) == 1 and fs[0].code == "J11"
         assert "boom" in fs[0].message

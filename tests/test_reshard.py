@@ -399,3 +399,169 @@ def test_recovery_stats_tier_accounting():
     assert d["mttr_reshard_mean_s"] == pytest.approx(0.2)
     assert d["mttr_restore_mean_s"] == pytest.approx(1.0)
     assert d["mttr_mean_s"] == pytest.approx((0.2 + 1.0 + 5.0) / 3)
+
+
+def test_elastic_rearm_second_preemption_reshards_again(tmp_path):
+    """Re-arm satellite: a LADDER policy (dp8 -> dp4 -> dp2) must
+    recover a SECOND preemption by the reshard tier too — no silent
+    fall-back to the slow restore tier in a long job."""
+    tr8 = _trainer(8, kind="sgd")
+    state = tr8.init_state(_params())
+    host_batch = _data()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=1),
+         chaos.FaultSpec("preemption", "queue.issue", step=3)], seed=11)
+    with chaos.activate(plan):
+        et = ElasticTrainer(
+            tr8, str(tmp_path), _ECFG, plan=plan,
+            reshard=ReshardPolicy(
+                lambda n: _trainer(n, kind="sgd"), shrink_to=(4, 2)))
+        et.prewarm_reshard(state, host_batch)
+        state, metrics = et.run(state, lambda i: host_batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert int(state.step) == 5
+    assert np.isfinite(float(metrics["loss"]))
+    assert et.trainer.n == 2
+    assert rec["faults"] == {"shrinkable": 2}
+    assert rec["reshards"] == 2
+    assert rec["checkpoint_restores"] == 0
+    # ladder exhausted -> disarmed
+    assert et.reshard_policy is None
+
+
+def test_rearm_bounded_by_max_reshards(tmp_path):
+    """The bound: max_reshards=1 on a two-rung ladder means the second
+    preemption takes the RESTORE tier (classified plain preemption)."""
+    tr8 = _trainer(8, kind="sgd")
+    state = tr8.init_state(_params())
+    host_batch = _data()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=1),
+         chaos.FaultSpec("preemption", "queue.issue", step=3)], seed=11)
+    with chaos.activate(plan):
+        et = ElasticTrainer(
+            tr8, str(tmp_path), _ECFG, plan=plan,
+            reshard=ReshardPolicy(
+                lambda n: _trainer(n, kind="sgd"), shrink_to=(4, 2),
+                max_reshards=1))
+        et.prewarm_reshard(state, host_batch)
+        state, metrics = et.run(state, lambda i: host_batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert int(state.step) == 5
+    assert et.trainer.n == 4                    # rung 2 never taken
+    assert rec["faults"] == {"shrinkable": 1, "preemption": 1}
+    assert rec["reshards"] == 1
+    assert rec["checkpoint_restores"] >= 1
+    assert et.reshard_policy is None            # bound exhausted
+
+
+def test_elastic_scale_out_grow_dp4_to_dp8(tmp_path):
+    """Scale-OUT under the supervisor (grow satellite): a preemption
+    with a GROW target armed recovers by union-seeded reshard — run
+    completes on the dp8 trainer, zero restores, and the banked
+    seed_bytes matches the plan's declaration (honesty: the grow-path
+    device_put is counted apart from the ppermute wire bytes)."""
+    tr4 = _trainer(4, kind="sgd")
+    state = tr4.init_state(_params())
+    host_batch = _data()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=2)], seed=11)
+    factory = lambda n: _trainer(n, kind="sgd")  # noqa: E731
+    with chaos.activate(plan):
+        et = ElasticTrainer(
+            tr4, str(tmp_path), _ECFG, plan=plan,
+            reshard=ReshardPolicy(factory, shrink_to=8))
+        et.prewarm_reshard(state, host_batch)
+        state, metrics = et.run(state, lambda i: host_batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert int(state.step) == 5
+    assert np.isfinite(float(metrics["loss"]))
+    assert et.trainer.n == 8
+    assert rec["faults"] == {"shrinkable": 1}
+    assert rec["reshards"] == 1
+    assert rec["checkpoint_restores"] == 0
+    # seed_bytes honesty: the event banks EXACTLY the plan's declaration,
+    # and a grow genuinely seeds (nonzero)
+    done = [e for e in et.profiler.events.snapshot()
+            if e["name"] == "reshard.done"]
+    assert done, "reshard.done instant missing"
+    src_ref, tgt_ref = _trainer(4, kind="sgd"), _trainer(8, kind="sgd")
+    src_ref._ensure_meta(_params())
+    want = rs.plan_for(src_ref, tgt_ref)
+    assert done[-1]["attrs"]["seed_bytes"] == want.seed_bytes() > 0
+    # the union chunking may equal the target chunking, in which case
+    # the collective program moves NOTHING (all movement was the seed) —
+    # the event must still bank the plan's exact (possibly zero) figure
+    assert done[-1]["attrs"]["wire_bytes"] == want.wire_bytes()
+
+
+def test_bit_parity_grow_dp4_to_dp8():
+    """The grow mirror of THE shrink acceptance test: a dp4 state grown
+    to dp8 by union seeding equals the natively-constructed dp8 state
+    leafwise BITWISE (fused-adamw moments and topk EF residual
+    included), and the next step is bitwise too."""
+    cls, codec, opts, fused = DPTrainer, "topk", (), True
+    tr4 = _trainer(4, codec=codec, codec_opts=opts, fused=fused, cls=cls)
+    state = tr4.init_state(_params())
+    batch4 = tr4.shard_batch(_data())
+    for _ in range(2):
+        state, _m = tr4.step(state, batch4)
+
+    tr8 = _trainer(8, codec=codec, codec_opts=opts, fused=fused, cls=cls)
+    host = jax.device_get(state)
+    native = _native_state(tr8, host, tr4)
+    plan = rs.plan_for(tr4, tr8)
+    assert plan.seed_bytes() > 0        # a grow genuinely union-seeds
+    grown = rs.reshard_state(tr4, tr8, state)
+
+    assert int(grown.step) == int(native.step) == 2
+    np.testing.assert_array_equal(np.asarray(grown.w_own),
+                                  np.asarray(native.w_own))
+    for k in native.opt_state:
+        np.testing.assert_array_equal(np.asarray(grown.opt_state[k]),
+                                      np.asarray(native.opt_state[k]))
+    if native.codec_state is not None:
+        np.testing.assert_array_equal(np.asarray(grown.codec_state),
+                                      np.asarray(native.codec_state))
+        assert float(jnp.abs(grown.codec_state).max()) > 0.0
+
+    batch8 = tr8.shard_batch(_data())
+    s_g, m_g = tr8.step(grown, batch8)
+    s_n, m_n = tr8.step(native, batch8)
+    lg = m_g["loss"] if isinstance(m_g, dict) else m_g
+    ln = m_n["loss"] if isinstance(m_n, dict) else m_n
+    assert float(lg) == float(ln)
+    np.testing.assert_array_equal(np.asarray(s_g.w_own),
+                                  np.asarray(s_n.w_own))
+
+
+def test_noop_rung_skipped_not_wedged(tmp_path):
+    """Review regression: a ladder written as the full descent (8, 4)
+    on a dp8 trainer must SKIP the no-op rung 8 and reshard to 4 on the
+    first preemption — not silently wedge the tier into restore-only
+    recovery with the policy still armed."""
+    tr8 = _trainer(8, kind="sgd")
+    state = tr8.init_state(_params())
+    host_batch = _data()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=2)], seed=11)
+    with chaos.activate(plan):
+        et = ElasticTrainer(
+            tr8, str(tmp_path), _ECFG, plan=plan,
+            reshard=ReshardPolicy(
+                lambda n: _trainer(n, kind="sgd"), shrink_to=(8, 4)))
+        state, metrics = et.run(state, lambda i: host_batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert int(state.step) == 5
+    assert et.trainer.n == 4
+    assert rec["faults"] == {"shrinkable": 1}
+    assert rec["reshards"] == 1
+    assert rec["checkpoint_restores"] == 0
+    assert et.reshard_policy is None          # ladder exhausted
+
+
+def test_reshard_policy_validates_rungs():
+    with pytest.raises(ValueError, match="non-positive"):
+        ReshardPolicy(lambda n: None, shrink_to=(4, 0))
+    with pytest.raises(ValueError, match="at least one"):
+        ReshardPolicy(lambda n: None, shrink_to=())

@@ -305,3 +305,54 @@ class TestLinkRateRouting:
         assert tuple(lr0["rates"]) == tuple(ring_cost.DEFAULT_LINK_RATES)
         be0 = ring_cost.break_even(8.0, 8.0, 3.76, 3.76)
         assert be0["calibrated"] is False
+
+
+class TestIntraCalibration:
+    """Satellite: the intra (fast-hop) rate must harvest from the banked
+    fused-kernel loopback rows — TUNE_BENCH_r09's calibration block said
+    `intra_calibrated: false` while loopback artifacts existed.  The
+    loopback runs the whole ring wire path THROUGH one chip, so it is a
+    genuine within-chip measurement; provenance carries the dryrun flag
+    honestly."""
+
+    def _loopback_artifact(self, platform="tpu", rate=1.5):
+        return (f"artifacts/collective_{platform}_x.json", {
+            "metric": "allreduce_busbw_gbps", "platform": platform,
+            "_provenance": {"git_sha": "c" * 40},
+            "fused_ring_loopback_gbps": rate})
+
+    def test_intra_harvested_from_tpu_loopback(self):
+        cal = tune.load_calibration(
+            artifacts=[self._loopback_artifact("tpu", 1.5)])
+        assert cal.intra_calibrated and cal.intra_gbps == 1.5
+        assert "loopback" in cal.intra_source
+        assert cal.intra_dryrun is False
+        d = cal.describe()
+        assert d["intra_calibrated"] is True
+        assert d["intra_dryrun"] is False
+
+    def test_intra_dryrun_provenance_is_honest(self):
+        cal = tune.load_calibration(
+            artifacts=[self._loopback_artifact("cpu", 0.9)])
+        assert cal.intra_calibrated and cal.intra_gbps == 0.9
+        assert cal.intra_dryrun is True
+        assert "dryrun" in cal.intra_source
+        # a TPU row outranks the dryrun one regardless of order
+        cal2 = tune.load_calibration(
+            artifacts=[self._loopback_artifact("cpu", 0.9),
+                       self._loopback_artifact("tpu", 1.5)])
+        assert cal2.intra_gbps == 1.5 and cal2.intra_dryrun is False
+
+    def test_no_loopback_stays_uncalibrated_fallback(self):
+        cal = tune.load_calibration(artifacts=[])
+        assert not cal.intra_calibrated
+        assert cal.intra_gbps == tune.calibration.FALLBACK_INTRA_GBPS
+        assert "fallback" in cal.intra_source
+
+    def test_repo_banked_artifacts_flip_the_flag(self):
+        """The repo HAS banked loopback rows (COLLECTIVE_r*.json /
+        artifacts/collective_tpu_*), so the real calibration's flag must
+        now read True — the satellite's acceptance."""
+        cal = tune.load_calibration()
+        assert cal.intra_calibrated is True
+        assert "loopback" in cal.intra_source

@@ -336,8 +336,14 @@ def run_shrink_cell(rig: WireRig, ecfg: ElasticConfig, n_steps: int,
 # serving cells: request-level SLO under fault (docs/SERVING.md)
 # ---------------------------------------------------------------------------
 
-SERVE_FAULTS = ("hang", "slowdown", "exception", "preemption")
+SERVE_FAULTS = ("hang", "slowdown", "exception", "corruption",
+                "preemption")
 SERVE_FAULT_TICK = 3        # mid-run: prefill and decode both in flight
+# corruption at serve.step NaN-damages the tick's KV payload; a high
+# fraction guarantees visible positions are hit so the in-graph
+# NaN/garbage-logits guard MUST trip (serve.engine._logit_guard) —
+# recovery, never a poisoned stream
+SERVE_CORRUPTION_FRACTION = 0.5
 
 
 class ServeRig:
@@ -378,10 +384,14 @@ class ServeRig:
 def run_serve_cell(rig: ServeRig, kind: str, timeout_s: float,
                    hang_s: float, slow_s: float) -> dict:
     t0 = time.time()
-    dur = hang_s if kind == "hang" else slow_s
+    kw: dict = {}
+    if kind in ("hang", "slowdown"):
+        kw["duration_s"] = hang_s if kind == "hang" else slow_s
+    elif kind == "corruption":
+        kw.update(mode="nan", fraction=SERVE_CORRUPTION_FRACTION)
     plan = chaos.FaultPlan(
-        [chaos.FaultSpec(kind, "serve.step", step=SERVE_FAULT_TICK,
-                         duration_s=dur)], seed=SEED)
+        [chaos.FaultSpec(kind, "serve.step", step=SERVE_FAULT_TICK, **kw)],
+        seed=SEED)
     cell = {"kind": kind, "site": "serve.step", "wire": "serve",
             "requests": len(rig.prompts), "max_new": rig.max_new}
     try:
@@ -434,6 +444,126 @@ def run_serve_cells(timeout_s: float, hang_s: float,
         log(f"cell serve {kind:10s} @ serve.step  : {verdict:9s} "
             f"token_exact={cell.get('token_exact')} "
             f"recoveries={cell.get('serve_recoveries')} "
+            f"({cell['wall_s']:.1f}s)")
+        cells.append(cell)
+    return cells
+
+
+# ---------------------------------------------------------------------------
+# fleet cells: replica-kill + handoff-fault SLO over the elastic fleet
+# (serve/fleet.py, docs/SERVING.md "The fleet")
+# ---------------------------------------------------------------------------
+
+FLEET_FAULTS = ("replica_kill", "handoff_exception")
+FLEET_KILL_TICK = 6         # mid-decode under load (prefills done, decoders live)
+
+
+class FleetRig:
+    """One fleet workload + its fault-free reference streams.  The
+    fault-free FLEET run is the reference (not isolated generate): the
+    replica-kill verdict is BYTE-identity of surviving streams, which
+    the deterministic scheduler + page-assignment-invariant forward
+    guarantee structurally — any divergence is a migration bug."""
+
+    def __init__(self):
+        from fpga_ai_nic_tpu.models import llama as llama_lib
+        from fpga_ai_nic_tpu.serve import FleetConfig, ServeConfig
+        self.llama_cfg = llama_lib.LlamaConfig.tiny()
+        self.params = llama_lib.init(jax.random.PRNGKey(0), self.llama_cfg)
+        rng = np.random.default_rng(SEED)
+        self.prompts = [rng.integers(0, self.llama_cfg.vocab,
+                                     int(n)).astype(np.int32)
+                        for n in rng.integers(4, 14, 6)]
+        self.max_new = 6
+        self.scfg = ServeConfig(max_reqs=4, page_size=4, n_pages=40,
+                                max_pages_per_seq=6, prefill_chunk=6)
+        self.fcfg = FleetConfig(n_prefill=1, n_decode=2)
+        _f, ref_reqs, self.ref_summary = self.serve(None)
+        self.reference = [list(r.generated) for r in ref_reqs]
+
+    def serve(self, plan):
+        from fpga_ai_nic_tpu.serve import ServeFleet
+        fleet = ServeFleet(self.params, self.llama_cfg, self.scfg,
+                           self.fcfg, chaos=plan)
+        reqs = [fleet.submit(p, max_new=self.max_new)
+                for p in self.prompts]
+        with chaos.activate(plan):
+            summary = fleet.run()
+        return fleet, reqs, summary
+
+
+def run_fleet_cell(rig: FleetRig, kind: str) -> dict:
+    t0 = time.time()
+    if kind == "replica_kill":
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("preemption", "fleet.membership",
+                             step=FLEET_KILL_TICK)], seed=SEED)
+    else:   # handoff_exception: fault EVERY early handoff attempt —
+            # each degraded request must land on the replay tier and
+            # still complete (specs fire at most once per step)
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("exception", "serve.handoff", step=s)
+             for s in range(12)], seed=SEED)
+    cell = {"kind": kind, "site": ("fleet.membership"
+                                   if kind == "replica_kill"
+                                   else "serve.handoff"),
+            "wire": "fleet", "requests": len(rig.prompts),
+            "max_new": rig.max_new}
+    try:
+        fleet, reqs, s = rig.serve(plan)
+    except Exception as err:  # noqa: BLE001 — the cell verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    completed = s["completed"] == len(rig.prompts)
+    token_exact = all(list(q.generated) == want
+                      for q, want in zip(reqs, rig.reference))
+    injected = len(plan.fired) >= 1
+    if kind == "replica_kill":
+        # THE acceptance: handoff tier used, replay tier NOT fired —
+        # zero replay-from-prompt for migrated requests
+        cell["recovered"] = (completed and injected
+                             and s["kills"] == 1
+                             and s["fleet_replays"] == 0
+                             and s["serve_recoveries"] == 0
+                             and s["handoffs"]
+                             > rig.ref_summary["handoffs"])
+    else:
+        # degraded-but-never-lost: every faulted handoff fell back to
+        # replay, all requests still completed token-exact
+        cell["recovered"] = (completed and injected
+                             and s["fleet_replays"] >= 1)
+    ok = cell["recovered"]
+    r = s["requests"]
+    cell.update(
+        ok=bool(ok and token_exact and s["recompiles_steady"] == 0),
+        token_exact=token_exact,
+        kills=s["kills"],
+        handoffs=s["handoffs"],
+        handoff_wire_bytes=s["handoff_wire_bytes"],
+        fleet_replays=s["fleet_replays"],
+        serve_recoveries=s["serve_recoveries"],
+        faults=s["recovery"]["faults"],
+        fleet_mttr_s=round(s["recovery"]["mttr_mean_s"], 4),
+        recompiles_steady=s["recompiles_steady"],
+        ttft_p95_s=r.get("ttft_p95_s"),
+        latency_p95_s=r.get("latency_p95_s"),
+        survivors=[x["replica"] for x in s["replicas"] if x["alive"]],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_fleet_cells() -> list:
+    rig = FleetRig()
+    cells = []
+    for kind in FLEET_FAULTS:
+        cell = run_fleet_cell(rig, kind)
+        verdict = "recovered" if cell.get("recovered") else "FAILED"
+        log(f"cell fleet {kind:17s}: {verdict:9s} "
+            f"token_exact={cell.get('token_exact')} "
+            f"handoffs={cell.get('handoffs')} "
+            f"replays={cell.get('fleet_replays')} "
             f"({cell['wall_s']:.1f}s)")
         cells.append(cell)
     return cells
@@ -563,6 +693,11 @@ def main() -> int:
                     help="run ONLY the serving SLO-under-fault cells "
                          "(the CI-sized gate; the full matrix also "
                          "includes them)")
+    ap.add_argument("--fleet-only", action="store_true",
+                    help="run ONLY the fleet cells (replica-kill KV "
+                         "migration + handoff-fault degradation; the "
+                         "CI-sized gate — the full matrix also includes "
+                         "them)")
     ap.add_argument("--reshard-bench", action="store_true",
                     help="run the trainer x codec reshard-vs-restore MTTR "
                          "matrix instead of the fault matrix (banked as "
@@ -585,6 +720,30 @@ def main() -> int:
     plat = jax.devices()[0].platform
     log(f"platform={plat} devices={len(jax.devices())} fast={args.fast}")
     chaos.install_collective_tap()     # before any step is traced
+
+    if args.fleet_only:
+        fleet_cells = run_fleet_cells()
+        result = {
+            "bench": "chaos_fleet",
+            "fast": args.fast,
+            "platform": plat,
+            "n_devices": len(jax.devices()),
+            "dryrun": plat != "tpu",
+            "fleet_cells": fleet_cells,
+            "ok": all(c["ok"] for c in fleet_cells),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+        if not args.no_artifact:
+            save_artifact("chaos_fleet", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "fleet_cells"} |
+                         {"fleet_cells_ok":
+                          sum(c["ok"] for c in fleet_cells),
+                          "fleet_cells_total": len(fleet_cells)},
+                         indent=1))
+        return 0 if result["ok"] else 1
 
     if args.serve_only:
         serve_cells = run_serve_cells(timeout_s, hang_s, slow_s)
@@ -657,6 +816,8 @@ def main() -> int:
     # the serving plane's cell battery: request-level SLO (completion +
     # token-exactness + recovery class) under the same fault kinds
     serve_cells = run_serve_cells(timeout_s, hang_s, slow_s)
+    # the fleet battery: replica-kill KV migration + handoff degradation
+    fleet_cells = run_fleet_cells()
 
     result = {
         "bench": "chaos_matrix",
@@ -666,14 +827,17 @@ def main() -> int:
         "dryrun": plat != "tpu",       # CPU-mesh evidence, marked as such
         "matrix": {"kinds": list(chaos.FAULT_KINDS),
                    "sites": list(chaos.TRAIN_SITES), "wires": wires,
-                   "serve_site": "serve.step"},
+                   "serve_site": "serve.step",
+                   "fleet_sites": ["fleet.membership", "serve.handoff"]},
         "cells": cells,
         "shrink_cells": shrink_cells,
         "serve_cells": serve_cells,
+        "fleet_cells": fleet_cells,
         "soak": soaks,
         "ok": (all(c["ok"] for c in cells)
                and all(c["ok"] for c in shrink_cells)
                and all(c["ok"] for c in serve_cells)
+               and all(c["ok"] for c in fleet_cells)
                and all(s["ok"] for s in soaks)),
     }
     if args.out:

@@ -82,6 +82,11 @@ _WATCH = {
               "fpga_ai_nic_tpu/models/llama_decode.py",
               "fpga_ai_nic_tpu/runtime/requests.py",
               "fpga_ai_nic_tpu/obs/metrics.py"],
+    "fleet": ["tools/serve_bench.py", "tools/chaos_bench.py",
+              "fpga_ai_nic_tpu/serve/",
+              "fpga_ai_nic_tpu/models/llama_decode.py",
+              "fpga_ai_nic_tpu/runtime/chaos.py",
+              "fpga_ai_nic_tpu/runtime/requests.py"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -710,6 +715,54 @@ def main():
                       "with the max_seq/working-set gap.  Accounting is "
                       "exact (`serve.paged.pool_bytes` == the device "
                       "array sizes, tested) and gated two-sided.", ""]
+
+    # -- elastic fleet (disaggregation + replica-kill KV migration) ----------
+    fl_art = (_newest("artifacts/fleet_bench_*.json")
+              or _newest("FLEET_BENCH_r*.json"))
+    if fl_art:
+        d = _load(fl_art)
+        rows = d.get("rows", [])
+        if rows:
+            dry = bool(d.get("dryrun"))
+            fl = d.get("fleet") or {}
+            wl = d.get("workload") or {}
+            L += ["## Elastic serving fleet (disaggregated "
+                  "prefill/decode + live KV migration)", "",
+                  f"Source: `{_rel(fl_art)}`{_badge(d, 'fleet')} "
+                  f"(platform: {d.get('platform')}; `make fleet-bench`)."
+                  f"  A {fl.get('n_prefill')}-prefill / "
+                  f"{fl.get('n_decode')}-decode fleet "
+                  f"({wl.get('n_requests')} requests) where every "
+                  "request rides prefill → KV-handoff → decode "
+                  "(`serve/fleet.py`): the handoff is a pair-ppermute "
+                  "transfer program whose wire bytes are exactly the "
+                  "migrated pages (graftlint J11).  The `replica_kill` "
+                  "row preempts a decode replica mid-run: surviving "
+                  "streams must be BYTE-identical to the steady fleet "
+                  "run with ZERO replay-from-prompt (handoff tier used, "
+                  "the replay tier never fires).", ""]
+            if dry:
+                L += ["**Dryrun rows** (virtual CPU mesh): MTTR/TTFT "
+                      "carry oversubscription noise — `make obs-gate` "
+                      "gates only the exact accounting "
+                      "(handoff bytes/counts, zero replays, zero "
+                      "recompiles, all two-sided); the timing verdict "
+                      "needs a TPU surface.", ""]
+            L += ["| scenario | tok/s | TTFT p95 s | handoffs "
+                  "| handoff wire B | replays | replay-tier | MTTR s "
+                  "| recompiles | token-exact |",
+                  "|---|---|---|---|---|---|---|---|---|---|"]
+            for r in rows:
+                L.append(
+                    f"| {r['scenario']} | {r.get('throughput_tok_s')} "
+                    f"| {r.get('ttft_p95_s')} | {r.get('handoffs')} "
+                    f"| {r.get('handoff_wire_bytes'):,} "
+                    f"| {r.get('fleet_replays')} "
+                    f"| {r.get('serve_recoveries')} "
+                    f"| {r.get('fleet_mttr_s')} "
+                    f"| {r.get('recompiles_steady')} "
+                    f"| {r.get('token_exact')} |")
+            L.append("")
 
     # -- telemetry summary (obs gate) ----------------------------------------
     obs_art = _newest("artifacts/obs_summary_*.json")

@@ -101,6 +101,19 @@ SERVE_BYTE_KEYS = ("pool_bytes", "page_table_bytes",
                    "contiguous_cache_bytes", "recompiles_steady")
 TOL_SERVE_TIME = 0.40
 
+# fleet rows (FLEET_BENCH_r*.json, one per scenario): the handoff wire
+# accounting and the recovery-tier facts are exact two-sided — the
+# banked zeros for fleet_replays / serve_recoveries mean ANY replay or
+# replay-tier firing where the handoff tier should have moved the
+# request fails CI, and handoff_wire_bytes drifting means the plan or
+# the migration set changed (J11 territory, not noise).  MTTR / TTFT /
+# throughput gate on non-dryrun artifacts only, the fused-opt honesty
+# rule.
+FLEET_GATE_KEYS = ("fleet_mttr_s", "ttft_p95_s", "throughput_tok_s")
+FLEET_BYTE_KEYS = ("handoff_wire_bytes", "handoffs", "fleet_replays",
+                   "serve_recoveries", "recompiles_steady")
+TOL_FLEET_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -124,6 +137,10 @@ def tune_metric(regime: str, key: str) -> str:
 
 def serve_metric(max_reqs, key: str) -> str:
     return f"serve.c{max_reqs}.{key}"
+
+
+def fleet_metric(scenario: str, key: str) -> str:
+    return f"fleet.{scenario}.{key}"
 
 
 def _load(path):
@@ -277,6 +294,28 @@ def build_banked_summary() -> dict:
                 else:
                     m = _metric(v, src, higher=False, tol=TOL_SERVE_TIME)
                 metrics[serve_metric(row["max_reqs"], key)] = m
+
+    # -- fleet (replica-kill / disaggregation) --------------------------------
+    p = (_newest("artifacts/fleet_bench_*.json")
+         or _newest("FLEET_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (FLEET_BYTE_KEYS if d.get("dryrun")
+                else FLEET_BYTE_KEYS + FLEET_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in FLEET_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                elif key == "throughput_tok_s":
+                    m = _metric(v, src, tol=TOL_FLEET_TIME)
+                else:
+                    m = _metric(v, src, higher=False,
+                                tol=TOL_FLEET_TIME)
+                metrics[fleet_metric(row["scenario"], key)] = m
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 

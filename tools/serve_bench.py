@@ -78,12 +78,15 @@ def _workload():
             for n in rng.integers(4, 17, N_REQUESTS)]
 
 
-def _reference(params, prompts):
-    """Greedy per-request reference continuations (isolated generate)."""
+def _reference(params, prompts, max_new=MAX_NEW):
+    """Greedy per-request reference continuations (isolated generate) —
+    THE token-exactness reference for the curve and the fleet bench
+    alike (one definition, so an eos/reference fix cannot skew one
+    verdict and not the other)."""
     out = []
     for p in prompts:
         full = np.asarray(dec.generate(
-            params, jnp.asarray(p)[None], MAX_NEW, CFG))[0]
+            params, jnp.asarray(p)[None], max_new, CFG))[0]
         out.append(full[len(p):].tolist())
     return out
 
@@ -131,13 +134,162 @@ def run_row(params, prompts, ref, max_reqs: int) -> dict:
     return row
 
 
+# -- fleet bench (make fleet-bench -> FLEET_BENCH artifact) ------------------
+#
+# Two scenarios over the same seeded workload on a 1-prefill/2-decode
+# fleet: `steady` (the disaggregated pipeline, fault-free, token-exact
+# vs isolated generate) and `replica_kill` (a decode replica preempted
+# mid-run; every surviving stream must be BYTE-identical to the steady
+# fleet run, with zero replay — the handoff tier).  CPU rows are
+# dryrun-class: obs-gate holds them to the exact accounting only
+# (handoff_wire_bytes / handoffs / replays / recoveries / recompiles,
+# all two-sided) — fleet MTTR and TTFT gate on a TPU surface.
+
+FLEET_N_REQUESTS = 12
+FLEET_MAX_NEW = 6
+FLEET_KILL_TICK = 6
+
+
+def _fleet_workload():
+    rng = np.random.default_rng(SEED)
+    return [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+            for n in rng.integers(4, 14, FLEET_N_REQUESTS)]
+
+
+def _fleet_scfg():
+    # per-replica slots/pages provisioned so ONE decode survivor can
+    # absorb the victim's whole live set (the zero-replay bar): 8 slots
+    # and 3 pages/slot + slack per replica
+    from fpga_ai_nic_tpu.serve import ServeConfig
+    return ServeConfig(max_reqs=8, page_size=PAGE_SIZE, n_pages=28,
+                       max_pages_per_seq=PAGES_PER_SEQ,
+                       prefill_chunk=PAGE_SIZE)
+
+
+def _fleet_serve(params, prompts, plan):
+    from fpga_ai_nic_tpu.runtime import chaos
+    from fpga_ai_nic_tpu.serve import FleetConfig, ServeFleet
+    fleet = ServeFleet(params, CFG, _fleet_scfg(),
+                       FleetConfig(n_prefill=1, n_decode=2), chaos=plan)
+    reqs = [fleet.submit(p, max_new=FLEET_MAX_NEW) for p in prompts]
+    with chaos.activate(plan):
+        s = fleet.run()
+    return fleet, reqs, s
+
+
+def _fleet_row(scenario, s, reqs, reference, t0) -> dict:
+    token_exact = all(list(q.generated) == want
+                      for q, want in zip(reqs, reference))
+    r = s["requests"]
+    row = {
+        "scenario": scenario,
+        "n_requests": s["n_requests"],
+        "completed": s["completed"],
+        "throughput_tok_s": s["throughput_tok_s"],
+        "ttft_p95_s": r.get("ttft_p95_s"),
+        "latency_p95_s": r.get("latency_p95_s"),
+        "handoffs": s["handoffs"],
+        "handoff_wire_bytes": s["handoff_wire_bytes"],
+        "handoff_host_bytes": s["handoff_host_bytes"],
+        "fleet_replays": s["fleet_replays"],
+        "serve_recoveries": s["serve_recoveries"],
+        "kills": s["kills"],
+        "fleet_mttr_s": round(s["recovery"]["mttr_mean_s"], 4),
+        "recompiles_steady": s["recompiles_steady"],
+        "survivors": sum(1 for x in s["replicas"] if x["alive"]),
+        "token_exact": token_exact,
+        "wall_s": round(time.time() - t0, 2),
+    }
+    row["ok"] = bool(token_exact
+                     and s["completed"] == s["n_requests"]
+                     and s["recompiles_steady"] == 0
+                     and s["fleet_replays"] == 0
+                     and s["serve_recoveries"] == 0
+                     and (s["kills"] == 1) == (scenario == "replica_kill"))
+    return row
+
+
+def run_fleet_bench(args) -> int:
+    from fpga_ai_nic_tpu.runtime import chaos
+    plat = jax.devices()[0].platform
+    log(f"platform={plat} devices={len(jax.devices())} bench=fleet")
+    params = llama.init(jax.random.PRNGKey(0), CFG)
+    prompts = _fleet_workload()
+    log(f"phase=reference n={len(prompts)} max_new={FLEET_MAX_NEW}")
+    iso_ref = _reference(params, prompts, FLEET_MAX_NEW)
+
+    t0 = time.time()
+    _f, reqs, s = _fleet_serve(params, prompts, None)
+    steady = _fleet_row("steady", s, reqs, iso_ref, t0)
+    # steady must ALSO be exact vs isolated generate — pinned above via
+    # reference; the kill row's reference is the steady FLEET streams
+    # (byte-identity is the migration claim)
+    fleet_ref = [list(q.generated) for q in reqs]
+    log(f"row steady: {steady['throughput_tok_s']} tok/s "
+        f"handoffs={steady['handoffs']} "
+        f"wire={steady['handoff_wire_bytes']}B "
+        f"{'ok' if steady['ok'] else 'FAILED'} ({steady['wall_s']}s)")
+
+    t0 = time.time()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "fleet.membership",
+                         step=FLEET_KILL_TICK)], seed=SEED)
+    _f2, reqs2, s2 = _fleet_serve(params, prompts, plan)
+    kill = _fleet_row("replica_kill", s2, reqs2, fleet_ref, t0)
+    kill["chaos_fired"] = len(plan.fired)
+    kill["ok"] = bool(kill["ok"] and len(plan.fired) == 1
+                      and s2["handoffs"] > s["handoffs"])
+    log(f"row replica_kill: mttr={kill['fleet_mttr_s']}s "
+        f"ttft_p95={kill['ttft_p95_s']}s "
+        f"handoffs={kill['handoffs']} replays={kill['fleet_replays']} "
+        f"{'ok' if kill['ok'] else 'FAILED'} ({kill['wall_s']}s)")
+
+    rows = [steady, kill]
+    result = {
+        "bench": "fleet",
+        "platform": plat,
+        "n_devices": len(jax.devices()),
+        # CPU rows are dryrun-class: obs-gate holds them only to the
+        # exact accounting (FLEET_BYTE_KEYS); MTTR/TTFT gate on TPU
+        "dryrun": not is_tpu_platform(plat),
+        "model": {"dim": CFG.dim, "n_layers": CFG.n_layers,
+                  "n_heads": CFG.n_heads, "n_kv_heads": CFG.n_kv_heads,
+                  "vocab": CFG.vocab, "dtype": CFG.dtype},
+        "fleet": {"n_prefill": 1, "n_decode": 2,
+                  "kill_tick": FLEET_KILL_TICK},
+        "workload": {"n_requests": FLEET_N_REQUESTS,
+                     "max_new": FLEET_MAX_NEW,
+                     "prompt_lens": [int(p.shape[0]) for p in prompts],
+                     "page_size": PAGE_SIZE, "seed": SEED},
+        "rows": rows,
+        "ok": all(r["ok"] for r in rows),
+    }
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(result, f, indent=1)
+    if not args.no_artifact:
+        save_artifact("fleet_bench", result)
+    print(json.dumps({k: v for k, v in result.items() if k != "rows"} |
+                     {"rows_ok": sum(r["ok"] for r in rows),
+                      "rows_total": len(rows)}, indent=1))
+    return 0 if result["ok"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--out", default=None,
                     help="also write the result JSON to this path")
     ap.add_argument("--no-artifact", action="store_true",
                     help="skip the artifacts/ evidence write")
+    ap.add_argument("--fleet", action="store_true",
+                    help="run the FLEET bench (disaggregated steady row "
+                         "+ replica-kill row) instead of the "
+                         "concurrency curve; banked as the FLEET_BENCH "
+                         "artifact by `make fleet-bench`")
     args = ap.parse_args()
+
+    if args.fleet:
+        return run_fleet_bench(args)
 
     plat = jax.devices()[0].platform
     log(f"platform={plat} devices={len(jax.devices())}")
