@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -148,6 +148,19 @@ fleet-bench:
 	@latest=$$(ls -t artifacts/fleet_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest FLEET_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> FLEET_BENCH_$(ROUND).json"
+
+# wire-integrity bench (docs/CHAOS.md "Exact wire integrity"): checksum
+# on/off overhead per ppermute-bearing route (flat/hier rings per codec,
+# reshard transfer, KV handoff, serve decode tick) + the wirebit
+# trip->recovery MTTR rows; snapshot the newest artifact as the round's
+# committed record (obs-gate consumes it — dryrun CPU rows gate only
+# the exact byte/counter keys: wire_bytes_delta==0 means no checksum
+# ever rides the wire, trips==0 means no false trips, integrity.* keys)
+integrity-bench:
+	python tools/integrity_bench.py
+	@latest=$$(ls -t artifacts/integrity_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest INTEGRITY_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> INTEGRITY_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

@@ -105,9 +105,15 @@ def main(argv):
             jnp.int32)
         batch = tr.shard_batch((x, y))
 
+    def scalar_loss(v):
+        # with integrity_check the step returns a metrics dict (the
+        # wire/value verdicts ride next to the loss) instead of the bare
+        # loss scalar
+        return float(v["loss"] if isinstance(v, dict) else v)
+
     with prof.bucket("warmup"):            # compile + first step
         state, loss = wd.run(tr.step, state, batch)
-        loss = wd.run(float, loss)
+        loss = wd.run(scalar_loss, loss)
 
     import contextlib
     trace_cm = (jax.profiler.trace(trace_dir) if trace_dir
@@ -125,7 +131,7 @@ def main(argv):
     with trace_cm, prof.bucket("train"):
         for _ in range(cfg.iters):
             state, loss = wd.run(tr.step, state, batch)
-        loss = wd.run(float, loss)         # materializes the chain
+        loss = wd.run(scalar_loss, loss)   # materializes the chain
     wall = time.perf_counter() - t0
 
     fl = mlp.flops_per_sample(mcfg) * cfg.global_batch * cfg.iters

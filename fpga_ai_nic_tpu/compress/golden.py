@@ -309,3 +309,70 @@ def hier_all_reduce(shards: np.ndarray, n_intra: int,
                     roundtrip: Optional[RoundtripFn] = None) -> np.ndarray:
     return hier_all_gather(hier_reduce_scatter(shards, n_intra, roundtrip),
                            n_intra, roundtrip)
+
+
+# ---------------------------------------------------------------------------
+# exact wire checksums (spec for ops.integrity — the PR-12 exact tier)
+# ---------------------------------------------------------------------------
+
+def golden_words_u32(x: np.ndarray) -> np.ndarray:
+    """Numpy twin of ops.integrity.words_u32: a payload array as the flat
+    uint32 word vector the checksum is defined over — 4-byte dtypes
+    reinterpret word-for-word (little-endian, the only byte order this
+    stack runs on), 1-/2-byte dtypes zero-extend."""
+    x = np.ascontiguousarray(x).reshape(-1)
+    size = x.dtype.itemsize
+    if size == 4:
+        return x.view(np.uint32)
+    if size == 2:
+        return x.view(np.uint16).astype(np.uint32)
+    if size == 1:
+        return x.view(np.uint8).astype(np.uint32)
+    raise TypeError(f"no wire payload may have itemsize {size}")
+
+
+_U32 = np.uint64(0xFFFFFFFF)
+
+
+def golden_word_checksum(x: np.ndarray) -> np.uint32:
+    """Numpy twin of ops.integrity.word_checksum: the odd-weighted
+    wraparound word sum  sum_i (2i+1) * word_i  (mod 2^32).  Every
+    product is reduced mod 2^32 BEFORE the sum (the jax side works in
+    u32 wraparound throughout); the masked-u32 partial sums then cannot
+    overflow u64 for any physical payload size."""
+    w = golden_words_u32(x).astype(np.uint64)
+    weights = (((np.arange(w.shape[0], dtype=np.uint64) << np.uint64(1))
+                | np.uint64(1)) & _U32)
+    prod = (w * weights) & _U32
+    return np.uint32(int(np.sum(prod, dtype=np.uint64)) & 0xFFFFFFFF)
+
+
+def golden_payload_checksum(payload) -> np.uint32:
+    """Numpy twin of ops.integrity.payload_checksum: per-element odd
+    multipliers over a hop's payload tuple."""
+    acc = 0
+    for k, p in enumerate(payload):
+        acc += (2 * k + 1) * int(golden_word_checksum(np.asarray(p)))
+    return np.uint32(acc & 0xFFFFFFFF)
+
+
+def golden_page_checksums(pool) -> np.ndarray:
+    """Numpy twin of ops.integrity.page_checksums: [n_pages] uint32 — one
+    checksum per KV-pool page over every layer's K and V bytes, word
+    weights restarting per page per array, odd per-array multipliers in
+    layer-major K-then-V order."""
+    acc = None
+    j = 0
+    for layer in pool:
+        for key in ("k", "v"):
+            arr = np.ascontiguousarray(np.asarray(layer[key]))
+            n_pages = arr.shape[0]
+            w = golden_words_u32(arr).reshape(n_pages, -1).astype(np.uint64)
+            weights = (((np.arange(w.shape[1], dtype=np.uint64)
+                         << np.uint64(1)) | np.uint64(1)) & _U32)
+            prod = (w * weights[None, :]) & _U32
+            per_page = np.sum(prod, axis=1, dtype=np.uint64) & _U32
+            term = (np.uint64(2 * j + 1) * per_page) & _U32
+            acc = term if acc is None else (acc + term) & _U32
+            j += 1
+    return acc.astype(np.uint32)

@@ -38,6 +38,11 @@ RULE_DOCS: Dict[str, str] = {
     "J11": "KV handoff program: callback-free, source pools donated, and "
            "ppermute operand bytes == exactly HandoffPlan.wire_bytes() — "
            "the migrated pages and nothing else cross the pair wire",
+    "J12": "wire-integrity coverage: every ppermute-bearing program must "
+           "carry its exact frame checksum when integrity is requested "
+           "(u32 arithmetic + boolean verdict), with ppermute bytes "
+           "IDENTICAL to the integrity-off twin (no checksum rides the "
+           "wire) — or an explicit J12_WAIVERS entry",
     "H1": "happens-before/lockset: an instance attribute written from two "
           "threads (trainer / watchdog worker / callback) needs a common "
           "lock — R1 generalized to cross-thread order",
@@ -48,7 +53,7 @@ RULE_DOCS: Dict[str, str] = {
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8", "J9", "J10", "J11")
+                                "J8", "J9", "J10", "J11", "J12")
 
 
 @dataclass(frozen=True)

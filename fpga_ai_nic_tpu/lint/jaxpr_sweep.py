@@ -1,4 +1,4 @@
-"""Plane 2 — jaxpr invariant sweep (J1–J6), CPU-only.
+"""Plane 2 — jaxpr invariant sweep (J1–J12), CPU-only.
 
 EQuARX (arXiv:2506.17615) and the weight-update sharding work
 (arXiv:2004.13336) both rest on compiler-level invariants of the lowered
@@ -956,6 +956,350 @@ def run_j11(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J12 — wire-integrity coverage (ops.integrity).  PR 12's contract: every
+# ppermute-bearing transfer program must CARRY its exact checksum check
+# when integrity is requested — and carrying it must not change what
+# rides the wire.  Each surface traces the shipped program twice
+# (integrity on / off) and asserts, statically on the jaxprs:
+#
+#   guarded    the integrity=True trace contains uint32 checksum
+#              arithmetic (the odd-weighted word sums) and emits a
+#              boolean verdict output — an integrity flag that lowers to
+#              nothing is coverage theater;
+#   invisible  the ppermute operand bytes x static trip counts are
+#              IDENTICAL between the two traces — no checksum ever rides
+#              the wire, so the exact byte accounting frozen by
+#              J4/J8/J9/J11 holds with integrity on (checksums travel as
+#              psum'd scalars, never payload);
+#   non-vacuous  the program has at least one ppermute to guard (except
+#              the decode-tick surface, whose wire is the KV pool's
+#              write-to-read window — it must emit the [n_pages] uint32
+#              ledger and the checksum arithmetic instead).
+#
+# A surface may be waived ONLY through J12_WAIVERS (name -> reason) —
+# the explicit, greppable escape hatch; the shipped tree must keep it
+# EMPTY (tests/test_lint.py pins that), so any future ppermute program
+# either carries its checksum or carries a visible waiver in review.
+# ---------------------------------------------------------------------------
+
+# name -> reason.  SHIPPED TREE: EMPTY — every surface is guarded.
+J12_WAIVERS: Dict[str, str] = {}
+
+
+def _u32_eqn_count(jaxpr) -> int:
+    """# of eqns (nested) producing a uint32 output — the static
+    signature of the ops.integrity word-sum arithmetic."""
+    import numpy as np
+    n = 0
+    for eqn, _ in _iter_eqns(jaxpr):
+        for v in eqn.outvars:
+            aval = getattr(v, "aval", None)
+            if aval is not None and getattr(aval, "dtype", None) is not None \
+                    and aval.dtype == np.uint32:
+                n += 1
+                break
+    return n
+
+
+def _ppermute_count(jaxpr) -> int:
+    return sum(1 for eqn, _ in _iter_eqns(jaxpr)
+               if eqn.primitive.name == "ppermute")
+
+
+def _has_bool_output(jaxpr) -> bool:
+    import numpy as np
+    return any(getattr(getattr(v, "aval", None), "dtype", None) == np.bool_
+               for v in jaxpr.outvars)
+
+
+def check_integrity_program(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J12 surface.  ``build()`` returns a dict:
+    kind='wire' with jx_on/jx_off (the integrity on/off twin traces of
+    the same program), or kind='page' with jx + n_pages (the decode-tick
+    ledger surface, whose guard is page checksums, not hop carries)."""
+    import numpy as np
+    findings: List[Finding] = []
+    cell = f"jaxpr[integrity {name}]"
+    spec = build()
+
+    if spec["kind"] == "page":
+        jx, n_pages = spec["jx"], spec["n_pages"]
+        if _u32_eqn_count(jx.jaxpr) == 0:
+            findings.append(Finding(
+                "J12", cell, 0,
+                "the decode-tick program carries NO exact checksum "
+                "arithmetic — the per-page KV ledger (the tier that "
+                "closes the finite wrong-KEY class the logit guard "
+                "cannot see) has vanished from the traced program"))
+        has_ledger = any(
+            getattr(getattr(v, "aval", None), "dtype", None) == np.uint32
+            and tuple(getattr(v.aval, "shape", ())) == (n_pages,)
+            for v in jx.jaxpr.outvars)
+        if not has_ledger:
+            findings.append(Finding(
+                "J12", cell, 0,
+                f"the decode-tick program emits no [n_pages={n_pages}] "
+                "uint32 ledger output — the next tick would have nothing "
+                "to verify its input pool against (write-time -> "
+                "read-time coverage broken)"))
+        return findings
+
+    jx_on, jx_off = spec["jx_on"], spec["jx_off"]
+    n_pp = _ppermute_count(jx_on.jaxpr)
+    if n_pp == 0:
+        findings.append(Finding(
+            "J12", cell, 0,
+            "surface has no ppermute to guard — the integrity check is "
+            "vacuous here; fix the surface (or waive it explicitly via "
+            "J12_WAIVERS with a reason)"))
+    if _u32_eqn_count(jx_on.jaxpr) == 0:
+        findings.append(Finding(
+            "J12", cell, 0,
+            "integrity=True traced to a program with NO uint32 checksum "
+            "arithmetic — the wire is unguarded; every ppermute program "
+            "must carry its exact frame checksums (ops.integrity) or an "
+            "explicit J12_WAIVERS entry"))
+    if not _has_bool_output(jx_on.jaxpr):
+        findings.append(Finding(
+            "J12", cell, 0,
+            "integrity=True program emits no boolean verdict output — a "
+            "checksum nobody can act on guards nothing (return wire_ok "
+            "so the recovery machinery can gate/invalidate the step)"))
+    c_on, c_off = _collect(jx_on.jaxpr), _collect(jx_off.jaxpr)
+    if c_on["wire_unknown"] or c_off["wire_unknown"]:
+        findings.append(Finding(
+            "J12", cell, 0,
+            "ppermute under a while_loop — integrity-on/off wire bytes "
+            "not statically comparable (use fori_loop/scan with static "
+            "trip counts)"))
+    elif c_on["wire_bytes"] != c_off["wire_bytes"]:
+        findings.append(Finding(
+            "J12", cell, 0,
+            f"integrity=True moves {c_on['wire_bytes']} ppermute bytes "
+            f"but the same program with integrity off moves "
+            f"{c_off['wire_bytes']} — the checksum rides the wire.  The "
+            "exact byte accounting (J4/J8/J9/J11, obs counters, banked "
+            "ratios) must be IDENTICAL with integrity on: checksums "
+            "travel as psum'd scalars, never as payload"))
+    return findings
+
+
+def _j12_ring_build(codec_name: Optional[str], which: str,
+                    topology: str = "flat", n_intra: int = 2,
+                    sliced: bool = False, L: int = 8192):
+    def build():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+        from ..compress import get_codec
+        from ..ops import ring as ring_ops, ring_hier
+
+        codec = get_codec(codec_name) if codec_name else None
+        unit = _NDEV * (codec.pad_elems if codec else 1)
+        Lp = L + (-L) % unit
+        slice_elems = (Lp // _NDEV) // 2 if sliced else None
+        mesh = Mesh(np.array(jax.devices()[:_NDEV]), ("dp",))
+
+        def trace(integrity: bool):
+            def f(x):
+                kw: Dict[str, Any] = dict(compression=codec,
+                                          integrity=integrity)
+                if topology == "hier":
+                    if which == "reduce_scatter":
+                        return ring_hier.hier_reduce_scatter(
+                            x, "dp", n_intra, slice_elems=slice_elems,
+                            **kw)
+                    if which == "all_gather":
+                        return ring_hier.hier_all_gather(x, "dp",
+                                                         n_intra, **kw)
+                    return ring_hier.hier_all_reduce(
+                        x, "dp", n_intra, slice_elems=slice_elems, **kw)
+                if which == "reduce_scatter":
+                    return ring_ops.ring_reduce_scatter(
+                        x, "dp", slice_elems=slice_elems, **kw)
+                if which == "all_gather":
+                    return ring_ops.ring_all_gather(x, "dp", **kw)
+                return ring_ops.ring_all_reduce(
+                    x, "dp", slice_elems=slice_elems, **kw)
+
+            C = Lp // _NDEV
+            per_dev = C if which == "all_gather" else Lp
+            out_specs = (P("dp"), P()) if integrity else P("dp")
+            return jax.make_jaxpr(jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P("dp"), out_specs=out_specs,
+                check_vma=False)))(
+                jax.ShapeDtypeStruct((_NDEV * per_dev,), jnp.float32))
+
+        return {"kind": "wire", "jx_on": trace(True),
+                "jx_off": trace(False)}
+    return build
+
+
+def _j12_train_build(codec_name: Optional[str], fused: bool):
+    def build():
+        from ..utils.config import (CollectiveConfig, MeshConfig,
+                                    OptimizerConfig, TrainConfig)
+
+        def trace(integrity: bool):
+            cfg = TrainConfig(
+                mesh=MeshConfig(dp=_NDEV),
+                collective=CollectiveConfig(impl="ring", codec=codec_name,
+                                            fused_optimizer=fused,
+                                            integrity_check=integrity),
+                optimizer=OptimizerConfig(kind="adamw"),
+                global_batch=_BATCH, obs_metrics=False)
+            phases, _, _ = _trace_dp(cfg, "dp")
+            return phases[0][1]
+
+        return {"kind": "wire", "jx_on": trace(True),
+                "jx_off": trace(False)}
+    return build
+
+
+def _j12_reshard_build(n_src: int, n_tgt: int, codec_name: Optional[str],
+                       n_flat_leaves: int, residual: bool):
+    def build():
+        import jax
+        from jax.sharding import Mesh
+        import numpy as np
+        from ..compress import get_codec
+        from ..parallel import reshard as reshard_lib
+
+        live = 5000
+        unit = 1 if codec_name is None else get_codec(codec_name).pad_elems
+        pad_src = live + (-live) % (n_src * unit)
+        pad_tgt = live + (-live) % (n_tgt * unit)
+        plan = reshard_lib.make_plan(
+            live, n_src, pad_src, n_tgt, pad_tgt,
+            n_flat_leaves=n_flat_leaves, residual=residual)
+        mesh = Mesh(np.array(jax.devices()[:plan.flat.n_union]), ("dp",))
+        ops = reshard_lib.abstract_operands(plan)
+
+        def trace(integrity: bool):
+            fn = reshard_lib.lower_apply(plan, mesh, "dp", donate=True,
+                                         integrity=integrity)
+            return jax.make_jaxpr(fn)(*ops)
+
+        return {"kind": "wire", "jx_on": trace(True),
+                "jx_off": trace(False)}
+    return build
+
+
+def _j12_handoff_build(n_layers: int, kv_local: int, page_size: int,
+                       head_dim: int, n_pages: int, n_move: int):
+    def build():
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+        from ..serve import handoff as handoff_lib
+
+        plan = handoff_lib.make_plan(
+            n_layers=n_layers, kv_local=kv_local, page_size=page_size,
+            head_dim=head_dim, n_pages=n_pages, n_move=n_move)
+        mesh = Mesh(np.array(jax.devices()[:2]), ("rep",))
+
+        def trace(integrity: bool):
+            fn = handoff_lib.lower_apply(plan, mesh, "rep", donate=True,
+                                         integrity=integrity)
+            return jax.make_jaxpr(fn)(
+                *handoff_lib.abstract_operands(plan, integrity=integrity))
+
+        return {"kind": "wire", "jx_on": trace(True),
+                "jx_off": trace(False)}
+    return build
+
+
+def _j12_decode_build():
+    def build():
+        import jax
+        import jax.numpy as jnp
+        from ..models import llama
+        from ..serve import ServeConfig, ServeEngine
+
+        cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=1, ffn_dim=64)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        scfg = ServeConfig(max_reqs=2, page_size=4, n_pages=4,
+                           max_pages_per_seq=2, prefill_chunk=4,
+                           page_integrity=True)
+        eng = ServeEngine(params, cfg, scfg)
+        toks = jnp.zeros((scfg.max_reqs, 1), jnp.int32)
+        table = jnp.zeros((scfg.max_reqs, scfg.max_pages_per_seq),
+                          jnp.int32)
+        pos = jnp.zeros((scfg.max_reqs,), jnp.int32)
+        act = jnp.zeros((scfg.max_reqs,), bool)
+        jx = jax.make_jaxpr(eng._decode_impl)(
+            eng.pool, eng.params, toks, table, pos, act, eng.ledger)
+        return {"kind": "page", "jx": jx, "n_pages": scfg.n_pages}
+    return build
+
+
+def j12_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs — one per ppermute-bearing program family x
+    route shape (flat/hier/sliced, trainer step incl. the fused route,
+    reshard, handoff) plus the decode-tick ledger surface.
+    GRAFTLINT_J12_FIXTURE appends a surface from a module path exposing
+    ``build()`` — the bad-fixture / exit-code hook, same contract as
+    J7–J11's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("ring rs bfp", _j12_ring_build("bfp", "reduce_scatter")),
+        ("ring rs bfp sliced", _j12_ring_build("bfp", "reduce_scatter",
+                                               sliced=True)),
+        ("ring ag int8", _j12_ring_build("int8", "all_gather")),
+        ("ring ar none", _j12_ring_build(None, "all_reduce")),
+        ("hier rs ni=2 bfp", _j12_ring_build("bfp", "reduce_scatter",
+                                             topology="hier", n_intra=2)),
+        ("hier ar ni=4 int8", _j12_ring_build("int8", "all_reduce",
+                                              topology="hier", n_intra=4)),
+        ("train step adamw bfp", _j12_train_build("bfp", False)),
+        ("train step fused-opt bfp", _j12_train_build("bfp", True)),
+        ("reshard dp8->dp4 adamw", _j12_reshard_build(8, 4, None, 3,
+                                                      False)),
+        ("reshard dp8->dp3 topk+EF", _j12_reshard_build(8, 3, "topk", 2,
+                                                        True)),
+        ("handoff gqa 3 pages", _j12_handoff_build(2, 4, 4, 8, 10, 3)),
+        ("decode tick page ledger", _j12_decode_build()),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J12_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j12_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j12(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j12_surfaces():
+        waiver = J12_WAIVERS.get(name)
+        if waiver:
+            # an explicit waiver is the ONLY sanctioned skip — loud in
+            # the sweep output, greppable in review, and pinned EMPTY
+            # for the shipped tree by tests/test_lint.py
+            if verbose:
+                print(f"[graftlint:jaxpr] integrity {name}: WAIVED "
+                      f"({waiver})")
+            continue
+        try:
+            fs = check_integrity_program(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J12", f"jaxpr[integrity {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] integrity {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -1053,4 +1397,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_j9(verbose=verbose))
     findings.extend(run_j10(verbose=verbose))
     findings.extend(run_j11(verbose=verbose))
+    findings.extend(run_j12(verbose=verbose))
     return findings

@@ -200,10 +200,15 @@ def _fused_bfp_cfg(coll: CollectiveConfig):
 
 def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
                            coll: CollectiveConfig,
-                           chunk_len: int) -> jax.Array:
+                           chunk_len: int):
     """Explicit-ring all-reduce respecting the fused_kernel AND topology
     routing (one definition shared by all_reduce_mean and ops.bucketed so
-    the fallback/slice/topology policy cannot drift between call sites)."""
+    the fallback/slice/topology policy cannot drift between call sites).
+
+    Carries no ``integrity=`` seam on purpose: every caller is a
+    bucketed/queued DDP reduce, and those trainers reject
+    integrity_check at construction until they thread the verdicts —
+    an untestable flag here would be claimed-but-unverified coverage."""
     codec = resolve_codec(coll)
     if getattr(coll, "topology", "flat") == "hier":
         from . import ring_hier
@@ -231,16 +236,23 @@ def ring_all_reduce_routed(flat: jax.Array, axis_name: str,
 
 
 def reduce_scatter(flat_g: jax.Array, axis_name: str,
-                   coll: CollectiveConfig) -> jax.Array:
+                   coll: CollectiveConfig, integrity: bool = False):
+    """``integrity=True`` returns ``(owned, wire_ok)``; wire_ok is the
+    exact frame-conservation verdict of the routed collective
+    (ops.integrity).  impl='xla' owns its own wire (no explicit frames
+    to checksum), so its verdict is constant True — the exact tier is a
+    property of the explicit-ring routes."""
     if coll.impl == "xla":
-        return lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
-                                tiled=True)
+        out = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                               tiled=True)
+        return (out, jnp.bool_(True)) if integrity else out
     codec = resolve_codec(coll)
     if getattr(coll, "topology", "flat") == "hier":
         from . import ring_hier
         return ring_hier.hier_reduce_scatter(
             flat_g, axis_name, coll.intra_size, compression=codec,
-            slice_elems=coll.slice_elems, unroll=coll.unroll_hops)
+            slice_elems=coll.slice_elems, unroll=coll.unroll_hops,
+            integrity=integrity)
     if coll.fused_kernel:
         from . import ring_pallas
         n = lax.axis_size(axis_name)
@@ -251,23 +263,27 @@ def reduce_scatter(flat_g: jax.Array, axis_name: str,
             return ring_pallas.ring_reduce_scatter_fused(
                 flat_g, axis_name, compression=bcfg,
                 slice_elems=slice_e,
-                pipeline_depth=coll.pipeline_depth)
+                pipeline_depth=coll.pipeline_depth,
+                integrity=integrity)
         # off-TPU: the separate-op ring with the CONFIGURED codec (see
         # _warn_fused_fallback); the kernel's own bit-exactness story
         # lives in tests/test_ring_pallas.py
         _warn_fused_fallback()
         return ring_ops.ring_reduce_scatter(
             flat_g, axis_name, compression=codec,
-            slice_elems=slice_e, unroll=coll.unroll_hops)
+            slice_elems=slice_e, unroll=coll.unroll_hops,
+            integrity=integrity)
     return ring_ops.ring_reduce_scatter(flat_g, axis_name,
                                         compression=codec,
                                         slice_elems=coll.slice_elems,
-                                        unroll=coll.unroll_hops)
+                                        unroll=coll.unroll_hops,
+                                        integrity=integrity)
 
 
 def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
                           step, axis_name: str, coll: CollectiveConfig,
-                          opt_cfg: OptimizerConfig):
+                          opt_cfg: OptimizerConfig,
+                          integrity: bool = False):
     """Fused gradient reduce + ZeRO-1 optimizer update: the reference's
     whole point (decode feeds hw/weight_update.sv, no separate optimizer
     pass over HBM) + cross-replica weight-update sharding (ZeRO-1).
@@ -285,7 +301,17 @@ def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
 
     Returns ``(g_own_sum, w_new, opt_state_new)``; g_own_sum is the raw
     reduced SUM shard (callers /n for metrics), bit-identical to
-    ``reduce_scatter`` on the same route."""
+    ``reduce_scatter`` on the same route.
+
+    ``integrity=True`` appends the exact wire verdict: ``(g_own_sum,
+    w_new, opt_state_new, wire_ok)``.  On the in-kernel TPU route the
+    kernel accumulates the frame checksums itself (the update retires
+    with the final-hop decode and the state is DONATED — a tripped
+    verdict invalidates the STEP via the elastic ladder, see
+    runtime.chaos.check_step_diag); every other route still holds the
+    pre-step state, so callers can gate the update in-graph
+    (``update_route_gatable`` tells them which situation they are
+    in)."""
     from ..utils.config import OptimizerSpec
     spec = OptimizerSpec.from_optimizer(opt_cfg)
     n = lax.axis_size(axis_name)
@@ -304,37 +330,70 @@ def reduce_scatter_update(flat_g: jax.Array, w_own: jax.Array, opt_state,
             return ring_pallas.ring_reduce_scatter_update_fused(
                 flat_g, w_own, opt_state, hyper, axis_name,
                 opt_kind=spec.kind, compression=bcfg, slice_elems=slice_e,
-                pipeline_depth=coll.pipeline_depth)
+                pipeline_depth=coll.pipeline_depth, integrity=integrity)
         # off-TPU: reduce_scatter itself warns and routes to the
         # separate-op ring; the update below stays the shared formula
-    g_own = reduce_scatter(flat_g, axis_name, coll)
+    res = reduce_scatter(flat_g, axis_name, coll, integrity=integrity)
+    g_own, wire_ok = res if integrity else (res, None)
     w_new, st2 = optim.fused_apply_flat(spec, w_own, g_own, opt_state,
                                         hyper, n)
+    if integrity:
+        return g_own, w_new, st2, wire_ok
     return g_own, w_new, st2
 
 
+def update_route_gatable(coll: CollectiveConfig, n: int = 0) -> bool:
+    """True when ``reduce_scatter_update`` takes a route that still
+    materializes the pre-step state — i.e. a tripped integrity verdict
+    can be gated IN-GRAPH (``jnp.where(ok, new, old)``).  False only on
+    the in-kernel TPU route, where the master/moment shards are donated
+    kernel operands updated in place: referencing the old value after
+    the call would read the aliased (already-updated) buffer, so the
+    only safe recovery is invalidating the step on the host
+    (check_step_diag -> elastic restore/reshard).  ``n`` is the axis
+    size when the caller knows it (``reduce_scatter_update`` only takes
+    the in-kernel route for n > 1 — a single-device mesh always runs
+    the shared formula, hence gatable); 0 = unknown, assume the
+    in-kernel route is reachable."""
+    from . import ring_pallas
+    return not (coll.fused_kernel and n != 1
+                and getattr(coll, "topology", "flat") == "flat"
+                and ring_pallas._is_tpu())
+
+
 def all_gather_flat(owned: jax.Array, axis_name: str,
-                    coll: CollectiveConfig) -> jax.Array:
+                    coll: CollectiveConfig, integrity: bool = False):
+    """``integrity=True`` returns ``(gathered, wire_ok)`` — per-hop
+    frame conservation on the explicit rings; the replica-agreement
+    exact check on the fused TPU kernel (its wire lives inside the
+    kernel); constant True on impl='xla' (no explicit frames)."""
     if coll.impl == "xla":
-        return lax.all_gather(owned, axis_name, tiled=True)
+        out = lax.all_gather(owned, axis_name, tiled=True)
+        return (out, jnp.bool_(True)) if integrity else out
     codec = resolve_codec(coll)
     if getattr(coll, "topology", "flat") == "hier":
         from . import ring_hier
         return ring_hier.hier_all_gather(
             owned, axis_name, coll.intra_size, compression=codec,
-            unroll=coll.unroll_hops)
+            unroll=coll.unroll_hops, integrity=integrity)
     if coll.fused_kernel:
         from . import ring_pallas
         if ring_pallas._is_tpu():
-            return ring_pallas.ring_all_gather_fused(
+            out = ring_pallas.ring_all_gather_fused(
                 owned, axis_name, compression=_fused_bfp_cfg(coll))
+            if not integrity:
+                return out
+            from . import integrity as integrity_lib
+            return out, integrity_lib.replica_consistent(out, axis_name)
         _warn_fused_fallback()
         return ring_ops.ring_all_gather(owned, axis_name,
                                         compression=codec,
-                                        unroll=coll.unroll_hops)
+                                        unroll=coll.unroll_hops,
+                                        integrity=integrity)
     return ring_ops.ring_all_gather(owned, axis_name,
                                     compression=codec,
-                                    unroll=coll.unroll_hops)
+                                    unroll=coll.unroll_hops,
+                                    integrity=integrity)
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2))

@@ -1,0 +1,182 @@
+"""Exact wire-integrity checksums over ENCODED transfer payloads.
+
+PR 1's collective integrity layer checks VALUE-space chunk sums against a
+codec-derived tolerance band (`runtime.chaos.collective_integrity`) — the
+right tool for "is the arithmetic sane", and provably blind to the class
+the serving ledger documents: a FINITE wrong value.  A flipped mantissa
+bit in a BFP/int8 frame decodes to a plausible, in-band number; a
+wrong-KEY KV page yields wrong-but-normal-magnitude logits.  No tolerance
+band, norm guard or logit guard can see either (docs/SERVING.md's honest
+boundary, pre-PR-12).
+
+This module is the exact tier underneath: a checksum over the BITS that
+cross the wire — the encoded frames themselves (int8 mantissa/scale
+tiles, int16 top-k indices, raw f32 words), not the decoded values — so
+the check is bit-exact with NO tolerance band at all.  Quantization noise
+cannot trip it (the checksum is computed on the post-encode frames both
+sides agree on); any corruption of the frames in flight must.
+
+The checksum is an odd-weighted wraparound word sum:
+
+    chk(x) = sum_i (2*i + 1) * word_i(x)      (mod 2^32)
+
+where ``word_i`` enumerates the payload's bytes widened to uint32 words
+(4-byte dtypes bitcast directly; 1-/2-byte dtypes widened).  Properties
+the wire plane leans on:
+
+  exact        integer arithmetic, wraparound mod 2^32 — deterministic on
+               every backend, inside jit/shard_map, at any slicing.
+  additive     checksums of independent messages ADD, so a multi-hop
+               collective can verify by CONSERVATION: every message is
+               checksummed once at send and once at receive, and
+               ``psum(send_acc - recv_acc) == 0`` iff every payload
+               arrived bit-identical (hop/message weights keep distinct
+               messages from aliasing).  No checksum ever rides the wire
+               itself, so the exact ppermute byte accounting frozen by
+               J4/J8/J9/J11 is UNCHANGED with integrity on.
+  single-error never misses: the weights are odd, hence invertible mod
+               2^32, so any single corrupted word changes the sum.
+               Multi-word corruptions cancel only on contrived algebraic
+               alignment (and the chaos battery injects real patterns).
+
+Numpy golden twins live in `compress.golden` (``golden_word_checksum``,
+``golden_payload_checksum``) — the same spec-first discipline as every
+codec (tests/test_integrity.py holds them bit-for-bit equal).
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+__all__ = ["words_u32", "word_checksum", "payload_checksum",
+           "hop_weight", "conservation_ok", "replica_consistent",
+           "page_checksums"]
+
+
+def words_u32(x: jax.Array) -> jax.Array:
+    """A payload array as a flat vector of uint32 words — the canonical
+    byte view the checksum is defined over.  4-byte dtypes bitcast
+    word-for-word; 1-/2-byte dtypes widen (zero-extend) so every stored
+    bit lands in exactly one word.  8-byte dtypes are rejected: nothing
+    8-byte may ride the wire (graftlint J2)."""
+    x = x.reshape(-1)
+    size = jnp.dtype(x.dtype).itemsize
+    if size == 4:
+        return lax.bitcast_convert_type(x, jnp.uint32)
+    if size == 2:
+        return lax.bitcast_convert_type(x, jnp.uint16).astype(jnp.uint32)
+    if size == 1:
+        return lax.bitcast_convert_type(x, jnp.uint8).astype(jnp.uint32)
+    raise TypeError(f"no wire payload may have itemsize {size} "
+                    f"(dtype {x.dtype}); J2 forbids 8-byte avals")
+
+
+def word_checksum(x: jax.Array) -> jax.Array:
+    """uint32 scalar: odd-weighted wraparound word sum of one array."""
+    w = words_u32(x)
+    weights = (jnp.arange(w.shape[0], dtype=jnp.uint32) << 1) | jnp.uint32(1)
+    return jnp.sum(w * weights, dtype=jnp.uint32)
+
+
+def payload_checksum(payload: Sequence[jax.Array]) -> jax.Array:
+    """uint32 scalar over a hop's payload TUPLE (the codec's encode
+    output, or a 1-tuple of the raw array).  Per-element odd multipliers
+    keep a mantissa<->scale swap from aliasing."""
+    acc = jnp.uint32(0)
+    for k, p in enumerate(payload):
+        acc = acc + jnp.uint32(2 * k + 1) * word_checksum(p)
+    return acc
+
+
+def hop_weight(s) -> jax.Array:
+    """Odd per-hop message weight (odd => invertible mod 2^32, so a
+    weighted single-word corruption can never vanish).  ``s`` may be a
+    traced loop index."""
+    return (jnp.asarray(s).astype(jnp.uint32) << 1) | jnp.uint32(1)
+
+
+def conservation_ok(send_acc: jax.Array, recv_acc: jax.Array,
+                    axis_name: str) -> jax.Array:
+    """Replicated bool: every message sent on the axis arrived
+    bit-identical.  Each payload is checksummed once on the send side and
+    once on the receive side with the SAME hop weight; the ring topology
+    delivers every message exactly once, so the global weighted sums must
+    agree — ``psum`` over the axis of (send - recv) is 0 iff no frame
+    changed in flight (wraparound arithmetic on both sides)."""
+    delta = send_acc - recv_acc                  # u32 wraparound
+    # psum in int32 (bit-identical reinterpretation): integer all-reduce
+    # support is universal for i32, and wraparound addition commutes with
+    # the bitcast
+    total = lax.psum(lax.bitcast_convert_type(delta, jnp.int32), axis_name)
+    return total == 0
+
+
+def replica_consistent(x: jax.Array, axis_name: str) -> jax.Array:
+    """Replicated bool: every device on the axis holds bit-identical
+    ``x``.  The post-hoc exact check for REPLICATING collectives
+    (all-gather): every replica's bytes must agree, and a frame corrupted
+    in flight damages only the receiver and its downstream forwards —
+    never the contributor's locally-stored copy — so any single wire
+    corruption breaks the agreement.  Used where the hop-conservation
+    carry cannot reach (the fused Pallas all-gather kernel, whose wire
+    lives inside the kernel); checksum compare only, no payload rides
+    the wire."""
+    chk = lax.bitcast_convert_type(word_checksum(x), jnp.int32)
+    return lax.pmax(chk, axis_name) == lax.pmin(chk, axis_name)
+
+
+# ---------------------------------------------------------------------------
+# per-page KV-pool checksums (the serving decode tick's exact tier)
+# ---------------------------------------------------------------------------
+
+def page_checksums(pool) -> jax.Array:
+    """[n_pages] uint32 — one exact checksum per KV-pool page, summed
+    over every layer's K and V bytes of that page (weights restart per
+    page per array; per-array odd multipliers keep layer/K-V swaps from
+    aliasing).  The serving engine records this ledger as each tick's
+    program writes the pool, and the NEXT tick verifies its input pool
+    against it — so a finite wrong-KEY page (bytes changed outside the
+    programs that maintain the ledger) trips bit-exactly BEFORE the tick
+    emits a token, closing the class the logit guard provably cannot see.
+    The handoff program verifies landed pages against the same ledger
+    (`serve.handoff.lower_apply(integrity=True)`), giving migrated KV
+    end-to-end write-time -> land-time coverage.
+
+    A zero-filled pool checksums to all-zeros (every term is 0), so a
+    fresh ledger is ``jnp.zeros([n_pages], uint32)`` by construction.
+    """
+    return gathered_page_checksums(
+        [layer[key] for layer in pool for key in ("k", "v")])
+
+
+def gathered_page_checksums(blocks: Sequence[jax.Array]) -> jax.Array:
+    """[n_pages] uint32 — one checksum per leading-axis page, summed
+    over the blocks with per-array odd multipliers (weights restart per
+    page per array, so layer/K-V swaps never alias).  THE per-page
+    checksum definition: `page_checksums` flattens the pool into the
+    same layer-major K-then-V block order the handoff program uses for
+    its gathered ``[n_move, kvl, ps, hd]`` operands, so a landed page
+    verifies bit-for-bit against the ledger entry recorded when the
+    page was written — one spec, both call shapes."""
+    acc = None
+    for j, arr in enumerate(blocks):
+        n_pages = arr.shape[0]
+        w = words_u32(arr).reshape(n_pages, -1)
+        weights = ((jnp.arange(w.shape[1], dtype=jnp.uint32) << 1)
+                   | jnp.uint32(1))
+        per_page = jnp.sum(w * weights[None, :], axis=1, dtype=jnp.uint32)
+        term = jnp.uint32(2 * j + 1) * per_page
+        acc = term if acc is None else acc + term
+    return acc
+
+
+ChkCarry = Tuple[jax.Array, jax.Array]
+
+
+def zero_carry() -> ChkCarry:
+    """(send_acc, recv_acc) uint32 accumulator pair for a collective."""
+    return (jnp.uint32(0), jnp.uint32(0))

@@ -51,6 +51,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from . import integrity as _integrity
 from . import ring as ring_ops
 
 
@@ -180,13 +181,20 @@ def _split_idx(axis_name: str, ni: int):
 def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
                         compression=None,
                         slice_elems: Optional[int] = None,
-                        unroll: bool = False) -> jax.Array:
+                        unroll: bool = False,
+                        integrity: bool = False):
     """2-stage ring reduce-scatter of a flat per-device vector: raw f32
     over the fast intra hop, the codec ring over the slow inter hop.
 
     x: [L] with L % n == 0.  Returns [L // n]: this device's fully
     reduced chunk, chunk index == device index (the flat ring's natural
     ownership, so callers are topology-agnostic).
+
+    ``integrity=True`` checksums BOTH phases' wire payloads — the raw
+    f32 intra words and the encoded inter frames — on both sides of
+    every hop (ops.integrity conservation) and returns ``(owned,
+    wire_ok)``.  No checksum rides the wire: the J9 per-phase byte
+    accounting is unchanged.
     """
     codec = ring_ops._as_codec(compression)
     ni = int(n_intra)
@@ -195,9 +203,10 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
     if x.ndim != 1 or x.shape[0] % n != 0:
         raise ValueError(f"need flat length divisible by {n}, got {x.shape}")
     if n == 1:
-        return x
+        return (x, jnp.bool_(True)) if integrity else x
     C = x.shape[0] // n
     x = ring_ops._tap(x, "ring_hier.reduce_scatter")
+    chk = _integrity.zero_carry() if integrity else None
 
     # phase A — intra ring over units [j'] = concat_g'(chunk g'*ni + j'),
     # raw f32 (the whole point: full precision is free on the fast hop)
@@ -205,12 +214,25 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
     if ni > 1:
         perm_a = _intra_perm(n, ni)
 
-        def hop_a(s, u):
-            send = jnp.take(u, ((j - s - 1) % ni)[None], axis=0)[0]
-            recv = ring_ops._send(send, axis_name, n, None, perm=perm_a)
-            return u.at[(j - s - 2) % ni].add(recv)
+        if integrity:
+            def hop_a_i(s, carry):
+                u, ck = carry
+                send = jnp.take(u, ((j - s - 1) % ni)[None], axis=0)[0]
+                recv, ck = ring_ops._send(
+                    send, axis_name, n, None, perm=perm_a, chk=ck,
+                    msg_base=s)
+                return u.at[(j - s - 2) % ni].add(recv), ck
 
-        units = lax.fori_loop(0, ni - 1, hop_a, units, unroll=unroll)
+            units, chk = lax.fori_loop(0, ni - 1, hop_a_i, (units, chk),
+                                       unroll=unroll)
+        else:
+            def hop_a(s, u):
+                send = jnp.take(u, ((j - s - 1) % ni)[None], axis=0)[0]
+                recv = ring_ops._send(send, axis_name, n, None,
+                                      perm=perm_a)
+                return u.at[(j - s - 2) % ni].add(recv)
+
+            units = lax.fori_loop(0, ni - 1, hop_a, units, unroll=unroll)
     # own[q] = sum over this group's members of chunk q*ni + j
     own = jnp.take(units, j[None], axis=0)[0].reshape(ng, C)
 
@@ -218,63 +240,98 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
     if ng > 1:
         perm_b = _inter_perm(n, ni)
 
-        def hop_b(s, u):
-            send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
-            recv = ring_ops._send(send, axis_name, n, codec, slice_elems,
-                                  perm=perm_b)
-            return u.at[(g - s - 2) % ng].add(recv)
+        if integrity:
+            # one message counter spans both phases: intra hop s is
+            # message s, inter hop s starts at (ni-1) + s*stride — no
+            # two messages in the shared carry ever share a weight
+            stride_b = ring_ops._send_n_messages(codec, C, slice_elems)
 
-        own = lax.fori_loop(0, ng - 1, hop_b, own, unroll=unroll)
+            def hop_b_i(s, carry):
+                u, ck = carry
+                send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
+                recv, ck = ring_ops._send(
+                    send, axis_name, n, codec, slice_elems, perm=perm_b,
+                    chk=ck, msg_base=(ni - 1) + s * stride_b)
+                return u.at[(g - s - 2) % ng].add(recv), ck
+
+            own, chk = lax.fori_loop(0, ng - 1, hop_b_i, (own, chk),
+                                     unroll=unroll)
+        else:
+            def hop_b(s, u):
+                send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
+                recv = ring_ops._send(send, axis_name, n, codec,
+                                      slice_elems, perm=perm_b)
+                return u.at[(g - s - 2) % ng].add(recv)
+
+            own = lax.fori_loop(0, ng - 1, hop_b, own, unroll=unroll)
     # final ownership: chunk g*ni + j == this device's index
-    return jnp.take(own, g[None], axis=0)[0]
+    owned = jnp.take(own, g[None], axis=0)[0]
+    if not integrity:
+        return owned
+    return owned, _integrity.conservation_ok(chk[0], chk[1], axis_name)
 
 
 def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
-                    compression=None, unroll: bool = False) -> jax.Array:
+                    compression=None, unroll: bool = False,
+                    integrity: bool = False):
     """2-stage ring all-gather: the codec inter gather first (each chunk
     crosses the slow boundary exactly once, encoded at first send and
     forwarded verbatim — the ops.ring replica-identity contract), then
     the raw intra gather.  owned: [C], device d contributes chunk d;
-    returns [n * C] in natural chunk order."""
+    returns [n * C] in natural chunk order (with ``integrity=True``:
+    ``(gathered, wire_ok)`` — both phases' frames checksummed both
+    sides, ops.integrity conservation)."""
     codec = ring_ops._as_codec(compression)
     ni = int(n_intra)
     n, g, j = _split_idx(axis_name, ni)
     ng = check_factorization(n, ni)
     owned = ring_ops._tap(owned, "ring_hier.all_gather")
     if n == 1:
-        if codec is not None:
-            return codec.roundtrip(owned).astype(owned.dtype)
-        return owned
+        out1 = (codec.roundtrip(owned).astype(owned.dtype)
+                if codec is not None else owned)
+        return (out1, jnp.bool_(True)) if integrity else out1
     C = owned.shape[0]
+    chk = _integrity.zero_carry() if integrity else None
+    tap = ring_ops._tap_wire
 
     # phase B' — inter all-gather of the owned chunk across groups
     blocks = jnp.zeros((ng, C), owned.dtype)
     if ng > 1:
         perm_b = _inter_perm(n, ni)
         if codec is None:
+            pay_b = (owned,)
             blocks = blocks.at[g].set(owned)
-
-            def hop_b(s, carry):
-                out_, pay = carry
-                pay = lax.ppermute(pay, axis_name, perm_b)
-                return out_.at[(g - s - 1) % ng].set(pay), pay
-
-            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, owned),
-                                      unroll=unroll)
         else:
-            pay = codec.encode(owned)
+            pay_b = codec.encode(owned)
             # the contributor stores the same quantized bytes it sends:
             # every replica sees wire-identical values for every chunk
-            blocks = blocks.at[g].set(codec.decode(pay, C, owned.dtype))
+            blocks = blocks.at[g].set(codec.decode(pay_b, C, owned.dtype))
 
+        def _landed_b(p):
+            return p[0] if codec is None else codec.decode(p, C,
+                                                           owned.dtype)
+
+        if integrity:
+            def hop_b_i(s, carry):
+                out_, p, (sa, ra) = carry
+                w = _integrity.hop_weight(s)
+                sa = sa + w * _integrity.payload_checksum(p)
+                p = tuple(lax.ppermute(q, axis_name, perm_b) for q in p)
+                p = tap(p, "ring.wire")
+                ra = ra + w * _integrity.payload_checksum(p)
+                return (out_.at[(g - s - 1) % ng].set(_landed_b(p)), p,
+                        (sa, ra))
+
+            blocks, _, chk = lax.fori_loop(
+                0, ng - 1, hop_b_i, (blocks, pay_b, chk), unroll=unroll)
+        else:
             def hop_b(s, carry):
-                out_, pay = carry
-                pay = tuple(lax.ppermute(p, axis_name, perm_b)
-                            for p in pay)
-                return (out_.at[(g - s - 1) % ng].set(
-                    codec.decode(pay, C, owned.dtype)), pay)
+                out_, p = carry
+                p = tuple(lax.ppermute(q, axis_name, perm_b) for q in p)
+                p = tap(p, "ring.wire")
+                return out_.at[(g - s - 1) % ng].set(_landed_b(p)), p
 
-            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, pay),
+            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, pay_b),
                                       unroll=unroll)
     else:
         # no slow boundary to cross: nothing is quantized (the flat
@@ -289,23 +346,57 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
     if ni > 1:
         perm_a = _intra_perm(n, ni)
 
-        def hop_a(s, carry):
-            out_, pay = carry
-            pay = lax.ppermute(pay, axis_name, perm_a)
-            return out_.at[(j - s - 1) % ni].set(pay), pay
+        if integrity:
+            def hop_a_i(s, carry):
+                out_, p, (sa, ra) = carry
+                # continue the message counter past phase B's ng-1
+                # inter frames so the shared carry never reuses a weight
+                w = _integrity.hop_weight((ng - 1) + s)
+                sa = sa + w * _integrity.payload_checksum(p)
+                p = tuple(lax.ppermute(q, axis_name, perm_a) for q in p)
+                p = tap(p, "ring.wire")
+                ra = ra + w * _integrity.payload_checksum(p)
+                return out_.at[(j - s - 1) % ni].set(p[0]), p, (sa, ra)
 
-        out, _ = lax.fori_loop(0, ni - 1, hop_a, (out, flat_block),
-                               unroll=unroll)
+            out, _, chk = lax.fori_loop(
+                0, ni - 1, hop_a_i, (out, (flat_block,), chk),
+                unroll=unroll)
+        else:
+            def hop_a(s, carry):
+                out_, pay = carry
+                pay = lax.ppermute(pay, axis_name, perm_a)
+                # same wire-tap contract as every other hop (identity
+                # when no tap is installed): a wirebit spec at
+                # 'collective' must be able to fire on the intra AG
+                # frames too, integrity trace or not
+                pay = tap((pay,), "ring.wire")[0]
+                return out_.at[(j - s - 1) % ni].set(pay), pay
+
+            out, _ = lax.fori_loop(0, ni - 1, hop_a, (out, flat_block),
+                                   unroll=unroll)
     # out[p] = blocks of member p = chunks {q*ni + p}; restore natural
     # chunk order (inverse of the reduce-scatter's regrouping)
-    return out.reshape(ni, ng, C).transpose(1, 0, 2).reshape(n * C)
+    full = out.reshape(ni, ng, C).transpose(1, 0, 2).reshape(n * C)
+    if not integrity:
+        return full
+    return full, _integrity.conservation_ok(chk[0], chk[1], axis_name)
 
 
 def hier_all_reduce(x: jax.Array, axis_name: str, n_intra: int, *,
                     compression=None,
                     slice_elems: Optional[int] = None,
-                    unroll: bool = False) -> jax.Array:
-    """Full hierarchical all-reduce (sum) = 2-stage RS + 2-stage AG."""
+                    unroll: bool = False,
+                    integrity: bool = False):
+    """Full hierarchical all-reduce (sum) = 2-stage RS + 2-stage AG.
+    With ``integrity=True`` returns ``(reduced, wire_ok)``."""
+    if integrity:
+        owned, ok_rs = hier_reduce_scatter(
+            x, axis_name, n_intra, compression=compression,
+            slice_elems=slice_elems, unroll=unroll, integrity=True)
+        full, ok_ag = hier_all_gather(owned, axis_name, n_intra,
+                                      compression=compression,
+                                      unroll=unroll, integrity=True)
+        return full, ok_rs & ok_ag
     owned = hier_reduce_scatter(x, axis_name, n_intra,
                                 compression=compression,
                                 slice_elems=slice_elems, unroll=unroll)

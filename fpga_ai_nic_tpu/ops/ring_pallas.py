@@ -229,22 +229,60 @@ def _rs_offsets(ids, n: int, S: int, slice_rows: int):
     return jnp.stack([send, recv]).astype(jnp.int32)
 
 
-def _rs_parse_refs(opt_kind: Optional[str], refs):
+def _rs_parse_refs(opt_kind: Optional[str], refs,
+                   integrity: bool = False):
     """Split a fused-opt (or plain) RS kernel's positional refs into the
     named slots shared by both kernels: pallas passes inputs, then
     outputs, then scratch, and the fused variants add (hyper, w, *state)
-    inputs and (w_new, *state_new) outputs.  Returns
-    (hyper, x, w, st_in, out, w_out, st_out, *scratch6)."""
+    inputs and (w_new, *state_new) outputs.  With ``integrity`` the LAST
+    output is the SMEM [2] uint32 (send_acc, recv_acc) checksum pair.
+    Returns (hyper, x, w, st_in, out, w_out, st_out, chk, *scratch6)."""
     if opt_kind is None:
         x_ref, out_ref = refs[0], refs[1]
-        return (None, x_ref, None, (), out_ref, None, ()) + tuple(refs[2:])
+        rest = refs[2:]
+        chk = None
+        if integrity:
+            chk, rest = rest[0], rest[1:]
+        return (None, x_ref, None, (), out_ref, None, (), chk) \
+            + tuple(rest)
     ns = OptimizerSpec(kind=opt_kind).n_state
     hyper_ref, x_ref, w_ref = refs[:3]
     st_in = tuple(refs[3:3 + ns])
     out_ref, w_out = refs[3 + ns], refs[4 + ns]
     st_out = tuple(refs[5 + ns:5 + 2 * ns])
+    rest = refs[5 + 2 * ns:]
+    chk = None
+    if integrity:
+        chk, rest = rest[0], rest[1:]
     return (hyper_ref, x_ref, w_ref, st_in, out_ref, w_out,
-            st_out) + tuple(refs[5 + 2 * ns:])
+            st_out, chk) + tuple(rest)
+
+
+def _frame_checksum(frame) -> jax.Array:
+    """uint32 scalar: the ops.integrity odd-weighted word sum over one
+    int8 wire frame, zero-extended byte-per-word — computed over the
+    FULL (tile-padded) frame, which is exactly what the RDMA moves, so
+    both ends of a hop sum identical bytes (pad rows are stale slot
+    garbage, but the SAME stale garbage on both sides: the checksum is
+    taken after encode on the send side and after wait_recv on the
+    receive side, and nothing touches the slot in between)."""
+    words = (frame[:].astype(jnp.int32) & 0xFF).astype(jnp.uint32)
+    r, l = words.shape
+    pos = (lax.broadcasted_iota(jnp.uint32, (r, l), 0) * jnp.uint32(l)
+           + lax.broadcasted_iota(jnp.uint32, (r, l), 1))
+    return jnp.sum(words * ((pos << 1) | jnp.uint32(1)),
+                   dtype=jnp.uint32)
+
+
+def _emission_weight(q) -> jax.Array:
+    """Odd per-emission weight: my emission q is my right neighbor's
+    arrival q, so sender and receiver weight the same message
+    identically and the global conservation sum telescopes to zero iff
+    every frame arrived bit-identical.  Delegates to
+    ops.integrity.hop_weight — the kernel-side and host-side weight
+    schemes MUST be one definition or conservation silently breaks."""
+    from . import integrity
+    return integrity.hop_weight(q)
 
 
 def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
@@ -252,7 +290,8 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                rounding: str, flow_control: bool, unrolled: bool,
                depth: int, n_slots: int, launch_first: bool,
                ablate: Optional[str] = None,
-               opt_kind: Optional[str] = None):
+               opt_kind: Optional[str] = None,
+               integrity: bool = False):
     """The whole sliced ring reduce-scatter, one kernel invocation, as a
     depth-D pipeline: encode(g+D), RDMA(g+D-1 .. g+1), and
     decode+accumulate(g) proceed concurrently over an (D+1)-slot comm
@@ -307,9 +346,19 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
     do_rdma = ablate in (None, "rdma")
     do_dec = ablate in (None, "decode")
     do_upd = opt_kind is not None and ablate in (None, "update")
-    refs = _rs_parse_refs(opt_kind, refs)
-    (hyper_ref, x_ref, w_ref, st_in, out_ref, w_out, st_out, acc,
-     send_pkt, recv_pkt, send_sem, recv_sem, credit_sem) = refs
+    refs = _rs_parse_refs(opt_kind, refs, integrity)
+    (hyper_ref, x_ref, w_ref, st_in, out_ref, w_out, st_out, chk_ref,
+     acc, send_pkt, recv_pkt, send_sem, recv_sem, credit_sem) = refs
+    # the integrity accumulators live in the SMEM output itself: pl.when
+    # blocks mutate refs, never loop-carried values, and the wraparound
+    # u32 sums are order-insensitive (addition mod 2^32 commutes)
+    do_chk = integrity and ablate is None
+    if integrity:
+        # zero the SMEM output whenever it EXISTS (it is appended for
+        # integrity=True regardless of ablate): an ablated kernel must
+        # report a clean 0==0 conservation, never uninitialized SMEM
+        chk_ref[0] = jnp.uint32(0)
+        chk_ref[1] = jnp.uint32(0)
     idx = ids_ref[0]
     right = ids_ref[1]               # we send downstream (IKL ring order,
     left = ids_ref[2]                # sw/setup_route.sh:12-40)
@@ -342,6 +391,10 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                                        mantissa_bits, rounding)
             send_pkt[slot, pl.ds(c, sub)] = mant
             send_pkt[slot, pl.ds(R + c // B, sub // B)] = scale
+        if do_chk:
+            # checksum the frame exactly as the RDMA will move it
+            chk_ref[0] = chk_ref[0] + _emission_weight(g) \
+                * _frame_checksum(send_pkt[slot])
 
     # flow_control=False only under the discharge interpreter, whose
     # lockstep emulation cannot execute remote semaphore signals; the
@@ -399,6 +452,9 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
         # decode slice g + accumulate into the chunk this hop owns
         if do_rdma:
             rdma(g).wait_recv()
+        if do_chk:
+            chk_ref[1] = chk_ref[1] + _emission_weight(g) \
+                * _frame_checksum(recv_pkt[g % n_slots])
         if not (do_dec or do_upd):
             if flow_control and do_rdma:
                 pltpu.semaphore_signal(
@@ -489,7 +545,7 @@ def _ring_ids(axis_name: Optional[str]) -> jax.Array:
                    static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
     "interpret", "collective_id", "loopback_n", "ablate", "depth",
-    "opt_kind"))
+    "opt_kind", "integrity"))
 def _rs_call(x2, axis_name: Optional[str], block_size: int,
              mantissa_bits: int, rounding: str, slice_elems: int,
              interpret: bool, collective_id: int,
@@ -499,7 +555,8 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
              opt_kind: Optional[str] = None,
              w2: Optional[jax.Array] = None,
              opt_st: Tuple[jax.Array, ...] = (),
-             hyper: Optional[jax.Array] = None):
+             hyper: Optional[jax.Array] = None,
+             integrity: bool = False):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -515,7 +572,7 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         block_size=block_size, mantissa_bits=mantissa_bits,
         rounding=rounding, flow_control=_flow, unrolled=_unrolled,
         depth=D, n_slots=n_slots, launch_first=launch_first,
-        ablate=ablate, opt_kind=opt_kind)
+        ablate=ablate, opt_kind=opt_kind, integrity=integrity)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     vmem = pl.BlockSpec(memory_space=pltpu.VMEM)
@@ -523,8 +580,12 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
     def sds(shape):
         return compat.shape_dtype_struct(shape, jnp.float32, vma=vma)
 
+    def chk_sds():
+        return compat.shape_dtype_struct((2,), jnp.uint32, vma=vma)
+
     if opt_kind is None:
-        out_shape = sds((chunk_rows, LANES))
+        out_shape = [sds((chunk_rows, LANES))]
+        out_specs = [vmem]
         in_specs = [smem, smem, vmem]
         args = (ids, sched, x2)
         io_alias = {}
@@ -536,15 +597,20 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         # donated shard operands (ZeRO-1: each replica owns 1/n of the
         # master + moments, updated in place)
         out_shape = [sds((chunk_rows, LANES))] * (2 + ns)
+        out_specs = [vmem] * (2 + ns)
         in_specs = [smem, smem, smem] + [vmem] * (2 + ns)
         args = (ids, sched, hyper, x2, w2) + tuple(opt_st)
         io_alias = {4: 1, **{5 + i: 2 + i for i in range(ns)}}
+    if integrity:
+        # (send_acc, recv_acc) u32 pair — SMEM scalars, psum'd into the
+        # conservation verdict OUTSIDE the kernel
+        out_shape = out_shape + [chk_sds()]
+        out_specs = out_specs + [smem]
     out = pl.pallas_call(
         kern,
-        out_shape=out_shape,
+        out_shape=(out_shape[0] if len(out_shape) == 1 else out_shape),
         in_specs=in_specs,
-        out_specs=(vmem if opt_kind is None
-                   else [vmem] * (2 + OptimizerSpec(kind=opt_kind).n_state)),
+        out_specs=(out_specs[0] if len(out_specs) == 1 else out_specs),
         input_output_aliases=io_alias,
         scratch_shapes=[
             pltpu.VMEM((L_rows, LANES), jnp.float32),          # acc
@@ -559,7 +625,12 @@ def _rs_call(x2, axis_name: Optional[str], block_size: int,
         interpret=_interp,
     )(*args)
     if opt_kind is None:
+        if integrity:
+            return out[0], (out[1][0], out[1][1])
         return out
+    if integrity:
+        return (out[0], out[1], tuple(out[2:-1]),
+                (out[-1][0], out[-1][1]))
     return (out[0], out[1], tuple(out[2:]))
 
 
@@ -575,7 +646,8 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
                               streaming: Optional[bool] = None,
                               interpret: Optional[bool] = None,
                               pipeline_depth: Optional[int] = None,
-                              collective_id: int = 7) -> jax.Array:
+                              collective_id: int = 7,
+                              integrity: bool = False):
     """Fused compress-into-hop ring reduce-scatter of a flat f32 [L].
 
     Drop-in for `ops.ring.ring_reduce_scatter(..., codec="pallas")` where
@@ -592,6 +664,16 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
     steady state encode(g+D), D RDMAs, and decode(g) run concurrently.
     A schedule choice, never a numerics choice: every depth is
     bit-identical (the slice partition and add order are unchanged).
+
+    integrity=True returns ``(owned, wire_ok)``: the kernel accumulates
+    the ops.integrity exact frame checksums of every emission (at
+    encode) and every arrival (at wait_recv) into its SMEM output, and
+    the conservation psum OUTSIDE the kernel yields the replicated
+    verdict — the gradient path is bit-identical to integrity=False at
+    every depth (checksums only READ the frames), no checksum rides the
+    wire, and the RDMA'd bytes are unchanged.  Validated under the
+    interpreters like the rest of the kernel contract (the hardware
+    canary discipline of CollectiveConfig.fused_kernel applies).
 
     Constraints (assert, don't silently repartition — changing the block
     partition would change the bits):
@@ -610,20 +692,19 @@ def ring_reduce_scatter_fused(x: jax.Array, axis_name: str, *,
             f"fused ring needs chunk {C} % slice_elems {slice_elems} == 0 "
             f"and slice_elems % {cfg.block_size * LANES} == 0")
     if n == 1:
-        return x
+        return (x, jnp.bool_(True)) if integrity else x
     if streaming is None:
         streaming = L * 4 > _VMEM_RESIDENT_MAX_BYTES
     x2 = x.astype(jnp.float32).reshape(-1, LANES)
-    if streaming:
-        out = _rs_stream_call(x2, axis_name, cfg.block_size,
-                              cfg.mantissa_bits, cfg.rounding, slice_elems,
-                              interpret, collective_id,
-                              depth=pipeline_depth)
-    else:
-        out = _rs_call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
-                       cfg.rounding, slice_elems, interpret, collective_id,
-                       depth=pipeline_depth)
-    return out.reshape(C)
+    call = _rs_stream_call if streaming else _rs_call
+    out = call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+               cfg.rounding, slice_elems, interpret, collective_id,
+               depth=pipeline_depth, integrity=integrity)
+    if not integrity:
+        return out.reshape(C)
+    out, (sa, ra) = out
+    from . import integrity as _integrity
+    return out.reshape(C), _integrity.conservation_ok(sa, ra, axis_name)
 
 
 def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
@@ -631,7 +712,8 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                       rounding: str, flow_control: bool, unrolled: bool,
                       depth: int, n_slots: int, launch_first: bool,
                       ablate: Optional[str] = None,
-                      opt_kind: Optional[str] = None):
+                      opt_kind: Optional[str] = None,
+                      integrity: bool = False):
     """HBM-streaming variant of _rs_kernel: the vector stays in HBM (acc
     aliases the input buffer) and only two slices of working f32 plus the
     int8 frames live in VMEM — the reference's exact memory shape, which
@@ -675,15 +757,20 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
     do_dec = ablate in (None, "decode")
     do_wb = ablate in (None, "hbm", "decode")
     do_upd = opt_kind is not None and ablate in (None, "update")
+    do_chk = integrity and ablate is None
     ns = 0 if opt_kind is None else OptimizerSpec(kind=opt_kind).n_state
     n_t = 1 + ns                     # fused-opt tensors: w + state shards
+    chk_ref = None
     if opt_kind is None:
         x_hbm = refs[0]
         hyper_ref = None
         acc = refs[1]
         opt_out = ()
+        rest = refs[2:]
+        if integrity:
+            chk_ref, rest = rest[0], rest[1:]
         (ld, st, send_pkt, recv_pkt, ld_sem, st_ld_sem, wb_sem, send_sem,
-         recv_sem, credit_sem) = refs[2:]
+         recv_sem, credit_sem) = rest
         opt_buf = opt_ld_sem = opt_wb_sem = None
     else:
         hyper_ref, x_hbm = refs[0], refs[1]
@@ -691,10 +778,18 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
         # acc — the out refs ARE the buffers (del the input handles)
         acc = refs[2 + n_t]
         opt_out = tuple(refs[3 + n_t:3 + 2 * n_t])
+        rest = refs[3 + 2 * n_t:]
+        if integrity:
+            chk_ref, rest = rest[0], rest[1:]
         (ld, st, send_pkt, recv_pkt, opt_buf, ld_sem, st_ld_sem, wb_sem,
          opt_ld_sem, opt_wb_sem, send_sem, recv_sem,
-         credit_sem) = refs[3 + 2 * n_t:]
+         credit_sem) = rest
     del refs, x_hbm
+    if integrity:
+        # zeroed whenever the SMEM output exists (see _rs_kernel): an
+        # ablated kernel reports clean 0==0 conservation, never garbage
+        chk_ref[0] = jnp.uint32(0)
+        chk_ref[1] = jnp.uint32(0)
     idx = ids_ref[0]
     right = ids_ref[1]
     left = ids_ref[2]
@@ -742,6 +837,9 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                                        mantissa_bits, rounding)
             send_pkt[slot, pl.ds(c, sub)] = mant
             send_pkt[slot, pl.ds(R + c // B, sub // B)] = scale
+        if do_chk:
+            chk_ref[0] = chk_ref[0] + _emission_weight(q) \
+                * _frame_checksum(send_pkt[slot])
 
     # -- fused-optimizer streaming plumbing (opt_kind only): the owned
     # master/state slice of final-hop consume g cycles through a 2-deep
@@ -846,6 +944,9 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
             stld_dma(g).start()            # overlap load with the wire
         if do_rdma:
             rdma(g).wait_recv()
+        if do_chk:
+            chk_ref[1] = chk_ref[1] + _emission_weight(g) \
+                * _frame_checksum(recv_pkt[g % n_slots])
         if do_stld:
             stld_dma(g).wait()
         if do_dec:
@@ -923,7 +1024,7 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                    donate_argnames=("w2", "opt_st"), static_argnames=(
     "axis_name", "block_size", "mantissa_bits", "rounding", "slice_elems",
     "interpret", "collective_id", "loopback_n", "ablate", "depth",
-    "opt_kind"))
+    "opt_kind", "integrity"))
 def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
                     mantissa_bits: int, rounding: str, slice_elems: int,
                     interpret: bool, collective_id: int,
@@ -933,7 +1034,8 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
                     opt_kind: Optional[str] = None,
                     w2: Optional[jax.Array] = None,
                     opt_st: Tuple[jax.Array, ...] = (),
-                    hyper: Optional[jax.Array] = None):
+                    hyper: Optional[jax.Array] = None,
+                    integrity: bool = False):
     n = loopback_n if axis_name is None else lax.axis_size(axis_name)
     L_rows = x2.shape[0]
     chunk_rows = L_rows // n
@@ -949,7 +1051,7 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
         block_size=block_size, mantissa_bits=mantissa_bits,
         rounding=rounding, flow_control=_flow, unrolled=_unrolled,
         depth=D, n_slots=n_slots, launch_first=launch_first,
-        ablate=ablate, opt_kind=opt_kind)
+        ablate=ablate, opt_kind=opt_kind, integrity=integrity)
     vma = jax.typeof(x2).vma | jax.typeof(ids).vma
     smem = pl.BlockSpec(memory_space=pltpu.SMEM)
     hbm = pl.BlockSpec(memory_space=pl.ANY)
@@ -960,22 +1062,27 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
     ns = 0 if opt_kind is None else OptimizerSpec(kind=opt_kind).n_state
     n_t = 1 + ns
     if opt_kind is None:
-        out_shape = sds((L_rows, LANES))
+        out_shape = [sds((L_rows, LANES))]
+        out_specs = [hbm]
         in_specs = [smem, smem, hbm]
         args = (ids, sched, x2)
         io_alias = {2: 0}
-        opt_scratch = []
     else:
         assert w2 is not None and hyper is not None and len(opt_st) == ns
         out_shape = [sds((L_rows, LANES))] + [sds((chunk_rows, LANES))] * n_t
+        out_specs = [hbm] * (1 + n_t)
         in_specs = [smem, smem, smem] + [hbm] * (1 + n_t)
         args = (ids, sched, hyper, x2, w2) + tuple(opt_st)
         io_alias = {3: 0, **{4 + i: 1 + i for i in range(n_t)}}
+    if integrity:
+        out_shape = out_shape \
+            + [compat.shape_dtype_struct((2,), jnp.uint32, vma=vma)]
+        out_specs = out_specs + [smem]
     res = pl.pallas_call(
         kern,
-        out_shape=out_shape,
+        out_shape=(out_shape[0] if len(out_shape) == 1 else out_shape),
         in_specs=in_specs,
-        out_specs=(hbm if opt_kind is None else [hbm] * (1 + n_t)),
+        out_specs=(out_specs[0] if len(out_specs) == 1 else out_specs),
         input_output_aliases=io_alias,
         scratch_shapes=[
             pltpu.VMEM((2, R, LANES), jnp.float32),        # send loads
@@ -1000,6 +1107,10 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
             has_side_effects=True, collective_id=collective_id),
         interpret=_interp,
     )(*args)
+    chk = None
+    if integrity:
+        chk = (res[-1][0], res[-1][1])
+        res = res[:-1] if opt_kind is not None else res[0]
     acc = res if opt_kind is None else res[0]
     # the owned chunk lives at rows [idx*chunk_rows, +chunk_rows) of the
     # accumulated (aliased) vector
@@ -1007,8 +1118,10 @@ def _rs_stream_call(x2, axis_name: Optional[str], block_size: int,
     g_own = lax.dynamic_slice_in_dim(acc, idx * chunk_rows, chunk_rows,
                                      axis=0)
     if opt_kind is None:
-        return g_own
-    return (g_own, res[1], tuple(res[2:]))
+        return g_own if chk is None else (g_own, chk)
+    if chk is None:
+        return (g_own, res[1], tuple(res[2:]))
+    return (g_own, res[1], tuple(res[2:]), chk)
 
 
 def _ag_kernel(ids_ref, own_ref, out_ref, send_pkt, recv_pkt, send_sem,
@@ -1619,7 +1732,8 @@ def ring_reduce_scatter_update_fused(
         compression: Optional[BFPConfig] = None,
         slice_elems: int = 8192, streaming: Optional[bool] = None,
         interpret: Optional[bool] = None,
-        pipeline_depth: Optional[int] = None, collective_id: int = 9):
+        pipeline_depth: Optional[int] = None, collective_id: int = 9,
+        integrity: bool = False):
     """Fused ring reduce-scatter + in-kernel ZeRO-1 optimizer update —
     the reference's defining datapath (decode feeds weight_update.sv with
     no host round-trip, SURVEY.md §3.2) plus ZeRO-1 weight-update
@@ -1639,7 +1753,20 @@ def ring_reduce_scatter_update_fused(
     ring_reduce_scatter_fused at every pipeline depth; the update formula
     is optim.fused_apply_blocks (bit spec: optim.golden_fused_apply
     composed with the codec's golden ring decode).  Same slicing/
-    residency constraints and routing as ring_reduce_scatter_fused."""
+    residency constraints and routing as ring_reduce_scatter_fused.
+
+    integrity=True appends a replicated ``wire_ok`` bool: the SAME
+    in-kernel frame-checksum accumulation as ring_reduce_scatter_fused
+    (every emission at encode, every arrival at wait_recv), psum'd into
+    the conservation verdict outside the kernel.  This is what lifts the
+    old ``fused_optimizer x integrity_check`` construction error: the
+    update consumed DONATED state, so nothing is left to gate a tripped
+    verdict back to in-graph — instead the verdict invalidates the STEP
+    (runtime.chaos.check_step_diag raises WireIntegrityError and the
+    elastic restore/reshard ladder discards the poisoned state).  The
+    gradient/update bits are identical to integrity=False at every depth
+    (checksums only READ the frames) and the RDMA'd bytes are
+    unchanged."""
     cfg = compression or BFPConfig()
     spec = OptimizerSpec(kind=opt_kind)
     n = lax.axis_size(axis_name)
@@ -1660,12 +1787,21 @@ def ring_reduce_scatter_update_fused(
     st = tuple(opt_state[k].astype(jnp.float32).reshape(-1, LANES)
                for k in spec.state_keys)
     call = _rs_stream_call if streaming else _rs_call
-    g2, w_new2, st2 = call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
-                           cfg.rounding, slice_elems, interpret,
-                           collective_id, depth=pipeline_depth,
-                           opt_kind=opt_kind, w2=w2, opt_st=st, hyper=hyper)
-    return (g2.reshape(C), w_new2.reshape(C),
-            {k: v.reshape(C) for k, v in zip(spec.state_keys, st2)})
+    res = call(x2, axis_name, cfg.block_size, cfg.mantissa_bits,
+               cfg.rounding, slice_elems, interpret,
+               collective_id, depth=pipeline_depth,
+               opt_kind=opt_kind, w2=w2, opt_st=st, hyper=hyper,
+               integrity=integrity)
+    if integrity:
+        g2, w_new2, st2, (sa, ra) = res
+    else:
+        g2, w_new2, st2 = res
+    out = (g2.reshape(C), w_new2.reshape(C),
+           {k: v.reshape(C) for k, v in zip(spec.state_keys, st2)})
+    if not integrity:
+        return out
+    from . import integrity as _integrity
+    return out + (_integrity.conservation_ok(sa, ra, axis_name),)
 
 
 def ring_all_reduce_fused(x: jax.Array, axis_name: str, *,

@@ -61,6 +61,14 @@ class DDPTrainer:
         self.cfg = cfg
         self.ax = axis_name
         self.n = mesh.shape[axis_name]
+        if cfg.collective.integrity_check:
+            raise ValueError(
+                "integrity_check is implemented on DPTrainer only (both "
+                "value and exact wire tiers ride its step diag); the "
+                "bucketed/queued DDP reduces do not thread the verdicts "
+                "yet, and a silently ignored flag would be claimed-but-"
+                "absent coverage — construct with integrity_check=False "
+                "(docs/CHAOS.md 'Exact wire integrity')")
         self._meta = None
         self._plan = None
         # codec="auto": the tuner owns codec / bucket_elems / depth /

@@ -285,6 +285,11 @@ class ElasticTrainer:
             return "preemption"
         if isinstance(err, DeviceHangError):
             return "hang"
+        if isinstance(err, chaos_lib.WireIntegrityError):
+            # the EXACT tier (encoded-frame / page checksums) — its own
+            # RecoveryStats fault class, so artifacts can prove WHICH
+            # tier caught a finite corruption the value band cannot see
+            return "wire-corruption"
         if isinstance(err, chaos_lib.IntegrityError):
             return "corruption"
         if isinstance(err, chaos_lib.InjectedFault):

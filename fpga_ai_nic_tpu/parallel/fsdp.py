@@ -87,6 +87,14 @@ class FSDPTrainer:
             raise ValueError(
                 "fused_optimizer cannot honor clip_norm (same contract "
                 "as DPTrainer: no barrier between reduce and update)")
+        if cfg.collective.integrity_check:
+            raise ValueError(
+                "integrity_check is implemented on DPTrainer only (both "
+                "value and exact wire tiers ride its step diag); "
+                "FSDPTrainer does not thread the verdicts yet, and a "
+                "silently ignored flag would be claimed-but-absent "
+                "coverage — construct with integrity_check=False "
+                "(docs/CHAOS.md 'Exact wire integrity')")
 
     def _set_codec_flags(self) -> None:
         coll = self.cfg.collective

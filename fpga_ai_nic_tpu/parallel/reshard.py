@@ -65,6 +65,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from . import mesh as mesh_lib
 from ..ops import fused_update
+from ..ops import integrity as integrity_lib
+from ..ops import ring as ring_ops
 
 __all__ = [
     "Transfer", "FlatPlan", "ResidualPlan", "ReshardPlan",
@@ -279,41 +281,89 @@ def make_plan(live: int, n_src: int, padded_src: int, n_tgt: int,
 # ---------------------------------------------------------------------------
 
 def _move_chunk(plan: FlatPlan, ax: str, chunk: jax.Array,
-                idx: jax.Array) -> jax.Array:
+                idx: jax.Array,
+                chk: Optional[Tuple[jax.Array, jax.Array]] = None,
+                base: int = 0) -> Any:
     """SPMD body for one flat leaf: [chunk_src] -> [chunk_tgt].  Each
     intersection segment is one exact-length hop: a single-pair ppermute
     when the owner changes (receivers outside the pair get zeros — the
     where-mask keeps only the true destination's write), a resident
     slice-copy when it does not.  All offsets/lengths are static, so the
-    program is a fixed DAG the J8 sweep can account byte-for-byte."""
+    program is a fixed DAG the J8 sweep can account byte-for-byte.
+
+    ``chk`` (None = integrity off) is the (send_acc, recv_acc) uint32
+    conservation carry (ops.integrity): every owner-changing segment is
+    checksummed on the SOURCE device before its ppermute and on the
+    TARGET device after it (post-wire-tap), with one odd weight per
+    (leaf, segment) — ``base`` is the leaf's offset into a single
+    program-wide message counter, so every message in the transfer gets
+    a DISTINCT odd weight and distinct messages never alias (a product
+    of two odd per-axis weights would collide across leaves).  Resident
+    copies never touch a wire and are not checksummed.  No checksum
+    rides the wire: the J8 ppermute byte accounting is identical either
+    way."""
     out = jnp.zeros((plan.chunk_tgt,), chunk.dtype)
-    for t in plan.table:
+    for ti, t in enumerate(plan.table):
         payload = lax.dynamic_slice_in_dim(chunk, t.src_off, t.length)
         if t.src != t.dst:
+            if chk is not None:
+                w = integrity_lib.hop_weight(base + ti)
+                sa, ra = chk
+                sa = sa + jnp.where(
+                    idx == t.src,
+                    w * integrity_lib.word_checksum(payload), jnp.uint32(0))
             payload = lax.ppermute(payload, ax, [(t.src, t.dst)])
+            payload = ring_ops._tap_wire((payload,), "reshard.wire",
+                                         consumed=idx == t.dst)[0]
+            if chk is not None:
+                ra = ra + jnp.where(
+                    idx == t.dst,
+                    w * integrity_lib.word_checksum(payload), jnp.uint32(0))
+                chk = (sa, ra)
         upd = lax.dynamic_update_slice_in_dim(out, payload, t.dst_off, 0)
         out = jnp.where(idx == t.dst, upd, out)
-    return out
+    return out if chk is None else (out, chk)
 
 
 def _move_residual(plan: ResidualPlan, ax: str, resid: jax.Array,
-                   idx: jax.Array) -> jax.Array:
+                   idx: jax.Array,
+                   chk: Optional[Tuple[jax.Array, jax.Array]] = None,
+                   base: int = 0) -> Any:
     """SPMD body for the EF residual: old device i's live residual lands
     (summed, ascending-i order — the golden twin's order) on new device
     ``owners[i]``.  Devices with no assignment keep zeros: a fresh
-    replica has dropped nothing yet."""
+    replica has dropped nothing yet.  ``chk``: the same conservation
+    carry as ``_move_chunk`` (wire moves only, ``base`` continuing the
+    program-wide message counter past the flat leaves' segments)."""
     live = lax.dynamic_slice_in_dim(resid, 0, plan.live)
     out = jnp.zeros((plan.pad_tgt,), resid.dtype)
     for i, owner in enumerate(plan.owners):
-        payload = live if i == owner else lax.ppermute(live, ax,
-                                                       [(i, owner)])
+        if i == owner:
+            payload = live
+        else:
+            if chk is not None:
+                w = integrity_lib.hop_weight(base + i)
+                sa, ra = chk
+                sa = sa + jnp.where(
+                    idx == i,
+                    w * integrity_lib.word_checksum(live), jnp.uint32(0))
+            payload = lax.ppermute(live, ax, [(i, owner)])
+            payload = ring_ops._tap_wire((payload,), "reshard.wire",
+                                         consumed=idx == owner)[0]
+            if chk is not None:
+                ra = ra + jnp.where(
+                    idx == owner,
+                    w * integrity_lib.word_checksum(payload), jnp.uint32(0))
+                chk = (sa, ra)
         upd = out.at[:plan.live].add(payload)
         out = jnp.where(idx == owner, upd, out)
-    return out
+    return out if chk is None else (out, chk)
 
 
 def lower_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str, *,
-                donate: bool = True) -> Callable[..., Tuple[jax.Array, ...]]:
+                donate: bool = True,
+                integrity: bool = False
+                ) -> Callable[..., Tuple[jax.Array, ...]]:
     """The plan as ONE jitted transfer program over the union mesh.
 
     Positional args: ``n_flat_leaves`` flat vectors in the union-source
@@ -322,33 +372,57 @@ def lower_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str, *,
     leaves in the union-target layout ([n_union * chunk_tgt] each).
     Every input is donated by default: the sources are dead the moment
     the transfer lands (the elastic loop never touches them again), so
-    the program runs in ~one state's footprint, not two."""
+    the program runs in ~one state's footprint, not two.
+
+    ``integrity=True`` appends a replicated ``wire_ok`` bool output:
+    every owner-changing segment of every leaf (and every residual
+    move) checksummed bit-exactly on both sides of its ppermute
+    (ops.integrity conservation over the union axis).  The landed bytes
+    and the J8 ppermute accounting are identical either way — only the
+    verdict is added."""
     fp = plan.flat
     n_ops = plan.n_flat_leaves + (1 if plan.residual is not None else 0)
 
     def body(*chunks: jax.Array) -> Tuple[jax.Array, ...]:
         idx = lax.axis_index(ax)
-        outs = [_move_chunk(fp, ax, c, idx)
-                for c in chunks[:plan.n_flat_leaves]]
+        chk = integrity_lib.zero_carry() if integrity else None
+        outs = []
+        for li, c in enumerate(chunks[:plan.n_flat_leaves]):
+            res = _move_chunk(fp, ax, c, idx, chk=chk,
+                              base=li * len(fp.table))
+            if integrity:
+                res, chk = res
+            outs.append(res)
         if plan.residual is not None:
-            outs.append(_move_residual(plan.residual, ax, chunks[-1], idx))
+            res = _move_residual(plan.residual, ax, chunks[-1], idx,
+                                 chk=chk,
+                                 base=plan.n_flat_leaves * len(fp.table))
+            if integrity:
+                res, chk = res
+            outs.append(res)
+        if integrity:
+            outs.append(integrity_lib.conservation_ok(chk[0], chk[1], ax))
         return tuple(outs)
 
+    out_specs = (P(ax),) * n_ops + ((P(),) if integrity else ())
     sm = jax.shard_map(body, mesh=union_mesh, in_specs=(P(ax),) * n_ops,
-                       out_specs=(P(ax),) * n_ops, check_vma=False)
+                       out_specs=out_specs, check_vma=False)
     return jax.jit(sm, donate_argnums=(tuple(range(n_ops)) if donate
                                        else ()))
 
 
 @functools.lru_cache(maxsize=32)
 def _cached_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str,
-                  donate: bool) -> Callable[..., Tuple[jax.Array, ...]]:
+                  donate: bool,
+                  integrity: bool = False
+                  ) -> Callable[..., Tuple[jax.Array, ...]]:
     """Memoized ``lower_apply``: a supervisor reshards against a handful
     of (plan, mesh) pairs at most, and reusing the jitted callable lets a
     prewarmed transfer hit the compile cache at fault time — the MTTR
     the recovery tier is measured on (plans and meshes are hashable
     value types, so the key is exact)."""
-    return lower_apply(plan, union_mesh, ax, donate=donate)
+    return lower_apply(plan, union_mesh, ax, donate=donate,
+                       integrity=integrity)
 
 
 def abstract_operands(plan: ReshardPlan,
@@ -451,13 +525,25 @@ def _to_union(v: jax.Array, plan: FlatPlan,
 
 
 def reshard_state(src_trainer: Any, tgt_trainer: Any, state: Any, *,
-                  events: Any = None, donate: bool = True) -> Any:
+                  events: Any = None, donate: bool = True,
+                  integrity: Optional[bool] = None) -> Any:
     """Move a live TrainState/FSDPState from ``src_trainer``'s mesh to
     ``tgt_trainer``'s in one collective transfer program (see module
     docstring).  Returns the target trainer's state, step preserved,
     masters/moments value-exact (the live elements only ever move),
     EF residual redistributed (not re-zeroed).  With ``donate`` the
-    source buffers are consumed."""
+    source buffers are consumed.
+
+    ``integrity`` (None = follow the source trainer's
+    ``collective.integrity_check``) runs the transfer with the exact
+    wire-checksum verdict (``lower_apply(integrity=True)``); a tripped
+    verdict raises ``runtime.chaos.WireIntegrityError`` BEFORE the
+    landed state is handed to the target trainer — the elastic ladder
+    then falls through to the checkpoint-restore tier instead of
+    training on silently corrupted masters."""
+    if integrity is None:
+        integrity = bool(getattr(src_trainer.cfg.collective,
+                                 "integrity_check", False))
     plan = plan_for(src_trainer, tgt_trainer)
     fp = plan.flat
     ax = src_trainer.ax
@@ -482,7 +568,7 @@ def reshard_state(src_trainer: Any, tgt_trainer: Any, state: Any, *,
                 resid, (0, (rp.n_union - rp.n_src) * rp.pad_src))
         ops.append(jax.device_put(resid, u_shard))
 
-    run = _cached_apply(plan, union_mesh, ax, donate)
+    run = _cached_apply(plan, union_mesh, ax, donate, bool(integrity))
     span = (events.span("reshard.transfer", **plan.describe())
             if events is not None else None)
     if span is not None:
@@ -491,6 +577,16 @@ def reshard_state(src_trainer: Any, tgt_trainer: Any, state: Any, *,
             jax.block_until_ready(outs)
     else:
         outs = run(*ops)
+    if integrity:
+        wire_ok = outs[-1]
+        outs = outs[:-1]
+        if not bool(jax.device_get(wire_ok)):
+            from ..runtime.chaos import WireIntegrityError
+            raise WireIntegrityError(
+                "reshard transfer wire checksum tripped: a ppermute "
+                "segment landed with different bytes than were sent "
+                f"({plan.flat.n_src}->{plan.flat.n_tgt}); refusing the "
+                "landed state — fall through to checkpoint restore")
 
     # union-target layout -> the target trainer's mesh (shards 0..n_tgt-1
     # are already resident on the right devices; the tail shards are the

@@ -89,6 +89,15 @@ class ShardedTrainer:
                 "loss_and_grads_fn (explicit-gradient schedule) does not "
                 "compose with accum_steps > 1 — fold accumulation into "
                 "the schedule's num_microbatches instead")
+        if cfg.collective.integrity_check:
+            raise ValueError(
+                "integrity_check is implemented on DPTrainer only (both "
+                "value and exact wire tiers ride its step diag); "
+                "ShardedTrainer's dp reduce/gather do not thread the "
+                "verdicts yet, and a silently ignored flag would be "
+                "claimed-but-absent coverage — construct with "
+                "integrity_check=False (docs/CHAOS.md 'Exact wire "
+                "integrity')")
         self.mesh = mesh
         self.cfg = cfg
         self.param_specs = param_specs

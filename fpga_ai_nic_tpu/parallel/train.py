@@ -221,6 +221,14 @@ class DPTrainer:
                 m["codec_obs_rel_err"] = lax.pmax(
                     obs_metrics.codec_observed_error(codec, flat_g), ax)
             diag = {}
+            icheck = coll.integrity_check
+            if icheck:
+                # checksums guard the COLLECTIVE (what actually rides the
+                # wire), so under EF they see the post-compression vector
+                # — local compression is intentional, not corruption
+                expect, l1 = chaos.chunk_checksums(flat_g, ax, self.n)
+                tol = (coll.integrity_tol if coll.integrity_tol is not None
+                       else chaos.integrity_tol(coll, self.n))
             if coll.fused_optimizer:
                 # decode+accumulate+update in one pass (in-kernel on the
                 # TPU fused-ring path; the same formula fused after the
@@ -228,30 +236,62 @@ class DPTrainer:
                 # update): the optimizer runs on zero exposed time, and
                 # the EF residual carry above is untouched by the fusion
                 # (it compensates the LOCAL encode, before the wire)
-                g_sum, w_new, opt_state2 = fused_update.reduce_scatter_update(
-                    flat_g, w_own, opt_state, step, ax, coll, opt_cfg)
+                res = fused_update.reduce_scatter_update(
+                    flat_g, w_own, opt_state, step, ax, coll, opt_cfg,
+                    integrity=icheck)
+                if icheck:
+                    g_sum, w_new, opt_state2, wire_ok = res
+                    # BOTH tiers ride the fused path since PR 12: the
+                    # value band compares the returned raw sum shard, the
+                    # exact tier is the in-graph/in-kernel frame verdict
+                    diag = chaos.collective_integrity(
+                        expect, l1, g_sum, ax, self.n, tol)
+                    diag["wire_ok"] = wire_ok
+                    if fused_update.update_route_gatable(coll, self.n):
+                        # pre-step state still materialized on this
+                        # route: a tripped verdict gates the update to a
+                        # no-op (the in-kernel route cannot — its state
+                        # is donated; check_step_diag invalidates the
+                        # step instead)
+                        ok = diag["integrity_ok"] & wire_ok
+                        w_new = jnp.where(ok, w_new, w_own)
+                        opt_state2 = jax.tree_util.tree_map(
+                            lambda new, old: jnp.where(ok, new, old),
+                            opt_state2, opt_state)
+                        if ef:
+                            new_resid = jnp.where(ok, new_resid,
+                                                  maybe_resid[0])
+                else:
+                    g_sum, w_new, opt_state2 = res
                 g_own = g_sum / self.n
+                if icheck:
+                    diag["grad_norm"] = jnp.sqrt(lax.psum(
+                        jnp.sum(g_own.astype(jnp.float32) ** 2), ax))
                 if obs_on:
-                    m["grad_norm"] = obs_metrics.l2_norm(g_own, ax)
+                    # same definition as the diag norm — reuse it (as
+                    # the unfused path below does) instead of paying a
+                    # second psum on the hot fused path
+                    m["grad_norm"] = (diag["grad_norm"] if icheck
+                                      else obs_metrics.l2_norm(g_own, ax))
                 loss_m = lax.pmean(loss, ax)
                 if obs_on:
                     m["loss"] = loss_m
                 out = (w_new, opt_state2, loss_m, diag)
                 return out + ((new_resid,) if ef else ()) + (
                     (m,) if obs_on else ())
-            if coll.integrity_check:
-                # checksums guard the COLLECTIVE (what actually rides the
-                # wire), so under EF they see the post-compression vector
-                # — local compression is intentional, not corruption
-                expect, l1 = chaos.chunk_checksums(flat_g, ax, self.n)
-            g_red = fused_update.reduce_scatter(flat_g, ax, coll)
-            if coll.integrity_check:
-                tol = (coll.integrity_tol if coll.integrity_tol is not None
-                       else chaos.integrity_tol(coll, self.n))
+            if icheck:
+                g_red, wire_ok = fused_update.reduce_scatter(
+                    flat_g, ax, coll, integrity=True)
                 diag = chaos.collective_integrity(expect, l1, g_red, ax,
                                                   self.n, tol)
+                # the EXACT tier (ops.integrity): bit-conservation of the
+                # encoded frames — the finite wrong-value class the value
+                # band above is provably blind to
+                diag["wire_ok"] = wire_ok
+            else:
+                g_red = fused_update.reduce_scatter(flat_g, ax, coll)
             g_own = g_red / self.n
-            if coll.integrity_check:
+            if icheck:
                 diag["grad_norm"] = jnp.sqrt(
                     lax.psum(jnp.sum(g_own.astype(jnp.float32) ** 2), ax))
             if obs_on:
@@ -263,11 +303,11 @@ class DPTrainer:
             g_own = optim.clip_by_global_norm(opt_cfg, g_own, (ax,))
             w_new, opt_state2 = optim.apply(opt_cfg, w_own, g_own,
                                             opt_state, step)
-            if coll.integrity_check:
+            if icheck:
                 # gate the update: a corrupted reduce-scatter must not
                 # reach the master weights — the step becomes a no-op and
                 # the host decides (retry / restore) from the diag verdict
-                ok = diag["integrity_ok"]
+                ok = diag["integrity_ok"] & diag["wire_ok"]
                 w_new = jnp.where(ok, w_new, w_own)
                 opt_state2 = jax.tree_util.tree_map(
                     lambda new, old: jnp.where(ok, new, old),
@@ -288,8 +328,16 @@ class DPTrainer:
 
         # Phase 2 (no autodiff): all-gather updated weights -> replicated
         # working params (the reference's host write-back of w_new,
-        # hw/all_reduce.sv:1286-1311).
+        # hw/all_reduce.sv:1286-1311).  With integrity on, this wire is
+        # checksummed too: a corrupted weight gather poisons the
+        # REPLICATED params (the masters are safe), so the verdict is
+        # surfaced for check_step_diag — the elastic ladder rebuilds the
+        # params from the still-clean masters.
         def shard_gather(w_new):
+            if coll.integrity_check:
+                flat_w, ag_ok = fused_update.all_gather_flat(
+                    w_new, ax, coll, integrity=True)
+                return fused_update.unflatten_tree(flat_w, meta), ag_ok
             flat_w = fused_update.all_gather_flat(w_new, ax, coll)
             return fused_update.unflatten_tree(flat_w, meta)
 
@@ -310,9 +358,15 @@ class DPTrainer:
                 # delivers the step's metric scalars to the ambient
                 # MetricsSink; consuming the tapped loss keeps it alive
                 loss = obs_metrics.tap(loss, res[-1])
-            new_params = jax.shard_map(
-                shard_gather, mesh=self.mesh, in_specs=P(ax), out_specs=P(),
-                check_vma=False)(w_own)
+            if coll.integrity_check:
+                new_params, ag_ok = jax.shard_map(
+                    shard_gather, mesh=self.mesh, in_specs=P(ax),
+                    out_specs=(P(), P()), check_vma=False)(w_own)
+                diag = dict(diag, wire_ok=diag["wire_ok"] & ag_ok)
+            else:
+                new_params = jax.shard_map(
+                    shard_gather, mesh=self.mesh, in_specs=P(ax),
+                    out_specs=P(), check_vma=False)(w_own)
             new_state = TrainState(new_params, w_own, opt_state,
                                    state.step + 1, codec_state)
             if coll.integrity_check:

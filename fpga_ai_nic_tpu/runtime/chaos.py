@@ -57,12 +57,14 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 __all__ = [
-    "FAULT_KINDS", "SITES", "TRAIN_SITES", "SERVE_SITES",
+    "FAULT_KINDS", "SITES", "TRAIN_SITES", "SERVE_SITES", "WIRE_SITES",
     "CORRUPTION_MODES",
     "InjectedFault", "InjectedPreemption", "IntegrityError",
+    "WireIntegrityError",
     "FaultSpec", "FaultPlan", "NormDriftGuard",
     "chunk_checksums", "collective_integrity", "integrity_tol",
     "check_step_diag", "install_collective_tap", "uninstall_collective_tap",
+    "install_wire_tap", "uninstall_wire_tap",
     "activate", "state_buffers_alive",
 ]
 
@@ -76,13 +78,45 @@ FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
 # victim's in-flight requests must migrate to survivors).  The TRAINING
 # matrix/soak in tools/chaos_bench.py iterates TRAIN_SITES — a serving
 # spec never fires in a training run.
+#
+# "reshard.transfer" is the live-reshard transfer program's WIRE (the
+# per-segment ppermute payloads of parallel/reshard.lower_apply): like
+# "collective" it executes inside an XLA callback (corruption only), via
+# the ENCODED-payload wire tap below — the boundary the exact frame
+# checksums (ops.integrity) guard.  It is not in TRAIN_SITES: it can
+# only fire while a reshard transfer is actually running, so the
+# generic matrix/soak would plan unfireable specs; the dedicated
+# integrity corruption cells in tools/chaos_bench.py own it.
 TRAIN_SITES = ("queue.issue", "queue.wait", "staging", "collective")
 SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
-SITES = TRAIN_SITES + SERVE_SITES
-CORRUPTION_MODES = ("nan", "bitflip", "scale")
+SITES = TRAIN_SITES + SERVE_SITES + ("reshard.transfer",)
+# "wirebit" is the FINITE corruption class the wire checksums exist for
+# (the blind spot of every value-space guard): a low bit flipped in the
+# ENCODED frame (int8 mantissa / int16 index / f32 low-mantissa word)
+# decodes to a plausible, in-band, wrong value — no NaN, no magnitude
+# excursion.  At WIRE_SITES it fires through the encoded-payload wire
+# tap; at host sites (serve.step payloads, staging) it flips low
+# mantissa bits of the float tree in place.
+CORRUPTION_MODES = ("nan", "bitflip", "scale", "wirebit")
 
 # faults that can run inside an XLA callback (no raising in there)
 _CALLBACK_KINDS = ("hang", "slowdown", "corruption")
+# sites that ONLY exist inside an XLA callback
+_CALLBACK_ONLY_SITES = ("collective", "reshard.transfer")
+# corruption modes consumed by the VALUE taps (collective input, host
+# payload trees); "wirebit" belongs to the encoded-payload wire tap
+_VALUE_MODES = ("nan", "bitflip", "scale")
+# wire-tap point (the string the transfer programs tap with) -> the
+# chaos SITE whose wirebit specs fire there
+_WIRE_POINT_SITES = {
+    "ring.wire": "collective",          # ops.ring / ops.ring_hier hops
+    "reshard.wire": "reshard.transfer",  # parallel.reshard segments
+    "handoff.wire": "serve.handoff",     # serve.handoff page blocks
+}
+# the sites wirebit specs reach through the wire tap — DERIVED from the
+# point map above so the exported constant can never drift from the
+# real routing
+WIRE_SITES = tuple(dict.fromkeys(_WIRE_POINT_SITES.values()))
 
 
 class InjectedFault(RuntimeError):
@@ -105,6 +139,14 @@ class IntegrityError(RuntimeError):
     """A collective/loss integrity guard tripped: the step's numbers cannot
     be trusted and must not reach (or have been gated out of) the
     optimizer."""
+
+
+class WireIntegrityError(IntegrityError):
+    """The EXACT tier tripped: an encoded wire frame / KV page failed its
+    bit-exact checksum (ops.integrity).  Distinguished from the
+    value-space IntegrityError so recovery stats and chaos verdicts can
+    prove WHICH tier caught a finite corruption — the class the
+    value-space guards are provably blind to."""
 
 
 def state_buffers_alive(state: Any) -> bool:
@@ -140,12 +182,21 @@ class FaultSpec:
         assert self.kind in FAULT_KINDS, self.kind
         assert self.site in SITES, self.site
         assert self.mode in CORRUPTION_MODES, self.mode
-        if self.site == "collective" and self.kind not in _CALLBACK_KINDS:
+        if self.site in _CALLBACK_ONLY_SITES \
+                and self.kind not in _CALLBACK_KINDS:
             raise ValueError(
-                f"{self.kind!r} cannot fire at the 'collective' site: it "
+                f"{self.kind!r} cannot fire at the {self.site!r} site: it "
                 "executes inside an XLA callback, where raising aborts the "
                 "runtime instead of unwinding the step — plan it at a host "
                 "site (queue.*/staging) instead")
+        if self.site == "reshard.transfer" and (
+                self.kind != "corruption" or self.mode != "wirebit"):
+            raise ValueError(
+                "the 'reshard.transfer' site is the transfer program's "
+                "wire tap: only corruption mode='wirebit' specs can fire "
+                "there (the tap pops wirebit alone — any other spec "
+                "would stay armed forever; hang/slowdown belong to the "
+                "host boundaries around the transfer)")
 
 
 class FaultPlan:
@@ -199,7 +250,10 @@ class FaultPlan:
             kind = str(rng.choice(legal))
             specs.append(FaultSpec(
                 kind=kind, site=site, step=step, duration_s=duration_s,
-                mode=str(rng.choice(list(CORRUPTION_MODES)))))
+                # value modes only: a random wirebit spec would need the
+                # wire tap installed to fire at all — the dedicated
+                # integrity cells own that mode deterministically
+                mode=str(rng.choice(list(_VALUE_MODES)))))
         return cls(specs, seed=seed)
 
     # -- stepping -----------------------------------------------------------
@@ -213,18 +267,25 @@ class FaultPlan:
         return self._step
 
     def _take(self, site: str, kinds: Sequence[str],
-              limit: Optional[int] = None) -> List[FaultSpec]:
+              limit: Optional[int] = None,
+              modes: Optional[Sequence[str]] = None) -> List[FaultSpec]:
         """Pop (mark fired) the unfired specs matching (site, current step,
         kinds).  Fired-ness is per spec INSTANCE (identity, not dataclass
         equality): a plan may deliberately schedule several equal specs —
         e.g. one per expected retry — and each must fire exactly once.
         ``limit`` caps how many are popped per call: raising hooks take one
-        at a time, so sibling specs stay armed for the retry."""
+        at a time, so sibling specs stay armed for the retry.  ``modes``
+        (corruption only) restricts which corruption modes this hook
+        consumes: the VALUE tap must leave "wirebit" specs armed for the
+        ENCODED-payload wire tap (and vice versa) — the two taps model
+        different fault locations and must not steal each other's specs."""
         with self._lock:
             fired_ids = {id(f) for f in self.fired}
             out = [s for s in self.faults
                    if s.site == site and s.step == self._step
-                   and s.kind in kinds and id(s) not in fired_ids]
+                   and s.kind in kinds and id(s) not in fired_ids
+                   and (modes is None or s.kind != "corruption"
+                        or s.mode in modes)]
             if limit is not None:
                 out = out[:limit]
             self.fired.extend(out)
@@ -274,6 +335,15 @@ class FaultPlan:
     def _corrupt_array(self, arr: np.ndarray, spec: FaultSpec) -> np.ndarray:
         """Deterministic damage: indices and bits derive from
         (plan seed, spec step) only."""
+        if spec.mode == "wirebit":
+            # the FINITE class: a low STORED bit flips in the array's
+            # native width (_corrupt_wire_array, its own rng — an f32
+            # round-trip would round the flip away below bf16/f16
+            # resolution and silently corrupt NOTHING), so the damaged
+            # value stays plausible and in-band — invisible to NaN/
+            # norm/magnitude guards by construction; only an exact
+            # checksum (ops.integrity) can prove it
+            return self._corrupt_wire_array(arr, spec)
         rng = np.random.default_rng((self.seed, spec.step, 0xC0FFEE))
         flat = arr.reshape(-1)
         k = max(1, int(flat.size * spec.fraction))
@@ -306,8 +376,54 @@ class FaultPlan:
         corrupts the first arriving shard's payload for corruption specs."""
         for spec in self._take("collective", ("hang", "slowdown")):
             time.sleep(spec.duration_s)
-        for spec in self._take("collective", ("corruption",)):
+        for spec in self._take("collective", ("corruption",),
+                               modes=_VALUE_MODES):
             arr = self._corrupt_array(np.array(arr), spec)
+        return arr
+
+    # -- in-program (encoded wire) path -------------------------------------
+
+    def wire_payload(self, arr: np.ndarray, point: str) -> np.ndarray:
+        """The host half of the ENCODED-payload wire tap: called from
+        inside a transfer program, once per payload array per hop, with
+        the bytes exactly as they ride the wire (int8 mantissa/scale
+        tiles, int16 top-k indices, raw f32 words).  Only "wirebit"
+        corruption specs fire here — the finite low-bit class the exact
+        frame checksums (ops.integrity) exist for; a flipped encoded bit
+        decodes to a plausible in-band value no value-space guard can
+        see."""
+        site = _WIRE_POINT_SITES.get(point)
+        if site is None:
+            return arr
+        # limit=1: ONE corruption event per wire crossing.  A transfer
+        # program taps once per payload array, so sibling specs at the
+        # same step stay armed for LATER payloads/attempts (the
+        # bounded-retry cells need the retry to trip too) — and two
+        # identical deterministic flips can never land on one array and
+        # XOR-cancel each other
+        for spec in self._take(site, ("corruption",), limit=1,
+                               modes=("wirebit",)):
+            arr = self._corrupt_wire_array(np.array(arr), spec)
+        return arr
+
+    def _corrupt_wire_array(self, arr: np.ndarray,
+                            spec: FaultSpec) -> np.ndarray:
+        """Deterministic low-bit damage to an ENCODED frame: the lowest
+        stored bit of ``fraction`` of the words flips — int frames flip
+        mantissa/index LSBs, f32 frames flip mantissa bit 1.  Always
+        finite, always in-band, always a changed wire byte."""
+        rng = np.random.default_rng((self.seed, spec.step, 0xB17F11B))
+        flat = arr.reshape(-1)
+        k = max(1, int(flat.size * spec.fraction))
+        idx = rng.choice(flat.size, size=min(k, flat.size), replace=False)
+        if flat.dtype == np.float32:
+            flat.view(np.uint32)[idx] ^= np.uint32(1 << 1)
+        elif flat.dtype.kind in "iu":
+            flat[idx] ^= flat.dtype.type(1)
+        else:   # other float widths: flip the lowest mantissa bit
+            w = flat.view(np.uint16 if flat.dtype.itemsize == 2
+                          else np.uint32)
+            w[idx] ^= w.dtype.type(1)
         return arr
 
 
@@ -346,6 +462,44 @@ def install_collective_tap() -> None:
 def uninstall_collective_tap() -> None:
     from ..ops import ring
     ring.set_fault_tap(None)
+
+
+def _wire_tap_fn(x, point: str, consumed=None):
+    """Trace-time ENCODED-payload tap body installed into ops.ring (and
+    through it every ppermute-bearing transfer program: flat/hier rings,
+    the reshard segments, the KV handoff): routes each wire payload
+    through the ACTIVE plan's wirebit hook on the host.  Identity copy
+    when no plan / no pending spec.  ``consumed`` (traced bool) gates
+    the hook to devices whose received bytes the program actually uses
+    (ops.ring._tap_wire docstring) — a spec must never be spent on a
+    bystander's zero payload."""
+    import jax
+    import jax.numpy as jnp
+
+    def host(v, c):
+        plan = _ACTIVE_PLAN
+        a = np.asarray(v)
+        if plan is None or not bool(np.asarray(c)):
+            return a
+        return np.asarray(plan.wire_payload(a, point), dtype=a.dtype)
+
+    c = jnp.bool_(True) if consumed is None else consumed
+    return jax.pure_callback(host, jax.ShapeDtypeStruct(x.shape, x.dtype),
+                             x, c)
+
+
+def install_wire_tap() -> None:
+    """Install the encoded-payload wire tap (the boundary the exact frame
+    checksums guard — ops.integrity).  Must run BEFORE the consuming
+    transfer program is first traced, same contract as
+    install_collective_tap; per-run plans switch via activate()."""
+    from ..ops import ring
+    ring.set_wire_tap(_wire_tap_fn)
+
+
+def uninstall_wire_tap() -> None:
+    from ..ops import ring
+    ring.set_wire_tap(None)
 
 
 class activate:
@@ -444,7 +598,20 @@ def collective_integrity(expect, l1, g_red, axis_name: str, n: int,
 
 def check_step_diag(diag: Dict[str, Any], step: int) -> None:
     """Host-side verdict on a step's integrity diagnostics (raises
-    IntegrityError).  Call AFTER the step's outputs are materialized."""
+    IntegrityError / WireIntegrityError).  Call AFTER the step's outputs
+    are materialized.  The EXACT tier (``wire_ok``: bit-conservation of
+    the encoded ring frames, ops.integrity) is checked FIRST — a wire
+    trip is a different fact than a value-band excursion (it proves the
+    bytes changed in flight, with no tolerance involved), and on the
+    in-kernel fused-optimizer route this raise is the ONLY recovery path
+    (the donated state cannot be gated in-graph; the elastic ladder
+    discards the invalidated step)."""
+    if not bool(diag.get("wire_ok", True)):
+        raise WireIntegrityError(
+            f"exact wire checksum tripped at step {step}: an encoded "
+            "frame changed between send and receive (finite corruption "
+            "class — invisible to the value band; gated/invalidated "
+            "before the masters could absorb it)")
     nonfinite = int(diag.get("nonfinite", 0))
     ok = bool(diag.get("integrity_ok", True))
     if nonfinite or not ok:

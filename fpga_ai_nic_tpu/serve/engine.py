@@ -29,7 +29,7 @@ from __future__ import annotations
 
 import math
 import time
-from typing import Any, Callable, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -38,6 +38,7 @@ import numpy as np
 from ..models import llama_decode
 from ..models.llama import LlamaConfig
 from ..obs.metrics import RequestSpans
+from ..ops import integrity as integrity_lib
 from ..runtime import chaos as chaos_lib
 from ..runtime.requests import DECODE, Request, RequestQueue, ServeStats
 from ..runtime.watchdog import DeviceHangError, Watchdog
@@ -107,10 +108,18 @@ class ServeEngine:
         self.batcher = ContinuousBatcher(scfg, self.alloc,
                                          stats=self.stats)
         self.pool: Pool = self._fresh_pool()
+        # exact per-page KV checksum ledger (scfg.page_integrity): what
+        # the last ledger-maintaining program computed over its OUTPUT
+        # pool; the next tick verifies its input pool against it.  A
+        # zero-filled pool checksums to all-zeros by construction
+        # (ops.integrity.page_checksums), so a fresh ledger is zeros.
+        self.ledger = self._fresh_ledger()
         self.ticks = 0
         self._wall_s = 0.0
         self._consec_failures = 0
         self._pages_peak = 0         # survives allocator rebuilds
+        self.page_trips = 0          # exact-tier (wire/page checksum) trips
+        self.logit_trips = 0         # magnitude-tier (logit guard) trips
         self._decode_fn, self._decode_traces = counted_jit(
             self._decode_impl, donate_argnums=(0,))
         self._prefill_fn, self._prefill_traces = counted_jit(
@@ -121,6 +130,37 @@ class ServeEngine:
         if self.device is not None:
             pool = jax.device_put(pool, self.device)
         return pool
+
+    def _fresh_ledger(self) -> Optional[jax.Array]:
+        if not self.scfg.page_integrity:
+            return None
+        ledger = jnp.zeros((self.scfg.n_pages,), jnp.uint32)
+        if self.device is not None:
+            ledger = jax.device_put(ledger, self.device)
+        return ledger
+
+    def record_landed_pages(self, pages: Sequence[int],
+                            checksums: Any) -> None:
+        """Ledger update for pages mutated OUTSIDE the tick programs —
+        the fleet's KV handoff lands page blocks directly into the pool,
+        and the destination must record their (verified) checksums or
+        the next tick's input check would trip on its own migration.
+        Called on FAILED migrations too: the landed-but-rejected pages
+        stay free-and-dirty, and dirty pages must still be
+        ledger-consistent (dirty content is harmless by the mask-parity
+        design; a ledger mismatch is corruption by definition)."""
+        if self.ledger is None:
+            return
+        idx = jnp.asarray(np.asarray(pages, np.int32))
+        self.ledger = self.ledger.at[idx].set(
+            jnp.asarray(np.asarray(checksums, np.uint32)))
+
+    def ledger_entries(self, pages: Sequence[int]) -> np.ndarray:
+        """uint32 [len(pages)] — the ledger's write-time checksums for
+        ``pages`` (what a migration's landed bytes must still hash to)."""
+        assert self.ledger is not None, "page_integrity is off"
+        return np.asarray(jax.device_get(self.ledger))[
+            np.asarray(pages, np.int64)]
 
     # -- the two jitted programs (shapes fixed by ServeConfig) ---------------
 
@@ -135,27 +175,48 @@ class ServeEngine:
                          > jnp.float32(self.scfg.logit_guard_abs))
         return bad
 
+    def _page_check(self, pool: Pool,
+                    ledger: Optional[jax.Array]) -> jax.Array:
+        """First-tier input verify: # of pool pages whose exact checksum
+        differs from the write-time ledger — any nonzero count means a
+        page's BYTES changed outside the ledger-maintaining programs (a
+        finite wrong-value corruption the logit guard cannot see)."""
+        if ledger is None:
+            return jnp.int32(0)
+        got = integrity_lib.page_checksums(pool)
+        return jnp.sum((got != ledger).astype(jnp.int32))
+
     def _decode_impl(self, pool: Pool, params: Dict[str, Any],
                      tokens: jax.Array, table: jax.Array, pos: jax.Array,
-                     active: jax.Array
-                     ) -> Tuple[jax.Array, jax.Array, Pool]:
+                     active: jax.Array,
+                     ledger: Optional[jax.Array] = None
+                     ) -> Tuple[jax.Array, ...]:
+        bad_pages = self._page_check(pool, ledger)
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, table, pos, self.cfg,
             page_size=self.scfg.page_size, active=active)
         toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-        return toks, self._logit_guard(logits), pool
+        if ledger is None:
+            return toks, self._logit_guard(logits), pool
+        return (toks, self._logit_guard(logits), bad_pages,
+                integrity_lib.page_checksums(pool), pool)
 
     def _prefill_impl(self, pool: Pool, params: Dict[str, Any],
                       tokens: jax.Array, row: jax.Array, pos0: jax.Array,
-                      last: jax.Array
-                      ) -> Tuple[jax.Array, jax.Array, Pool]:
+                      last: jax.Array,
+                      ledger: Optional[jax.Array] = None
+                      ) -> Tuple[jax.Array, ...]:
+        bad_pages = self._page_check(pool, ledger)
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, row, pos0, self.cfg,
             page_size=self.scfg.page_size)
         # the sampled continuation at the chunk's last TRUE token — only
         # consumed when this chunk completes a FRESH prefill
         nxt = jnp.argmax(logits[0, last], axis=-1).astype(jnp.int32)
-        return nxt, self._logit_guard(logits), pool
+        if ledger is None:
+            return nxt, self._logit_guard(logits), pool
+        return (nxt, self._logit_guard(logits), bad_pages,
+                integrity_lib.page_checksums(pool), pool)
 
     # -- intake --------------------------------------------------------------
 
@@ -222,6 +283,8 @@ class ServeEngine:
                 self._recover(err)
                 return True
         self.pool = pool
+        if self.ledger is not None and out.get("ledger") is not None:
+            self.ledger = out["ledger"]
         self._consec_failures = 0
         self._apply(pre, dec, out)
         self.ticks += 1
@@ -256,24 +319,34 @@ class ServeEngine:
             pre_snap = (pre_tokens, req.slot, start, last)
         dec_snap = [(r.slot, r.generated[-1], r.n_tokens) for r in dec]
 
+        ledger_in = self.ledger
+
         def work() -> Tuple[Pool, Dict[str, Any]]:
             pool = pool_in
+            ledger = ledger_in
             if self.chaos is not None:
                 self.chaos.begin_step(self.ticks)
                 self.chaos.fire("serve.step")      # may sleep or raise
                 # a corruption spec damages the tick's KV payload — the
-                # in-graph logit guard must catch it BEFORE any token
-                # reaches a stream (zero copies when nothing is pending)
+                # page-checksum tier (finite damage) or the logit guard
+                # (NaN/scale) must catch it BEFORE any token reaches a
+                # stream (zero copies when nothing is pending)
                 pool = self.chaos.corrupt("serve.step", pool)
             out: Dict[str, Any] = {}
             corrupted = False
+            bad_pages = 0
             if pre_snap is not None:
                 pre_tokens, slot, start, last = pre_snap
-                tok, bad, pool = self._prefill_fn(
+                res = self._prefill_fn(
                     pool, self.params, jnp.asarray(pre_tokens),
                     jnp.asarray(table[slot:slot + 1]),
                     jnp.asarray([start], jnp.int32),
-                    jnp.asarray(last, jnp.int32))
+                    jnp.asarray(last, jnp.int32), ledger)
+                if ledger is None:
+                    tok, bad, pool = res
+                else:
+                    tok, bad, nbad, ledger, pool = res
+                    bad_pages += int(nbad)                 # blocks
                 out["prefill_tok"] = int(tok)              # blocks
                 corrupted |= bool(bad)
             if dec_snap:
@@ -285,12 +358,28 @@ class ServeEngine:
                     toks[slot, 0] = tok_in
                     pos[slot] = n_tok
                     act[slot] = True
-                ntok, bad, pool = self._decode_fn(
+                res = self._decode_fn(
                     pool, self.params, jnp.asarray(toks),
                     jnp.asarray(table), jnp.asarray(pos),
-                    jnp.asarray(act))
+                    jnp.asarray(act), ledger)
+                if ledger is None:
+                    ntok, bad, pool = res
+                else:
+                    ntok, bad, nbad, ledger, pool = res
+                    bad_pages += int(nbad)                 # blocks
                 out["decode_toks"] = np.asarray(ntok)      # blocks
                 corrupted |= bool(bad)
+            if bad_pages:
+                # the EXACT tier tripped first: some page's bytes changed
+                # outside the ledger-maintaining programs — finite,
+                # plausible, invisible to the logit guard; gated out
+                # BEFORE _apply, so no poisoned token was emitted
+                raise chaos_lib.WireIntegrityError(
+                    f"serve tick {self.ticks}: {bad_pages} KV pool "
+                    "page(s) failed their exact checksum against the "
+                    "write-time ledger — wrong-value corruption gated "
+                    "before emission (recovery rebuilds pool + ledger "
+                    "and replays)")
             if corrupted:
                 # gated out BEFORE _apply: no poisoned token was emitted
                 raise chaos_lib.IntegrityError(
@@ -298,6 +387,7 @@ class ServeEngine:
                     "garbage logits — corrupted decode tick gated before "
                     "emission (recovery will rebuild the pool and "
                     "replay)")
+            out["ledger"] = ledger
             return pool, out
 
         if self.watchdog is not None:
@@ -352,8 +442,15 @@ class ServeEngine:
             kind = "preemption"
         elif isinstance(err, DeviceHangError):
             kind = "hang"
+        elif isinstance(err, chaos_lib.WireIntegrityError):
+            # the EXACT tier (page checksums) — counted apart from the
+            # logit guard so a chaos cell can prove WHICH tier caught a
+            # finite corruption
+            kind = "wire-corruption"
+            self.page_trips += 1
         elif isinstance(err, chaos_lib.IntegrityError):
             kind = "corruption"
+            self.logit_trips += 1
         else:
             kind = getattr(err, "kind", type(err).__name__)
         ev = self.profiler.recovery.record_fault(
@@ -364,6 +461,9 @@ class ServeEngine:
         self.alloc = PageAllocator(self.scfg.n_pages)
         self.batcher.rebind(self.alloc)
         self.pool = self._fresh_pool()
+        # fresh zero pool -> all-zero checksums, so the ledger resets
+        # with it (the zero-pool invariant of ops.integrity)
+        self.ledger = self._fresh_ledger()
         jax.block_until_ready(self.pool)
         self.profiler.recovery.record_recovery(
             time.perf_counter() - t0, event=ev)
@@ -420,6 +520,9 @@ class ServeEngine:
                                  if wall > 0 else None),
             "trace_counts": self.trace_counts(),
             "recompiles_steady": self.recompiles_steady(),
+            "page_integrity": bool(self.scfg.page_integrity),
+            "page_trips": self.page_trips,
+            "logit_trips": self.logit_trips,
             "requests": self.spans.summary(),
             "recovery": {"faults": rec["faults"],
                          "recoveries": rec["recoveries"],

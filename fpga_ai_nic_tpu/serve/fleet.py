@@ -152,6 +152,11 @@ class ServeFleet:
         self.handoff_host_bytes = 0
         self.fleet_replays = 0                   # replay-tier fallbacks
         self.kills = 0
+        # exact-tier handoff verification (scfg.page_integrity): a
+        # tripped landed-page checksum retries once before degrading to
+        # replay — bounded-retry-then-replay, counted honestly
+        self.handoff_retries = 1
+        self.handoff_integrity_trips = 0
 
     # -- membership ----------------------------------------------------------
 
@@ -211,7 +216,17 @@ class ServeFleet:
         """Migrate one request's KV pages src -> dst over the lowered
         transfer program; on success the request continues on dst with
         ZERO replay.  Raises on an injected handoff fault BEFORE any
-        state moved (the caller degrades that request to replay)."""
+        state moved (the caller degrades that request to replay).
+
+        With ``scfg.page_integrity`` the transfer runs the
+        integrity-checked program: the landed page blocks are
+        re-checksummed bit-exactly against the source ledger's
+        write-time entries (``handoff.lower_apply(integrity=True)``),
+        so migrated KV has end-to-end write-time -> land-time coverage.
+        A tripped verdict gets ONE bounded retry (a transient wire fault
+        must not cost replay); a second trip raises
+        ``WireIntegrityError`` and the caller degrades the request to
+        the replay tier — degraded, never lost, never silently wrong."""
         if self.chaos is not None:
             self.chaos.fire("serve.handoff")     # may sleep or raise
         src_eng, dst_eng = src.engine, dst.engine
@@ -223,14 +238,41 @@ class ServeFleet:
         plan = handoff_lib.plan_for(self.cfg, self.scfg, n,
                                     dtype=self.dtype)
         mesh = handoff_lib.pair_mesh(src.device, dst.device)
+        integrity = bool(self.scfg.page_integrity)
+        expect = src_eng.ledger_entries(src_pages) if integrity else None
         with self.profiler.events.span(
                 "fleet.handoff", lane="serve", uid=req.uid, src=src.idx,
-                dst=dst.idx, pages=n, wire_bytes=plan.wire_bytes()):
-            new_src, new_dst = handoff_lib.apply_handoff(
-                plan, mesh, src_eng.pool, dst_eng.pool, src_pages,
-                dst_pages)
-        src_eng.pool = new_src
-        dst_eng.pool = new_dst
+                dst=dst.idx, pages=n, wire_bytes=plan.wire_bytes(),
+                integrity=integrity):
+            ok = True
+            for attempt in range(self.handoff_retries + 1):
+                res = handoff_lib.apply_handoff(
+                    plan, mesh, src_eng.pool, dst_eng.pool, src_pages,
+                    dst_pages, expect=expect)
+                if integrity:
+                    new_src, new_dst, ok, landed = res
+                    # ALWAYS record what actually landed — a rejected
+                    # page stays free-and-dirty and dirty pages must be
+                    # ledger-consistent (engine.record_landed_pages)
+                    src_eng.pool, dst_eng.pool = new_src, new_dst
+                    dst_eng.record_landed_pages(dst_pages, landed)
+                    if ok:
+                        break
+                    self.handoff_integrity_trips += 1
+                    self.profiler.events.instant(
+                        "fleet.handoff_trip", uid=req.uid, src=src.idx,
+                        dst=dst.idx, attempt=attempt)
+                else:
+                    new_src, new_dst = res
+                    src_eng.pool, dst_eng.pool = new_src, new_dst
+                    break
+        if not ok:
+            dst_eng.alloc.free_pages(dst_pages)
+            raise chaos_lib.WireIntegrityError(
+                f"KV handoff {src.idx}->{dst.idx} for request {req.uid} "
+                f"failed its landed-page checksums "
+                f"{self.handoff_retries + 1}x — degrading this request "
+                "to the replay tier (KV discarded, tokens kept)")
         src_eng.batcher.release(req)
         slot = dst_eng.batcher.adopt(req, dst_pages, state=state)
         assert slot is not None, "target lost its free slot mid-handoff"
@@ -278,9 +320,13 @@ class ServeFleet:
             return
         try:
             self._handoff(src, dst, req, state=state)
-        except chaos_lib.InjectedFault as err:
+        except (chaos_lib.InjectedFault,
+                chaos_lib.WireIntegrityError) as err:
+            kind = ("wire-corruption"
+                    if isinstance(err, chaos_lib.WireIntegrityError)
+                    else err.kind)
             ev = self.profiler.recovery.record_fault(
-                err.kind, step=self.ticks, site="serve.handoff",
+                kind, step=self.ticks, site="serve.handoff",
                 error=repr(err))
             t0 = time.perf_counter()
             self._replay_fallback(src, req)
@@ -458,6 +504,10 @@ class ServeFleet:
             "handoffs": self.handoffs,
             "handoff_wire_bytes": self.handoff_wire_bytes,
             "handoff_host_bytes": self.handoff_host_bytes,
+            "handoff_integrity_trips": self.handoff_integrity_trips,
+            "page_trips": sum(r.engine.page_trips for r in self.replicas),
+            "logit_trips": sum(r.engine.logit_trips
+                               for r in self.replicas),
             "fleet_replays": self.fleet_replays,
             "kills": self.kills,
             "serve_recoveries": agg.get("serve_recoveries", 0),

@@ -51,6 +51,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import llama_decode
 from ..models.llama import LlamaConfig
+from ..ops import integrity as integrity_lib
+from ..ops import ring as ring_ops
 from .paged import ServeConfig
 
 __all__ = ["HandoffPlan", "make_plan", "plan_for", "lower_apply",
@@ -132,7 +134,7 @@ def plan_for(cfg: LlamaConfig, scfg: ServeConfig, n_move: int, *,
 # ---------------------------------------------------------------------------
 
 def lower_apply(plan: HandoffPlan, mesh: Mesh, ax: str = REP_AXIS, *,
-                donate: bool = True) -> Any:
+                donate: bool = True, integrity: bool = False) -> Any:
     """The plan as ONE jitted transfer program over a 2-device pair mesh.
 
     Positional args: ``2 * n_layers`` stacked pools
@@ -141,7 +143,19 @@ def lower_apply(plan: HandoffPlan, mesh: Mesh, ax: str = REP_AXIS, *,
     int32 (replicated).  Returns the same pools with the gathered source
     pages landed at the destination's page ids; the source shard passes
     through untouched (its pages are freed host-side and recycled
-    dirty).  Every pool operand is donated by default."""
+    dirty).  Every pool operand is donated by default.
+
+    ``integrity=True`` adds one replicated operand — ``expect [n_move]``
+    uint32, the source replica's page-checksum ledger entries for the
+    migrating pages (``ops.integrity.page_checksums``, recorded when the
+    pages were last WRITTEN) — and two replicated outputs: ``landed
+    [n_move]`` uint32 (the same exact checksum recomputed over the
+    post-wire landed page blocks) and ``ok`` (landed == expect for every
+    page).  A flipped bit anywhere between the source write and the
+    destination land — including on the pair wire itself — fails ``ok``
+    bit-exactly.  The page bytes moved and the J11 ppermute accounting
+    are identical either way: the checksums are psum'd scalars, never
+    wire payload."""
     assert mesh.shape[ax] == 2, mesh.shape
     n_pool = 2 * plan.n_layers
 
@@ -150,42 +164,64 @@ def lower_apply(plan: HandoffPlan, mesh: Mesh, ax: str = REP_AXIS, *,
         src_idx, dst_idx = ops[n_pool], ops[n_pool + 1]
         i = lax.axis_index(ax)
         outs = []
+        blocks = []
         for p in pools:
             # exact-length payload: ONLY the migrating pages cross —
             # [n_move, kv_local, page_size, hd] per layer per K/V
             payload = jnp.take(p[0], src_idx, axis=0)
             payload = lax.ppermute(payload, ax, [(0, 1)])
+            payload = ring_ops._tap_wire((payload,), "handoff.wire",
+                                         consumed=i == 1)[0]
+            blocks.append(payload)
             landed = p.at[0, dst_idx].set(payload)
             outs.append(jnp.where(i == 1, landed, p))
+        if integrity:
+            expect = ops[n_pool + 2]
+            got = integrity_lib.gathered_page_checksums(blocks)
+            # device 0 received zeros; replicate device 1's verdict (the
+            # psum rides i32 — wraparound addition commutes with the
+            # bitcast, and i32 all-reduce support is universal)
+            landed_chk = lax.bitcast_convert_type(
+                lax.psum(lax.bitcast_convert_type(
+                    jnp.where(i == 1, got, jnp.zeros_like(got)),
+                    jnp.int32), ax), jnp.uint32)
+            bad = lax.psum(jnp.where(
+                i == 1, jnp.sum((got != expect).astype(jnp.int32)), 0), ax)
+            outs.extend([landed_chk, bad == 0])
         return tuple(outs)
 
-    sm = jax.shard_map(body, mesh=mesh,
-                       in_specs=(P(ax),) * n_pool + (P(), P()),
-                       out_specs=(P(ax),) * n_pool, check_vma=False)
+    in_specs = (P(ax),) * n_pool + (P(), P()) + ((P(),) if integrity
+                                                 else ())
+    out_specs = (P(ax),) * n_pool + ((P(), P()) if integrity else ())
+    sm = jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+                       out_specs=out_specs, check_vma=False)
     return jax.jit(sm, donate_argnums=(tuple(range(n_pool)) if donate
                                        else ()))
 
 
 @functools.lru_cache(maxsize=64)
 def _cached_apply(plan: HandoffPlan, mesh: Mesh, ax: str,
-                  donate: bool) -> Any:
+                  donate: bool, integrity: bool = False) -> Any:
     """Memoized ``lower_apply``: migrations of the same page count over
     the same pair mesh hit the jit dispatch cache — the fleet's handoff
     trace count is bounded by distinct (n_move, pair) values, not by
     migration events."""
-    return lower_apply(plan, mesh, ax, donate=donate)
+    return lower_apply(plan, mesh, ax, donate=donate, integrity=integrity)
 
 
-def abstract_operands(plan: HandoffPlan
+def abstract_operands(plan: HandoffPlan, *, integrity: bool = False
                       ) -> Tuple[jax.ShapeDtypeStruct, ...]:
     """ShapeDtypeStructs matching ``lower_apply``'s positional args —
-    the zero-device-work handle the graftlint J11 sweep traces the
+    the zero-device-work handle the graftlint J11/J12 sweeps trace the
     program through."""
     pool_sds = jax.ShapeDtypeStruct(
         (2, plan.n_pages, plan.kv_local, plan.page_size, plan.head_dim),
         jnp.dtype(plan.dtype))
     idx = jax.ShapeDtypeStruct((plan.n_move,), jnp.int32)
-    return (pool_sds,) * (2 * plan.n_layers) + (idx, idx)
+    ops = (pool_sds,) * (2 * plan.n_layers) + (idx, idx)
+    if integrity:
+        ops = ops + (jax.ShapeDtypeStruct((plan.n_move,), jnp.uint32),)
+    return ops
 
 
 # ---------------------------------------------------------------------------
@@ -219,28 +255,44 @@ def _unstack(out: jax.Array) -> Tuple[jax.Array, jax.Array]:
 def apply_handoff(plan: HandoffPlan, mesh: Mesh, src_pool: Pool,
                   dst_pool: Pool, src_pages: Sequence[int],
                   dst_pages: Sequence[int], *, ax: str = REP_AXIS,
-                  donate: bool = True) -> Tuple[Pool, Pool]:
+                  donate: bool = True,
+                  expect: Optional[Any] = None) -> Any:
     """Run the transfer: source pages ``src_pages`` of ``src_pool`` land
     at ``dst_pages`` of ``dst_pool``.  Returns (new_src_pool,
     new_dst_pool); with ``donate`` the stacked inputs are consumed.  The
     caller owns the host bookkeeping (allocator, table rows, request
-    state) — this is ONLY the device move."""
+    state) — this is ONLY the device move.
+
+    ``expect`` (uint32 [n_move], the source ledger's checksums for
+    ``src_pages``) switches on the integrity-checked program: the return
+    grows to ``(new_src, new_dst, ok, landed)`` where ``ok`` is the
+    bit-exact landed-vs-written verdict and ``landed`` the recomputed
+    per-page checksums (what the destination ledger must record for
+    ``dst_pages`` — even on a tripped run, so the destination's dirty
+    pages stay ledger-consistent)."""
     assert len(src_pages) == len(dst_pages) == plan.n_move
+    integrity = expect is not None
     sharding = NamedSharding(mesh, P(ax))
     ops = []
     for ls, ld in zip(src_pool, dst_pool):
         for key in ("k", "v"):
             ops.append(_stacked(ls[key], ld[key], sharding))
-    run = _cached_apply(plan, mesh, ax, donate)
-    outs = run(*ops, jnp.asarray(np.asarray(src_pages, np.int32)),
-               jnp.asarray(np.asarray(dst_pages, np.int32)))
+    run = _cached_apply(plan, mesh, ax, donate, integrity)
+    args = (jnp.asarray(np.asarray(src_pages, np.int32)),
+            jnp.asarray(np.asarray(dst_pages, np.int32)))
+    if integrity:
+        args = args + (jnp.asarray(np.asarray(expect, np.uint32)),)
+    outs = run(*ops, *args)
     jax.block_until_ready(outs)
     new_src: Pool = []
     new_dst: Pool = []
-    it = iter(outs)
+    it = iter(outs[:2 * plan.n_layers])
     for _ in range(plan.n_layers):
         sk, dk = _unstack(next(it))
         sv, dv = _unstack(next(it))
         new_src.append({"k": sk, "v": sv})
         new_dst.append({"k": dk, "v": dv})
-    return new_src, new_dst
+    if not integrity:
+        return new_src, new_dst
+    landed, ok = outs[-2], outs[-1]
+    return new_src, new_dst, bool(np.asarray(ok)), np.asarray(landed)

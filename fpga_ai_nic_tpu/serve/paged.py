@@ -58,14 +58,30 @@ class ServeConfig:
     step_timeout_s: Optional[float] = None
     max_retries: int = 4
     backoff_s: float = 0.01
-    # corrupted-tick guard: a decode/prefill tick whose logits are
-    # non-finite OR exceed this magnitude is GATED (IntegrityError ->
-    # replay-tier recovery) before any token reaches a stream — the
-    # serving analogue of the collective integrity checksums.  Healthy
-    # logits are O(10); a NaN'd or scale-corrupted KV pool lands far
-    # past this.  None disables the magnitude half (non-finite always
-    # trips).
+    # corrupted-tick guard, SECOND tier: a decode/prefill tick whose
+    # logits are non-finite OR exceed this magnitude is GATED
+    # (IntegrityError -> replay-tier recovery) before any token reaches
+    # a stream.  Healthy logits are O(10); a NaN'd or scale-corrupted KV
+    # pool lands far past this.  This tier is a magnitude guard ONLY —
+    # it is provably blind to finite wrong-value damage (a flipped
+    # mantissa bit in a KV page yields wrong-but-normal-magnitude
+    # logits).  That class is owned by the FIRST tier, the exact
+    # per-page checksum ledger below (``page_integrity``); the logit
+    # guard remains as the backstop for damage classes that bypass the
+    # pool (activation corruption, a poisoned weight replica).  None
+    # disables the magnitude half (non-finite always trips).
     logit_guard_abs: Optional[float] = 1e6
+    # corrupted-tick guard, FIRST tier: exact per-page checksums over
+    # the KV pool (ops.integrity.page_checksums).  Every tick's program
+    # verifies its INPUT pool bit-for-bit against the ledger the
+    # previous program's output recorded, and emits the new ledger —
+    # so any byte of any page changed OUTSIDE the ledger-maintaining
+    # programs (host corruption, a wrong-KEY write, a failed migration)
+    # trips BEFORE the tick emits a token, closing the finite
+    # wrong-value class the logit guard cannot see (the honest boundary
+    # docs/SERVING.md carried until PR 12).  The ledger is values-only:
+    # shapes/trace counts are unchanged (J10 holds either way).
+    page_integrity: bool = True
 
     def __post_init__(self) -> None:
         if self.max_reqs < 1 or self.page_size < 1:

@@ -186,11 +186,19 @@ class CollectiveConfig:
     # recompiles); otherwise the same update formula
     # (optim.fused_apply_flat, bit-specified by the numpy golden twins in
     # optim.py) runs fused into the step right after the reduce.
-    # Incompatible with integrity_check (the gate needs the pre-step
-    # state, which the fused path donates) — and the trainers reject
-    # clip_norm (a global-norm clip needs a barrier between the reduce
-    # and the update, which is exactly the exposed optimizer time this
-    # mode removes).  See docs/FUSED_OPTIMIZER.md.
+    # Combines with integrity_check since PR 12: the EXACT wire-checksum
+    # tier (ops.integrity) verifies the encoded ring frames with no
+    # tolerance band, so the fused path carries integrity coverage too —
+    # on the shared-formula routes (hier / off-TPU / n==1) a tripped
+    # verdict gates the update in-graph (pre-step state preserved); on
+    # the in-kernel TPU route the kernel accumulates the frame checksums
+    # itself and a tripped conservation verdict invalidates the step
+    # (check_step_diag raises WireIntegrityError -> the elastic ladder
+    # restores/reshards; the donated in-kernel state is discarded with
+    # the step).  The trainers still reject clip_norm (a global-norm
+    # clip needs a barrier between the reduce and the update, which is
+    # exactly the exposed optimizer time this mode removes).  See
+    # docs/FUSED_OPTIMIZER.md.
     fused_optimizer: bool = False
     slice_elems: int = 8192       # 32 KiB of f32, matching BUF_SIZE=512 CLs
     # unroll the n-1 ring-hop loop at trace time: marginally better codegen
@@ -203,15 +211,27 @@ class CollectiveConfig:
     # call per bwd layer, sw/mlp_mpi_example_f32.cpp:753); 4M f32 = 16 MiB
     # amortizes per-collective latency while keeping backward overlap.
     bucket_elems: int = 4 * 1024 * 1024
-    # collective integrity guard (runtime.chaos): per-chunk checksums
-    # across the gradient reduce-scatter plus a NaN/inf count, computed
-    # inside the jitted step; a tripped guard GATES the optimizer update
-    # (weights/optimizer state keep their pre-step values) and surfaces
-    # the verdict in the step's metrics dict for the elastic loop to act
-    # on.  Catches the silent-corruption surface a compressed wire adds
-    # (BFP codec faults, flipped exponent bits) before they poison the
-    # master weights.  integrity_tol=None derives the tolerance from the
-    # wire format (chaos.integrity_tol): reassociation-only for f32,
+    # collective integrity guard, two tiers computed inside the jitted
+    # step:
+    #   value tier (runtime.chaos): per-chunk checksums across the
+    #     gradient reduce-scatter plus a NaN/inf count against a
+    #     codec-derived tolerance band — the gross-corruption tripwire
+    #     (NaN, flipped exponent bits, runaway scale).
+    #   exact tier (ops.integrity, PR 12): bit-exact checksums over the
+    #     ENCODED frames of every ring hop (flat and hier), verified by
+    #     conservation — no tolerance band, so the FINITE wrong-value
+    #     class (a flipped mantissa bit that decodes to a plausible
+    #     number) trips too.  ``wire_ok`` lands in the step diag; the
+    #     exact tier only exists on impl='ring' (XLA collectives own
+    #     their own wire).
+    # A tripped verdict GATES the optimizer update in-graph where the
+    # pre-step state is still materialized (all unfused routes + the
+    # shared-formula fused_optimizer routes) and surfaces the verdict in
+    # the step's metrics dict for the elastic loop to act on; the
+    # in-kernel fused TPU route surfaces the verdict only (its state is
+    # donated — recovery is the elastic restore/reshard ladder).
+    # integrity_tol=None derives the value-tier tolerance from the wire
+    # format (chaos.integrity_tol): reassociation-only for f32,
     # quantization-bounded for BFP.
     integrity_check: bool = False
     integrity_tol: Optional[float] = None
@@ -257,13 +277,6 @@ class CollectiveConfig:
                 raise ValueError(
                     "codec='auto' conflicts with compression= (a "
                     "BFPConfig parameterizes the 'bfp' codec only)")
-        if self.fused_optimizer and self.integrity_check:
-            raise ValueError(
-                "fused_optimizer is incompatible with integrity_check: the "
-                "in-kernel update donates the pre-step master/optimizer "
-                "state, so there is nothing left to gate a tripped "
-                "checksum back to — run the integrity guard on the "
-                "unfused path")
         if self.codec is not None:
             if not isinstance(self.codec_opts, tuple):
                 raise ValueError("codec_opts must be a tuple of (key, "

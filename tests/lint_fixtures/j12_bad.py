@@ -1,0 +1,44 @@
+"""J12 bad fixture: an "integrity" transfer lowering that ships its
+checksum ON the wire next to the payload and never emits a verdict —
+the two anti-patterns the rule freezes out.  A checksum that rides the
+wire changes the exact ppermute byte accounting J4/J8/J9/J11 bank (and
+can itself be corrupted in flight); a checksum nobody compares guards
+nothing.  check_integrity_program must report BOTH the on/off byte
+mismatch and the missing boolean verdict output."""
+
+N = 8
+L = 1024
+
+
+def build():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    from fpga_ai_nic_tpu.ops import integrity as integrity_lib
+
+    mesh = Mesh(np.array(jax.devices()[:N]), ("dp",))
+    perm = [(i, (i + 1) % N) for i in range(N)]
+
+    def trace(integrity: bool):
+        def f(x):
+            if not integrity:
+                return lax.ppermute(x, "dp", perm)
+            chk = integrity_lib.word_checksum(x)
+            recv = lax.ppermute(x, "dp", perm)
+            # BAD: the checksum travels as ppermute PAYLOAD (extra wire
+            # bytes, itself corruptible in flight) ...
+            recv_chk = lax.ppermute(chk[None].astype(jnp.float32),
+                                    "dp", perm)
+            # ... and is consumed into the result instead of being
+            # COMPARED — no boolean verdict ever leaves the program
+            return recv + 0.0 * recv_chk
+
+        return jax.make_jaxpr(jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"),
+            check_vma=False)))(
+            jax.ShapeDtypeStruct((N * L,), jnp.float32))
+
+    return {"kind": "wire", "jx_on": trace(True), "jx_off": trace(False)}

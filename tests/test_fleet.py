@@ -302,16 +302,39 @@ class TestCorruptionGuard:
         return s, reqs, ref
 
     def test_nan_corruption_gated_and_recovered(self):
+        # with the exact per-page ledger on (the PR-12 default), ANY
+        # pool byte change — NaN included — is caught by the FIRST tier
+        # (wire-corruption), before the logit guard ever sees a logit
         plan = chaos.FaultPlan(
             [chaos.FaultSpec("corruption", "serve.step", step=3,
                              mode="nan", fraction=0.5)], seed=1)
         s, reqs, ref = self._run(plan)
         assert len(plan.fired) == 1
         assert s["serve_recoveries"] >= 1
-        assert s["recovery"]["faults"].get("corruption", 0) >= 1
+        assert s["recovery"]["faults"].get("wire-corruption", 0) >= 1
+        assert s["page_trips"] >= 1 and s["logit_trips"] == 0
         for q, want in zip(reqs, ref):
             assert q.generated == want         # no poisoned token leaked
         assert s["recompiles_steady"] == 0
+
+    def test_logit_guard_still_owns_the_tick_without_the_ledger(self):
+        # page_integrity off: the SECOND tier (logit guard) must still
+        # gate a NaN'd pool — the backstop is not vacuous
+        import dataclasses
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "serve.step", step=3,
+                             mode="nan", fraction=0.5)], seed=1)
+        params = llama.init(jax.random.PRNGKey(0), CFG)
+        rng = np.random.default_rng(4)
+        prompts = [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
+                   for n in rng.integers(4, 10, 4)]
+        scfg = dataclasses.replace(self.SCFG, page_integrity=False)
+        eng = ServeEngine(params, CFG, scfg, chaos=plan)
+        reqs = [eng.submit(p, max_new=4) for p in prompts]
+        with chaos.activate(plan):
+            s = eng.run()
+        assert s["recovery"]["faults"].get("corruption", 0) >= 1
+        assert s["logit_trips"] >= 1 and s["page_trips"] == 0
 
     def test_magnitude_guard_trips_on_garbage_logits(self):
         """The magnitude half of the guard, exercised directly: logits

@@ -1,0 +1,661 @@
+"""Exact wire-integrity plane (ops.integrity, PR 12) — the spec layer.
+
+The contract under test (docs/CHAOS.md "Exact wire integrity"):
+
+- the numpy golden twins (compress.golden.golden_*_checksum) equal the
+  jax checksums BIT FOR BIT per wire dtype, and a single flipped bit in
+  any word always changes the sum (odd weights are invertible mod 2^32);
+- NO FALSE TRIPS: clean runs across codec x topology x slicing x depth
+  (flat/hier rings, the fused Pallas kernels, the reshard transfer, the
+  KV handoff, the serve decode tick) return ``wire_ok=True`` with
+  results BIT-IDENTICAL to the same program with integrity off — the
+  checksum is computed on the encoded frames both sides agree on, so
+  quantization noise cannot trip it;
+- a FINITE low-bit wire corruption ("wirebit": plausible, in-band,
+  invisible to every value-space guard by construction) TRIPS the
+  checksum at every wire: ring hops, reshard segments, handoff page
+  blocks, and the serve pool's per-page ledger — the blind spot the
+  honest boundary in docs/SERVING.md documented until PR 12;
+- enabling integrity adds no trace and no recompile on hyperparam
+  change (the J10 counted-trace discipline applied to the wire plane).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fpga_ai_nic_tpu import compress
+from fpga_ai_nic_tpu.compress import golden
+from fpga_ai_nic_tpu.models import mlp
+from fpga_ai_nic_tpu.ops import fused_update
+from fpga_ai_nic_tpu.ops import integrity
+from fpga_ai_nic_tpu.ops import ring as ring_ops
+from fpga_ai_nic_tpu.ops import ring_hier
+from fpga_ai_nic_tpu.ops import ring_pallas as rp
+from fpga_ai_nic_tpu.parallel import DPTrainer, make_mesh
+from fpga_ai_nic_tpu.parallel import reshard as rs
+from fpga_ai_nic_tpu.runtime import chaos
+from fpga_ai_nic_tpu.utils.config import (BFPConfig, CollectiveConfig,
+                                          MeshConfig, MLPConfig,
+                                          OptimizerConfig, TrainConfig)
+
+N = 8
+MCFG = MLPConfig(layer_sizes=(32, 64, 10), dtype="float32")
+
+
+def _mesh(n=N):
+    return Mesh(np.array(jax.devices()[:n]), ("dp",))
+
+
+def _loss(params, batch):
+    return mlp.loss_fn(params, batch, MCFG)
+
+
+def _data(n=64, seed=0):
+    r = np.random.default_rng(seed)
+    x = r.standard_normal((n, 32)).astype(np.float32)
+    y = r.integers(0, 10, n).astype(np.int32)
+    return jnp.asarray(x), jnp.asarray(y)
+
+
+@pytest.fixture
+def wire_tap():
+    """The encoded-payload wire tap, installed for the duration of a
+    trip test and ALWAYS removed after: a leaked tap would thread host
+    callbacks into every later-traced transfer program in the
+    process."""
+    chaos.install_wire_tap()
+    try:
+        yield
+    finally:
+        chaos.uninstall_wire_tap()
+
+
+# ---------------------------------------------------------------------------
+# golden twins: the numpy spec == the jax implementation, bit for bit
+# ---------------------------------------------------------------------------
+
+class TestGoldenTwins:
+
+    @pytest.mark.parametrize("dtype", [np.float32, np.int32, np.int16,
+                                       np.int8, np.uint8])
+    def test_word_checksum_matches_golden(self, rng, dtype):
+        if np.issubdtype(dtype, np.floating):
+            arr = (rng.standard_normal(777) * 5).astype(dtype)
+        else:
+            info = np.iinfo(dtype)
+            arr = rng.integers(info.min, int(info.max) + 1, 777,
+                               dtype=np.int64).astype(dtype)
+        got = jax.jit(integrity.word_checksum)(jnp.asarray(arr))
+        assert np.uint32(np.asarray(got)) == golden.golden_word_checksum(arr)
+
+    def test_rejects_8_byte_payloads(self):
+        # the jax side can't even construct an 8-byte aval with x64
+        # disabled (the suite's config) — the numpy twin carries the
+        # rejection contract
+        with pytest.raises(TypeError, match="itemsize 8"):
+            golden.golden_words_u32(np.zeros((4,), np.float64))
+
+    @pytest.mark.parametrize("name,opts", [
+        ("bfp", ()),
+        ("topk", (("bucket_elems", 512), ("k", 64))),
+        ("int8", ()),
+    ])
+    def test_payload_checksum_matches_golden(self, rng, name, opts):
+        codec = compress.get_codec(name, dict(opts))
+        L = codec.pad_elems * 4
+        x = jnp.asarray(rng.standard_normal(L), jnp.float32)
+        pay = codec.encode(x)
+        got = jax.jit(integrity.payload_checksum)(tuple(pay))
+        want = golden.golden_payload_checksum(
+            [np.asarray(p) for p in pay])
+        assert np.uint32(np.asarray(got)) == want
+        # element order matters: a mantissa<->scale swap must not alias
+        if len(pay) > 1:
+            swapped = jax.jit(integrity.payload_checksum)(
+                tuple(reversed(tuple(pay))))
+            assert np.uint32(np.asarray(swapped)) != want
+
+    def test_page_checksums_match_golden(self, rng):
+        pool = [{k: jnp.asarray(rng.standard_normal((6, 2, 4, 8)),
+                                jnp.float32) for k in ("k", "v")}
+                for _ in range(2)]
+        got = np.asarray(jax.jit(integrity.page_checksums)(pool))
+        host = [{k: np.asarray(l[k]) for k in l} for l in pool]
+        np.testing.assert_array_equal(got,
+                                      golden.golden_page_checksums(host))
+
+    def test_zero_pool_ledger_is_zeros(self):
+        pool = [{k: jnp.zeros((5, 2, 4, 8), jnp.float32)
+                 for k in ("k", "v")} for _ in range(3)]
+        got = np.asarray(jax.jit(integrity.page_checksums)(pool))
+        np.testing.assert_array_equal(got, np.zeros(5, np.uint32))
+
+    def test_gathered_page_checksums_match_pool_ledger(self, rng):
+        """The handoff program's gathered-block checksum recomputes the
+        SAME per-page value the pool ledger recorded — the identity the
+        write-time -> land-time verification rests on."""
+        pool = [{k: jnp.asarray(rng.standard_normal((6, 2, 4, 8)),
+                                jnp.float32) for k in ("k", "v")}
+                for _ in range(2)]
+        ledger = np.asarray(jax.jit(integrity.page_checksums)(pool))
+        pages = jnp.asarray([4, 1, 5], jnp.int32)
+        blocks = [jnp.take(l[k], pages, axis=0)
+                  for l in pool for k in ("k", "v")]
+        got = np.asarray(jax.jit(integrity.gathered_page_checksums)(
+            blocks))
+        np.testing.assert_array_equal(got, ledger[[4, 1, 5]])
+
+    def test_single_bit_flip_always_changes_the_checksum(self, rng):
+        """Odd weights are invertible mod 2^32: no single corrupted word
+        can ever vanish from the sum, at any position, at any bit."""
+        arr = rng.standard_normal(257).astype(np.float32)
+        base = golden.golden_word_checksum(arr)
+        for i in rng.choice(257, 40, replace=False):
+            for bit in (0, 1, 11, 23, 31):
+                mut = arr.copy()
+                mut.view(np.uint32)[i] ^= np.uint32(1 << bit)
+                assert golden.golden_word_checksum(mut) != base, (i, bit)
+
+
+# ---------------------------------------------------------------------------
+# no false trips + bit-identity: flat / hier rings, every codec
+# ---------------------------------------------------------------------------
+
+RING_CELLS = [
+    # (codec, opts, which, topology, n_intra, sliced)
+    (None, (), "reduce_scatter", "flat", 1, False),
+    (None, (), "all_gather", "flat", 1, False),
+    ("bfp", (), "reduce_scatter", "flat", 1, True),
+    ("bfp", (), "all_reduce", "flat", 1, False),
+    ("topk", (("bucket_elems", 512), ("k", 64)), "reduce_scatter",
+     "flat", 1, False),
+    ("int8", (), "all_gather", "flat", 1, False),
+    ("bfp", (), "all_reduce", "hier", 2, False),
+    ("int8", (), "reduce_scatter", "hier", 4, True),
+    (None, (), "all_gather", "hier", 2, False),
+]
+
+
+def _ring_fns(codec, which, topology, ni, slice_elems):
+    def run(x, integ):
+        kw = dict(compression=codec, integrity=integ)
+        if topology == "hier":
+            if which == "reduce_scatter":
+                return ring_hier.hier_reduce_scatter(
+                    x, "dp", ni, slice_elems=slice_elems, **kw)
+            if which == "all_gather":
+                return ring_hier.hier_all_gather(x, "dp", ni, **kw)
+            return ring_hier.hier_all_reduce(
+                x, "dp", ni, slice_elems=slice_elems, **kw)
+        if which == "reduce_scatter":
+            return ring_ops.ring_reduce_scatter(
+                x, "dp", slice_elems=slice_elems, **kw)
+        if which == "all_gather":
+            return ring_ops.ring_all_gather(x, "dp", **kw)
+        return ring_ops.ring_all_reduce(x, "dp", slice_elems=slice_elems,
+                                        **kw)
+    return run
+
+
+@pytest.mark.parametrize("name,opts,which,topology,ni,sliced", RING_CELLS)
+def test_ring_integrity_no_false_trips_and_bit_identical(
+        rng, name, opts, which, topology, ni, sliced):
+    """THE no-false-trips property: a clean run with integrity on is
+    bit-identical to integrity off AND reports wire_ok=True — for every
+    codec, both topologies, sliced and whole-chunk hops.  The checksum
+    reads the encoded frames both sides agree on, so codec quantization
+    can never trip it."""
+    codec = compress.get_codec(name, dict(opts)) if name else None
+    # sizing: shard_map splits the GLOBAL vector over N devices, and the
+    # per-device flat vector must then chunk into n codec-padded hop
+    # payloads — so the global length needs the N^2 * pad unit
+    unit = N * N * (codec.pad_elems if codec else 1)
+    L = unit * max(1, 32768 // unit)
+    loc = L // N                      # per-device flat vector
+    chunk = loc // N                  # per-hop payload
+    slice_elems = chunk // 2 if sliced else None
+    x = jnp.asarray(rng.standard_normal(L), jnp.float32)
+    run = _ring_fns(codec, which, topology, ni, slice_elems)
+
+    def shard(fn, out_specs):
+        return jax.jit(jax.shard_map(fn, mesh=_mesh(),
+                                     in_specs=P("dp"),
+                                     out_specs=out_specs,
+                                     check_vma=False))
+
+    xin = (jnp.tile(x[:loc], N) if which == "all_gather" else x)
+    got_on, ok = shard(lambda v: run(v, True), (P("dp"), P()))(xin)
+    got_off = shard(lambda v: run(v, False), P("dp"))(xin)
+    assert bool(np.asarray(ok)), "clean run tripped the exact tier"
+    np.testing.assert_array_equal(np.asarray(got_on), np.asarray(got_off))
+
+
+# ---------------------------------------------------------------------------
+# the fused Pallas kernels: in-kernel accumulation, every depth
+# ---------------------------------------------------------------------------
+
+CFGP = BFPConfig(codec="pallas")
+SLICE = CFGP.block_size * rp.LANES
+
+
+class TestFusedKernels:
+
+    @pytest.mark.parametrize("depth", [1, 2, 4])
+    @pytest.mark.parametrize("streaming", [False, True])
+    def test_fused_rs_integrity_bit_identical_every_depth(
+            self, rng, depth, streaming):
+        """THE acceptance criterion: the fused ring kernel with
+        integrity on stays bit-identical to integrity off on the
+        gradient path at every pipeline depth (the checksums only READ
+        the frames), and the clean-run verdict is True."""
+        n, C = 4, SLICE * 2
+        x = jnp.asarray(rng.standard_normal(n * n * C), jnp.float32)
+
+        def shard(integ):
+            def f(v):
+                return rp.ring_reduce_scatter_fused(
+                    v, "dp", compression=CFGP, slice_elems=SLICE,
+                    streaming=streaming, pipeline_depth=depth,
+                    integrity=integ)
+            out_specs = (P("dp"), P()) if integ else P("dp")
+            return jax.jit(jax.shard_map(f, mesh=_mesh(n),
+                                         in_specs=P("dp"),
+                                         out_specs=out_specs,
+                                         check_vma=False))
+
+        got_on, ok = shard(True)(x)
+        got_off = shard(False)(x)
+        assert bool(np.asarray(ok))
+        np.testing.assert_array_equal(np.asarray(got_on),
+                                      np.asarray(got_off),
+                                      err_msg=f"depth={depth} "
+                                              f"streaming={streaming}")
+
+    @pytest.mark.parametrize("kind", ["momentum", "adamw"])
+    @pytest.mark.parametrize("streaming", [False, True])
+    def test_fused_update_integrity_bit_identical(self, rng, kind,
+                                                  streaming):
+        """The in-kernel optimizer route (the one the old construction
+        error forbade): integrity on == integrity off bit-for-bit on
+        gradients, weights AND moments, verdict True on a clean run."""
+        from fpga_ai_nic_tpu import optim
+        from fpga_ai_nic_tpu.utils.config import OptimizerSpec
+        n, R = 4, 16
+        C = 2 * R * rp.LANES
+        spec = OptimizerSpec(kind=kind)
+        x = (rng.standard_normal((n, n * C))).astype(np.float32)
+        w = (rng.standard_normal((n, C)) * 0.1).astype(np.float32)
+        st = {k: np.zeros((n, C), np.float32) for k in spec.state_keys}
+        hyper = optim.fused_hyperparams(
+            OptimizerConfig(kind=kind, learning_rate=1e-2),
+            jnp.asarray(0, jnp.int32))
+
+        def shard(integ):
+            def f(hy, xv, wv, *sts):
+                return rp.ring_reduce_scatter_update_fused(
+                    xv, wv, dict(zip(spec.state_keys, sts)), hy, "dp",
+                    opt_kind=kind, compression=CFGP,
+                    slice_elems=R * rp.LANES, interpret=True,
+                    streaming=streaming, pipeline_depth=2,
+                    integrity=integ)
+            ns = len(spec.state_keys)
+            out = (P("dp"), P("dp"), {k: P("dp") for k in spec.state_keys})
+            out_specs = out + ((P(),) if integ else ())
+            return jax.jit(jax.shard_map(
+                f, mesh=_mesh(n), in_specs=(P(),) + (P("dp"),) * (2 + ns),
+                out_specs=out_specs, check_vma=False))
+
+        args = ((hyper, jnp.asarray(x.reshape(-1)),
+                 jnp.asarray(w.reshape(-1)))
+                + tuple(jnp.asarray(st[k].reshape(-1))
+                        for k in spec.state_keys))
+        g_on, w_on, st_on, ok = shard(True)(*args)
+        g_off, w_off, st_off = shard(False)(*args)
+        assert bool(np.asarray(ok))
+        np.testing.assert_array_equal(np.asarray(g_on), np.asarray(g_off))
+        np.testing.assert_array_equal(np.asarray(w_on), np.asarray(w_off))
+        for k in spec.state_keys:
+            np.testing.assert_array_equal(np.asarray(st_on[k]),
+                                          np.asarray(st_off[k]))
+
+    def test_fused_update_integrity_hyper_change_no_retrace(
+            self, rng, monkeypatch):
+        """The satellite's counted-trace clause at the kernel level: the
+        integrity-carrying fused-opt kernel traces at most once across
+        an lr/step change (hyper rides the SMEM vector either way)."""
+        from fpga_ai_nic_tpu import optim
+        traces = []
+        orig = rp._rs_kernel
+
+        def counting(*a, **k):
+            traces.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(rp, "_rs_kernel", counting)
+        n, R = 4, 16
+        C = 2 * R * rp.LANES
+        x = (rng.standard_normal((n, n * C))).astype(np.float32)
+        w = (rng.standard_normal((n, C)) * 0.1).astype(np.float32)
+
+        def f(hy, xv, wv, mv):
+            g, w2, st2, ok = rp.ring_reduce_scatter_update_fused(
+                xv, wv, {"m": mv}, hy, "dp", opt_kind="momentum",
+                compression=CFGP, slice_elems=R * rp.LANES,
+                interpret=True, streaming=False, pipeline_depth=2,
+                integrity=True)
+            return w2, ok
+
+        step_fn = jax.jit(jax.shard_map(
+            f, mesh=_mesh(n), in_specs=(P(),) + (P("dp"),) * 3,
+            out_specs=(P("dp"), P()), check_vma=False))
+        counts, outs = [], []
+        for lr, step in ((1e-3, 0), (5e-2, 7)):
+            hyper = optim.fused_hyperparams(
+                OptimizerConfig(kind="momentum", learning_rate=lr),
+                jnp.asarray(step, jnp.int32))
+            w2, ok = step_fn(hyper, jnp.asarray(x.reshape(-1)),
+                             jnp.asarray(w.reshape(-1)),
+                             jnp.zeros((n * C,), jnp.float32))
+            assert bool(np.asarray(ok))
+            outs.append(np.asarray(w2))
+            counts.append(sum(traces))
+        assert counts[0] <= 1, counts
+        assert counts[1] == counts[0], \
+            "hyper change retraced the integrity-carrying fused kernel"
+        assert not np.array_equal(outs[0], outs[1])
+
+
+# ---------------------------------------------------------------------------
+# trainer integration: clean bit-identity + counted traces
+# ---------------------------------------------------------------------------
+
+def _dp_trainer(fused: bool, integ: bool, codec="bfp", n=N):
+    cfg = TrainConfig(
+        iters=4, global_batch=64, mesh=MeshConfig(dp=n),
+        collective=CollectiveConfig(impl="ring", codec=codec,
+                                    fused_optimizer=fused,
+                                    integrity_check=integ),
+        optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
+    return DPTrainer(_loss, make_mesh(cfg.mesh), cfg)
+
+
+def _params():
+    return mlp.init(jax.random.PRNGKey(0), MCFG)
+
+
+class TestTrainerIntegration:
+
+    @pytest.mark.parametrize("fused", [False, True])
+    def test_integrity_on_is_bit_identical_on_clean_steps(self, fused):
+        """Enabling the exact tier changes nothing on a clean run.  The
+        FUSED route — the lifted incompatibility — is BITWISE identical
+        (the in-kernel checksums only read the frames; no graph around
+        the update changes).  The unfused route inherits the value
+        band's pre-existing graph effect (chunk_checksums adds a
+        consumer of flat_g, which lets XLA re-fuse the gradient math a
+        few ulp differently — present since PR 1, not a wire effect:
+        the route-level cells above pin the collectives themselves
+        bitwise), so it gates at tight float equality."""
+        tr_on = _dp_trainer(fused, True)
+        tr_off = _dp_trainer(fused, False)
+        batch_on = tr_on.shard_batch(_data())
+        batch_off = tr_off.shard_batch(_data())
+        s_on, s_off = tr_on.init_state(_params()), \
+            tr_off.init_state(_params())
+        for step in range(2):
+            s_on, m = tr_on.step(s_on, batch_on)
+            s_off, _ = tr_off.step(s_off, batch_off)
+            assert bool(np.asarray(m["wire_ok"]))
+            chaos.check_step_diag(
+                {k: np.asarray(v) for k, v in m.items()
+                 if k != "loss"}, step)           # must not raise
+
+        def same(a, b):
+            a, b = np.asarray(a), np.asarray(b)
+            if fused:
+                np.testing.assert_array_equal(a, b)
+            else:
+                np.testing.assert_allclose(a, b, rtol=0, atol=1e-6)
+
+        same(s_on.w_own, s_off.w_own)
+        for k in s_off.opt_state:
+            same(s_on.opt_state[k], s_off.opt_state[k])
+        for a, b in zip(jax.tree_util.tree_leaves(s_on.params),
+                        jax.tree_util.tree_leaves(s_off.params)):
+            same(a, b)
+
+    def test_integrity_adds_no_trace_across_steps(self, monkeypatch):
+        """The satellite's counted-trace clause at the trainer level:
+        the fused+integrity step traces its collective exactly once for
+        any number of steps (step number and hyper scalars ride traced
+        values — no recompile per step)."""
+        traces = []
+        orig = fused_update.reduce_scatter_update
+
+        def counting(*a, **k):
+            traces.append(1)
+            return orig(*a, **k)
+
+        monkeypatch.setattr(fused_update, "reduce_scatter_update",
+                            counting)
+        tr = _dp_trainer(True, True)
+        batch = tr.shard_batch(_data())
+        state = tr.init_state(_params())
+        for _ in range(3):
+            state, m = tr.step(state, batch)
+            assert bool(np.asarray(m["wire_ok"]))
+        assert sum(traces) == 1, \
+            f"integrity-on fused step traced {sum(traces)}x over 3 steps"
+
+    def test_fused_plus_integrity_constructs(self):
+        cfg = CollectiveConfig(impl="ring", codec="bfp",
+                               fused_optimizer=True, integrity_check=True)
+        assert cfg.fused_optimizer and cfg.integrity_check
+
+
+# ---------------------------------------------------------------------------
+# trips: the finite "wirebit" class at every wire
+# ---------------------------------------------------------------------------
+
+class TestWirebitTrips:
+
+    @pytest.mark.parametrize("name,opts", [
+        (None, ()), ("bfp", ()), ("int8", ()),
+    ])
+    def test_wirebit_trips_the_ring_checksum(self, wire_tap, rng, name,
+                                             opts):
+        """A single low bit flipped in one ENCODED frame — finite,
+        in-band, invisible to any magnitude guard — must fail the
+        conservation verdict, for raw f32 words and int8 codec frames
+        alike.  The decoded result stays FINITE: that is the whole
+        point of the blind spot."""
+        codec = compress.get_codec(name, dict(opts)) if name else None
+        unit = N * N * (codec.pad_elems if codec else 1)
+        L = unit * max(1, 32768 // unit)
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "collective", step=0,
+                             mode="wirebit", fraction=0.01)], seed=3)
+        x = jnp.asarray(rng.standard_normal(L), jnp.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda v: ring_ops.ring_all_reduce(v, "dp",
+                                               compression=codec,
+                                               integrity=True),
+            mesh=_mesh(), in_specs=P("dp"), out_specs=(P("dp"), P()),
+            check_vma=False))
+        with chaos.activate(plan):
+            plan.begin_step(0)
+            out, ok = fn(x)
+            out, ok = np.asarray(out), bool(np.asarray(ok))
+        assert len(plan.fired) == 1
+        assert not ok, "the exact tier missed a flipped wire bit"
+        assert np.isfinite(out).all(), \
+            "wirebit must be the FINITE class (else the value band " \
+            "would have caught it and the cell proves nothing)"
+
+    def test_clean_run_with_tap_installed_does_not_trip(self, wire_tap,
+                                                        rng):
+        """The tap alone (no pending spec) is an identity copy: no
+        false trips from the instrumentation itself."""
+        L = N * 512
+        x = jnp.asarray(rng.standard_normal(L), jnp.float32)
+        fn = jax.jit(jax.shard_map(
+            lambda v: ring_ops.ring_all_reduce(v, "dp", integrity=True),
+            mesh=_mesh(), in_specs=P("dp"), out_specs=(P("dp"), P()),
+            check_vma=False))
+        _, ok = fn(x)
+        assert bool(np.asarray(ok))
+
+    def test_wirebit_trips_the_reshard_transfer(self, wire_tap):
+        """A flipped bit on a reshard segment's wire raises
+        WireIntegrityError BEFORE the landed state reaches the target
+        trainer — the elastic ladder then falls through to restore
+        instead of training on silently corrupted masters."""
+        rs._cached_apply.cache_clear()
+        cfg8 = TrainConfig(
+            iters=4, global_batch=64, mesh=MeshConfig(dp=8),
+            collective=CollectiveConfig(impl="ring"),
+            optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
+        tr8 = DPTrainer(_loss, make_mesh(cfg8.mesh), cfg8)
+        cfg4 = TrainConfig(
+            iters=4, global_batch=64, mesh=MeshConfig(dp=4),
+            collective=CollectiveConfig(impl="ring"),
+            optimizer=OptimizerConfig(kind="adamw", learning_rate=3e-3))
+        tr4 = DPTrainer(_loss, make_mesh(cfg4.mesh), cfg4)
+        state = tr8.init_state(_params())
+        state, _ = tr8.step(state, tr8.shard_batch(_data()))
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "reshard.transfer", step=0,
+                             mode="wirebit", fraction=0.05)], seed=5)
+        with chaos.activate(plan):
+            plan.begin_step(0)
+            with pytest.raises(chaos.WireIntegrityError,
+                               match="reshard transfer"):
+                rs.reshard_state(tr8, tr4, state, integrity=True)
+        assert len(plan.fired) == 1
+        rs._cached_apply.cache_clear()
+
+    def test_reshard_integrity_clean_is_bit_identical(self):
+        """Clean reshard with the verdict on lands bitwise the state of
+        the unchecked transfer (and does not raise)."""
+        rs._cached_apply.cache_clear()
+        cfgs = {}
+        for n in (8, 4):
+            cfgs[n] = TrainConfig(
+                iters=4, global_batch=64, mesh=MeshConfig(dp=n),
+                collective=CollectiveConfig(impl="ring", codec="topk",
+                                            codec_opts=(("bucket_elems",
+                                                         512),
+                                                        ("k", 64))),
+                optimizer=OptimizerConfig(kind="adamw",
+                                          learning_rate=3e-3))
+        tr8 = DPTrainer(_loss, make_mesh(cfgs[8].mesh), cfgs[8])
+        tr4 = DPTrainer(_loss, make_mesh(cfgs[4].mesh), cfgs[4])
+        state = tr8.init_state(_params())
+        state, _ = tr8.step(state, tr8.shard_batch(_data()))
+        host = jax.device_get(state)
+        state2 = jax.tree_util.tree_map(jnp.asarray, host)
+        got_i = rs.reshard_state(tr8, tr4, state, integrity=True)
+        got_p = rs.reshard_state(tr8, tr4, state2, integrity=False)
+        np.testing.assert_array_equal(np.asarray(got_i.w_own),
+                                      np.asarray(got_p.w_own))
+        for k in got_p.opt_state:
+            np.testing.assert_array_equal(np.asarray(got_i.opt_state[k]),
+                                          np.asarray(got_p.opt_state[k]))
+        if got_p.codec_state is not None:
+            np.testing.assert_array_equal(np.asarray(got_i.codec_state),
+                                          np.asarray(got_p.codec_state))
+
+
+# ---------------------------------------------------------------------------
+# the serving plane: per-page ledger + handoff write-to-land coverage
+# ---------------------------------------------------------------------------
+
+class TestServeLedger:
+
+    def _world(self, seed=2, n_prompts=4, max_new=4):
+        from fpga_ai_nic_tpu.models import llama
+        cfg = llama.LlamaConfig.tiny()
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        r = np.random.default_rng(seed)
+        prompts = [r.integers(0, cfg.vocab, int(n)).astype(np.int32)
+                   for n in r.integers(4, 10, n_prompts)]
+        return cfg, params, prompts, max_new
+
+    def test_wirebit_at_serve_step_trips_the_ledger_not_the_logit_guard(
+            self):
+        """THE honest-boundary closure: a FINITE wrong-value pool
+        corruption (low mantissa bit — wrong-but-normal-magnitude
+        logits, provably invisible to the logit guard) is caught by the
+        exact per-page ledger BEFORE any token is emitted, recovery
+        replays, and the surviving streams are byte-identical to the
+        fault-free run."""
+        from fpga_ai_nic_tpu.serve import ServeConfig, ServeEngine
+        cfg, params, prompts, max_new = self._world()
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=14,
+                           max_pages_per_seq=5, prefill_chunk=6,
+                           backoff_s=0.01)
+        ref_eng = ServeEngine(params, cfg, scfg)
+        ref = [ref_eng.submit(p, max_new=max_new) for p in prompts]
+        ref_eng.run()
+        want = [list(r.generated) for r in ref]
+
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "serve.step", step=3,
+                             mode="wirebit", fraction=0.25)], seed=9)
+        eng = ServeEngine(params, cfg, scfg, chaos=plan)
+        reqs = [eng.submit(p, max_new=max_new) for p in prompts]
+        with chaos.activate(plan):
+            s = eng.run()
+        assert len(plan.fired) == 1
+        assert s["page_trips"] >= 1, "the exact tier never fired"
+        assert s["logit_trips"] == 0, \
+            "the logit guard caught it — then the corruption was not " \
+            "in the finite blind-spot class and the cell proves nothing"
+        assert s["recovery"]["faults"].get("wire-corruption", 0) >= 1
+        for q, w in zip(reqs, want):
+            assert list(q.generated) == w, "a poisoned token leaked"
+        assert s["recompiles_steady"] == 0
+
+    def test_fleet_handoff_wirebit_bounded_retry_zero_replay(
+            self, wire_tap):
+        """A flipped bit on the KV handoff wire trips the landed-page
+        checksum; ONE bounded retry re-sends the (intact) source pages
+        and the migration completes — zero replay-from-prompt, token
+        streams byte-identical to the isolated reference."""
+        from fpga_ai_nic_tpu.models import llama_decode as dec
+        from fpga_ai_nic_tpu.serve import (FleetConfig, ServeConfig,
+                                           ServeFleet)
+        from fpga_ai_nic_tpu.serve import handoff as handoff_lib
+        handoff_lib._cached_apply.cache_clear()
+        cfg, params, prompts, max_new = self._world(seed=7, max_new=5)
+        ref = []
+        for p in prompts:
+            full = np.asarray(dec.generate(
+                params, jnp.asarray(p)[None], max_new, cfg))[0]
+            ref.append(full[len(p):].tolist())
+        scfg = ServeConfig(max_reqs=4, page_size=4, n_pages=40,
+                           max_pages_per_seq=6, prefill_chunk=6)
+        # the handoff tick is scheduler-dependent: arm one wirebit spec
+        # per step so whichever tick carries the migration trips (each
+        # spec fires at most once, so the in-step retry runs clean)
+        plan = chaos.FaultPlan(
+            [chaos.FaultSpec("corruption", "serve.handoff", step=s,
+                             mode="wirebit", fraction=0.2)
+             for s in range(20)], seed=11)
+        fleet = ServeFleet(params, cfg, scfg,
+                           FleetConfig(n_prefill=1, n_decode=2),
+                           chaos=plan)
+        reqs = [fleet.submit(p, max_new=max_new) for p in prompts]
+        with chaos.activate(plan):
+            s = fleet.run()
+        assert s["handoff_integrity_trips"] >= 1, \
+            "no handoff wire trip — the cell proved nothing"
+        assert s["fleet_replays"] == 0 and s["serve_recoveries"] == 0, \
+            "a bounded retry should have absorbed the transient trip"
+        for q, w in zip(reqs, ref):
+            assert list(q.generated) == w
+        handoff_lib._cached_apply.cache_clear()

@@ -405,14 +405,15 @@ class TestJ7GradScale:
         # one subprocess pays for the full sweep, so ALL value-level
         # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
         # accounting), J9 (hierarchical hop accounting), J10 (serve
-        # recompile-freedom) and J11 (KV-handoff wire accounting) must
-        # each fire and fail the CLI
+        # recompile-freedom), J11 (KV-handoff wire accounting) and J12
+        # (wire-integrity coverage) must each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
                    GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
                    GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE,
                    GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE,
-                   GRAFTLINT_J11_FIXTURE=TestJ11Handoff.FIXTURE)
+                   GRAFTLINT_J11_FIXTURE=TestJ11Handoff.FIXTURE,
+                   GRAFTLINT_J12_FIXTURE=TestJ12Integrity.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -423,6 +424,7 @@ class TestJ7GradScale:
         assert "J9:" in proc.stdout
         assert "J10:" in proc.stdout
         assert "J11:" in proc.stdout
+        assert "J12:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -656,4 +658,95 @@ class TestJ11Handoff:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j11()
         assert len(fs) == 1 and fs[0].code == "J11"
+        assert "boom" in fs[0].message
+
+
+class TestJ12Integrity:
+    """J12: every ppermute-bearing transfer program must carry its exact
+    wire checksum (ops.integrity) when integrity is requested — present
+    (u32 arithmetic + boolean verdict), invisible (ppermute bytes
+    IDENTICAL to the integrity-off twin: no checksum rides the wire),
+    with the decode-tick ledger surface guarded by page checksums — or
+    carry an explicit J12_WAIVERS entry (docs/LINT.md)."""
+
+    FIXTURE = os.path.join(FIXTURES, "j12_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j12
+        findings = run_j12()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_zero_waivers_in_shipped_tree(self):
+        """The waiver table is the ONLY sanctioned skip, and the shipped
+        tree must not use it: every surface is actually guarded."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import J12_WAIVERS
+        assert J12_WAIVERS == {}
+
+    def test_bad_fixture_fires_on_wire_riding_checksum(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j12_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_integrity_program
+        fs = check_integrity_program("j12_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J12"}
+        # both anti-patterns must be named: the checksum on the wire
+        # (with the on/off byte numbers) and the missing verdict
+        assert any("rides the wire" in f.message and "4100" in f.message
+                   for f in fs), fs
+        assert any("verdict" in f.message for f in fs), fs
+
+    def test_unguarded_program_fires(self):
+        """integrity=True lowering with no checksum arithmetic at all —
+        the 'coverage theater' class — must be named."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax import lax
+        from jax.sharding import Mesh, PartitionSpec as P
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_integrity_program
+
+        mesh = Mesh(np.array(jax.devices()[:8]), ("dp",))
+        perm = [(i, (i + 1) % 8) for i in range(8)]
+
+        def trace(integrity):
+            def f(x):
+                out = lax.ppermute(x, "dp", perm)
+                if integrity:
+                    return out, jnp.bool_(True)    # vacuous verdict
+                return out
+            out_specs = (P("dp"), P()) if integrity else P("dp")
+            return jax.make_jaxpr(jax.jit(jax.shard_map(
+                f, mesh=mesh, in_specs=P("dp"), out_specs=out_specs,
+                check_vma=False)))(
+                jax.ShapeDtypeStruct((8 * 128,), jnp.float32))
+
+        fs = check_integrity_program("unguarded", lambda: {
+            "kind": "wire", "jx_on": trace(True), "jx_off": trace(False)})
+        assert any("NO uint32 checksum arithmetic" in f.message
+                   for f in fs), fs
+
+    def test_waived_surface_is_skipped_not_failed(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j12_surfaces",
+                            lambda: [("broken", boom)])
+        monkeypatch.setattr(jaxpr_sweep, "J12_WAIVERS",
+                            {"broken": "intentionally waived for test"})
+        assert jaxpr_sweep.run_j12() == []
+
+    def test_surface_failure_lands_as_j12_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j12_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j12()
+        assert len(fs) == 1 and fs[0].code == "J12"
         assert "boom" in fs[0].message

@@ -342,7 +342,12 @@ SERVE_FAULT_TICK = 3        # mid-run: prefill and decode both in flight
 # corruption at serve.step NaN-damages the tick's KV payload; a high
 # fraction guarantees visible positions are hit so the in-graph
 # NaN/garbage-logits guard MUST trip (serve.engine._logit_guard) —
-# recovery, never a poisoned stream
+# recovery, never a poisoned stream.  This cell runs with
+# page_integrity=False so it pins the VALUE tier in isolation: with the
+# exact per-page ledger on (the default), the checksum trips FIRST and
+# the fault lands as "wire-corruption" — that routing is exactly what
+# the wirebit battery below (run_integrity_cells) pins, so the two
+# cells together prove both tiers and their ordering.
 SERVE_CORRUPTION_FRACTION = 0.5
 
 
@@ -365,16 +370,17 @@ class ServeRig:
         ref_eng, ref_reqs, _ = self.serve(None, None)
         self.reference = [list(r.generated) for r in ref_reqs]
 
-    def scfg(self, timeout_s):
+    def scfg(self, timeout_s, page_integrity=True):
         from fpga_ai_nic_tpu.serve import ServeConfig
         return ServeConfig(max_reqs=3, page_size=4, n_pages=14,
                            max_pages_per_seq=5, prefill_chunk=6,
-                           step_timeout_s=timeout_s, backoff_s=0.01)
+                           step_timeout_s=timeout_s, backoff_s=0.01,
+                           page_integrity=page_integrity)
 
-    def serve(self, plan, timeout_s):
+    def serve(self, plan, timeout_s, page_integrity=True):
         from fpga_ai_nic_tpu.serve import ServeEngine
         eng = ServeEngine(self.params, self.llama_cfg,
-                          self.scfg(timeout_s), chaos=plan)
+                          self.scfg(timeout_s, page_integrity), chaos=plan)
         reqs = [eng.submit(p, max_new=self.max_new) for p in self.prompts]
         with chaos.activate(plan):
             summary = eng.run()
@@ -395,7 +401,10 @@ def run_serve_cell(rig: ServeRig, kind: str, timeout_s: float,
     cell = {"kind": kind, "site": "serve.step", "wire": "serve",
             "requests": len(rig.prompts), "max_new": rig.max_new}
     try:
-        eng, reqs, s = rig.serve(plan, timeout_s)
+        # the NaN cell isolates the value tier (see the fraction comment
+        # above); every other kind runs the production default
+        eng, reqs, s = rig.serve(plan, timeout_s,
+                                 page_integrity=kind != "corruption")
     except Exception as err:  # noqa: BLE001 — the cell verdict IS the point
         cell.update(ok=False, error=repr(err),
                     wall_s=round(time.time() - t0, 2))
@@ -433,8 +442,8 @@ def run_serve_cell(rig: ServeRig, kind: str, timeout_s: float,
 
 
 def run_serve_cells(timeout_s: float, hang_s: float,
-                    slow_s: float) -> list:
-    rig = ServeRig()
+                    slow_s: float, rig: "ServeRig" = None) -> list:
+    rig = rig if rig is not None else ServeRig()
     cells = []
     for kind in SERVE_FAULTS:
         cell = run_serve_cell(rig, kind, timeout_s, hang_s, slow_s)
@@ -554,8 +563,8 @@ def run_fleet_cell(rig: FleetRig, kind: str) -> dict:
     return cell
 
 
-def run_fleet_cells() -> list:
-    rig = FleetRig()
+def run_fleet_cells(rig: "FleetRig" = None) -> list:
+    rig = rig if rig is not None else FleetRig()
     cells = []
     for kind in FLEET_FAULTS:
         cell = run_fleet_cell(rig, kind)
@@ -564,6 +573,264 @@ def run_fleet_cells() -> list:
             f"token_exact={cell.get('token_exact')} "
             f"handoffs={cell.get('handoffs')} "
             f"replays={cell.get('fleet_replays')} "
+            f"({cell['wall_s']:.1f}s)")
+        cells.append(cell)
+    return cells
+
+
+# ---------------------------------------------------------------------------
+# integrity cells: the FINITE "wirebit" corruption class at every wire
+# (docs/CHAOS.md "Exact wire integrity").  Every cell flips a LOW bit in
+# bytes that cross (or sit behind) a wire — encoded ring frames, reshard
+# segments, KV handoff page blocks, pool float words — so the damage is
+# plausible, in-band and invisible to NaN/norm/magnitude guards BY
+# CONSTRUCTION; only the exact checksums (ops.integrity) can see it.
+# The battery is the matrix that proves the honest boundary closed: the
+# exact tier must trip (never the value/logit tier), and recovery must
+# end token-/bit-exact vs the fault-free reference.
+# ---------------------------------------------------------------------------
+
+def _ref_loss(rig: WireRig, ecfg: ElasticConfig, n_steps: int) -> float:
+    """Fault-free supervised reference loss — the bit-exact recovery
+    bar for the training integrity cells."""
+    state = rig.fresh_state()
+    with tempfile.TemporaryDirectory() as d:
+        et = ElasticTrainer(rig.trainer, d, ecfg)
+        state, metrics = et.run(state, lambda i: rig.batch, n_steps)
+    return float(metrics["loss"])
+
+
+def run_integrity_train_cell(rig: WireRig, ecfg: ElasticConfig,
+                             n_steps: int, ref_loss: float) -> dict:
+    """wirebit on a ring hop's ENCODED frame mid-run: the exact tier
+    must trip (fault class `wire-corruption` — the value band sees a
+    finite, in-band number and says nothing), the gated/invalidated
+    step recovers by restore, and the finished run is BIT-exact vs the
+    fault-free reference."""
+    t0 = time.time()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("corruption", "collective", step=FAULT_STEP,
+                         mode="wirebit", fraction=0.01)], seed=SEED)
+    cell = {"kind": "corruption", "mode": "wirebit", "site": "collective",
+            "wire": rig.wire, "steps": n_steps}
+    state = rig.fresh_state()
+    with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+        et = ElasticTrainer(rig.trainer, d, ecfg, plan=plan,
+                            stage_fn=plan.stage)
+        try:
+            state, metrics = et.run(state, lambda i: rig.batch, n_steps)
+        except Exception as err:  # noqa: BLE001 — the verdict IS the point
+            cell.update(ok=False, error=repr(err),
+                        recovery=et.profiler.recovery.as_dict(),
+                        wall_s=round(time.time() - t0, 2))
+            return cell
+        rec = et.profiler.recovery.as_dict()
+    loss = float(metrics["loss"])
+    bit_exact = loss == ref_loss
+    cell["recovered"] = (int(state.step) == n_steps
+                         and len(plan.fired) == 1
+                         and rec["faults"].get("wire-corruption", 0) >= 1
+                         and rec["faults"].get("corruption", 0) == 0
+                         and rec["recoveries"] >= 1)
+    cell.update(
+        ok=bool(cell["recovered"] and bit_exact),
+        bit_exact=bit_exact, final_loss=loss, ref_loss=ref_loss,
+        faults=rec["faults"], recoveries=rec["recoveries"],
+        checkpoint_restores=rec["checkpoint_restores"],
+        mttr_mean_s=round(rec["mttr_mean_s"], 4),
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_integrity_reshard_cell(rig: WireRig, ecfg: ElasticConfig,
+                               n_steps: int, shrink_to: int = 4) -> dict:
+    """wirebit on a reshard SEGMENT's wire: a preemption arms the
+    reshard tier, the transfer's exact verdict trips
+    (WireIntegrityError) before the landed state reaches the target
+    trainer, and the ladder falls through to checkpoint-restore instead
+    of training on silently corrupted masters — degraded, never
+    wrong."""
+    t0 = time.time()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("preemption", "queue.issue", step=FAULT_STEP),
+         chaos.FaultSpec("corruption", "reshard.transfer",
+                         step=FAULT_STEP, mode="wirebit",
+                         fraction=0.02)], seed=SEED)
+    cell = {"kind": "corruption", "mode": "wirebit",
+            "site": "reshard.transfer", "wire": rig.wire,
+            "steps": n_steps, "shrink": f"dp8->dp{shrink_to}"}
+    pol = ReshardPolicy(rig.shrink_trainer, shrink_to=shrink_to)
+    state = rig.fresh_state()
+    with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+        et = ElasticTrainer(rig.trainer, d, ecfg, plan=plan,
+                            stage_fn=plan.stage, reshard=pol)
+        et.prewarm_reshard(state, rig.host_batch)
+        try:
+            state, metrics = et.run(state, lambda i: rig.batch, n_steps)
+        except Exception as err:  # noqa: BLE001 — the verdict IS the point
+            cell.update(ok=False, error=repr(err),
+                        recovery=et.profiler.recovery.as_dict(),
+                        wall_s=round(time.time() - t0, 2))
+            return cell
+        rec = et.profiler.recovery.as_dict()
+    # the tripped transfer must NOT count as a reshard; the restore tier
+    # finishes the job on the ORIGINAL mesh (trainer width unchanged)
+    cell["recovered"] = (int(state.step) == n_steps
+                         and len(plan.fired) == 2
+                         and rec["reshards"] == 0
+                         and rec["checkpoint_restores"] >= 1
+                         and et.trainer.n == 8)
+    cell.update(
+        ok=bool(cell["recovered"]
+                and np.isfinite(float(metrics["loss"]))),
+        final_loss=round(float(metrics["loss"]), 6),
+        faults=rec["faults"], recoveries=rec["recoveries"],
+        reshards=rec["reshards"],
+        checkpoint_restores=rec["checkpoint_restores"],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_integrity_serve_cell(rig: ServeRig, timeout_s: float) -> dict:
+    """wirebit on the serve pool's float words: wrong-but-normal-
+    magnitude logits — the class docs/SERVING.md's honest boundary
+    documented as invisible.  The per-page ledger (NOT the logit guard)
+    must trip, recovery replays, and the streams end byte-identical."""
+    t0 = time.time()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("corruption", "serve.step",
+                         step=SERVE_FAULT_TICK, mode="wirebit",
+                         fraction=0.25)], seed=SEED)
+    cell = {"kind": "corruption", "mode": "wirebit", "site": "serve.step",
+            "wire": "serve", "requests": len(rig.prompts),
+            "max_new": rig.max_new}
+    try:
+        eng, reqs, s = rig.serve(plan, timeout_s)
+    except Exception as err:  # noqa: BLE001 — the verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    token_exact = all(list(q.generated) == want
+                      for q, want in zip(reqs, rig.reference))
+    cell["recovered"] = (s["completed"] == len(rig.prompts)
+                         and len(plan.fired) >= 1
+                         and s["page_trips"] >= 1
+                         and s["logit_trips"] == 0
+                         and s["recovery"]["faults"].get(
+                             "wire-corruption", 0) >= 1)
+    cell.update(
+        ok=bool(cell["recovered"] and token_exact
+                and s["recompiles_steady"] == 0),
+        token_exact=token_exact,
+        page_trips=s["page_trips"], logit_trips=s["logit_trips"],
+        serve_recoveries=s["serve_recoveries"],
+        faults=s["recovery"]["faults"],
+        mttr_mean_s=round(s["recovery"]["mttr_mean_s"], 4),
+        recompiles_steady=s["recompiles_steady"],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_integrity_handoff_cell(rig: FleetRig, exhaust: bool) -> dict:
+    """wirebit on the KV handoff wire.  One spec per tick: the landed-
+    page checksum trips, ONE bounded retry re-sends the intact source
+    pages and the migration completes — zero replay.  ``exhaust``
+    doubles the specs so the retry trips too: the request degrades to
+    the replay tier — counted, never lost, never silently wrong.
+    Either way the streams end byte-identical to the fault-free run."""
+    t0 = time.time()
+    # the wire tap consumes ONE spec per payload array (2 * n_layers
+    # arrays per handoff attempt): one spec per step trips only the
+    # first attempt (retry clean); exhaust arms more specs than one
+    # attempt can consume, so the bounded retry trips too and the
+    # request must degrade to replay
+    reps = 8 if exhaust else 1
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("corruption", "serve.handoff", step=s,
+                         mode="wirebit", fraction=0.2)
+         for s in range(20) for _ in range(reps)], seed=SEED)
+    cell = {"kind": "corruption", "mode": "wirebit",
+            "site": "serve.handoff", "wire": "fleet",
+            "variant": "retry-exhausted" if exhaust else "bounded-retry",
+            "requests": len(rig.prompts), "max_new": rig.max_new}
+    try:
+        fleet, reqs, s = rig.serve(plan)
+    except Exception as err:  # noqa: BLE001 — the verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    token_exact = all(list(q.generated) == want
+                      for q, want in zip(reqs, rig.reference))
+    completed = s["completed"] == len(rig.prompts)
+    if exhaust:
+        cell["recovered"] = (completed
+                             and s["handoff_integrity_trips"] >= 2
+                             and s["fleet_replays"] >= 1
+                             and s["recovery"]["faults"].get(
+                                 "wire-corruption", 0) >= 1)
+    else:
+        cell["recovered"] = (completed
+                             and s["handoff_integrity_trips"] >= 1
+                             and s["fleet_replays"] == 0
+                             and s["serve_recoveries"] == 0)
+    cell.update(
+        ok=bool(cell["recovered"] and token_exact
+                and s["recompiles_steady"] == 0),
+        token_exact=token_exact,
+        handoff_integrity_trips=s["handoff_integrity_trips"],
+        handoffs=s["handoffs"], fleet_replays=s["fleet_replays"],
+        serve_recoveries=s["serve_recoveries"],
+        faults=s["recovery"]["faults"],
+        recompiles_steady=s["recompiles_steady"],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_integrity_cells(ecfg: ElasticConfig, n_steps: int,
+                        timeout_s: float, wire_rigs=None,
+                        serve_rig=None, fleet_rig=None) -> list:
+    """The full wirebit battery: every wire site, exact tier trips,
+    token-/bit-exact recovery.  Pre-built rigs are reused when the full
+    matrix already compiled them."""
+    cells = []
+    rigs = wire_rigs if wire_rigs else {"bfp": WireRig("bfp", n_steps)}
+    for wire, rig in sorted(rigs.items()):
+        ref = _ref_loss(rig, ecfg, n_steps)
+        cell = run_integrity_train_cell(rig, ecfg, n_steps, ref)
+        log(f"cell integrity wirebit @ collective       wire={wire}: "
+            f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+            f"bit_exact={cell.get('bit_exact')} "
+            f"faults={cell.get('faults')} ({cell['wall_s']:.1f}s)")
+        cells.append(cell)
+    rig = rigs.get("bfp") or next(iter(rigs.values()))
+    cell = run_integrity_reshard_cell(rig, ecfg, n_steps)
+    log(f"cell integrity wirebit @ reshard.transfer : "
+        f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+        f"restores={cell.get('checkpoint_restores')} "
+        f"reshards={cell.get('reshards')} ({cell['wall_s']:.1f}s)")
+    cells.append(cell)
+    srig = serve_rig if serve_rig is not None else ServeRig()
+    cell = run_integrity_serve_cell(srig, timeout_s)
+    log(f"cell integrity wirebit @ serve.step       : "
+        f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+        f"page_trips={cell.get('page_trips')} "
+        f"logit_trips={cell.get('logit_trips')} "
+        f"token_exact={cell.get('token_exact')} "
+        f"({cell['wall_s']:.1f}s)")
+    cells.append(cell)
+    frig = fleet_rig if fleet_rig is not None else FleetRig()
+    for exhaust in (False, True):
+        cell = run_integrity_handoff_cell(frig, exhaust)
+        log(f"cell integrity wirebit @ serve.handoff    "
+            f"[{cell['variant']}]: "
+            f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+            f"trips={cell.get('handoff_integrity_trips')} "
+            f"replays={cell.get('fleet_replays')} "
+            f"token_exact={cell.get('token_exact')} "
             f"({cell['wall_s']:.1f}s)")
         cells.append(cell)
     return cells
@@ -698,6 +965,12 @@ def main() -> int:
                          "migration + handoff-fault degradation; the "
                          "CI-sized gate — the full matrix also includes "
                          "them)")
+    ap.add_argument("--integrity-only", action="store_true",
+                    help="run ONLY the wirebit integrity cells (the "
+                         "finite-corruption class at every wire site, "
+                         "exact-tier trips + token-/bit-exact recovery; "
+                         "the CI-sized gate — the full matrix also "
+                         "includes them)")
     ap.add_argument("--reshard-bench", action="store_true",
                     help="run the trainer x codec reshard-vs-restore MTTR "
                          "matrix instead of the fault matrix (banked as "
@@ -720,6 +993,44 @@ def main() -> int:
     plat = jax.devices()[0].platform
     log(f"platform={plat} devices={len(jax.devices())} fast={args.fast}")
     chaos.install_collective_tap()     # before any step is traced
+    # the ENCODED-payload wire tap rides next to it (identity copy when
+    # no wirebit spec is pending): the integrity cells corrupt encoded
+    # ring frames / reshard segments / handoff page blocks through it.
+    # The tap is consulted at TRACE time and must precede ALL tracing
+    # when wirebit cells will run (the reshard/handoff transfer
+    # programs are module-level lru-memoized — a late install would
+    # reuse tap-free programs and the specs would silently never fire),
+    # but it threads one host callback per payload per hop into every
+    # traced collective, so the serve-/fleet-only/reshard-bench lanes —
+    # whose cells never fire wirebit through the wire tap — skip it and
+    # keep their banked MTTR rows tap-free (comparable with the
+    # pre-tap rounds' artifacts)
+    if not (args.serve_only or args.fleet_only or args.reshard_bench):
+        chaos.install_wire_tap()
+
+    if args.integrity_only:
+        integrity_cells = run_integrity_cells(ecfg, n_steps, timeout_s)
+        result = {
+            "bench": "chaos_integrity",
+            "fast": args.fast,
+            "platform": plat,
+            "n_devices": len(jax.devices()),
+            "dryrun": plat != "tpu",
+            "integrity_cells": integrity_cells,
+            "ok": all(c["ok"] for c in integrity_cells),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+        if not args.no_artifact:
+            save_artifact("chaos_integrity", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "integrity_cells"} |
+                         {"integrity_cells_ok":
+                          sum(c["ok"] for c in integrity_cells),
+                          "integrity_cells_total":
+                          len(integrity_cells)}, indent=1))
+        return 0 if result["ok"] else 1
 
     if args.fleet_only:
         fleet_cells = run_fleet_cells()
@@ -784,8 +1095,10 @@ def main() -> int:
 
     wires = [args.wire] if args.wire else sorted(WIRES)
     cells, soaks, shrink_cells = [], [], []
+    wire_rig_map = {}
     for wire in wires:
         rig = WireRig(wire, n_steps)
+        wire_rig_map[wire] = rig
         for kind, site, mode in _legal_cells():
             cell = run_cell(rig, kind, site, mode, ecfg, n_steps,
                             hang_s, slow_s)
@@ -815,9 +1128,17 @@ def main() -> int:
 
     # the serving plane's cell battery: request-level SLO (completion +
     # token-exactness + recovery class) under the same fault kinds
-    serve_cells = run_serve_cells(timeout_s, hang_s, slow_s)
+    serve_rig = ServeRig()
+    serve_cells = run_serve_cells(timeout_s, hang_s, slow_s,
+                                  rig=serve_rig)
     # the fleet battery: replica-kill KV migration + handoff degradation
-    fleet_cells = run_fleet_cells()
+    fleet_rig = FleetRig()
+    fleet_cells = run_fleet_cells(rig=fleet_rig)
+    # the wirebit integrity battery: the finite-corruption class at
+    # every wire, exact tier trips, token-/bit-exact recovery
+    integrity_cells = run_integrity_cells(
+        ecfg, n_steps, timeout_s, wire_rigs=wire_rig_map,
+        serve_rig=serve_rig, fleet_rig=fleet_rig)
 
     result = {
         "bench": "chaos_matrix",
@@ -828,16 +1149,20 @@ def main() -> int:
         "matrix": {"kinds": list(chaos.FAULT_KINDS),
                    "sites": list(chaos.TRAIN_SITES), "wires": wires,
                    "serve_site": "serve.step",
-                   "fleet_sites": ["fleet.membership", "serve.handoff"]},
+                   "fleet_sites": ["fleet.membership", "serve.handoff"],
+                   "integrity_sites": ["collective", "reshard.transfer",
+                                       "serve.step", "serve.handoff"]},
         "cells": cells,
         "shrink_cells": shrink_cells,
         "serve_cells": serve_cells,
         "fleet_cells": fleet_cells,
+        "integrity_cells": integrity_cells,
         "soak": soaks,
         "ok": (all(c["ok"] for c in cells)
                and all(c["ok"] for c in shrink_cells)
                and all(c["ok"] for c in serve_cells)
                and all(c["ok"] for c in fleet_cells)
+               and all(c["ok"] for c in integrity_cells)
                and all(s["ok"] for s in soaks)),
     }
     if args.out:

@@ -87,6 +87,15 @@ _WATCH = {
               "fpga_ai_nic_tpu/models/llama_decode.py",
               "fpga_ai_nic_tpu/runtime/chaos.py",
               "fpga_ai_nic_tpu/runtime/requests.py"],
+    "integrity": ["tools/integrity_bench.py", "tools/chaos_bench.py",
+                  "fpga_ai_nic_tpu/ops/integrity.py",
+                  "fpga_ai_nic_tpu/ops/ring.py",
+                  "fpga_ai_nic_tpu/ops/ring_hier.py",
+                  "fpga_ai_nic_tpu/ops/ring_pallas.py",
+                  "fpga_ai_nic_tpu/parallel/reshard.py",
+                  "fpga_ai_nic_tpu/serve/",
+                  "fpga_ai_nic_tpu/runtime/chaos.py",
+                  "fpga_ai_nic_tpu/compress/golden.py"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -763,6 +772,61 @@ def main():
                     f"| {r.get('recompiles_steady')} "
                     f"| {r.get('token_exact')} |")
             L.append("")
+
+    # -- wire integrity (exact checksums on every transfer program) ----------
+    ig_art = (_newest("artifacts/integrity_bench_*.json")
+              or _newest("INTEGRITY_BENCH_r*.json"))
+    if ig_art:
+        d = _load(ig_art)
+        rows = d.get("rows", [])
+        if rows:
+            dry = bool(d.get("dryrun"))
+            L += ["## Wire integrity (exact checksums, PR 12)", "",
+                  f"Source: `{_rel(ig_art)}`{_badge(d, 'integrity')} "
+                  f"(platform: {d.get('platform')}; "
+                  "`make integrity-bench`).  Every ppermute-bearing "
+                  "transfer program traced twice — exact frame "
+                  "checksums (`ops/integrity.py`) on and off.  The "
+                  "gate-worthy facts are exact on every surface: "
+                  "`Δwire B` == 0 (NO checksum ever rides the wire — "
+                  "the J4/J8/J9/J11 byte accounting is untouched, "
+                  "frozen as graftlint J12), zero false trips on clean "
+                  "runs, and bit-identical results with the guard on.",
+                  ""]
+            if dry:
+                L += ["**Dryrun rows** (virtual CPU mesh): the on/off "
+                      "timings carry oversubscription noise — `make "
+                      "obs-gate` gates only the exact byte/counter "
+                      "keys (two-sided); the overhead verdict needs a "
+                      "TPU surface.", ""]
+            L += ["| route | ms off | ms on | overhead | wire B "
+                  "| Δwire B | trips | bit-identical |",
+                  "|---|---|---|---|---|---|---|---|"]
+            for r in rows:
+                L.append(
+                    f"| {r['route']} | {r.get('ms_off')} "
+                    f"| {r.get('ms_on')} | x{r.get('overhead_ratio')} "
+                    f"| {r.get('wire_bytes'):,} "
+                    f"| {r.get('wire_bytes_delta')} "
+                    f"| {r.get('trips')} | {r.get('bit_identical')} |")
+            L.append("")
+            mrows = d.get("mttr_rows", [])
+            if mrows:
+                L += ["Trip→recovery (the wirebit chaos cells: a "
+                      "FINITE low-bit wire corruption — invisible to "
+                      "every value/logit guard — must trip the exact "
+                      "tier and recover token-/bit-exact):", "",
+                      "| site | variant | ok | MTTR s | counters |",
+                      "|---|---|---|---|---|"]
+                for r in mrows:
+                    extra = {k: v for k, v in r.items()
+                             if k not in ("site", "variant", "ok",
+                                          "mttr_s") and v is not None}
+                    L.append(
+                        f"| {r['site']} | {r.get('variant', '—')} "
+                        f"| {r['ok']} | {r.get('mttr_s')} "
+                        f"| {json.dumps(extra)} |")
+                L.append("")
 
     # -- telemetry summary (obs gate) ----------------------------------------
     obs_art = _newest("artifacts/obs_summary_*.json")

@@ -114,6 +114,26 @@ FLEET_BYTE_KEYS = ("handoff_wire_bytes", "handoffs", "fleet_replays",
                    "serve_recoveries", "recompiles_steady")
 TOL_FLEET_TIME = 0.40
 
+# wire-integrity rows (INTEGRITY_BENCH_r*.json).  Route rows: the
+# checksum must be INVISIBLE (wire_bytes_delta banked 0 — any nonzero
+# means a checksum started riding the wire, J12 territory), must never
+# false-trip on a clean run (trips banked 0) and must leave the result
+# bit-identical (bit_identical banked 1); ms_on/ms_off/overhead gate on
+# non-dryrun artifacts only (CPU timings are oversubscription noise).
+# MTTR rows: the trip/recovery COUNTERS are exact two-sided — a drifted
+# counter means the recovery routing changed (e.g. the logit guard
+# started winning the race the page ledger must win) — while mttr_s
+# gates non-dryrun only.
+INTEGRITY_GATE_KEYS = ("ms_on", "ms_off", "overhead_ratio")
+INTEGRITY_BYTE_KEYS = ("wire_bytes", "wire_bytes_delta", "trips",
+                       "bit_identical")
+INTEGRITY_MTTR_EXACT = ("wire_corruption_faults", "checkpoint_restores",
+                        "reshards", "page_trips", "logit_trips",
+                        "token_exact", "bit_exact",
+                        "handoff_integrity_trips", "fleet_replays",
+                        "serve_recoveries", "recompiles_steady")
+TOL_INTEGRITY_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -141,6 +161,10 @@ def serve_metric(max_reqs, key: str) -> str:
 
 def fleet_metric(scenario: str, key: str) -> str:
     return f"fleet.{scenario}.{key}"
+
+
+def integrity_metric(route: str, key: str) -> str:
+    return f"integrity.{route}.{key}"
 
 
 def _load(path):
@@ -316,6 +340,39 @@ def build_banked_summary() -> dict:
                     m = _metric(v, src, higher=False,
                                 tol=TOL_FLEET_TIME)
                 metrics[fleet_metric(row["scenario"], key)] = m
+
+    # -- wire integrity (checksum overhead + trip->recovery) ------------------
+    p = (_newest("artifacts/integrity_bench_*.json")
+         or _newest("INTEGRITY_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (INTEGRITY_BYTE_KEYS if d.get("dryrun")
+                else INTEGRITY_BYTE_KEYS + INTEGRITY_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in INTEGRITY_BYTE_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                else:
+                    m = _metric(v, src, higher=False,
+                                tol=TOL_INTEGRITY_TIME)
+                metrics[integrity_metric(row["route"], key)] = m
+        for row in d.get("mttr_rows", []):
+            name = row["site"] + (f".{row['variant']}"
+                                  if row.get("variant") else "")
+            for key in INTEGRITY_MTTR_EXACT:
+                v = row.get(key)
+                if v is None:
+                    continue
+                metrics[integrity_metric(name, key)] = _metric(
+                    v, src, tol=TOL_EXACT, two_sided=True)
+            if not d.get("dryrun") and row.get("mttr_s") is not None:
+                metrics[integrity_metric(name, "mttr_s")] = _metric(
+                    row["mttr_s"], src, higher=False,
+                    tol=TOL_INTEGRITY_TIME)
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
