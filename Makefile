@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench adapt-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -161,6 +161,20 @@ integrity-bench:
 	@latest=$$(ls -t artifacts/integrity_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest INTEGRITY_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> INTEGRITY_BENCH_$(ROUND).json"
+
+# adaptive-tuning bench (docs/TUNING.md "Online plan adaptation"): the
+# drift observatory's switch events banked — the forced
+# slowdown@collective regime shift detected from measured-vs-modeled
+# residuals and answered by a step-boundary switch to a pre-compiled
+# plan (recompiles_across_switch == 0, the J13 contract), plus the
+# zero-switch steady guard; snapshot the newest artifact as the round's
+# committed record (obs-gate consumes it — dryrun CPU rows gate only
+# the exact switch/trace counters, adapt.* keys)
+adapt-bench:
+	python tools/adapt_bench.py
+	@latest=$$(ls -t artifacts/adapt_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest ADAPT_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> ADAPT_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

@@ -43,6 +43,10 @@ RULE_DOCS: Dict[str, str] = {
            "(u32 arithmetic + boolean verdict), with ppermute bytes "
            "IDENTICAL to the integrity-off twin (no checksum rides the "
            "wire) — or an explicit J12_WAIVERS entry",
+    "J13": "adaptive candidate set: every pre-compiled plan must trace "
+           "exactly once, up front at construction, and a runtime plan "
+           "switch must cause ZERO new traces — the J10 counted-trace "
+           "discipline applied to training (tune.adapt)",
     "H1": "happens-before/lockset: an instance attribute written from two "
           "threads (trainer / watchdog worker / callback) needs a common "
           "lock — R1 generalized to cross-thread order",
@@ -53,7 +57,7 @@ RULE_DOCS: Dict[str, str] = {
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8", "J9", "J10", "J11", "J12")
+                                "J8", "J9", "J10", "J11", "J12", "J13")
 
 
 @dataclass(frozen=True)

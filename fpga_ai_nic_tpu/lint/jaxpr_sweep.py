@@ -1,4 +1,4 @@
-"""Plane 2 — jaxpr invariant sweep (J1–J12), CPU-only.
+"""Plane 2 — jaxpr invariant sweep (J1–J13), CPU-only.
 
 EQuARX (arXiv:2506.17615) and the weight-update sharding work
 (arXiv:2004.13336) both rest on compiler-level invariants of the lowered
@@ -1300,6 +1300,155 @@ def run_j12(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J13 — the adaptive-training candidate set (tune.adapt) must be traced
+# UP FRONT and a runtime plan switch must cause ZERO new traces — the
+# J10 counted-trace discipline applied to training.  The tempting-but-
+# wrong implementation compiles the target plan lazily "when we need
+# it": the switch then pays a compile spike exactly when the job is
+# already degraded (the regime shift that triggered it), and every
+# switch after that retraces again.  Like J10 this rule runs CONCRETELY:
+# a tiny AdaptiveTrainer (fixture calibration — zero banked-artifact
+# dependence) is built, prewarmed, stepped, forced through a plan switch
+# (the deterministic inject_shift seam; the chaos `slowdown@collective`
+# cell proves the measured detection path), and stepped again; every
+# candidate's step must have traced EXACTLY once and the total trace
+# count must not move across the switch.  A run that performs no switch
+# (or has a one-plan "set") proves nothing and is itself a finding.
+# ---------------------------------------------------------------------------
+
+def _j13_adaptive_build() -> Callable:
+    def run() -> Dict[str, Any]:
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from ..models import mlp
+        from ..parallel import mesh as mesh_lib
+        from ..tune import adapt as adapt_lib
+        from ..tune.calibration import fixture_calibration
+        from ..utils.config import (AdaptConfig, CollectiveConfig,
+                                    MeshConfig, MLPConfig,
+                                    OptimizerConfig, TrainConfig)
+
+        mcfg = MLPConfig(layer_sizes=_LAYERS, dtype="float32")
+        # THE shared fixture regime (tune.calibration.fixture_calibration
+        # — also the adapt chaos cells'): a fast wire so plan 0 is the
+        # uncompressed ring and the injected regime shift has a cheaper
+        # wire format to move to — deterministic, no banked artifacts
+        calib = fixture_calibration()
+        cfg = TrainConfig(
+            iters=8, global_batch=_BATCH, mesh=MeshConfig(dp=_NDEV),
+            collective=CollectiveConfig(impl="ring", codec="auto"),
+            optimizer=OptimizerConfig(),
+            adapt=AdaptConfig(enabled=True, n_candidates=2,
+                              live_calibration=False, warmup_steps=2,
+                              cooldown_steps=2))
+        at = adapt_lib.AdaptiveTrainer(
+            lambda p, b: mlp.loss_fn(p, b, mcfg),
+            mesh_lib.make_mesh(cfg.mesh), cfg, calibration=calib)
+        params = mlp.init(jax.random.PRNGKey(0), mcfg)
+        state = at.init_state(params)
+        r = np.random.default_rng(0)
+        batch = at.shard_batch((
+            jnp.asarray(r.standard_normal((_BATCH, _LAYERS[0]))
+                        .astype(np.float32)),
+            jnp.asarray(r.integers(0, _LAYERS[-1], _BATCH)
+                        .astype(np.int32))))
+        for _ in range(3):
+            state, _loss = at.step(state, batch)
+        # the forced regime shift: the wire now behaves ~dead-slow, the
+        # re-priced argmin moves to a compressed candidate
+        at.controller.inject_shift(1e-4, step=3)
+        for _ in range(3):
+            state, _loss = at.step(state, batch)
+        return {
+            "candidates": at.trace_counts(),
+            "switches": at.switches,
+            "recompiles_across_switch": at.recompiles_across_switch,
+            "_exercised": int(at.switches >= 1 and len(at.plans) >= 2),
+        }
+    return run
+
+
+def check_adaptive_traces(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J13 surface.  ``build()`` returns a zero-arg runner
+    executing a scripted adaptive run and returning ``candidates``
+    ({plan label: step trace count}), ``switches``,
+    ``recompiles_across_switch`` and optionally ``_exercised`` (falsy =
+    the run proved nothing)."""
+    findings: List[Finding] = []
+    cell = f"jaxpr[adapt {name}]"
+    out = dict(build()())
+    if not out.pop("_exercised", 1):
+        findings.append(Finding(
+            "J13", cell, 0,
+            "the scripted adaptive run performed no plan switch (or the "
+            "candidate set has fewer than 2 plans) — the counted-trace "
+            "check is vacuous; widen the scenario"))
+    for label, n in sorted(out.get("candidates", {}).items()):
+        if n == 0:
+            findings.append(Finding(
+                "J13", cell, 0,
+                f"candidate plan '{label}' was NEVER traced — the "
+                "candidate set must be compiled up front at "
+                "construction; a lazily-compiled plan pays its compile "
+                "spike at the switch, exactly when the job is already "
+                "degraded by the regime shift"))
+        elif n > 1:
+            findings.append(Finding(
+                "J13", cell, 0,
+                f"candidate plan '{label}' traced {n}x across the "
+                "scripted run — a plan switch must replay the "
+                "pre-compiled program, never retrace it (slot the "
+                "switch-shaped state into the prewarm, or the jit cache "
+                "misses on sharding/weak-type drift)"))
+    rec = out.get("recompiles_across_switch", 0)
+    if rec:
+        findings.append(Finding(
+            "J13", cell, 0,
+            f"{rec} new trace(s) appeared across the plan switch — the "
+            "switch must cause ZERO new traces (the J10 counted-trace "
+            "discipline applied to training); trace every candidate's "
+            "step AND gather programs at construction"))
+    return findings
+
+
+def j13_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs.  GRAFTLINT_J13_FIXTURE appends a surface
+    from a module path exposing ``build()`` — the bad-fixture /
+    exit-code hook, same contract as J7–J12's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("candidate-set switch schedule", _j13_adaptive_build),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J13_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j13_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j13(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j13_surfaces():
+        try:
+            fs = check_adaptive_traces(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J13", f"jaxpr[adapt {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] adapt {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -1398,4 +1547,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_j10(verbose=verbose))
     findings.extend(run_j11(verbose=verbose))
     findings.extend(run_j12(verbose=verbose))
+    findings.extend(run_j13(verbose=verbose))
     return findings

@@ -46,8 +46,35 @@ import numpy as np
 
 from .events import EventStream
 
-__all__ = ["MetricsSink", "use_sink", "active_sink", "tap",
+__all__ = ["Ewma", "MetricsSink", "use_sink", "active_sink", "tap",
            "codec_static_metrics", "codec_observed_error"]
+
+
+class Ewma:
+    """Exponentially-weighted moving average SEEDED WITH THE FIRST
+    OBSERVATION: ``value`` is exactly the first sample until the second
+    arrives, never a decay up from an arbitrary zero.  A zero-seeded
+    EWMA under-reports every early sample by (1-a)^k — harmless for a
+    dashboard, poisonous for drift detection, where the warm-up bias
+    reads as a fake downward regime shift and the modeled-vs-measured
+    residuals (tune.adapt) inherit it.  Shared by MetricsSink and the
+    drift plane so there is ONE seeding rule (pinned by test_obs)."""
+
+    def __init__(self, alpha: float) -> None:
+        assert 0.0 < alpha <= 1.0, alpha
+        self.alpha = float(alpha)
+        self.value: Optional[float] = None
+        # leaf lock: updates arrive from XLA callback threads (the sink)
+        # and the trainer thread (the drift plane) — the H1 cross-thread
+        # ordering rule, same discipline as the stats record_* methods
+        self._lock = threading.Lock()
+
+    def update(self, v: float) -> float:
+        v = float(v)
+        with self._lock:
+            self.value = v if self.value is None \
+                else (1.0 - self.alpha) * self.value + self.alpha * v
+            return self.value
 
 
 # ---------------------------------------------------------------------------
@@ -70,15 +97,25 @@ class MetricsSink:
         self.events = events
         self.static = dict(static or {})
         self.latest: Dict[str, float] = {}
-        self.ewma: Dict[str, float] = {}
+        self._ewma: Dict[str, Ewma] = {}
         self.n_updates = 0
         self._last_t: Optional[float] = None
         self._lock = threading.Lock()
 
     def _ewma_update(self, name: str, value: float) -> None:
-        a = self.ewma_alpha
-        prev = self.ewma.get(name)
-        self.ewma[name] = value if prev is None else (1 - a) * prev + a * value
+        # first-observation seeding (Ewma contract): no decay-from-zero
+        # warm-up bias in the series drift residuals are built on.
+        # get-then-create, not setdefault: this runs per step on the
+        # XLA-callback path, and setdefault would allocate a throwaway
+        # Ewma (and its lock) on every call
+        e = self._ewma.get(name)
+        if e is None:
+            e = self._ewma[name] = Ewma(self.ewma_alpha)
+        e.update(value)
+
+    def ewma_value(self, name: str) -> Optional[float]:
+        e = self._ewma.get(name)
+        return None if e is None else e.value
 
     def update(self, values: Dict[str, float]) -> None:
         now = time.perf_counter()
@@ -102,8 +139,8 @@ class MetricsSink:
             out: Dict[str, Any] = {
                 "n_updates": self.n_updates,
                 "latest": dict(self.latest),
-                "loss_ewma": self.ewma.get("loss"),
-                "step_time_ewma_s": self.ewma.get("step_time_s"),
+                "loss_ewma": self.ewma_value("loss"),
+                "step_time_ewma_s": self.ewma_value("step_time_s"),
             }
             if self.static:
                 out["static"] = dict(self.static)
@@ -236,10 +273,15 @@ def l2_norm(x: Any, axis_name: Optional[str] = None) -> Any:
 # ---------------------------------------------------------------------------
 
 def percentile(sorted_vals: List[float], q: float) -> float:
-    """Nearest-rank percentile over an ALREADY-SORTED non-empty list —
-    tiny and dependency-free so the gate tooling can share it."""
-    assert sorted_vals, "percentile of an empty series"
+    """Nearest-rank percentile over an ALREADY-SORTED list — tiny and
+    dependency-free so the gate tooling can share it.  An EMPTY series
+    returns NaN: the caller gets an explicitly not-a-number answer it
+    can flag (RequestSpans.summary's ``*_empty``) instead of an assert
+    that turns "no requests completed yet" into a crash in whatever
+    thread asked for a summary."""
     assert 0.0 <= q <= 100.0, q
+    if not sorted_vals:
+        return float("nan")
     idx = min(len(sorted_vals) - 1,
               max(0, int(round(q / 100.0 * (len(sorted_vals) - 1)))))
     return float(sorted_vals[idx])
@@ -297,16 +339,27 @@ class RequestSpans:
                        "queue_wait_s": round(vals["queue_wait_s"], 6)})
 
     def summary(self) -> Dict[str, Any]:
-        """mean / p50 / p95 per series + completion/drop accounting."""
+        """mean / p50 / p95 per series + completion/drop accounting.
+        An empty series reports not-a-number stats WITH an explicit
+        ``<series>_empty: True`` flag — "no samples" must read as no
+        samples, never as a silently absent (or zero) latency row.  The
+        not-a-number spelling here is ``None`` (JSON null), NOT float
+        NaN: summaries land verbatim in banked JSON artifacts, and
+        ``json.dump`` would serialize NaN as a bare token strict
+        parsers reject."""
         with self._lock:
             series = {k: sorted(v) for k, v in self._series.items()}
             completed, dropped = self.completed, self.samples_dropped
         out: Dict[str, Any] = {"completed": completed,
                                "samples_dropped": dropped}
         for name, vals in series.items():
-            if not vals:
-                continue
             base = name[:-2] if name.endswith("_s") else name
+            if not vals:
+                out[f"{base}_empty"] = True
+                out[f"{base}_mean_s"] = None
+                out[f"{base}_p50_s"] = None
+                out[f"{base}_p95_s"] = None
+                continue
             out[f"{base}_mean_s"] = round(sum(vals) / len(vals), 6)
             out[f"{base}_p50_s"] = round(percentile(vals, 50.0), 6)
             out[f"{base}_p95_s"] = round(percentile(vals, 95.0), 6)

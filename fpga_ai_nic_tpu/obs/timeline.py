@@ -31,7 +31,7 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 from . import events as events_lib
 
@@ -39,6 +39,7 @@ from . import events as events_lib
 _PID_HOST = 1
 _PID_QUEUE = 2
 _PID_DEVICE = 3
+_PID_ATTRIB = 4     # drift attribution: modeled-vs-measured per stage
 
 DEFAULT_ANCHOR_SPAN = "jax_profile"
 
@@ -57,11 +58,17 @@ def _host_trace_events(host_events: Sequence[Dict[str, Any]],
                        t0_ns: int) -> List[Dict]:
     """Host stream -> chrome events.  Spans whose attrs carry
     ``lane='queue'`` (the CollectiveQueue's ticket intervals) get their
-    own process so ticket overlap reads at a glance; other spans lane by
-    emitting thread."""
+    own process so ticket overlap reads at a glance; spans/instants with
+    ``lane='attribution'`` (the drift observatory's modeled-vs-measured
+    stage residuals, tune.adapt) get the attribution process with one
+    thread per stage, so the excess over the roofline model — and every
+    ``adapt.switch`` it triggers — reads directly off the timeline;
+    other spans lane by emitting thread."""
     out: List[Dict] = []
     tids: Dict[int, int] = {}
+    attrib_tids: Dict[str, int] = {}
     queue_meta_done = False
+    attrib_meta_done = False
     for ev in host_events:
         ts_us = (ev["t_unix_ns"] - t0_ns) / 1e3
         attrs = ev.get("attrs") or {}
@@ -71,6 +78,20 @@ def _host_trace_events(host_events: Sequence[Dict[str, Any]],
             if not queue_meta_done:
                 out.extend(_meta(_PID_QUEUE, "collective queue (tickets)"))
                 queue_meta_done = True
+        elif attrs.get("lane") == "attribution":
+            pid = _PID_ATTRIB
+            if not attrib_meta_done:
+                out.extend(_meta(_PID_ATTRIB,
+                                 "drift attribution (modeled vs measured)"))
+                attrib_meta_done = True
+            stage = str(attrs.get("stage", "step"))
+            if stage not in attrib_tids:        # first sighting
+                attrib_tids[stage] = len(attrib_tids) + 1
+                out.append({"ph": "M", "pid": _PID_ATTRIB,
+                            "tid": attrib_tids[stage],
+                            "name": "thread_name",
+                            "args": {"name": stage}})
+            tid = attrib_tids[stage]
         else:
             pid = _PID_HOST
             raw_tid = ev.get("tid", 0)
@@ -98,24 +119,26 @@ def _host_trace_events(host_events: Sequence[Dict[str, Any]],
 
 def _device_offset_ns(device_intervals: Sequence[Dict[str, Any]],
                       host_events: Sequence[Dict[str, Any]],
-                      anchor_span: str) -> int:
-    """Shift applied to device timestamps: pin the earliest device event
-    to the start of the anchor span (the host span wrapping the profiler
-    capture), else to the earliest host event.  0 when no device events
-    (or no host events to anchor on)."""
+                      anchor_span: str) -> Tuple[int, str]:
+    """(shift, alignment) applied to device timestamps.  With the anchor
+    span present (the host span wrapping the profiler capture) the
+    earliest device event pins to its start: alignment ``anchored``.
+    With device events but NO anchor span, the fallback to the earliest
+    host event is a GUESS — the device epoch is backend-defined, so the
+    merge may be misaligned by an arbitrary constant; that state is
+    reported as ``offset_unknown`` (and chrome_trace plants an explicit
+    marker in the device lane) instead of silently rendering a timeline
+    whose cross-plane overlap claims mean nothing."""
     if not device_intervals:
-        return 0
+        return 0, "n/a"
     dev_min = min(iv["start_ns"] for iv in device_intervals)
-    anchor = None
     for ev in host_events:
         if ev.get("kind") == events_lib.SPAN and ev["name"] == anchor_span:
-            anchor = ev["t_unix_ns"]
-            break
-    if anchor is None and host_events:
+            return int(ev["t_unix_ns"] - dev_min), "anchored"
+    if host_events:
         anchor = min(ev["t_unix_ns"] for ev in host_events)
-    if anchor is None:
-        return 0
-    return int(anchor - dev_min)
+        return int(anchor - dev_min), "offset_unknown"
+    return 0, "offset_unknown"
 
 
 def _device_trace_events(device_intervals: Sequence[Dict[str, Any]],
@@ -147,7 +170,8 @@ def chrome_trace(host_events: Sequence[Dict[str, Any]],
     earliest host event so the trace opens at t=0."""
     device_intervals = list(device_intervals or [])
     host_events = list(host_events)
-    offset = _device_offset_ns(device_intervals, host_events, anchor_span)
+    offset, alignment = _device_offset_ns(device_intervals, host_events,
+                                          anchor_span)
     starts = [ev["t_unix_ns"] for ev in host_events]
     starts += [iv["start_ns"] + offset for iv in device_intervals]
     t0_ns = min(starts) if starts else 0
@@ -156,13 +180,24 @@ def chrome_trace(host_events: Sequence[Dict[str, Any]],
     trace_events.extend(_host_trace_events(host_events, t0_ns))
     trace_events.extend(_device_trace_events(device_intervals, offset,
                                              t0_ns))
+    if alignment == "offset_unknown":
+        # the anchor span is missing: the device plane is placed by a
+        # guess, and anyone reading cross-plane overlap must see that IN
+        # the trace, not only in metadata nobody opens
+        trace_events.append({
+            "ph": "i", "pid": _PID_DEVICE, "tid": 0, "s": "p",
+            "name": "offset_unknown", "ts": 0.0,
+            "args": {"why": f"no '{anchor_span}' anchor span in the host "
+                            "stream — device timestamps aligned to the "
+                            "earliest host event, which may be off by an "
+                            "arbitrary constant"}})
     other: Dict[str, Any] = {
         "schema_version": events_lib.SCHEMA_VERSION,
         "t0_unix_ns": t0_ns,
         "n_host_events": len(host_events),
         "n_device_intervals": len(device_intervals),
         "device_offset_ns": offset,
-        "device_alignment": ("anchored" if device_intervals else "n/a"),
+        "device_alignment": alignment,
     }
     if header:
         other["stream_header"] = header

@@ -77,6 +77,13 @@ class DPTrainer:
         # plan lands in obs_static_metrics() for obs-gate to diff
         self._tuned_plan = None
         self._tune_calib = None
+        # trace counters: the traced Python bodies below bump these once
+        # per TRACE (cache miss), so the adaptation plane (tune.adapt)
+        # and graftlint J13 can hold "a plan switch causes zero new
+        # traces" as a counted fact, the J10 discipline applied to
+        # training
+        self.step_traces = 0
+        self.gather_traces = 0
         self._set_codec_flags()
         if cfg.collective.fused_optimizer \
                 and cfg.optimizer.clip_norm is not None:
@@ -109,10 +116,21 @@ class DPTrainer:
         """One-shot autotune resolution of a codec='auto' template (no-op
         otherwise): deterministic in the banked artifacts, done in plain
         Python before any tracing.  The calibration is kept so the
-        padded-length rescore prices with the SAME artifacts."""
+        padded-length rescore prices with the SAME artifacts.  With
+        ``cfg.adapt`` armed for live calibration, the banked rates are
+        first upgraded by the startup mesh microbenches
+        (tune.adapt.live_calibrate) — the `live` provenance tier: the
+        plan is priced for the mesh the job actually landed on, not the
+        mesh some artifact was banked on."""
         from .. import tune as tune_lib
+        calibration = None
+        acfg = getattr(self.cfg, "adapt", None)
+        if (acfg is not None and acfg.enabled and acfg.live_calibration
+                and tune_lib.needs_autotune(self.cfg.collective)):
+            from ..tune import adapt as adapt_lib
+            calibration = adapt_lib.live_calibrate(self.mesh, self.ax)
         cfg, plan, calib = tune_lib.resolve_train_config(
-            self.cfg, self.n, params_like)
+            self.cfg, self.n, params_like, calibration=calibration)
         if plan is None:
             return
         self.cfg = cfg
@@ -342,6 +360,7 @@ class DPTrainer:
             return fused_update.unflatten_tree(flat_w, meta)
 
         def _step(state: TrainState, batch):
+            self.step_traces += 1           # trace-count bookkeeping only
             in_specs = (P(), P(ax), P(ax), P(), P(ax)) + (
                 (P(ax),) if ef else ())
             out_specs = (P(ax), P(ax), P(), P()) + (
@@ -422,6 +441,7 @@ class DPTrainer:
         coll, ax = self.cfg.collective, self.ax
 
         def _gather(w):
+            self.gather_traces += 1         # trace-count bookkeeping only
             flat = fused_update.all_gather_flat(w, ax, coll)
             return fused_update.unflatten_tree(flat, meta)
 

@@ -256,6 +256,24 @@ class FaultPlan:
                 mode=str(rng.choice(list(_VALUE_MODES)))))
         return cls(specs, seed=seed)
 
+    @classmethod
+    def sustained(cls, kind: str, site: str, *, start_step: int,
+                  n_steps: int, duration_s: float = 0.25,
+                  mode: str = "nan", fraction: float = 0.01,
+                  seed: int = 0) -> "FaultPlan":
+        """A REGIME SHIFT, not a glitch: one identical spec per step for
+        ``n_steps`` consecutive steps from ``start_step``.  Single specs
+        fire at most once (transient by contract), so a sustained
+        condition — the straggling link whose codec break-even has moved
+        (SparCML), the forced `slowdown@collective` cell that proves the
+        drift observatory's detection→switch path end to end — is
+        modeled as one spec per step, each firing exactly once."""
+        assert n_steps >= 1, n_steps
+        return cls([FaultSpec(kind, site, step=start_step + i,
+                              duration_s=duration_s, mode=mode,
+                              fraction=fraction)
+                    for i in range(n_steps)], seed=seed)
+
     # -- stepping -----------------------------------------------------------
 
     def begin_step(self, step: int) -> None:

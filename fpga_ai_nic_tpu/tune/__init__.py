@@ -4,21 +4,28 @@ measured rates harvested from banked artifacts, picks ``codec``,
 topology per payload — ``CollectiveConfig(codec="auto")`` resolved once
 at trainer construction, static thereafter.  docs/TUNING.md.
 
-  tune.calibration   artifact harvesting + provenance (no jax import)
+  tune.calibration   artifact harvesting + provenance (no jax import);
+                     the `live` tier overlay (apply_live)
   tune.autotune      candidate enumeration, scoring, argmin, config
-                     resolution
+                     resolution; tune_topk (the bounded candidate set)
+  tune.adapt         the drift observatory: live startup calibration,
+                     modeled-vs-measured attribution (tune.drift.*),
+                     CUSUM regime-shift detection, recompile-free plan
+                     switching (AdaptiveTrainer, graftlint J13)
 """
 
-from .calibration import (Calibration, CodecRates,  # noqa: F401
+from .calibration import (Calibration, CodecRates, apply_live,  # noqa: F401
                           load_calibration)
 from .autotune import (Candidate, TunedPlan, enumerate_candidates,  # noqa: F401
                        needs_autotune, payload_class, rescore,
                        resolve_collective, resolve_train_config,
-                       score_candidate, tune)
+                       score_candidate, tune, tune_topk)
+from . import adapt  # noqa: F401
 
 __all__ = [
-    "Calibration", "CodecRates", "load_calibration",
+    "Calibration", "CodecRates", "apply_live", "load_calibration",
     "Candidate", "TunedPlan", "enumerate_candidates", "needs_autotune",
     "payload_class", "rescore", "resolve_collective",
-    "resolve_train_config", "score_candidate", "tune",
+    "resolve_train_config", "score_candidate", "tune", "tune_topk",
+    "adapt",
 ]

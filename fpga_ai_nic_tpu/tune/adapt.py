@@ -1,0 +1,801 @@
+"""Drift observatory + online plan adaptation — ROADMAP item 5 closed.
+
+The autotuner (tune.autotune) resolves codec/depth/bucket/topology ONCE
+at trainer construction from banked artifacts; the obs metrics plane
+measures real per-stage times every step.  Until now those two halves
+never talked at runtime: a job that landed on a mesh whose effective
+link rate disagrees with the roofline — SparCML's codec break-even
+moving with the wire (arXiv:1802.08021), EQuARX's regime-dependent
+quantized-collective wins (arXiv:2506.17615) — kept running the stale
+plan forever.  This module closes the loop, in four pieces:
+
+  live calibration   ``live_calibrate`` runs the existing microbenches
+                     (a timed explicit-ring all-reduce, per-codec
+                     encode/decode stages) ON THE REAL MESH at trainer
+                     startup and overlays the measured rates at the
+                     `live` provenance tier (tune.calibration.apply_live
+                     — above every banked artifact, dryrun-flagged on a
+                     CPU mesh, source strings prefixed ``live:``).
+  attribution        ``Attribution`` joins each step's MEASURED wall
+                     time against the active plan's MODELED stage times
+                     (ring_cost roofline: stream / overhead /
+                     collective) into per-stage residuals, streamed as
+                     ``tune.drift.*`` metrics (MetricsSink + EventStream
+                     counters) and as spans on the Perfetto
+                     "attribution" lane (obs.timeline).  The attribution
+                     assumption is explicit: the warmup-median step time
+                     minus the modeled collective is the compute
+                     baseline, so sustained excess is attributed to the
+                     collective stage — exactly the stage the candidate
+                     plans differ in.
+  detection          ``DriftDetector``: two-sided CUSUM over the EWMA'd
+                     relative residual with hysteresis (post-trip
+                     cooldown) — a spike is absorbed, a SUSTAINED shift
+                     trips.  Pure host-side Python over banked metrics;
+                     nothing here is visible to jax tracing (R2/R4).
+  adaptation         ``AdaptiveTrainer``: the bounded candidate set
+                     (tune.tune_topk — the argmin winner + best
+                     runner-ups from distinct wire-format groups) is
+                     built AND traced up front; on a detected shift the
+                     candidates are re-priced under the measured
+                     effective link rate and the argmin is installed AT
+                     A STEP BOUNDARY.  A switch causes ZERO new traces
+                     (counted via DPTrainer.step_traces/gather_traces,
+                     frozen as graftlint J13 — the J10 counted-trace
+                     discipline applied to training); every switch is an
+                     ``adapt.switch`` event carrying (from_plan,
+                     to_plan, step, residual evidence), banked by
+                     tools/adapt_bench.py and regression-gated by
+                     obs-gate ``adapt.*`` keys.
+
+Switch semantics: candidates sharing the active plan's codec (and hence
+flat layout) switch by PASSING THE STATE THROUGH UNTOUCHED — bitwise
+identity on the gradient path by construction.  A codec switch re-pads
+the masters/moments onto the target layout (fused_update.repad_flat,
+value-exact — the checkpoint-restore discipline) and re-zeros the EF
+residual (the same self-healing rule restore applies); codec switches
+are admissible because every registered codec already rides the
+convergence smoke battery (tests/test_codec.py).  docs/TUNING.md
+carries the full contract.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from .autotune import TunedPlan, needs_autotune, score_candidate, tune_topk
+from .calibration import Calibration, CodecRates, apply_live, \
+    load_calibration
+
+__all__ = [
+    "live_calibrate", "measure_ring_gbps", "Attribution", "DriftDetector",
+    "AdaptiveController", "AdaptiveTrainer", "SwitchDecision",
+]
+
+_EPS_GBPS = 1e-4        # floor for the effective-rate estimate
+
+
+# ---------------------------------------------------------------------------
+# live calibration (the `live` tier — run at trainer startup)
+# ---------------------------------------------------------------------------
+
+def _best_of(fn: Callable[[], None], repeats: int) -> float:
+    """Best-of-N wall time of ``fn`` (which must block) — the standard
+    microbench discipline: the minimum is the least-perturbed sample."""
+    best = float("inf")
+    for _ in range(max(1, repeats)):
+        t0 = time.perf_counter()
+        fn()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def measure_ring_gbps(mesh: Any, axis_name: str = "dp", *,
+                      payload_elems: int = 1 << 16,
+                      repeats: int = 2) -> Tuple[float, float]:
+    """(per-direction GB/s, seconds) of one uncompressed explicit-ring
+    all-reduce of an [payload_elems] f32 payload on the LIVE mesh — the
+    startup upgrade of the single-chip-loopback inter-rate proxy: the
+    same ring program the trainers run, timed where the job actually
+    landed.  Rate = the per-device wire bytes the ring's own accounting
+    declares (ops.ring.wire_bytes_per_device) over the best-of wall
+    time."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+    from ..ops import ring as ring_ops
+
+    n = int(mesh.shape[axis_name])
+    L = payload_elems + (-payload_elems) % max(n, 1)
+    fn = jax.jit(jax.shard_map(
+        lambda x: ring_ops.ring_all_reduce(x, axis_name),
+        mesh=mesh, in_specs=P(axis_name), out_specs=P(axis_name),
+        check_vma=False))
+    x = jnp.ones((L,), jnp.float32)
+    jax.block_until_ready(fn(x))        # compile outside the timed window
+    t = _best_of(lambda: jax.block_until_ready(fn(x)), repeats)
+    wire = ring_ops.wire_bytes_per_device(L, n, None)
+    return (wire / t / 1e9 if t > 0 else 0.0), t
+
+
+def _measure_codec_rates(payload_elems: int, repeats: int,
+                         dryrun: bool) -> Dict[str, Dict[str, CodecRates]]:
+    """Per-registered-codec encode/decode stage rates measured live —
+    the codec half of the startup microbench sweep.  Raw f32 bytes over
+    the best-of stage wall time; both payload classes get the same row
+    (one mesh, one placement — the class split is a banked-artifact
+    refinement this startup probe does not pretend to have)."""
+    import jax
+    import jax.numpy as jnp
+    from ..compress import available_codecs, get_codec
+
+    out: Dict[str, Dict[str, CodecRates]] = {}
+    for name in available_codecs():
+        codec = get_codec(name)
+        L = payload_elems + (-payload_elems) % codec.pad_elems
+        x = jnp.ones((L,), jnp.float32)
+        enc_fn = jax.jit(codec.encode)
+        payload = jax.block_until_ready(enc_fn(x))
+        dec_fn = jax.jit(lambda p: codec.decode(p, L, jnp.float32))
+        jax.block_until_ready(dec_fn(payload))
+        t_enc = _best_of(lambda: jax.block_until_ready(enc_fn(x)), repeats)
+        t_dec = _best_of(lambda: jax.block_until_ready(dec_fn(payload)),
+                         repeats)
+        if t_enc <= 0 or t_dec <= 0:
+            continue                    # never fabricate a rate
+        raw = L * 4
+        rates = CodecRates(raw / t_enc / 1e9, raw / t_dec / 1e9,
+                           "live startup microbench", dryrun)
+        out[name] = {"vmem": rates, "streaming": rates}
+    return out
+
+
+def live_calibrate(mesh: Any, axis_name: str = "dp", *,
+                   base: Optional[Calibration] = None,
+                   payload_elems: int = 1 << 16,
+                   repeats: int = 2,
+                   measure_codecs: bool = True) -> Calibration:
+    """First-step self-calibration: run the startup microbenches on the
+    real mesh and overlay the measured rates onto the banked calibration
+    at the `live` tier.  Provenance is honest by construction
+    (calibration.apply_live): sources read ``live: ...``, ``*_live``
+    flags are set, and a CPU mesh marks every live rate dryrun-class —
+    better than any banked proxy for THIS machine, but still not a TPU
+    measurement."""
+    import jax
+    base = base if base is not None else load_calibration()
+    plat = jax.devices()[0].platform
+    dryrun = plat != "tpu"
+    gbps, t = measure_ring_gbps(mesh, axis_name,
+                                payload_elems=payload_elems,
+                                repeats=repeats)
+    codec_rates = (_measure_codec_rates(payload_elems, repeats, dryrun)
+                   if measure_codecs else None)
+    n = int(mesh.shape[axis_name])
+    return apply_live(
+        base, inter_gbps=gbps if gbps > 0 else None,
+        codec_rates=codec_rates, dryrun=dryrun,
+        source=f"ring all-reduce microbench on the {plat} mesh "
+               f"(n={n}, {payload_elems} elems, best of {repeats})")
+
+
+# ---------------------------------------------------------------------------
+# attribution: modeled vs measured, per stage
+# ---------------------------------------------------------------------------
+
+class Attribution:
+    """Joins measured step wall times against the active plan's modeled
+    stage times into per-stage residuals.
+
+    Model (docs/TUNING.md "The attribution contract"): the ring_cost
+    roofline prices the COLLECTIVE (stream + overhead); compute is not
+    modeled.  The first ``warmup_steps`` observations establish the
+    measured baseline (median), and ``compute_s`` is defined as
+    baseline - modeled collective (floored at 0).  Thereafter each
+    step's excess over the baseline is attributed to the collective
+    stage — the stage the candidate plans differ in, and the one a
+    regime shift on the wire moves.  Every observation yields a record
+    with the raw join (measured, modeled, excess, relative residual,
+    EWMA'd residual) so the ``tune.drift.*`` stream carries facts, not
+    conclusions."""
+
+    def __init__(self, modeled: Dict[str, float], *,
+                 warmup_steps: int = 3, ewma_alpha: float = 0.25) -> None:
+        from ..obs.metrics import Ewma
+        assert warmup_steps >= 1, warmup_steps
+        self.modeled = dict(modeled)        # stream_s/overhead_s/collective_s
+        self.warmup_steps = int(warmup_steps)
+        self._warm: List[float] = []
+        self.baseline_step_s: Optional[float] = None
+        self.compute_s: Optional[float] = None
+        self._alpha = ewma_alpha
+        self.resid_rel = Ewma(ewma_alpha)
+        self.excess_s = Ewma(ewma_alpha)
+        self.n_observed = 0
+
+    def rebase(self, modeled: Optional[Dict[str, float]] = None) -> None:
+        """Forget the baseline (after a plan switch: the new plan has a
+        new modeled collective AND a new steady step time) and re-enter
+        warmup."""
+        from ..obs.metrics import Ewma
+        if modeled is not None:
+            self.modeled = dict(modeled)
+        self._warm = []
+        self.baseline_step_s = None
+        self.compute_s = None
+        self.resid_rel = Ewma(self._alpha)
+        self.excess_s = Ewma(self._alpha)
+
+    @property
+    def warmed_up(self) -> bool:
+        return self.baseline_step_s is not None
+
+    def observe(self, step_s: float) -> Optional[Dict[str, float]]:
+        """One measured step.  Returns the residual record, or None
+        while the baseline is still warming up."""
+        import statistics
+        self.n_observed += 1
+        step_s = float(step_s)
+        if self.baseline_step_s is None:
+            self._warm.append(step_s)
+            if len(self._warm) < self.warmup_steps:
+                return None
+            self.baseline_step_s = float(statistics.median(self._warm))
+            self.compute_s = max(
+                0.0, self.baseline_step_s - self.modeled["collective_s"])
+            return None
+        excess = step_s - self.baseline_step_s
+        rel = excess / max(self.baseline_step_s, 1e-12)
+        return {
+            "step_s": step_s,
+            "baseline_step_s": self.baseline_step_s,
+            "compute_s": self.compute_s or 0.0,
+            "modeled_collective_s": self.modeled["collective_s"],
+            "modeled_stream_s": self.modeled.get("stream_s", 0.0),
+            "modeled_overhead_s": self.modeled.get("overhead_s", 0.0),
+            "collective_excess_s": excess,
+            "measured_collective_s":
+                max(0.0, self.modeled["collective_s"] + excess),
+            "resid_rel": rel,
+            "resid_rel_ewma": self.resid_rel.update(rel),
+            "excess_s_ewma": self.excess_s.update(excess),
+        }
+
+
+# ---------------------------------------------------------------------------
+# detection: CUSUM with hysteresis
+# ---------------------------------------------------------------------------
+
+class DriftDetector:
+    """Two-sided CUSUM over the per-step relative residual: a sustained
+    shift accumulates past ``threshold`` and trips; a one-step spike of
+    magnitude below ``threshold + drift_rel`` cannot.  ``drift_rel`` is
+    the CUSUM slack — residual magnitude below it DRAINS the statistic,
+    so the detector self-resets through calm stretches.  Hysteresis:
+    after a trip the detector disarms for ``cooldown_steps`` (the
+    switch's own re-baselining happens in that window), preventing
+    flapping between two plans that score within noise of each other."""
+
+    def __init__(self, *, drift_rel: float = 0.75,
+                 threshold: float = 3.0,
+                 cooldown_steps: int = 8) -> None:
+        assert drift_rel > 0 and threshold > 0
+        self.drift_rel = float(drift_rel)
+        self.threshold = float(threshold)
+        self.cooldown_steps = int(cooldown_steps)
+        self.pos = 0.0      # sustained SLOWER-than-baseline drift
+        self.neg = 0.0      # sustained FASTER-than-baseline drift
+        self.cooldown = 0
+        self.trips = 0
+
+    def reset(self, *, cooldown: bool = True) -> None:
+        self.pos = self.neg = 0.0
+        if cooldown:
+            self.cooldown = self.cooldown_steps
+
+    def update(self, resid_rel: float) -> Optional[Tuple[str, float]]:
+        """One residual observation -> None, or ("slow"|"fast", stat) on
+        a sustained-shift trip."""
+        if self.cooldown > 0:
+            self.cooldown -= 1
+            return None
+        r = float(resid_rel)
+        self.pos = max(0.0, self.pos + r - self.drift_rel)
+        self.neg = max(0.0, self.neg + (-r) - self.drift_rel)
+        if self.pos >= self.threshold:
+            stat = self.pos
+            direction = "slow"
+        elif self.neg >= self.threshold:
+            stat = self.neg
+            direction = "fast"
+        else:
+            return None
+        self.trips += 1
+        self.reset(cooldown=True)
+        return direction, stat
+
+
+@dataclasses.dataclass(frozen=True)
+class SwitchDecision:
+    """A pending step-boundary plan switch plus its evidence record —
+    exactly what the ``adapt.switch`` event (and ADAPT_BENCH) banks."""
+    target: int
+    evidence: Dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# the controller: attribution + detection + candidate re-pricing
+# ---------------------------------------------------------------------------
+
+class AdaptiveController:
+    """Host-side glue: feeds measured step times through Attribution,
+    the residual through DriftDetector, and on a trip re-prices the
+    candidate set under the measured EFFECTIVE link rate to pick the
+    switch target.  Emits the ``tune.drift.*`` counter stream (ambient
+    MetricsSink + EventStream) and the Perfetto attribution-lane spans.
+
+    Effective-rate estimate: with the baseline's compute fixed, a
+    sustained excess ``e`` means the collective now takes
+    (modeled + e) seconds, so the wire behaves as if the link ran at
+    W_eff = W * modeled / (modeled + e) — the exact monotone knob the
+    scoring model's codec argmin responds to (tune.autotune docstring).
+    """
+
+    def __init__(self, plans: List[TunedPlan], calibration: Calibration,
+                 *, payload_elems: int, n: int, slice_elems: int = 8192,
+                 warmup_steps: int = 3, ewma_alpha: float = 0.25,
+                 drift_rel: float = 0.75, cusum_threshold: float = 3.0,
+                 cooldown_steps: int = 8,
+                 events: Optional[Any] = None) -> None:
+        assert plans, "empty candidate set"
+        self.plans = list(plans)
+        self.calibration = calibration
+        self.payload_elems = int(payload_elems)
+        self.n = int(n)
+        self.slice_elems = int(slice_elems)
+        self.active = 0
+        self.events = events
+        self.attribution = Attribution(
+            self._modeled(0), warmup_steps=warmup_steps,
+            ewma_alpha=ewma_alpha)
+        self.detector = DriftDetector(
+            drift_rel=drift_rel, threshold=cusum_threshold,
+            cooldown_steps=cooldown_steps)
+        self._pending: Optional[SwitchDecision] = None
+        self.last_record: Optional[Dict[str, float]] = None
+
+    def _modeled(self, idx: int) -> Dict[str, float]:
+        p = self.plans[idx]
+        s = score_candidate(self.payload_elems, self.n, p.candidate,
+                            self.calibration, self.slice_elems)
+        return {"collective_s": s["collective_s"],
+                "stream_s": s["stream_s"], "overhead_s": s["overhead_s"]}
+
+    # -- observation --------------------------------------------------------
+
+    def observe(self, step_s: float, *, step: int,
+                t0_perf_ns: Optional[int] = None) -> None:
+        """One measured step (call AFTER the step's outputs are
+        materialized).  Streams the residual record and may arm a
+        pending switch decision for the next step boundary."""
+        rec = self.attribution.observe(step_s)
+        self.last_record = rec
+        if rec is None:
+            return
+        trip = self.detector.update(rec["resid_rel"])
+        # counters emit AFTER the detector absorbs this step's residual,
+        # and a trip emits its CROSSING value (the detector has already
+        # reset): anyone correlating the Perfetto counter track with the
+        # adapt.switch instant must see the statistic reach threshold
+        cusum_pos, cusum_neg = self.detector.pos, self.detector.neg
+        if trip is not None:
+            if trip[0] == "slow":
+                cusum_pos = trip[1]
+            else:
+                cusum_neg = trip[1]
+        self._emit(rec, step, t0_perf_ns, cusum_pos, cusum_neg)
+        if trip is None or self._pending is not None:
+            return
+        direction, stat = trip
+        eff = self.effective_inter_gbps(rec["excess_s_ewma"])
+        target = self.retarget(eff)
+        self._pending = SwitchDecision(target, {
+            "direction": direction,
+            "cusum_stat": round(stat, 4),
+            "resid_rel_ewma": round(rec["resid_rel_ewma"], 4),
+            "collective_excess_s_ewma": round(rec["excess_s_ewma"], 6),
+            "effective_inter_gbps": round(eff, 6),
+            "calibrated_inter_gbps":
+                round(self.calibration.inter_gbps, 6),
+            "detected_step": int(step),
+        })
+
+    def _emit(self, rec: Dict[str, float], step: int,
+              t0_perf_ns: Optional[int], cusum_pos: float,
+              cusum_neg: float) -> None:
+        from ..obs import metrics as obs_metrics
+        drift = {
+            "tune.drift.resid_rel": rec["resid_rel"],
+            "tune.drift.resid_rel_ewma": rec["resid_rel_ewma"],
+            "tune.drift.collective_excess_s": rec["collective_excess_s"],
+            "tune.drift.measured_collective_s":
+                rec["measured_collective_s"],
+            "tune.drift.modeled_collective_s":
+                rec["modeled_collective_s"],
+            "tune.drift.cusum_pos": cusum_pos,
+            "tune.drift.cusum_neg": cusum_neg,
+        }
+        obs_metrics.host_observe(drift)
+        ev = self.events
+        if ev is None:
+            return
+        for name, v in drift.items():
+            ev.counter(name, float(v))
+        # the Perfetto attribution lane: one span per modeled stage plus
+        # the measured step envelope, all anchored at the step's start,
+        # so modeled-vs-measured reads as bar-vs-bar per step
+        t0 = (t0_perf_ns if t0_perf_ns is not None
+              else time.perf_counter_ns() - int(rec["step_s"] * 1e9))
+        common = {"lane": "attribution", "step": int(step),
+                  "plan": self.active}
+        ev.emit("span", "attr.step_measured", t_ns=t0,
+                dur_ns=int(rec["step_s"] * 1e9),
+                attrs=dict(common, stage="measured step",
+                           resid_rel=round(rec["resid_rel"], 4)))
+        ev.emit("span", "attr.compute_baseline", t_ns=t0,
+                dur_ns=int(rec["compute_s"] * 1e9),
+                attrs=dict(common, stage="compute (baseline)"))
+        ev.emit("span", "attr.collective_modeled",
+                t_ns=t0 + int(rec["compute_s"] * 1e9),
+                dur_ns=int(rec["modeled_collective_s"] * 1e9),
+                attrs=dict(common, stage="collective (modeled)"))
+        excess = max(0.0, rec["collective_excess_s"])
+        if excess > 0:
+            ev.emit("span", "attr.collective_excess",
+                    t_ns=t0 + int((rec["compute_s"]
+                                   + rec["modeled_collective_s"]) * 1e9),
+                    dur_ns=int(excess * 1e9),
+                    attrs=dict(common, stage="collective (excess)"))
+
+    # -- re-pricing / switching ---------------------------------------------
+
+    def effective_inter_gbps(self, excess_s: float) -> float:
+        """The measured-regime link rate (docstring formula)."""
+        modeled = self.attribution.modeled["collective_s"]
+        denom = max(modeled + max(excess_s, 0.0), 1e-12)
+        return max(self.calibration.inter_gbps * modeled / denom,
+                   _EPS_GBPS)
+
+    def retarget(self, effective_inter_gbps: float) -> int:
+        """Argmin over the PRE-COMPILED candidate set, re-priced at the
+        effective link rate — never over the full grid: only plans that
+        are already traced are admissible (the J13 contract)."""
+        calib = dataclasses.replace(self.calibration,
+                                    inter_gbps=float(effective_inter_gbps))
+        best, best_s = 0, float("inf")
+        for i, p in enumerate(self.plans):
+            s = score_candidate(self.payload_elems, self.n, p.candidate,
+                                calib, self.slice_elems)["exposed_s"]
+            if s < best_s:
+                best, best_s = i, s
+        return best
+
+    def inject_shift(self, effective_inter_gbps: float,
+                     step: int = -1) -> None:
+        """Deterministic test/lint seam: arm the switch decision the
+        detector WOULD arm at this effective rate, bypassing the timing
+        path.  The chaos `slowdown@collective` cell proves the measured
+        path; this seam lets graftlint J13 and the unit tests exercise
+        the switch mechanics without depending on wall-clock noise."""
+        target = self.retarget(effective_inter_gbps)
+        self._pending = SwitchDecision(target, {
+            "direction": "injected",
+            "effective_inter_gbps": round(float(effective_inter_gbps), 6),
+            "detected_step": int(step),
+        })
+
+    def take_pending(self) -> Optional[SwitchDecision]:
+        dec, self._pending = self._pending, None
+        return dec
+
+    def note_switch(self, target: int) -> None:
+        """Install ``target`` as the active plan: rebase attribution on
+        its modeled stages and put the detector in its post-switch
+        hysteresis window."""
+        self.active = int(target)
+        self.attribution.rebase(self._modeled(self.active))
+        self.detector.reset(cooldown=True)
+
+
+# ---------------------------------------------------------------------------
+# the adaptive trainer
+# ---------------------------------------------------------------------------
+
+class AdaptiveTrainer:
+    """A DPTrainer fleet over one mesh: the top-K tuned plans, each a
+    fully constructed trainer, every jitted program traced up front, the
+    controller deciding which one runs — plan switches at step
+    boundaries with ZERO new traces (graftlint J13).
+
+    Contract:
+      - ``cfg.collective.codec`` must be "auto" (the candidate set IS
+        the autotuner grid) and ``cfg.adapt.enabled`` True.
+      - ``init_state`` resolves live calibration + the candidate set and
+        returns the active trainer's state; the first ``step`` call
+        prewarms every candidate (compile cost is paid ONCE, before the
+        steady state, never at a switch).
+      - ``step(state, batch)`` runs the active plan, feeds the measured
+        wall time to the controller, and applies any pending switch at
+        the NEXT boundary.  Switches between same-codec candidates pass
+        the state through untouched (bitwise on the gradient path);
+        codec switches re-pad masters/moments (value-exact) and re-zero
+        the EF residual.
+      - ``recompiles_across_switch`` counts traces beyond the prewarm
+        baseline — banked 0 by ADAPT_BENCH and held there by obs-gate.
+    """
+
+    def __init__(self, loss_fn: Callable, mesh: Any, cfg: Any,
+                 axis_name: str = "dp", *,
+                 events: Optional[Any] = None,
+                 calibration: Optional[Calibration] = None,
+                 plans: Optional[List[TunedPlan]] = None) -> None:
+        acfg = cfg.adapt
+        if not acfg.enabled:
+            raise ValueError("AdaptiveTrainer needs cfg.adapt.enabled=True "
+                             "(use DPTrainer for a static plan)")
+        if not needs_autotune(cfg.collective):
+            raise ValueError(
+                "AdaptiveTrainer needs collective.codec='auto': the "
+                "candidate set is the autotuner grid — a hand-pinned "
+                "codec leaves nothing to adapt between")
+        self.loss_fn = loss_fn
+        self.mesh = mesh
+        self.cfg = cfg
+        self.ax = axis_name
+        self.n = int(mesh.shape[axis_name])
+        self.events = events
+        self._calib_override = calibration
+        self._plans_override = plans
+        self.plans: List[TunedPlan] = []
+        self.trainers: List[Any] = []
+        self.controller: Optional[AdaptiveController] = None
+        self.calibration: Optional[Calibration] = None
+        self._params_like = None
+        self._prewarmed = False
+        self._trace_baseline = 0
+        self._step_i = 0
+        self.switches = 0
+        self.switch_events: List[Dict[str, Any]] = []
+
+    # -- construction -------------------------------------------------------
+
+    @property
+    def active(self) -> int:
+        assert self.controller is not None, "call init_state first"
+        return self.controller.active
+
+    @property
+    def trainer(self) -> Any:
+        """The active underlying DPTrainer."""
+        return self.trainers[self.active]
+
+    def _resolve(self, params: Any) -> None:
+        import jax
+        import numpy as np
+        from ..parallel.train import DPTrainer
+
+        acfg = self.cfg.adapt
+        calib = self._calib_override
+        if calib is None:
+            calib = load_calibration()
+            if acfg.live_calibration:
+                calib = live_calibrate(self.mesh, self.ax, base=calib)
+        self.calibration = calib
+        leaves = jax.tree_util.tree_leaves(params)
+        total = sum(int(np.prod(l.shape)) if l.shape else 1
+                    for l in leaves)
+        coll = self.cfg.collective
+        topology = "hier" if coll.topology == "hier" else None
+        plans = self._plans_override
+        if plans is None:
+            # depth grid pinned to 1 for the same reason as
+            # tune.resolve_collective: the separate-op ring cannot
+            # consume a launch-ahead depth
+            plans = tune_topk(total, self.n, acfg.n_candidates,
+                              intra_size=coll.intra_size,
+                              topology=topology, calibration=calib,
+                              slice_elems=coll.slice_elems, depths=(1,))
+        self.plans = list(plans)
+        self.trainers = []
+        for plan in self.plans:
+            c = plan.candidate
+            resolved = dataclasses.replace(
+                coll, codec=c.codec, codec_opts=(),
+                pipeline_depth=c.pipeline_depth,
+                bucket_elems=c.bucket_elems, topology=c.topology,
+                intra_size=(c.intra_size if c.topology == "hier"
+                            else coll.intra_size))
+            cfg_i = dataclasses.replace(self.cfg, collective=resolved)
+            self.trainers.append(
+                DPTrainer(self.loss_fn, self.mesh, cfg_i,
+                          axis_name=self.ax))
+        self.controller = AdaptiveController(
+            self.plans, calib, payload_elems=total, n=self.n,
+            slice_elems=coll.slice_elems,
+            warmup_steps=acfg.warmup_steps, ewma_alpha=acfg.ewma_alpha,
+            drift_rel=acfg.drift_rel,
+            cusum_threshold=acfg.cusum_threshold,
+            cooldown_steps=acfg.cooldown_steps, events=self.events)
+
+    def init_state(self, params: Any) -> Any:
+        import jax
+        self._resolve(params)
+        self._params_like = jax.tree_util.tree_map(
+            lambda a: jax.ShapeDtypeStruct(a.shape, a.dtype), params)
+        return self.trainers[0].init_state(params)
+
+    def _ghost_params(self) -> Any:
+        import jax
+        import jax.numpy as jnp
+        return jax.tree_util.tree_map(
+            lambda s: jnp.zeros(s.shape, s.dtype), self._params_like)
+
+    def prewarm(self, batch: Any) -> None:
+        """Trace EVERY candidate's full program set up front: per
+        trainer one init-shaped step, the master->params gather, and —
+        for non-active candidates — one step on a SWITCH-shaped state
+        (built through the exact migration path a real switch takes), so
+        a later switch replays cached programs only.  The trace counts
+        after this call are the J13 baseline; steady state and switches
+        must add zero."""
+        import jax
+        assert self.controller is not None, "call init_state first"
+        src = self.trainers[self.active]
+        ghost = src.init_state(self._ghost_params())
+        ghost, _ = src.step(ghost, batch)
+        jax.block_until_ready(ghost.w_own)
+        src.params_from_master(ghost.w_own)
+        for i, tr in enumerate(self.trainers):
+            if i == self.active:
+                continue
+            mstate = self._migrate(ghost, self.active, i)
+            mstate, _ = tr.step(mstate, batch)
+            jax.block_until_ready(mstate.w_own)
+            tr.params_from_master(mstate.w_own)
+            # and the reverse migration's programs (switching BACK):
+            ghost = self._migrate(mstate, i, self.active)
+            ghost, _ = src.step(ghost, batch)
+            jax.block_until_ready(ghost.w_own)
+        self._prewarmed = True
+        self._trace_baseline = self.total_traces
+
+    @property
+    def total_traces(self) -> int:
+        return sum(t.step_traces + t.gather_traces for t in self.trainers)
+
+    @property
+    def recompiles_across_switch(self) -> int:
+        """Traces beyond the prewarm baseline — 0 is the J13 contract
+        (and the banked obs-gate fact)."""
+        if not self._prewarmed:
+            return 0
+        return self.total_traces - self._trace_baseline
+
+    # -- switching ----------------------------------------------------------
+
+    def _migrate(self, state: Any, src_i: int, tgt_i: int) -> Any:
+        """State from candidate ``src_i``'s layout onto ``tgt_i``'s.
+        Same codec => same flat layout => the state passes through
+        UNTOUCHED (bitwise).  Otherwise the checkpoint-restore
+        discipline: re-pad masters/moments onto the target layout
+        (value-exact), rebuild the replicated params from the landed
+        masters, re-zero the EF residual (bounded self-healing
+        accumulator, exactly like restore)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        src, tgt = self.trainers[src_i], self.trainers[tgt_i]
+        if tgt._meta is None:
+            tgt._ensure_meta(self._params_like)
+        if (src.cfg.collective.codec == tgt.cfg.collective.codec
+                and src._meta.padded_len == tgt._meta.padded_len):
+            return state
+        from ..ops import fused_update
+        from ..parallel.train import TrainState
+        sh = NamedSharding(self.mesh, P(self.ax))
+        w_own = jax.device_put(
+            fused_update.repad_flat(state.w_own, tgt._meta), sh)
+        opt_state = {
+            k: jax.device_put(fused_update.repad_flat(v, tgt._meta), sh)
+            for k, v in state.opt_state.items()}
+        return TrainState(
+            params=tgt.params_from_master(w_own), w_own=w_own,
+            opt_state=opt_state, step=state.step,
+            codec_state=tgt._init_codec_state())
+
+    def _plan_label(self, i: int) -> str:
+        c = self.plans[i].candidate
+        return (f"{i}:{c.codec or 'none'}/{c.topology}"
+                f"/b{c.bucket_elems}")
+
+    def _apply_switch(self, state: Any, dec: SwitchDecision) -> Any:
+        frm, to = self.active, dec.target
+        state = self._migrate(state, frm, to)
+        self.controller.note_switch(to)
+        self.switches += 1
+        event = {
+            "step": self._step_i,
+            "from_plan": self._plan_label(frm),
+            "to_plan": self._plan_label(to),
+            "from": self.plans[frm].describe(),
+            "to": self.plans[to].describe(),
+            "evidence": dict(dec.evidence),
+            "bitwise": (self.plans[frm].candidate.codec
+                        == self.plans[to].candidate.codec),
+        }
+        self.switch_events.append(event)
+        if self.events is not None:
+            self.events.instant(
+                "adapt.switch", lane="attribution", stage="switch",
+                step=self._step_i, from_plan=event["from_plan"],
+                to_plan=event["to_plan"], **dec.evidence)
+        from ..obs.metrics import host_observe
+        host_observe({"adapt.switches": float(self.switches)})
+        return state
+
+    # -- stepping -----------------------------------------------------------
+
+    def step(self, state: Any, batch: Any) -> Tuple[Any, Any]:
+        import jax
+        assert self.controller is not None, "call init_state first"
+        if not self._prewarmed:
+            self.prewarm(batch)
+        dec = self.controller.take_pending()
+        if dec is not None and dec.target != self.active:
+            state = self._apply_switch(state, dec)
+        elif dec is not None:
+            # detected shift, but the re-priced argmin IS the active
+            # plan: rebase so the new regime becomes the baseline
+            self.controller.note_switch(dec.target)
+        t0_ns = time.perf_counter_ns()
+        state, out = self.trainers[self.active].step(state, batch)
+        jax.block_until_ready((state, out))
+        step_s = (time.perf_counter_ns() - t0_ns) / 1e9
+        self.controller.observe(step_s, step=self._step_i,
+                                t0_perf_ns=t0_ns)
+        self._step_i += 1
+        return state, out
+
+    # -- passthroughs / telemetry -------------------------------------------
+
+    @property
+    def batch_spec(self) -> Any:
+        return self.trainers[0].batch_spec if self.trainers else None
+
+    def shard_batch(self, batch: Any) -> Any:
+        return self.trainers[self.active].shard_batch(batch)
+
+    def trace_counts(self) -> Dict[str, int]:
+        """Per-candidate STEP trace counts — what graftlint J13 and the
+        ADAPT_BENCH rows read: exactly 1 each after prewarm, and still 1
+        each after any number of switches (gather traces ride
+        ``total_traces``/``recompiles_across_switch``)."""
+        return {self._plan_label(i): t.step_traces
+                for i, t in enumerate(self.trainers)}
+
+    def obs_static_metrics(self) -> Dict[str, Any]:
+        """The active trainer's statics plus the adaptation plane's own
+        banked facts: candidate set, calibration provenance (incl. the
+        live tier), switch/trace accounting."""
+        d = self.trainer.obs_static_metrics()
+        d["adapt"] = {
+            "n_candidates": len(self.plans),
+            "active": self.active,
+            "candidates": [p.describe() for p in self.plans],
+            "calibration": (self.calibration.describe()
+                            if self.calibration else None),
+            "switches": self.switches,
+            "recompiles_across_switch": self.recompiles_across_switch,
+        }
+        return d

@@ -266,28 +266,62 @@ def tune(payload_elems: int, n: int, *, intra_size: int = 0,
          depths: Optional[Sequence[int]] = None) -> TunedPlan:
     """Argmin over the candidate grid — deterministic: candidates are
     scored in sorted order and ties break on the sort key, so the same
-    calibration artifacts always produce the same plan."""
+    calibration artifacts always produce the same plan.  Exactly
+    ``tune_topk(..., k=1)[0]`` (the global argmin IS the best plan of
+    its wire-format group) — one construction path, so a field added to
+    TunedPlan can never drift between the two."""
+    return tune_topk(payload_elems, n, 1, intra_size=intra_size,
+                     topology=topology, codecs=codecs,
+                     calibration=calibration, slice_elems=slice_elems,
+                     depths=depths)[0]
+
+
+def tune_topk(payload_elems: int, n: int, k: int = 3, *,
+              intra_size: int = 0, topology: Optional[str] = None,
+              codecs: Optional[Sequence[Optional[str]]] = None,
+              calibration: Optional[Calibration] = None,
+              slice_elems: int = 8192,
+              depths: Optional[Sequence[int]] = None) -> List[TunedPlan]:
+    """The argmin winner plus the best runner-up plans from DISTINCT
+    (codec, topology, intra_size) groups of the same grid — the bounded
+    pre-compiled candidate set of the online adaptation plane
+    (tune.adapt): when the measured regime shifts (the SparCML
+    break-even moving with the effective link rate), the detector
+    re-prices exactly these candidates and switches to one that is
+    ALREADY traced.  Grouping by wire format guarantees the set spans
+    genuinely different regimes instead of k bucket-size variants of one
+    plan; within a group the best-scoring schedule wins.  Element 0 is
+    always identical to ``tune(...)`` (same grid, same tie-breaks), and
+    the list is deterministic for the same calibration."""
+    assert k >= 1, k
     calib = calibration if calibration is not None else load_calibration()
     cands = enumerate_candidates(n, intra_size, codecs, topology, depths)
-    best: Optional[Tuple[float, Candidate, Dict[str, Any]]] = None
+    best_by_group: Dict[Tuple[str, str, int],
+                        Tuple[float, Candidate, Dict[str, Any]]] = {}
     for cand in cands:
         s = score_candidate(payload_elems, n, cand, calib, slice_elems)
-        if best is None or s["exposed_s"] < best[0]:
-            best = (s["exposed_s"], cand, s)
-    assert best is not None
-    _, cand, s = best
-    return TunedPlan(
-        candidate=cand,
-        modeled_exposed_s=s["exposed_s"],
-        modeled_collective_s=s["collective_s"],
-        wire_bytes_per_device=s["wire_bytes_per_device"],
-        raw_bytes_per_device=s["raw_bytes_per_device"],
-        payload_elems=int(payload_elems), n=int(n),
-        payload_class=s["payload_class"],
-        calibrated=calib.calibrated,
-        dryrun=calib.dryrun,
-        n_candidates=len(cands),
-        calibration=calib.describe())
+        group = (cand.codec or "", cand.topology, cand.intra_size)
+        cur = best_by_group.get(group)
+        if cur is None or s["exposed_s"] < cur[0]:
+            best_by_group[group] = (s["exposed_s"], cand, s)
+    # deterministic: score ascending, candidate sort key breaking ties
+    ranked = sorted(best_by_group.values(),
+                    key=lambda t: (t[0], t[1].key()))
+    out = []
+    for score, cand, s in ranked[:k]:
+        out.append(TunedPlan(
+            candidate=cand,
+            modeled_exposed_s=s["exposed_s"],
+            modeled_collective_s=s["collective_s"],
+            wire_bytes_per_device=s["wire_bytes_per_device"],
+            raw_bytes_per_device=s["raw_bytes_per_device"],
+            payload_elems=int(payload_elems), n=int(n),
+            payload_class=s["payload_class"],
+            calibrated=calib.calibrated,
+            dryrun=calib.dryrun,
+            n_candidates=len(cands),
+            calibration=calib.describe()))
+    return out
 
 
 def rescore(plan: TunedPlan, payload_elems: int,

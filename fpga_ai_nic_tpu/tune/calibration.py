@@ -16,6 +16,14 @@ collective cost model is parameterized by:
                  (flagged as a loopback proxy), else the CPU-mesh sweep
                  (flagged dryrun-class).
 
+Source ranking (highest wins): **live** startup microbench on the mesh
+the job actually landed on (``apply_live`` — measured by
+``tune.adapt.live_calibrate`` at trainer construction, so it outranks
+every banked artifact including a real multi-chip sweep: the banked
+number describes SOME machine, the live number describes THIS one) >
+banked multi-chip ICI sweep > single-chip fused loopback proxy >
+CPU-mesh sweep (dryrun-class) > documented fallback constant.
+
 Honesty rules (the provenance record every consumer banks alongside the
 plan):
 
@@ -71,11 +79,14 @@ class ArtifactRecord:
 
 @dataclass(frozen=True)
 class CodecRates:
-    """Measured stage rates of one codec at one payload class."""
+    """Measured stage rates of one codec at one payload class.
+    ``live`` marks rows measured by the startup mesh microbench
+    (apply_live stamps it — never trust a caller's string alone)."""
     encode_gbps: float
     decode_gbps: float
     source: str
     dryrun: bool
+    live: bool = False
 
 
 @dataclass(frozen=True)
@@ -91,10 +102,16 @@ class Calibration:
     inter_calibrated: bool = False
     inter_source: str = "fallback constant (FALLBACK_INTER_GBPS)"
     inter_dryrun: bool = False
+    # True when the rate came from the `live` tier (a startup microbench
+    # on THIS mesh, apply_live) rather than a banked artifact — the
+    # provenance bit consumers bank so a plan scored on live rates can
+    # never masquerade as artifact-derived (or vice versa)
+    inter_live: bool = False
     intra_gbps: float = FALLBACK_INTRA_GBPS
     intra_calibrated: bool = False
     intra_source: str = "fallback constant (FALLBACK_INTRA_GBPS)"
     intra_dryrun: bool = False
+    intra_live: bool = False
     dispatch_s: float = DEFAULT_DISPATCH_S
     rtt_s: float = DEFAULT_RTT_S
     artifacts: Tuple[ArtifactRecord, ...] = ()
@@ -139,16 +156,19 @@ class Calibration:
             "inter_gbps": round(self.inter_gbps, 3),
             "inter_calibrated": self.inter_calibrated,
             "inter_source": self.inter_source,
+            "inter_live": self.inter_live,
             "intra_gbps": round(self.intra_gbps, 3),
             "intra_calibrated": self.intra_calibrated,
             "intra_source": self.intra_source,
             "intra_dryrun": self.intra_dryrun,
+            "intra_live": self.intra_live,
             "dispatch_s": self.dispatch_s,
             "rtt_s": self.rtt_s,
             "codec_rates": {
                 name: {klass: {"encode_gbps": r.encode_gbps,
                                "decode_gbps": r.decode_gbps,
-                               "source": r.source, "dryrun": r.dryrun}
+                               "source": r.source, "dryrun": r.dryrun,
+                               "live": r.live}
                        for klass, r in by_class.items()}
                 for name, by_class in sorted(self.codec_rates.items())},
             "artifacts": [a.describe() for a in self.artifacts],
@@ -307,3 +327,84 @@ def load_calibration(root: Optional[str] = None,
         intra_gbps=intra[0], intra_calibrated=intra[1],
         intra_source=intra[2], intra_dryrun=intra[3],
         artifacts=tuple(records))
+
+
+def fixture_calibration(inter_gbps: float = 50.0,
+                        codec_gbps: float = 8.0,
+                        topk_gbps: Optional[float] = None) -> Calibration:
+    """The deterministic FIXTURE regime shared by the J13 lint surface
+    (lint/jaxpr_sweep), the adaptive chaos cells (tools/chaos_bench /
+    adapt_bench) and the unit tests — ONE definition, because the
+    premise is load-bearing: at the default fast wire the argmin's plan
+    0 is the uncompressed flat ring, so a forced regime shift has a
+    cheaper wire format to move to.  Retuning it in one consumer but
+    not another would silently make the other's switch scenario vacuous
+    (or flip its plan identity).  Pure data, zero banked-artifact
+    dependence."""
+    tk = codec_gbps if topk_gbps is None else topk_gbps
+    rates = {
+        name: {klass: CodecRates(r, r, "fixture", False)
+               for klass in ("vmem", "streaming")}
+        for name, r in (("bfp", codec_gbps), ("int8", codec_gbps),
+                        ("topk", tk))}
+    return Calibration(
+        codec_rates=rates, inter_gbps=inter_gbps, inter_calibrated=True,
+        inter_source="fixture", intra_gbps=40.0,
+        artifacts=(ArtifactRecord("fixture.json", "f" * 40, "tpu",
+                                  False),))
+
+
+# ---------------------------------------------------------------------------
+# the `live` tier (startup mesh microbenches — tune.adapt.live_calibrate)
+# ---------------------------------------------------------------------------
+
+def apply_live(base: Calibration, *,
+               inter_gbps: Optional[float] = None,
+               intra_gbps: Optional[float] = None,
+               codec_rates: Optional[Mapping[str, Mapping[str,
+                                                          "CodecRates"]]]
+               = None,
+               dryrun: bool = False,
+               source: str = "startup mesh microbench") -> Calibration:
+    """Overlay LIVE-measured rates onto a banked calibration — the top
+    of the source ranking (module docstring): a rate measured on the
+    mesh the job actually landed on outranks every banked artifact,
+    because the banked number describes some machine and the live one
+    describes THIS one.
+
+    Honest provenance rules (the same contract as the banked tiers):
+    every overridden component's source string is prefixed ``live:`` and
+    its ``*_live`` flag set, ``dryrun`` must reflect the platform the
+    microbench ran on (a CPU-mesh live rate is still dryrun-class —
+    better than any constant, but verdicts built on it carry the flag),
+    and components with no live measurement keep their banked provenance
+    untouched.  Pure arithmetic: no jax import (the measuring half lives
+    in ``tune.adapt.live_calibrate``)."""
+    import dataclasses
+    kw: Dict[str, Any] = {}
+    tag = f"live: {source}" + (" (dryrun-class CPU mesh)" if dryrun else "")
+    if inter_gbps is not None and inter_gbps > 0:
+        kw.update(inter_gbps=float(inter_gbps), inter_calibrated=True,
+                  inter_source=tag, inter_dryrun=bool(dryrun),
+                  inter_live=True)
+    if intra_gbps is not None and intra_gbps > 0:
+        kw.update(intra_gbps=float(intra_gbps), intra_calibrated=True,
+                  intra_source=tag, intra_dryrun=bool(dryrun),
+                  intra_live=True)
+    if codec_rates:
+        merged: Dict[str, Dict[str, CodecRates]] = {
+            name: dict(by_class)
+            for name, by_class in base.codec_rates.items()}
+        for name, by_class in codec_rates.items():
+            for klass, rates in by_class.items():
+                # stamp the live provenance HERE, never trusting the
+                # caller's string: the overridden row must be
+                # distinguishable from an artifact-harvested one in
+                # every banked describe(), same contract as inter/intra
+                src = rates.source if rates.source.startswith("live:") \
+                    else f"live: {rates.source}"
+                merged.setdefault(name, {})[klass] = CodecRates(
+                    rates.encode_gbps, rates.decode_gbps, src,
+                    bool(dryrun), live=True)
+        kw["codec_rates"] = merged
+    return dataclasses.replace(base, **kw) if kw else base

@@ -345,6 +345,60 @@ class OptimizerConfig:
 
 
 @dataclass(frozen=True)
+class AdaptConfig:
+    """Online plan adaptation (fpga_ai_nic_tpu.tune.adapt): the drift
+    observatory that closes the autotune loop WHILE the job runs.
+
+    The autotuner (codec="auto") resolves a plan once at construction
+    from banked/live-calibrated rates; this config arms the runtime half:
+    a bounded candidate set (the top ``n_candidates`` runner-up plans
+    from the same argmin grid) is built AND traced up front, each step's
+    measured wall time is joined against the active plan's modeled stage
+    times into drift residuals (streamed as ``tune.drift.*`` metrics and
+    an "attribution" Perfetto lane), and a host-side CUSUM detector with
+    hysteresis swaps to a pre-compiled alternate plan at a step boundary
+    when the modeled-vs-measured regime shifts for good (SparCML's
+    break-even moving with the effective link rate).  Everything here is
+    HOST-side and trace-time static: detection reads banked metrics,
+    never runs inside jit (R2/R4), and a switch causes ZERO new traces
+    (graftlint J13).  docs/TUNING.md carries the full contract."""
+
+    enabled: bool = False
+    # run the startup mesh microbenches (tune.adapt.live_calibrate) and
+    # feed the measured rates into plan resolution at the `live`
+    # provenance tier (above every banked artifact; dryrun-flagged on a
+    # CPU mesh — the honesty rules of tune.calibration apply unchanged)
+    live_calibration: bool = True
+    # bounded pre-compiled candidate set: the argmin winner plus the
+    # best runner-up plans from distinct (codec, topology) groups of the
+    # same grid, every one traced at construction
+    n_candidates: int = 3
+    # drift plane: EWMA smoothing of the per-step residuals, the
+    # per-step relative excess considered drift (CUSUM slack), the
+    # accumulated-drift trip threshold, warmup steps spent establishing
+    # the measured step-time baseline (re-entered after every switch),
+    # and the post-trip hysteresis window during which the detector
+    # stays disarmed (no flapping)
+    ewma_alpha: float = 0.25
+    drift_rel: float = 0.75
+    cusum_threshold: float = 3.0
+    warmup_steps: int = 3
+    cooldown_steps: int = 8
+
+    def __post_init__(self) -> None:
+        assert 0.0 < self.ewma_alpha <= 1.0, self.ewma_alpha
+        assert self.drift_rel > 0, self.drift_rel
+        assert self.cusum_threshold > 0, self.cusum_threshold
+        assert self.warmup_steps >= 1, self.warmup_steps
+        assert self.cooldown_steps >= 0, self.cooldown_steps
+        if self.enabled and self.n_candidates < 2:
+            raise ValueError(
+                "AdaptConfig.enabled needs n_candidates >= 2: a "
+                "candidate set of one has nothing to switch to — the "
+                "detector would observe drift it can never act on")
+
+
+@dataclass(frozen=True)
 class MeshConfig:
     """Logical device mesh. The reference supports only a 1-D ring of FPGAs
     (data parallelism, sw/setup_route.sh); we generalize to the full
@@ -401,6 +455,10 @@ class TrainConfig:
     # (the default) compiles the step to HLO bit-identical to a build
     # with no obs plumbing at all (tests/test_obs.py asserts this).
     obs_metrics: bool = False
+    # online plan adaptation (tune.adapt.AdaptiveTrainer): live startup
+    # calibration + modeled-vs-measured drift attribution + recompile-
+    # free plan switching.  Host-side and off by default; see AdaptConfig.
+    adapt: AdaptConfig = field(default_factory=AdaptConfig)
 
     @property
     def per_device_batch(self) -> int:

@@ -1,0 +1,50 @@
+"""J13 bad fixture: a candidate "set" that retraces on switch.
+
+The tempting-but-wrong way to do online plan adaptation — "why compile
+plans we may never run?" — builds the target plan's jitted step LAZILY
+at switch time, and (worse) rebuilds it on every switch because the jit
+wrapper is a fresh closure each time.  Every switch then pays a compile
+spike exactly when the job is already degraded by the regime shift that
+triggered it.  The counted-trace check must flag it (the real
+AdaptiveTrainer traces every candidate up front at construction and a
+switch replays cached programs only)."""
+
+
+def build():
+    def run():
+        import jax.numpy as jnp
+
+        from fpga_ai_nic_tpu.serve.engine import counted_jit
+
+        traces = {"plan0": 0, "plan1": 0}
+
+        def make_step(label, scale):
+            step, n = counted_jit(lambda x: (x * scale).sum())
+
+            def counted(x):
+                before = n()
+                out = step(x)
+                traces[label] += n() - before
+                return out
+            return counted
+
+        x = jnp.arange(8.0)
+        # plan0 compiled up front (so far so good)...
+        step0 = make_step("plan0", 2.0)
+        step0(x)
+        # ...but plan1 is built AT SWITCH TIME, and REBUILT on the
+        # second switch: a fresh jit closure per switch, each one a
+        # genuine new trace
+        switches = 0
+        for _ in range(2):
+            step1 = make_step("plan1", 3.0)     # the lazy anti-pattern
+            step1(x)
+            switches += 1
+            step0(x)
+        return {
+            "candidates": dict(traces),
+            "switches": switches,
+            "recompiles_across_switch": traces["plan1"],
+            "_exercised": 1,
+        }
+    return run

@@ -405,15 +405,17 @@ class TestJ7GradScale:
         # one subprocess pays for the full sweep, so ALL value-level
         # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
         # accounting), J9 (hierarchical hop accounting), J10 (serve
-        # recompile-freedom), J11 (KV-handoff wire accounting) and J12
-        # (wire-integrity coverage) must each fire and fail the CLI
+        # recompile-freedom), J11 (KV-handoff wire accounting), J12
+        # (wire-integrity coverage) and J13 (adaptive counted traces)
+        # must each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
                    GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
                    GRAFTLINT_J9_FIXTURE=TestJ9Hier.FIXTURE,
                    GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE,
                    GRAFTLINT_J11_FIXTURE=TestJ11Handoff.FIXTURE,
-                   GRAFTLINT_J12_FIXTURE=TestJ12Integrity.FIXTURE)
+                   GRAFTLINT_J12_FIXTURE=TestJ12Integrity.FIXTURE,
+                   GRAFTLINT_J13_FIXTURE=TestJ13AdaptiveTraces.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -425,6 +427,7 @@ class TestJ7GradScale:
         assert "J10:" in proc.stdout
         assert "J11:" in proc.stdout
         assert "J12:" in proc.stdout
+        assert "J13:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -749,4 +752,72 @@ class TestJ12Integrity:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j12()
         assert len(fs) == 1 and fs[0].code == "J12"
+        assert "boom" in fs[0].message
+
+
+class TestJ13AdaptiveTraces:
+    """J13: the adaptive-training candidate set (tune.adapt) must be
+    traced up front at construction, and a runtime plan switch must
+    cause ZERO new traces — the J10 counted-trace discipline applied to
+    training (docs/LINT.md, docs/TUNING.md)."""
+
+    FIXTURE = os.path.join(FIXTURES, "j13_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j13
+        findings = run_j13()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_bad_fixture_fires_with_trace_counts(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j13_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_adaptive_traces
+        fs = check_adaptive_traces("j13_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J13"}
+        # both anti-patterns must be named: the lazily-rebuilt plan's
+        # retrace count and the nonzero across-switch recompiles
+        assert any("traced 2x" in f.message for f in fs), fs
+        assert any("ZERO new traces" in f.message for f in fs), fs
+
+    def test_never_traced_candidate_is_a_finding(self):
+        """A candidate that was never pre-traced would pay its compile
+        at the switch — J13 must name it even before any switch."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_adaptive_traces
+
+        def build():
+            return lambda: {"candidates": {"plan0": 1, "plan1": 0},
+                            "switches": 1,
+                            "recompiles_across_switch": 0,
+                            "_exercised": 1}
+
+        fs = check_adaptive_traces("lazy", build)
+        assert len(fs) == 1 and fs[0].code == "J13"
+        assert "NEVER traced" in fs[0].message
+
+    def test_vacuous_run_is_a_finding(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_adaptive_traces
+
+        def build():
+            return lambda: {"candidates": {"plan0": 1},
+                            "switches": 0,
+                            "recompiles_across_switch": 0,
+                            "_exercised": 0}
+
+        fs = check_adaptive_traces("lazy", build)
+        assert len(fs) == 1 and fs[0].code == "J13"
+        assert "vacuous" in fs[0].message
+
+    def test_surface_failure_lands_as_j13_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j13_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j13()
+        assert len(fs) == 1 and fs[0].code == "J13"
         assert "boom" in fs[0].message

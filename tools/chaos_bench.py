@@ -836,6 +836,193 @@ def run_integrity_cells(ecfg: ElasticConfig, n_steps: int,
     return cells
 
 
+# ---------------------------------------------------------------------------
+# adaptive-tuning cells: the forced regime shift (docs/TUNING.md "Online
+# plan adaptation").  A SUSTAINED slowdown@collective — one spec per
+# step, FaultPlan.sustained — is the chaos stand-in for the wire whose
+# codec break-even moved (SparCML): the drift observatory must DETECT it
+# from measured-vs-modeled step residuals and SWITCH to a pre-compiled
+# alternate plan at a step boundary with zero new traces (graftlint
+# J13), while a fault-free run must never switch (the false-positive
+# guard).  Detection rides real wall-clock measurement; only the
+# calibration priors are fixture-pinned (fast wire -> plan 0 is the
+# uncompressed ring, so the shift has a cheaper wire format to move to)
+# — deterministic plan IDENTITY without faking the measured path.
+# ---------------------------------------------------------------------------
+
+ADAPT_STEPS = 12
+ADAPT_FAULT_STEP = 5
+ADAPT_SLOW_S = 0.25
+
+
+class AdaptRig:
+    """One AdaptiveTrainer workload per cell (controller/detector state
+    is per-run, so cells never share a trainer).  The fixture regime is
+    the SHARED one (tune.calibration.fixture_calibration — also the J13
+    lint surface's), and ``self.cfg`` is the single source the cells AND
+    the ADAPT_BENCH meta block derive from."""
+
+    def __init__(self):
+        from fpga_ai_nic_tpu.tune.calibration import fixture_calibration
+        from fpga_ai_nic_tpu.utils.config import AdaptConfig
+        self.calib = fixture_calibration()
+        self.cfg = TrainConfig(
+            iters=ADAPT_STEPS, global_batch=64, mesh=MeshConfig(dp=8),
+            collective=CollectiveConfig(impl="ring", codec="auto"),
+            optimizer=OptimizerConfig(),
+            # slightly wider slack/threshold than the defaults: the
+            # oversubscribed CPU mesh jitters run to run, and the
+            # steady cell's zero-switch verdict must hold against that
+            # noise while the 0.25s sustained slowdown (r >> 10) still
+            # trips on its first post-warmup observation
+            adapt=AdaptConfig(enabled=True, n_candidates=3,
+                              live_calibration=False, warmup_steps=3,
+                              drift_rel=1.0, cusum_threshold=4.0,
+                              cooldown_steps=8))
+        self.params0 = jax.device_get(mlp.init(jax.random.PRNGKey(0),
+                                               MCFG))
+        self.host_batch = _data()
+
+    def plans_meta(self) -> dict:
+        """The candidate set + calibration provenance the bench banks —
+        derived through the same tuner call the rig's trainers resolve
+        with, from the rig's OWN cfg (n_candidates, mesh width), so it
+        can never diverge from the rows it annotates."""
+        from fpga_ai_nic_tpu import tune
+        total = sum(int(np.prod(np.shape(l))) or 1
+                    for l in jax.tree_util.tree_leaves(self.params0))
+        plans = tune.tune_topk(
+            total, self.cfg.mesh.dp, self.cfg.adapt.n_candidates,
+            calibration=self.calib,
+            slice_elems=self.cfg.collective.slice_elems, depths=(1,))
+        return {"n_candidates": len(plans),
+                "candidates": [p.describe() for p in plans],
+                "calibration": self.calib.describe()}
+
+    def build(self):
+        from fpga_ai_nic_tpu.obs import EventStream
+        from fpga_ai_nic_tpu.tune import adapt as adapt_lib
+        events = EventStream()
+        at = adapt_lib.AdaptiveTrainer(
+            _loss_fn, make_mesh(self.cfg.mesh), self.cfg, events=events,
+            calibration=self.calib)
+        state = at.init_state(
+            jax.tree_util.tree_map(jnp.asarray, self.params0))
+        batch = at.shard_batch(self.host_batch)
+        at.prewarm(batch)
+        return at, state, batch, events
+
+
+def _run_adapt(rig: AdaptRig, plan) -> dict:
+    at, state, batch, events = rig.build()
+    with chaos.activate(plan):          # activate(None) is a clean no-op
+        for i in range(ADAPT_STEPS):
+            if plan is not None:
+                plan.begin_step(i)
+            state, loss = at.step(state, batch)
+    return {"at": at, "loss": float(loss), "events": events,
+            "final_step": int(state.step)}
+
+
+def run_adapt_shift_cell(rig: AdaptRig) -> dict:
+    """THE end-to-end adaptation proof: sustained slowdown@collective ->
+    detected from measured-vs-modeled residuals -> step-boundary switch
+    to a pre-compiled plan, recompiles_across_switch == 0."""
+    t0 = time.time()
+    plan = chaos.FaultPlan.sustained(
+        "slowdown", "collective", start_step=ADAPT_FAULT_STEP,
+        n_steps=ADAPT_STEPS - ADAPT_FAULT_STEP, duration_s=ADAPT_SLOW_S,
+        seed=SEED)
+    cell = {"kind": "slowdown-shift", "site": "collective",
+            "wire": "adapt", "steps": ADAPT_STEPS,
+            "fault_start_step": ADAPT_FAULT_STEP}
+    try:
+        r = _run_adapt(rig, plan)
+    except Exception as err:  # noqa: BLE001 — the cell verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    at = r["at"]
+    switch = at.switch_events[0] if at.switch_events else None
+    switch_instants = [e for e in r["events"].snapshot()
+                       if e["name"] == "adapt.switch"]
+    detected = switch is not None
+    cell["recovered"] = (
+        detected and at.switches == 1
+        and r["final_step"] == ADAPT_STEPS
+        and switch["step"] > ADAPT_FAULT_STEP
+        and at.recompiles_across_switch == 0
+        and len(plan.fired) >= 1
+        # the switch event is a first-class obs fact, with evidence
+        and len(switch_instants) == 1
+        and switch_instants[0]["attrs"]["from_plan"]
+        != switch_instants[0]["attrs"]["to_plan"])
+    cell.update(
+        ok=bool(cell["recovered"] and np.isfinite(r["loss"])),
+        detected=int(detected),
+        switches=at.switches,
+        switch_step=switch["step"] if switch else None,
+        detection_latency_steps=(switch["step"] - ADAPT_FAULT_STEP
+                                 if switch else None),
+        from_plan=switch["from_plan"] if switch else None,
+        to_plan=switch["to_plan"] if switch else None,
+        evidence=switch["evidence"] if switch else None,
+        recompiles_across_switch=at.recompiles_across_switch,
+        trace_counts=at.trace_counts(),
+        n_candidates=len(at.plans),
+        final_loss=round(r["loss"], 6),
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_adapt_steady_cell(rig: AdaptRig) -> dict:
+    """The false-positive guard: a fault-free run must end with ZERO
+    switches — the detector's hysteresis/slack absorbing CPU noise."""
+    t0 = time.time()
+    cell = {"kind": "steady", "site": None, "wire": "adapt",
+            "steps": ADAPT_STEPS}
+    try:
+        r = _run_adapt(rig, None)
+    except Exception as err:  # noqa: BLE001 — the cell verdict IS the point
+        cell.update(ok=False, error=repr(err),
+                    wall_s=round(time.time() - t0, 2))
+        return cell
+    at = r["at"]
+    cell.update(
+        ok=bool(at.switches == 0 and at.recompiles_across_switch == 0
+                and r["final_step"] == ADAPT_STEPS
+                and np.isfinite(r["loss"])),
+        detected=0, switches=at.switches, false_switches=at.switches,
+        recompiles_across_switch=at.recompiles_across_switch,
+        trace_counts=at.trace_counts(),
+        n_candidates=len(at.plans),
+        final_loss=round(r["loss"], 6),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_adapt_cells(rig: "AdaptRig" = None) -> list:
+    rig = rig if rig is not None else AdaptRig()
+    cells = []
+    cell = run_adapt_steady_cell(rig)
+    log(f"cell adapt steady            : "
+        f"{'ok' if cell['ok'] else 'FAILED':9s} "
+        f"switches={cell.get('switches')} "
+        f"recompiles={cell.get('recompiles_across_switch')} "
+        f"({cell['wall_s']:.1f}s)")
+    cells.append(cell)
+    cell = run_adapt_shift_cell(rig)
+    log(f"cell adapt slowdown-shift    : "
+        f"{'detected' if cell.get('detected') else 'FAILED':9s} "
+        f"switch {cell.get('from_plan')} -> {cell.get('to_plan')} "
+        f"@ step {cell.get('switch_step')} "
+        f"recompiles={cell.get('recompiles_across_switch')} "
+        f"({cell['wall_s']:.1f}s)")
+    cells.append(cell)
+    return cells
+
+
 RESHARD_CODECS = (None, "bfp", "topk", "int8")
 
 
@@ -971,6 +1158,14 @@ def main() -> int:
                          "exact-tier trips + token-/bit-exact recovery; "
                          "the CI-sized gate — the full matrix also "
                          "includes them)")
+    ap.add_argument("--adapt-only", action="store_true",
+                    help="run ONLY the adaptive-tuning cells (sustained "
+                         "slowdown@collective regime shift detected "
+                         "from measured-vs-modeled residuals -> "
+                         "step-boundary switch to a pre-compiled plan "
+                         "with zero new traces, plus the zero-switch "
+                         "steady guard; the CI-sized gate — the full "
+                         "matrix also includes them)")
     ap.add_argument("--reshard-bench", action="store_true",
                     help="run the trainer x codec reshard-vs-restore MTTR "
                          "matrix instead of the fault matrix (banked as "
@@ -1005,8 +1200,33 @@ def main() -> int:
     # whose cells never fire wirebit through the wire tap — skip it and
     # keep their banked MTTR rows tap-free (comparable with the
     # pre-tap rounds' artifacts)
-    if not (args.serve_only or args.fleet_only or args.reshard_bench):
+    if not (args.serve_only or args.fleet_only or args.reshard_bench
+            or args.adapt_only):
         chaos.install_wire_tap()
+
+    if args.adapt_only:
+        adapt_cells = run_adapt_cells()
+        result = {
+            "bench": "chaos_adapt",
+            "fast": args.fast,
+            "platform": plat,
+            "n_devices": len(jax.devices()),
+            "dryrun": plat != "tpu",
+            "adapt_cells": adapt_cells,
+            "ok": all(c["ok"] for c in adapt_cells),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+        if not args.no_artifact:
+            save_artifact("chaos_adapt", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "adapt_cells"} |
+                         {"adapt_cells_ok":
+                          sum(c["ok"] for c in adapt_cells),
+                          "adapt_cells_total": len(adapt_cells)},
+                         indent=1))
+        return 0 if result["ok"] else 1
 
     if args.integrity_only:
         integrity_cells = run_integrity_cells(ecfg, n_steps, timeout_s)
@@ -1139,6 +1359,9 @@ def main() -> int:
     integrity_cells = run_integrity_cells(
         ecfg, n_steps, timeout_s, wire_rigs=wire_rig_map,
         serve_rig=serve_rig, fleet_rig=fleet_rig)
+    # the adaptive-tuning battery: forced regime shift -> detection ->
+    # recompile-free plan switch, plus the zero-switch steady guard
+    adapt_cells = run_adapt_cells()
 
     result = {
         "bench": "chaos_matrix",
@@ -1151,18 +1374,21 @@ def main() -> int:
                    "serve_site": "serve.step",
                    "fleet_sites": ["fleet.membership", "serve.handoff"],
                    "integrity_sites": ["collective", "reshard.transfer",
-                                       "serve.step", "serve.handoff"]},
+                                       "serve.step", "serve.handoff"],
+                   "adapt_cells": ["steady", "slowdown-shift"]},
         "cells": cells,
         "shrink_cells": shrink_cells,
         "serve_cells": serve_cells,
         "fleet_cells": fleet_cells,
         "integrity_cells": integrity_cells,
+        "adapt_cells": adapt_cells,
         "soak": soaks,
         "ok": (all(c["ok"] for c in cells)
                and all(c["ok"] for c in shrink_cells)
                and all(c["ok"] for c in serve_cells)
                and all(c["ok"] for c in fleet_cells)
                and all(c["ok"] for c in integrity_cells)
+               and all(c["ok"] for c in adapt_cells)
                and all(s["ok"] for s in soaks)),
     }
     if args.out:

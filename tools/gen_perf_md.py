@@ -96,6 +96,12 @@ _WATCH = {
                   "fpga_ai_nic_tpu/serve/",
                   "fpga_ai_nic_tpu/runtime/chaos.py",
                   "fpga_ai_nic_tpu/compress/golden.py"],
+    "adapt": ["tools/adapt_bench.py", "tools/chaos_bench.py",
+              "fpga_ai_nic_tpu/tune/",
+              "fpga_ai_nic_tpu/parallel/train.py",
+              "fpga_ai_nic_tpu/ops/ring_cost.py",
+              "fpga_ai_nic_tpu/obs/metrics.py",
+              "fpga_ai_nic_tpu/runtime/chaos.py"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -827,6 +833,58 @@ def main():
                         f"| {r['ok']} | {r.get('mttr_s')} "
                         f"| {json.dumps(extra)} |")
                 L.append("")
+
+    # -- adaptive tuning (drift observatory, PR 13) --------------------------
+    ad_art = (_newest("artifacts/adapt_bench_*.json")
+              or _newest("ADAPT_BENCH_r*.json"))
+    if ad_art:
+        d = _load(ad_art)
+        rows = d.get("rows", [])
+        if rows:
+            dry = bool(d.get("dryrun"))
+            meta = d.get("adapt") or {}
+            cal = meta.get("calibration") or {}
+            L += ["## Adaptive tuning (drift observatory, PR 13)", "",
+                  f"Source: `{_rel(ad_art)}`{_badge(d, 'adapt')} "
+                  f"(platform: {d.get('platform')}; "
+                  "`make adapt-bench`).  The runtime half of the "
+                  "autotuner (`tune/adapt.py`): each step's measured "
+                  "wall time is joined against the active plan's "
+                  "modeled stage times (`tune.drift.*`, the Perfetto "
+                  "attribution lane), a CUSUM detector with hysteresis "
+                  "watches the residuals, and a sustained regime shift "
+                  "switches to a PRE-COMPILED runner-up plan at a step "
+                  "boundary — `recompiles_across_switch == 0` is the "
+                  "graftlint J13 contract, gated two-sided by obs-gate "
+                  "`adapt.*` keys.", ""]
+            if dry:
+                L += ["**Dryrun rows** (virtual CPU mesh): the "
+                      "detection latency carries oversubscription "
+                      "noise — `make obs-gate` gates only the exact "
+                      "switch/trace counters (two-sided); the latency "
+                      "verdict needs a TPU surface.", ""]
+            L += ["| scenario | detected | switches | switch | latency "
+                  "(steps) | recompiles across switch | ok |",
+                  "|---|---|---|---|---|---|---|"]
+            for r in rows:
+                sw = (f"{r.get('from_plan')} → {r.get('to_plan')}"
+                      if r.get("from_plan") else "—")
+                L.append(
+                    f"| {r['scenario']} | {r.get('detected')} "
+                    f"| {r.get('switches')} | {sw} "
+                    f"| {r.get('detection_latency_steps', '—')} "
+                    f"| {r.get('recompiles_across_switch')} "
+                    f"| {r.get('ok')} |")
+            L.append("")
+            if meta.get("candidates"):
+                cands = ", ".join(
+                    f"{c['codec']}/{c['topology']}"
+                    for c in meta["candidates"])
+                L += [f"Candidate set ({meta.get('n_candidates')} "
+                      f"plans, every one traced at construction): "
+                      f"{cands}.  Calibration: inter "
+                      f"{cal.get('inter_gbps')} GB/s "
+                      f"({cal.get('inter_source')}).", ""]
 
     # -- telemetry summary (obs gate) ----------------------------------------
     obs_art = _newest("artifacts/obs_summary_*.json")

@@ -4,7 +4,7 @@
 Planes (docs/LINT.md):
   --ast     AST rules R1–R5 over the package/tools/bench tree (no jax
             import; sub-second)
-  --jaxpr   jaxpr invariant sweep J1–J12: codec x trainer x obs grid traced
+  --jaxpr   jaxpr invariant sweep J1–J13: codec x trainer x obs grid traced
             abstractly on the 8-device virtual CPU mesh (no TPU)
   --ext     ruff + mypy on the strict core, when installed (skipped with a
             notice otherwise — the container may not carry them)

@@ -134,6 +134,20 @@ INTEGRITY_MTTR_EXACT = ("wire_corruption_faults", "checkpoint_restores",
                         "serve_recoveries", "recompiles_steady")
 TOL_INTEGRITY_TIME = 0.40
 
+# adaptive-tuning rows (ADAPT_BENCH_r*.json, one per scenario): the
+# switch/trace counters are exact two-sided — `switches` banked 1 on
+# the forced-shift row means detection AND the step-boundary switch
+# both happened (0 would be a dead detector, 2+ flapping), banked 0 on
+# the steady row means zero false positives, and
+# `recompiles_across_switch` banked 0 is the graftlint J13 contract as
+# an artifact fact (ANY trace appearing across a switch fails CI).
+# detection_latency_steps is a measured quantity: non-dryrun artifacts
+# only, lower is better.
+ADAPT_GATE_KEYS = ("detection_latency_steps",)
+ADAPT_EXACT_KEYS = ("detected", "switches", "false_switches",
+                    "recompiles_across_switch", "n_candidates")
+TOL_ADAPT_TIME = 0.40
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -165,6 +179,10 @@ def fleet_metric(scenario: str, key: str) -> str:
 
 def integrity_metric(route: str, key: str) -> str:
     return f"integrity.{route}.{key}"
+
+
+def adapt_metric(scenario: str, key: str) -> str:
+    return f"adapt.{scenario}.{key}"
 
 
 def _load(path):
@@ -373,6 +391,25 @@ def build_banked_summary() -> dict:
                 metrics[integrity_metric(name, "mttr_s")] = _metric(
                     row["mttr_s"], src, higher=False,
                     tol=TOL_INTEGRITY_TIME)
+
+    # -- adaptive tuning (drift detection -> recompile-free switch) -----------
+    p = (_newest("artifacts/adapt_bench_*.json")
+         or _newest("ADAPT_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (ADAPT_EXACT_KEYS if d.get("dryrun")
+                else ADAPT_EXACT_KEYS + ADAPT_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in ADAPT_EXACT_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                else:
+                    m = _metric(v, src, higher=False, tol=TOL_ADAPT_TIME)
+                metrics[adapt_metric(row["scenario"], key)] = m
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
