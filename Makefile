@@ -29,16 +29,30 @@ lint:
 	python tools/graftlint.py
 
 # graftmc protocol model check (docs/MODELCHECK.md): exhaustive
-# explicit-state exploration of all four collective op streams (flat,
-# streaming, hier, reshard) for n<=6, S<=6, D<=4 — deadlock freedom,
-# slot overwrite, decode ordering, credit safety, termination, DMA
-# discipline — plus the n=8 randomized fuzz sweep and the H1
-# happens-before/lockset pass.  Plain-Python state exploration, no jax
-# APIs, <60 s, CPU-platform env pinned before import (wedged-tunnel
-# safe); violations leave pretty-printed + Perfetto counterexamples
-# under artifacts/.  Runs BETWEEN lint and obs-gate in `make ci`.
+# explicit-state exploration of all six collective op streams (flat,
+# streaming, streaming-AG, hier, reshard, handoff — integrity variants
+# included) for n<=6, S<=6, D<=4 — deadlock freedom, slot overwrite,
+# decode ordering, credit safety, termination, DMA discipline, and the
+# M2 static checksum-weight pass — plus the n=8 randomized fuzz sweep
+# and the H1 happens-before/lockset pass.  Plain-Python state
+# exploration, no jax APIs, <60 s, CPU-platform env pinned before
+# import (wedged-tunnel safe); violations leave pretty-printed +
+# Perfetto counterexamples under artifacts/.  Every run banks its
+# envelope (per-route cells/states, POR reduction, wall time) as
+# artifacts/mc_envelope_*.json; the newest is snapshotted as the
+# round's committed record, which obs-gate's mc.* keys hold future
+# runs to TWO-SIDED (a silent envelope shrink fails CI) with a wall-
+# time budget so state-explosion regressions fail loudly.  Runs
+# BETWEEN lint and obs-gate in `make ci`.
 modelcheck:
-	python tools/graftlint.py --mc
+	@start=$$(date +%s); \
+	  GRAFTMC_NO_BANK= python tools/graftlint.py --mc || exit $$?; \
+	  latest=$$(ls -t artifacts/mc_envelope_*.json 2>/dev/null | head -1); \
+	  if [ -z "$$latest" ] || [ $$(stat -c %Y "$$latest") -lt $$start ]; then \
+	    echo "modelcheck: no FRESH envelope artifact to bank (found: '$$latest')" >&2; exit 1; \
+	  fi; \
+	  cp $$latest MC_ENVELOPE_$(ROUND).json; \
+	  echo "saved $$latest -> MC_ENVELOPE_$(ROUND).json"
 
 # fast fixture-corpus loop (<30 s, CPU-only): every rule fires on its bad
 # fixture / stays silent on the good one, suppression hygiene, and the
@@ -55,7 +69,7 @@ bench:
 # run the collective/codec benchmark and snapshot its newest artifact as
 # the round's committed record (the round-2 review's item 3: the
 # first-named BASELINE metric must land in a committed file every round)
-ROUND ?= r04
+ROUND ?= r14
 collective:
 	python bench_collective.py
 	@latest=$$(ls -t artifacts/collective_tpu_*.json artifacts/collective_2*.json 2>/dev/null | head -1); \

@@ -53,6 +53,11 @@ from jax import lax
 
 from . import integrity as _integrity
 from . import ring as ring_ops
+# the shared protocol IR: the phase program (hop counts, subring
+# permutations, conservation message ids) is emitted once there and
+# consumed both by the lowerings below and by graftmc's checked streams
+# (verify.opstream.hier_op_stream) — no second schedule definition
+from ..verify import opstream as _opstream
 
 
 # ---------------------------------------------------------------------------
@@ -152,20 +157,12 @@ def wire_bytes_per_device(L: int, n: int, n_intra: int,
 
 
 # ---------------------------------------------------------------------------
-# subring permutations
+# subring permutations — delegates to the shared protocol IR (one
+# definition; tests pin the delegation by identity)
 # ---------------------------------------------------------------------------
 
-def _intra_perm(n: int, ni: int):
-    """Next-neighbor inside each group of ni consecutive ranks."""
-    return [(g * ni + j, g * ni + (j + 1) % ni)
-            for g in range(n // ni) for j in range(ni)]
-
-
-def _inter_perm(n: int, ni: int):
-    """Next-group, same intra position: the inter rings."""
-    ng = n // ni
-    return [(g * ni + j, ((g + 1) % ng) * ni + j)
-            for g in range(ng) for j in range(ni)]
+_intra_perm = _opstream.intra_perm
+_inter_perm = _opstream.inter_perm
 
 
 # ---------------------------------------------------------------------------
@@ -207,12 +204,20 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
     C = x.shape[0] // n
     x = ring_ops._tap(x, "ring_hier.reduce_scatter")
     chk = _integrity.zero_carry() if integrity else None
+    # THE phase program (hop counts, perms, and — for integrity — the
+    # single message counter spanning both phases: intra hop s is
+    # message s, inter hop s slice k is (ni-1) + s*stride + k, so no
+    # two messages in the shared carry ever share a weight).  graftmc's
+    # checked hier streams expand the same program.
+    stride_b = ring_ops._send_n_messages(codec, C, slice_elems)
+    prog = _opstream.hier_program(n, ni, s_inter=stride_b)
 
     # phase A — intra ring over units [j'] = concat_g'(chunk g'*ni + j'),
     # raw f32 (the whole point: full precision is free on the fast hop)
     units = x.reshape(ng, ni, C).transpose(1, 0, 2).reshape(ni, ng * C)
     if ni > 1:
-        perm_a = _intra_perm(n, ni)
+        pa = prog.rs_intra
+        perm_a = list(pa.perm)
 
         if integrity:
             def hop_a_i(s, carry):
@@ -220,10 +225,10 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
                 send = jnp.take(u, ((j - s - 1) % ni)[None], axis=0)[0]
                 recv, ck = ring_ops._send(
                     send, axis_name, n, None, perm=perm_a, chk=ck,
-                    msg_base=s)
+                    msg_base=pa.msg(s))
                 return u.at[(j - s - 2) % ni].add(recv), ck
 
-            units, chk = lax.fori_loop(0, ni - 1, hop_a_i, (units, chk),
+            units, chk = lax.fori_loop(0, pa.hops, hop_a_i, (units, chk),
                                        unroll=unroll)
         else:
             def hop_a(s, u):
@@ -232,29 +237,25 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
                                       perm=perm_a)
                 return u.at[(j - s - 2) % ni].add(recv)
 
-            units = lax.fori_loop(0, ni - 1, hop_a, units, unroll=unroll)
+            units = lax.fori_loop(0, pa.hops, hop_a, units, unroll=unroll)
     # own[q] = sum over this group's members of chunk q*ni + j
     own = jnp.take(units, j[None], axis=0)[0].reshape(ng, C)
 
     # phase B — inter ring over the ng group-partial chunks, codec wire
     if ng > 1:
-        perm_b = _inter_perm(n, ni)
+        pb = prog.rs_inter
+        perm_b = list(pb.perm)
 
         if integrity:
-            # one message counter spans both phases: intra hop s is
-            # message s, inter hop s starts at (ni-1) + s*stride — no
-            # two messages in the shared carry ever share a weight
-            stride_b = ring_ops._send_n_messages(codec, C, slice_elems)
-
             def hop_b_i(s, carry):
                 u, ck = carry
                 send = jnp.take(u, ((g - s - 1) % ng)[None], axis=0)[0]
                 recv, ck = ring_ops._send(
                     send, axis_name, n, codec, slice_elems, perm=perm_b,
-                    chk=ck, msg_base=(ni - 1) + s * stride_b)
+                    chk=ck, msg_base=pb.msg(s))
                 return u.at[(g - s - 2) % ng].add(recv), ck
 
-            own, chk = lax.fori_loop(0, ng - 1, hop_b_i, (own, chk),
+            own, chk = lax.fori_loop(0, pb.hops, hop_b_i, (own, chk),
                                      unroll=unroll)
         else:
             def hop_b(s, u):
@@ -263,7 +264,7 @@ def hier_reduce_scatter(x: jax.Array, axis_name: str, n_intra: int, *,
                                       slice_elems, perm=perm_b)
                 return u.at[(g - s - 2) % ng].add(recv)
 
-            own = lax.fori_loop(0, ng - 1, hop_b, own, unroll=unroll)
+            own = lax.fori_loop(0, pb.hops, hop_b, own, unroll=unroll)
     # final ownership: chunk g*ni + j == this device's index
     owned = jnp.take(own, g[None], axis=0)[0]
     if not integrity:
@@ -293,11 +294,14 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
     C = owned.shape[0]
     chk = _integrity.zero_carry() if integrity else None
     tap = ring_ops._tap_wire
+    # THE phase program for the gather direction (its own conservation
+    # carry: inter hop s is message s, intra hop s is (ng-1) + s)
+    prog = _opstream.hier_program(n, ni)
 
     # phase B' — inter all-gather of the owned chunk across groups
     blocks = jnp.zeros((ng, C), owned.dtype)
     if ng > 1:
-        perm_b = _inter_perm(n, ni)
+        perm_b = list(prog.ag_inter.perm)
         if codec is None:
             pay_b = (owned,)
             blocks = blocks.at[g].set(owned)
@@ -314,7 +318,7 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
         if integrity:
             def hop_b_i(s, carry):
                 out_, p, (sa, ra) = carry
-                w = _integrity.hop_weight(s)
+                w = _integrity.hop_weight(prog.ag_inter.msg(s))
                 sa = sa + w * _integrity.payload_checksum(p)
                 p = tuple(lax.ppermute(q, axis_name, perm_b) for q in p)
                 p = tap(p, "ring.wire")
@@ -323,7 +327,8 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
                         (sa, ra))
 
             blocks, _, chk = lax.fori_loop(
-                0, ng - 1, hop_b_i, (blocks, pay_b, chk), unroll=unroll)
+                0, prog.ag_inter.hops, hop_b_i, (blocks, pay_b, chk),
+                unroll=unroll)
         else:
             def hop_b(s, carry):
                 out_, p = carry
@@ -331,8 +336,8 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
                 p = tap(p, "ring.wire")
                 return out_.at[(g - s - 1) % ng].set(_landed_b(p)), p
 
-            blocks, _ = lax.fori_loop(0, ng - 1, hop_b, (blocks, pay_b),
-                                      unroll=unroll)
+            blocks, _ = lax.fori_loop(0, prog.ag_inter.hops, hop_b,
+                                      (blocks, pay_b), unroll=unroll)
     else:
         # no slow boundary to cross: nothing is quantized (the flat
         # ring's n == 1 quantize exists for replica identity, which the
@@ -344,14 +349,14 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
     flat_block = blocks.reshape(ng * C)
     out = jnp.zeros((ni, ng * C), owned.dtype).at[j].set(flat_block)
     if ni > 1:
-        perm_a = _intra_perm(n, ni)
+        perm_a = list(prog.ag_intra.perm)
 
         if integrity:
             def hop_a_i(s, carry):
                 out_, p, (sa, ra) = carry
                 # continue the message counter past phase B's ng-1
                 # inter frames so the shared carry never reuses a weight
-                w = _integrity.hop_weight((ng - 1) + s)
+                w = _integrity.hop_weight(prog.ag_intra.msg(s))
                 sa = sa + w * _integrity.payload_checksum(p)
                 p = tuple(lax.ppermute(q, axis_name, perm_a) for q in p)
                 p = tap(p, "ring.wire")
@@ -359,7 +364,7 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
                 return out_.at[(j - s - 1) % ni].set(p[0]), p, (sa, ra)
 
             out, _, chk = lax.fori_loop(
-                0, ni - 1, hop_a_i, (out, (flat_block,), chk),
+                0, prog.ag_intra.hops, hop_a_i, (out, (flat_block,), chk),
                 unroll=unroll)
         else:
             def hop_a(s, carry):
@@ -372,8 +377,8 @@ def hier_all_gather(owned: jax.Array, axis_name: str, n_intra: int, *,
                 pay = tap((pay,), "ring.wire")[0]
                 return out_.at[(j - s - 1) % ni].set(pay), pay
 
-            out, _ = lax.fori_loop(0, ni - 1, hop_a, (out, flat_block),
-                                   unroll=unroll)
+            out, _ = lax.fori_loop(0, prog.ag_intra.hops, hop_a,
+                                   (out, flat_block), unroll=unroll)
     # out[p] = blocks of member p = chunks {q*ni + p}; restore natural
     # chunk order (inverse of the reduce-scatter's regrouping)
     full = out.reshape(ni, ng, C).transpose(1, 0, 2).reshape(n * C)

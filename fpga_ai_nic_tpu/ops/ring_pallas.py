@@ -63,6 +63,10 @@ from .. import compat
 from .bfp_pallas import LANES, _is_tpu
 from .. import optim as _optim
 from ..utils.config import BFPConfig, OptimizerSpec
+# the shared protocol IR: the kernels below CONSUME its emitters — the
+# schedule they execute and the stream graftmc explores are one
+# definition (no jax inside verify.opstream; importing it here is free)
+from ..verify import opstream as _opstream
 
 
 def _encode_rows(x, block_size: int, mantissa_bits: int, rounding: str):
@@ -170,6 +174,111 @@ def _when(cond, static: bool):
                 f()
         return deco
     return pl.when(cond)
+
+
+class _KernelSink(_opstream.OpSink):
+    """Maps the shared emitters' abstract ops (`verify.opstream`) onto
+    one Pallas kernel's DMA/semaphore/VPU resources.  The emitter owns
+    the FULL schedule — every wait/signal/transfer order decision; this
+    sink only (a) binds each abstract op to a real call, (b) filters op
+    classes for stage ablation (`do_*`) and the interpreter's
+    flow-control limitation, and (c) lowers `when` to `pl.when` on the
+    rolled path / a python ``if`` on the unrolled path (`_when`).  The
+    kernels therefore carry no schedule text of their own to drift from
+    the checked model — the PR-9 flat-route discipline, applied to every
+    route."""
+
+    def __init__(self, *, unrolled, flow_control, do_rdma=True,
+                 do_enc=True, do_dec=True, do_upd=True, do_chk=False,
+                 barrier=None, send=None, wait_send=None, wait_recv=None,
+                 credit_wait=None, credit_signal=None, credit_drain=None,
+                 encode=None, decode=None, update=None, chk_emit=None,
+                 chk_arrive=None, dma_start=None, dma_wait=None,
+                 local=None):
+        self._unrolled = unrolled
+        self._flow = flow_control
+        self._do_rdma = do_rdma
+        self._do_enc = do_enc
+        self._do_dec = do_dec
+        self._do_upd = do_upd
+        self._do_chk = do_chk
+        self._barrier = barrier
+        self._send = send
+        self._wait_send = wait_send
+        self._wait_recv = wait_recv
+        self._credit_wait = credit_wait
+        self._credit_signal = credit_signal
+        self._credit_drain = credit_drain
+        self._encode = encode
+        self._decode = decode
+        self._update = update
+        self._chk_emit = chk_emit
+        self._chk_arrive = chk_arrive
+        self._dma_start = dma_start
+        self._dma_wait = dma_wait
+        self._local = local
+
+    def when(self, cond):
+        return _when(cond, self._unrolled)
+
+    def barrier(self):
+        if self._flow and self._do_rdma:
+            self._barrier()
+
+    def send(self, q, src=None):
+        if self._do_rdma:
+            self._send(q, src)
+
+    def wait_send(self, j):
+        if self._do_rdma:
+            self._wait_send(j)
+
+    def wait_recv(self, g):
+        if self._do_rdma:
+            self._wait_recv(g)
+
+    def credit_wait(self):
+        if self._flow and self._do_rdma:
+            self._credit_wait()
+
+    def credit_signal(self):
+        if self._flow and self._do_rdma:
+            self._credit_signal()
+
+    def credit_drain(self, k):
+        if self._flow and self._do_rdma:
+            self._credit_drain(k)
+
+    def encode(self, q, src=None):
+        if self._do_enc:
+            self._encode(q, src)
+
+    def decode(self, g):
+        if self._do_dec:
+            self._decode(g)
+
+    def update(self, g):
+        if self._do_upd:
+            self._update(g)
+
+    def chk_emit(self, msg, carry="wire", weight=None):
+        if self._do_chk:
+            self._chk_emit(msg)
+
+    def chk_arrive(self, msg, carry="wire", weight=None):
+        if self._do_chk:
+            self._chk_arrive(msg)
+
+    def local(self, name, *args):
+        self._local(name, *args)
+
+    def dma_start(self, chan, i, *conf):
+        # conf (the checker's hazard-predecessor annotations) is
+        # evidence for `check_dma_discipline`, not schedule — ignored
+        self._dma_start(chan, i)
+
+    def dma_wait(self, chan, i):
+        self._dma_wait(chan, i)
 
 
 # Default pipeline depth D of the reduce-scatter schedule: at steady
@@ -380,10 +489,10 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
             send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
 
-    def encode_to_slot(g):
+    def encode_to_slot(g, _src=None):
         # rolled path: g = loop index + D can exceed the table under the
         # pl.when(q < total) guard — clamp the (guarded-dead) SMEM load
-        # like _ag_stream_kernel's is_own_j does
+        # like the AG kernel's is_own_j does
         off = sched_ref[0, g if unrolled else jnp.clip(g, 0, total - 1)]
         slot = g % n_slots
         for c in range(0, R, sub):   # sub-slice chunks, block-aligned
@@ -391,128 +500,86 @@ def _rs_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
                                        mantissa_bits, rounding)
             send_pkt[slot, pl.ds(c, sub)] = mant
             send_pkt[slot, pl.ds(R + c // B, sub // B)] = scale
-        if do_chk:
-            # checksum the frame exactly as the RDMA will move it
-            chk_ref[0] = chk_ref[0] + _emission_weight(g) \
-                * _frame_checksum(send_pkt[slot])
 
-    # flow_control=False only under the discharge interpreter, whose
-    # lockstep emulation cannot execute remote semaphore signals; the
-    # threaded interpreter (interpret="threaded") and hardware both run
-    # the barrier + credits for real (see _interp_args).
-    if flow_control and do_rdma:
-        _neighbor_barrier(left, right)
+    def chk_emit(q):
+        # checksum the frame exactly as the RDMA will move it
+        chk_ref[0] = chk_ref[0] + _emission_weight(q) \
+            * _frame_checksum(send_pkt[q % n_slots])
 
-    # prologue: emissions 0..D-1 (all hop-0 sends reading the initial x)
-    # fill the pipeline before the first consume; none reuses a slot
-    # (D < n_slots), so no waits
-    for q in range(D):
-        if do_enc:
-            encode_to_slot(q)
-        if do_rdma:
-            rdma(q).start()
+    def chk_arrive(g):
+        chk_ref[1] = chk_ref[1] + _emission_weight(g) \
+            * _frame_checksum(recv_pkt[g % n_slots])
 
-    def launch(q):
-        # launch send q while RDMAs q-1..q-D+1 are in flight — the
-        # encode/wire overlap the reference gets by pipelining compress
-        # into the egress path
-        @_when(q < total, unrolled)
-        def _launch():
-            if do_rdma:
-                @_when(q >= n_slots, unrolled)
-                def _reuse():        # slot q % n_slots was used by RDMA
-                    rdma(q - n_slots).wait_send()   # source must be drained
-            if do_enc:
-                encode_to_slot(q)
-
-            if flow_control and do_rdma:
-                @_when(q >= n_slots, unrolled)
-                def _credit():       # destination slot safety: the
-                    pltpu.semaphore_wait(credit_sem, 1)  # recvr freed it
-            if do_rdma:
-                rdma(q).start()
-
-    def update_chunk(off, loc, c):
-        # fused ZeRO-1 optimizer update of owned sub-chunk c: the mean
-        # gradient is read straight out of the just-retired accumulator
-        # rows, the master/state shards update in place (aliased outputs)
-        # — the decode feeds weight_update with no HBM round-trip in
-        # between, and the remaining ring hops still overlap this VPU
-        # work.  Formula/bit contract: optim.fused_apply_blocks.
-        gblk = acc[pl.ds(off + c, sub)] / jnp.float32(n)
-        wblk = w_ref[pl.ds(loc + c, sub)]
-        stblks = tuple(s[pl.ds(loc + c, sub)] for s in st_in)
-        w2, st2 = _optim.fused_apply_blocks(opt_kind, wblk, gblk, stblks,
-                                            lambda i: hyper_ref[i])
-        w_out[pl.ds(loc + c, sub)] = w2
-        for so, sv in zip(st_out, st2):
-            so[pl.ds(loc + c, sub)] = sv
-
-    def consume(g):
+    def decode_slice(g):
         # decode slice g + accumulate into the chunk this hop owns
-        if do_rdma:
-            rdma(g).wait_recv()
-        if do_chk:
-            chk_ref[1] = chk_ref[1] + _emission_weight(g) \
-                * _frame_checksum(recv_pkt[g % n_slots])
-        if not (do_dec or do_upd):
-            if flow_control and do_rdma:
-                pltpu.semaphore_signal(
-                    credit_sem, inc=1, device_id=left,
-                    device_id_type=pltpu.DeviceIdType.LOGICAL)
-            return
         off = sched_ref[1, g]
         slot = g % n_slots
-        final = g >= final_g0           # this slice lands in OUR chunk
-        loc = off - idx * chunk_rows    # owned-shard row offset (final only)
         for c in range(0, R, sub):
-            if do_dec:
-                dec = _decode_rows(recv_pkt[slot, pl.ds(c, sub)],
-                                   recv_pkt[slot, pl.ds(R + c // B, sub // B)],
-                                   B)
-                acc[pl.ds(off + c, sub)] = acc[pl.ds(off + c, sub)] + dec
-            if do_upd:
-                @_when(final, unrolled)
-                def _upd(c=c):
-                    update_chunk(off, loc, c)
-        if flow_control and do_rdma:
-            # free the slot for our upstream sender
-            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
+            dec = _decode_rows(recv_pkt[slot, pl.ds(c, sub)],
+                               recv_pkt[slot, pl.ds(R + c // B, sub // B)],
+                               B)
+            acc[pl.ds(off + c, sub)] = acc[pl.ds(off + c, sub)] + dec
 
-    # Send q's source chunk is finalized by consume q-S (hop s reads what
-    # hop s-1 accumulated into the same slice index) — _rs_plan's RAW
-    # invariant: launch-ahead BEFORE the consume is safe up to D = S-1;
-    # D = S flips the order (the reference has the same serialization: a
-    # slice is forwarded only after it is reduced, hw/all_reduce.sv
-    # REDUCE->FORWARD).
-    if launch_first:
-        def step(g):
-            launch(g + D)
-            consume(g)
-    else:
-        def step(g):
-            consume(g)
-            launch(g + D)
+    def update_slice(g):
+        # fused ZeRO-1 optimizer update of the owned chunk this final-
+        # hop decode just retired: the mean gradient is read straight
+        # out of the accumulator rows, the master/state shards update in
+        # place (aliased outputs) — the decode feeds weight_update with
+        # no HBM round-trip in between, and the remaining ring hops
+        # still overlap this VPU work.  Formula/bit contract:
+        # optim.fused_apply_blocks.
+        off = sched_ref[1, g]
+        loc = off - idx * chunk_rows    # owned-shard row offset
+        for c in range(0, R, sub):
+            gblk = acc[pl.ds(off + c, sub)] / jnp.float32(n)
+            wblk = w_ref[pl.ds(loc + c, sub)]
+            stblks = tuple(s[pl.ds(loc + c, sub)] for s in st_in)
+            w2, st2 = _optim.fused_apply_blocks(
+                opt_kind, wblk, gblk, stblks, lambda i: hyper_ref[i])
+            w_out[pl.ds(loc + c, sub)] = w2
+            for so, sv in zip(st_out, st2):
+                so[pl.ds(loc + c, sub)] = sv
 
+    # The schedule itself — prologue pipe-fill, launch/consume order,
+    # wait/credit placement, drain — is NOT written here: the kernel
+    # consumes the shared emitter (`verify.opstream.RsEmitter`), the
+    # same object graftmc explores exhaustively, through the sink
+    # below.  flow_control=False only under the discharge interpreter,
+    # whose lockstep emulation cannot execute remote semaphore signals;
+    # the threaded interpreter and hardware run barrier + credits for
+    # real (see _interp_args).
+    emitter = _opstream.RsEmitter(n, S, depth, opt_kind=opt_kind,
+                                  integrity=do_chk,
+                                  default_depth=_PIPE_DEPTH)
+    assert (emitter.n_slots, emitter.launch_first) == \
+        (n_slots, launch_first), (emitter.n_slots, n_slots)
+    sink = _KernelSink(
+        unrolled=unrolled, flow_control=flow_control, do_rdma=do_rdma,
+        do_enc=do_enc, do_dec=do_dec, do_upd=do_upd, do_chk=do_chk,
+        barrier=lambda: _neighbor_barrier(left, right),
+        send=lambda q, src: rdma(q).start(),
+        wait_send=lambda j: rdma(j).wait_send(),
+        wait_recv=lambda g: rdma(g).wait_recv(),
+        credit_wait=lambda: pltpu.semaphore_wait(credit_sem, 1),
+        credit_signal=lambda: pltpu.semaphore_signal(
+            credit_sem, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL),
+        credit_drain=lambda k: pltpu.semaphore_wait(credit_sem, k),
+        encode=encode_to_slot, decode=decode_slice, update=update_slice,
+        chk_emit=chk_emit, chk_arrive=chk_arrive)
+
+    emitter.prologue(sink)
     if unrolled:
         # static schedule (the interpreter path): every counter decision
         # is a python bool, no lax.cond joins for the vma checker to fight
         for g in range(total):
-            step(g)
+            emitter.step(sink, g)
     else:
         def body(g, _):
-            step(g)
+            emitter.step(sink, g)
             return 0
         lax.fori_loop(0, total, body, 0)
-
-    # drain: the last n_slots sends' source-buffer semaphores, and the
-    # residual credits our receiver signaled but no later send consumed
-    if do_rdma:
-        for j in range(max(0, total - n_slots), total):
-            rdma(j).wait_send()
-        if flow_control:
-            pltpu.semaphore_wait(credit_sem, min(total, n_slots))
+    emitter.epilogue(sink)
 
     out_ref[:] = acc[pl.ds(idx * chunk_rows, chunk_rows)]
 
@@ -830,16 +897,30 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
             send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
 
-    def encode_from_ld(q):
+    def encode_from_ld(q, _src=None):
         slot = q % n_slots
         for c in range(0, R, sub):   # sub-slice chunks, block-aligned
             mant, scale = _encode_rows(ld[q % 2, pl.ds(c, sub)], B,
                                        mantissa_bits, rounding)
             send_pkt[slot, pl.ds(c, sub)] = mant
             send_pkt[slot, pl.ds(R + c // B, sub // B)] = scale
-        if do_chk:
-            chk_ref[0] = chk_ref[0] + _emission_weight(q) \
-                * _frame_checksum(send_pkt[slot])
+
+    def chk_emit(q):
+        # checksum the frame exactly as the RDMA will move it
+        chk_ref[0] = chk_ref[0] + _emission_weight(q) \
+            * _frame_checksum(send_pkt[q % n_slots])
+
+    def chk_arrive(g):
+        chk_ref[1] = chk_ref[1] + _emission_weight(g) \
+            * _frame_checksum(recv_pkt[g % n_slots])
+
+    def decode_slice(g):
+        slot = g % n_slots
+        for c in range(0, R, sub):
+            dec = _decode_rows(recv_pkt[slot, pl.ds(c, sub)],
+                               recv_pkt[slot, pl.ds(R + c // B, sub // B)],
+                               B)
+            st[g % 2, pl.ds(c, sub)] = st[g % 2, pl.ds(c, sub)] + dec
 
     # -- fused-optimizer streaming plumbing (opt_kind only): the owned
     # master/state slice of final-hop consume g cycles through a 2-deep
@@ -875,149 +956,85 @@ def _rs_stream_kernel(ids_ref, sched_ref, *refs, n: int, n_slices: int,
             for i, sv in enumerate(st2):
                 opt_buf[1 + i, g % 2, pl.ds(c, sub)] = sv
 
-    if flow_control and do_rdma:
-        _neighbor_barrier(left, right)
-
-    # One-ahead slice-load prefetch (ld(q+1) starts inside launch(q))
-    # moves the send-side HBM read one step earlier, so it needs one more
-    # step of RAW slack than the launch itself: ld(q+1) reads what
-    # wb(q+1-S) wrote, and at prefetch time (step q-D) only wbs <= q-D-1
-    # are complete — legal iff D <= S-2.  Tighter plans start ld(q)
-    # inside launch(q) itself (still overlapped with the wire via the
-    # comm window, just not with this emission's codec).
-    prefetch = launch_first and D + 2 <= S
-
-    # prologue: fill the pipeline with emissions 0..D-1 (hop-0 sends,
-    # no RAW: their rows are the initial x)
-    if do_ld and prefetch:
-        ld_dma(0).start()
-    for q in range(D):
-        if do_ld:
-            if prefetch:
-                if q + 1 < total:
-                    ld_dma(q + 1).start()
-            else:
-                ld_dma(q).start()
-            ld_dma(q).wait()
-        if do_enc:
-            encode_from_ld(q)
-        if do_rdma:
-            rdma(q).start()
-
-    def launch(q):
-        @_when(q < total, unrolled)
-        def _launch():
+    def dma_start(chan, i):
+        # the abstract DMA channels of `RsStreamEmitter`, bound to this
+        # kernel's copy descriptors (ablation filters per channel class)
+        if chan == "ld":
             if do_ld:
-                if prefetch:
-                    @_when(q + 1 < total, unrolled)
-                    def _prefetch():          # hide the next HBM read
-                        ld_dma(q + 1).start() # behind this codec + wire
-                else:
-                    ld_dma(q).start()
-            if do_rdma:
-                @_when(q >= n_slots, unrolled)
-                def _reuse():
-                    rdma(q - n_slots).wait_send()  # frame slot drained
+                ld_dma(i).start()
+        elif chan == "st":
+            if do_stld:
+                stld_dma(i).start()
+        elif chan == "wb":
+            if do_wb:
+                wb_dma(i).start()
+        elif chan.startswith("optld"):
+            if do_upd:
+                opt_ld_dma(int(chan[5:]), i).start()
+        elif chan.startswith("optwb"):
+            if do_upd:
+                opt_wb_dma(int(chan[5:]), i).start()
+        else:
+            raise AssertionError(chan)
+
+    def dma_wait(chan, i):
+        if chan == "ld":
             if do_ld:
-                ld_dma(q).wait()
-            if do_enc:
-                encode_from_ld(q)
-            if flow_control and do_rdma:
-                @_when(q >= n_slots, unrolled)
-                def _credit():
-                    pltpu.semaphore_wait(credit_sem, 1)
-            if do_rdma:
-                rdma(q).start()
-
-    def consume(g):
-        if do_upd:
-            @_when(g >= final_g0 + 2, unrolled)
-            def _opt_slot_free():          # VMEM window slot reuse guard
-                for t in range(n_t):
-                    opt_wb_dma(t, g - 2).wait()
-
-            @_when(g >= final_g0, unrolled)
-            def _opt_ld():                 # hide the state read under the
-                for t in range(n_t):       # wire wait + decode
-                    opt_ld_dma(t, g).start()
-        if do_stld:
-            stld_dma(g).start()            # overlap load with the wire
-        if do_rdma:
-            rdma(g).wait_recv()
-        if do_chk:
-            chk_ref[1] = chk_ref[1] + _emission_weight(g) \
-                * _frame_checksum(recv_pkt[g % n_slots])
-        if do_stld:
-            stld_dma(g).wait()
-        if do_dec:
-            slot = g % n_slots
-            for c in range(0, R, sub):
-                dec = _decode_rows(recv_pkt[slot, pl.ds(c, sub)],
-                                   recv_pkt[slot, pl.ds(R + c // B, sub // B)],
-                                   B)
-                st[g % 2, pl.ds(c, sub)] = st[g % 2, pl.ds(c, sub)] + dec
-        if flow_control and do_rdma:
-            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-        if do_wb:
-            wb_dma(g).start()
-        if do_upd:
-            @_when(g >= final_g0, unrolled)
-            def _opt_update():             # grad wb streams out above
-                for t in range(n_t):       # while the VPU updates here
-                    opt_ld_dma(t, g).wait()
-                update_slice(g)
-                for t in range(n_t):
-                    opt_wb_dma(t, g).start()
-
-    # Writeback discipline: each wb_dma is waited EXACTLY ONCE, at a point
-    # that dominates both of its consumers — the send-side RAW (the load
-    # for launch q reads what wb q-S wrote; with the one-ahead prefetch
-    # the earliest reader of wb(g)'s rows is ld(g+S) started inside
-    # launch(g+S-1)) and the st-slot reuse (stld g overwrites what wb g-2
-    # drained).  Two independent waits on one DMA signal would deadlock on
-    # hardware (one signal per DMA), invisibly to the interpreter (which
-    # does not block on semaphore counts).  launch_first (D <= S-1; the
-    # one-ahead prefetch additionally needs D <= S-2 and gates itself off
-    # otherwise) keeps the 1-lag head wait sufficient; D == S flips the
-    # order so the immediate-RAW writeback is waited before the launch.
-    if launch_first:
-        def step(g):
+                ld_dma(i).wait()
+        elif chan == "st":
+            if do_stld:
+                stld_dma(i).wait()
+        elif chan == "wb":
             if do_wb:
-                @_when(g >= 1, unrolled)
-                def _wb_prev():            # single wait, 1-iteration lag:
-                    wb_dma(g - 1).wait()   # every wb <= g-1 complete here
-            launch(g + D)
-            consume(g)
-    else:
-        def step(g):                       # RAW is immediate at D=S: the
-            consume(g)                     # next send reads THIS writeback
-            if do_wb:
-                wb_dma(g).wait()
-            launch(g + D)
+                wb_dma(i).wait()
+        elif chan.startswith("optld"):
+            if do_upd:
+                opt_ld_dma(int(chan[5:]), i).wait()
+        elif chan.startswith("optwb"):
+            if do_upd:
+                opt_wb_dma(int(chan[5:]), i).wait()
+        else:
+            raise AssertionError(chan)
 
+    # The schedule — prologue pipe-fill, one-ahead prefetch gate,
+    # launch/consume order, the single-wait writeback discipline, the
+    # fused-opt state windows, every drain — is NOT written here: the
+    # kernel consumes the shared emitter (`verify.opstream.
+    # RsStreamEmitter`), the same object graftmc explores exhaustively
+    # and `check_dma_discipline` audits statically, through the sink
+    # below.  flow_control=False only under the discharge interpreter
+    # (see _interp_args).
+    emitter = _opstream.RsStreamEmitter(n, S, depth, opt_kind=opt_kind,
+                                        integrity=do_chk,
+                                        default_depth=_PIPE_DEPTH)
+    assert (emitter.n_slots, emitter.launch_first) == \
+        (n_slots, launch_first), (emitter.n_slots, n_slots)
+    sink = _KernelSink(
+        unrolled=unrolled, flow_control=flow_control, do_rdma=do_rdma,
+        do_enc=do_enc, do_dec=do_dec, do_upd=do_upd, do_chk=do_chk,
+        barrier=lambda: _neighbor_barrier(left, right),
+        send=lambda q, src: rdma(q).start(),
+        wait_send=lambda j: rdma(j).wait_send(),
+        wait_recv=lambda g: rdma(g).wait_recv(),
+        credit_wait=lambda: pltpu.semaphore_wait(credit_sem, 1),
+        credit_signal=lambda: pltpu.semaphore_signal(
+            credit_sem, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL),
+        credit_drain=lambda k: pltpu.semaphore_wait(credit_sem, k),
+        encode=encode_from_ld, decode=decode_slice, update=update_slice,
+        chk_emit=chk_emit, chk_arrive=chk_arrive,
+        dma_start=dma_start, dma_wait=dma_wait)
+
+    emitter.prologue(sink)
     if unrolled:
         for g in range(total):
-            step(g)
+            emitter.step(sink, g)
     else:
         def body(g, _):
-            step(g)
+            emitter.step(sink, g)
             return 0
         lax.fori_loop(0, total, body, 0)
-
-    if do_wb and launch_first:
-        wb_dma(total - 1).wait()           # D==S waits each wb in-loop
-    if do_upd:
-        # drain the last min(2, S) state writebacks (earlier ones were
-        # waited by the in-loop slot-reuse guard); bounds are static
-        for gg in range(max(final_g0, total - 2), total):
-            for t in range(n_t):
-                opt_wb_dma(t, gg).wait()
-    if do_rdma:
-        for j in range(max(0, total - n_slots), total):
-            rdma(j).wait_send()
-        if flow_control:
-            pltpu.semaphore_wait(credit_sem, min(total, n_slots))
+    emitter.epilogue(sink)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,),
@@ -1246,98 +1263,39 @@ def _ag_call(own2, axis_name: Optional[str], block_size: int,
     )(ids, own2)
 
 
-def _ag_schedule(n: int, S: int, n_slots: int):
-    """Explicit interleaved emission schedule for the streaming gather.
+# THE interleaved emission schedule of the streaming gather — moved to
+# the shared protocol IR (P1/P2/P3 asserted per (n, S) there; the
+# exhaustive graftmc exploration of the full wait/credit protocol over
+# this schedule is what retired the "statically asserted" ledger row,
+# and what caught the fwd/own emission-index inversion whose one-credit
+# under-wait the static sweep could not see).  tests/test_verify.py
+# pins the delegation by identity.
+_ag_schedule = _opstream.ag_schedule
 
-    Every node runs the SAME emission sequence E (the reference's
-    SEND_LOCAL/FORWARD beat multiplexing, hw/all_reduce.sv:891-1086),
-    built by simulating one node: per arrival step m, emit own slice m+1
-    (while the own phase lasts) and forward arrival m onward unless its
-    content is at the last hop.  Because arrivals ARE the upstream's
-    emissions in E order, wire slots and semaphores cycle by EMISSION
-    index j (mod n_slots on BOTH ends), and a node's m-th arrival has the
-    content of E[m] one hop deeper.  Simple closed forms exist only for
-    n >= 4 or S <= 2 (for n == 3, S >= 3 the terminal arrivals interleave
-    non-contiguously and punch holes in any arithmetic j assignment), so
-    the schedule is built explicitly — it is static per (n, S).
 
-    Two properties are asserted here per (n, S) because the kernel's
-    safety rests on them (verified by sweep for n<=16, S<=16, and
-    re-checked statically on every trace):
+class _SmemAgSchedule:
+    """The rolled (hardware) path's schedule accessor: the same
+    `ag_schedule` tables as `verify.opstream.AgSchedule`, read per
+    decision from the kernel's SMEM copy (in-kernel jnp table constants
+    are rejected by the Mosaic compiler).  Rows: 0 content, 1 fwd_j,
+    2 own_at, 3 own-mask, 4 own_j — built in `_ag_stream_call` from the
+    emitter's python tables."""
 
-      P1  m_e(m) < m: arrival m's emission is issued at a consume step
-          STRICTLY before step m on the identical upstream program — so
-          in the interpreter's lockstep-primitive model the data has
-          landed before consume(m) decodes it, and on hardware wait_recv
-          can always be satisfied.
-      P2  j - m_e(j) <= S: no emission runs more than S ahead of its
-          consume step (the own phase emits two frames per step for S-1
-          steps, which is the worst case).  With n_slots >= S + 1, the
-          overwrite of wire slot j % n_slots (emission j) therefore comes
-          after the decode of arrival j - n_slots in program order
-          (interpreter safety), and the credit window never dead-ends
-          (hardware): emission j's credit waits on downstream consume
-          j - n_slots <= m_e(j) - 1, a strictly earlier step, so every
-          cross-node dependency edge points from (step m, node) to
-          (step < m, neighbor) and the dependency graph is acyclic for
-          ARBITRARY S and n.  n_slots = S + 2 adds one slot of margin.
+    def __init__(self, sched_ref, total):
+        self._s = sched_ref
+        self._total = total
 
-    Returns (content[m], fwd_j[m], own_at[m], own_j[k], own_js,
-    tail_own_js):
-      content[m]   (chunk_depth_hops - 1) * S + slice of arrival m
-      fwd_j[m]     emission index of arrival m's onward forward, -1 if
-                   terminal (content at depth n-2)
-      own_at[m]    own slice emitted AFTER consuming arrival m (-1 none)
-      own_j[k]     emission index of own slice k
-      own_js       set(own_j) — membership drives the pre-wait rule
-      tail_own_js  own emissions never followed by a same-slot emission
-                   (their send semaphores drain at kernel exit)
-    """
-    total = (n - 1) * S
-    own_j = [0] * S
-    content = [0] * total
-    fwd_j = [-1] * total
-    own_at = [-1] * total
-    step_at = {0: -1}                   # emission index -> consume step
-    j = 0
+    def fwd_j(self, m):
+        return self._s[1, m]
 
-    def emit_own(k):
-        nonlocal j
-        own_j[k] = j
-        j += 1
+    def own_at(self, m):
+        return self._s[2, m]
 
-    emit_own(0)
-    # arrival m's content: my arrival stream is the upstream's emission
-    # stream; its k-th own is my depth-0 content (chunk idx-1, slice k),
-    # and its forward of ITS arrival m' is my (content[m'] + one hop)
-    emissions = [("own", 0)]            # E, in order
+    def own_j(self, k):
+        return self._s[4, k]
 
-    for m in range(total):
-        kind, val = emissions[m]
-        content[m] = val if kind == "own" else content[val] + S
-        if m + 1 < S:
-            own_at[m] = m + 1
-            step_at[j] = m
-            emit_own(m + 1)
-            emissions.append(("own", m + 1))
-        if content[m] < (n - 2) * S:    # not yet at the last hop
-            fwd_j[m] = j
-            step_at[j] = m
-            j += 1
-            emissions.append(("fwd", m))
-    assert j == total and len(emissions) == total, (j, len(emissions))
-    assert sorted(content) == list(range(total))
-    assert all(step_at[m] < m for m in range(total)), (n, S)        # P1
-    assert all(jj - st <= S for jj, st in step_at.items()), (n, S)  # P2
-
-    # single-wait bookkeeping for send semaphores: a forward's send is
-    # waited at its own consume step; an own send is waited by the NEXT
-    # same-slot emission's pre-wait iff that emission exists AND the
-    # preceding same-slot emission was an own (forwards self-wait)
-    own_js = set(own_j)
-    tail_own_js = [oj for oj in own_j
-                   if oj + n_slots >= total]   # no same-slot successor
-    return content, fwd_j, own_at, own_j, own_js, tail_own_js
+    def is_own_j(self, j):
+        return (j >= 0) & (self._s[3, jnp.clip(j, 0, self._total - 1)] == 1)
 
 
 def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
@@ -1345,7 +1303,7 @@ def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
                       send_sem, recv_sem, credit_sem, *, n: int,
                       n_slices: int, n_slots: int, slice_rows: int,
                       block_size: int, mantissa_bits: int, rounding: str,
-                      flow_control: bool, unrolled: bool, schedule: tuple):
+                      flow_control: bool, unrolled: bool, emitter):
     """HBM-streaming fused ring all-gather, interleaved emission order.
 
     Loop index m = arrival order (== upstream's emission order; wire slots
@@ -1383,51 +1341,27 @@ def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
     SB = R // block_size
     chunk_rows = S * R
     total = (n - 1) * S                 # arrivals == emissions
-    # the static schedule arrives twice: as python lists (compile-time —
-    # drives the unrolled interpreter schedule and the static tail-drain
-    # list) and as the sched_ref SMEM input (runtime — the rolled hardware
-    # schedule reads it; in-kernel jnp table constants are rejected by the
-    # Mosaic compiler: "kernel captures constants ... pass them as inputs")
-    (content_t, fwd_j_t, own_at_t, own_j_t, own_js,
-     tail_own_js) = schedule
 
     def wslot(x):
         return x % n_slots
 
+    # the static schedule arrives twice: as the emitter's python tables
+    # (compile-time — drives the unrolled interpreter schedule and the
+    # static tail-drain list) and as the sched_ref SMEM input (runtime —
+    # the rolled hardware schedule reads it; in-kernel jnp table
+    # constants are rejected by the Mosaic compiler: "kernel captures
+    # constants ... pass them as inputs").  Both views read the SAME
+    # `ag_schedule` tables; `_SmemAgSchedule` is only a reading style.
     if unrolled:
+        acc_sched = emitter.sched
+
         def content(m):
-            return content_t[m]
-
-        def fwd_j(m):
-            return fwd_j_t[m]
-
-        def own_at(m):
-            return own_at_t[m]
-
-        def own_j(k):
-            return own_j_t[k]
-
-        def is_own_j(j):
-            return j >= 0 and j in own_js
+            return emitter.sched.content_t[m]
     else:
-        # static dispatch tables, one scalar SMEM load per schedule
-        # decision (sched_ref rows: 0 content, 1 fwd_j, 2 own_at,
-        # 3 own-mask, 4 own_j — built in _ag_stream_call)
+        acc_sched = _SmemAgSchedule(sched_ref, total)
 
         def content(m):
             return sched_ref[0, m]
-
-        def fwd_j(m):
-            return sched_ref[1, m]
-
-        def own_at(m):
-            return sched_ref[2, m]
-
-        def own_j(k):
-            return sched_ref[4, k]
-
-        def is_own_j(j):
-            return (j >= 0) & (sched_ref[3, jnp.clip(j, 0, total - 1)] == 1)
 
     def out_rdma(j, src):
         slot = wslot(j)
@@ -1436,10 +1370,20 @@ def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
             send_sem=send_sem.at[slot], recv_sem=recv_sem.at[slot],
             device_id=right, device_id_type=pltpu.DeviceIdType.LOGICAL)
 
+    def send(j, src):
+        # src=None: own emission out of its send_pkt slot; src=m: the
+        # onward forward straight out of arrival m's recv slot
+        buf = send_pkt.at[wslot(j)] if src is None \
+            else recv_pkt.at[wslot(src)]
+        out_rdma(j, buf).start()
+
     def wait_send(j):
         # wait_send consumes emission j's send sem; frame shapes are
         # uniform, so any same-shape src is a valid descriptor
         out_rdma(j, send_pkt.at[wslot(j)]).wait_send()
+
+    def wait_recv(m):
+        out_rdma(m, send_pkt.at[wslot(m)]).wait_recv()
 
     def ld_dma(k):
         return pltpu.make_async_copy(
@@ -1459,119 +1403,79 @@ def _ag_stream_kernel(ids_ref, sched_ref, own_hbm, out_hbm, ld, own_st, st,
                                      out_hbm.at[pl.ds(off, R)],
                                      wb_sem.at[m % 2])
 
-    if flow_control:
-        _neighbor_barrier(left, right)
+    # mant/scale flow from the encode op to the own-store op of the SAME
+    # send_own block (one `when` region — the emitter keeps them
+    # adjacent), stashed here between the two sink calls
+    last_enc = [None]
 
-    def send_own(k):
-        """Emit own slice k (emission own_j(k)): load, encode, locally
-        decode (the replica stores its own wire bytes), send."""
-        j = own_j(k)
-        ld_dma(k).start()
-        @_when(is_own_j(j - n_slots), unrolled)
-        def _pre_wait():                  # previous same-slot emission was
-            wait_send(j - n_slots)        # an own send (unwaited) AND its
-                                          # frame lives in this buffer slot:
-                                          # drain before overwriting below
-        ld_dma(k).wait()
+    def encode_own(j, k):
+        """Encode own slice k into emission j's frame slot (the replica
+        stores its own wire bytes — `own_store` below decodes the stash
+        so every replica sees wire-identical values)."""
         mant, scale = _encode_rows(ld[k % 2], block_size, mantissa_bits,
                                    rounding)
         slot = wslot(j)
         send_pkt[slot, pl.ds(0, R)] = mant
         send_pkt[slot, pl.ds(R, SB)] = scale
-        @_when(k >= 2, unrolled)
-        def _own_slot():
-            own_wb_dma(k - 2).wait()
+        last_enc[0] = (mant, scale)
+
+    def local_op(name, *args):
+        assert name == "own_store", name
+        k = args[0]
+        mant, scale = last_enc[0]
         own_st[k % 2] = _decode_rows(mant, scale, block_size)
-        own_wb_dma(k).start()
-        if flow_control:
-            @_when(j >= n_slots, unrolled)
-            def _credit():
-                pltpu.semaphore_wait(credit_sem, 1)
-        out_rdma(j, send_pkt.at[slot]).start()
 
-    def consume(m):
-        @_when(m >= 1, unrolled)
-        def _wb_prev():                   # 1-lag single wait: st slot
-            wb_dma(m - 1).wait()          # reuse at m covers wb(m-2)
-        slot = wslot(m)                   # arrival m's recv slot
-        out_rdma(m, send_pkt.at[wslot(m)]).wait_recv()
-        jf = fwd_j(m)                     # -1 when arrival m is terminal
-        fwd = jf >= 0
+    def decode_arrival(m):
+        # dst slot is the LOCAL st pipeline's (depth 2, cycled by
+        # arrival index, drained by wb_dma(m) which reads st[m % 2]);
+        # only the SRC uses the wire slot — conflating the two was a
+        # real out-of-bounds bug the moment the wire window grew past
+        # the st depth
+        slot = wslot(m)
+        st[m % 2] = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
+                                 recv_pkt[slot, pl.ds(R, SB)],
+                                 block_size)
 
-        def start_forward():
-            @_when(is_own_j(jf - n_slots), unrolled)
-            def _pre_wait():
-                wait_send(jf - n_slots)
-            if flow_control:
-                @_when(jf >= n_slots, unrolled)
-                def _credit():
-                    pltpu.semaphore_wait(credit_sem, 1)
-            out_rdma(jf, recv_pkt.at[slot]).start()
+    def dma_start(chan, i):
+        {"ld": lambda: ld_dma(i).start(),
+         "ownwb": lambda: own_wb_dma(i).start(),
+         "wb": lambda: wb_dma(i).start()}[chan]()
 
-        def decode_arrival():
-            # dst slot is the LOCAL st pipeline's (depth 2, cycled by
-            # arrival index, drained by wb_dma(m) which reads st[m % 2]);
-            # only the SRC uses the wire slot — conflating the two was a
-            # real out-of-bounds bug the moment the wire window grew past
-            # the st depth
-            st[m % 2] = _decode_rows(recv_pkt[slot, pl.ds(0, R)],
-                                     recv_pkt[slot, pl.ds(R, SB)],
-                                     block_size)
+    def dma_wait(chan, i):
+        {"ld": lambda: ld_dma(i).wait(),
+         "ownwb": lambda: own_wb_dma(i).wait(),
+         "wb": lambda: wb_dma(i).wait()}[chan]()
 
-        if unrolled:
-            # Interpreter primitive-lockstep hazard: a neighbor's emission
-            # primitive in THIS step can land in my recv slot before my
-            # decode primitive runs (the RS kernels are safe by a full
-            # iteration of separation; the interleaved gather is not).
-            # All reads first, then emissions — identical programs then
-            # order every device's reads before any device's same-step
-            # writes.  Hardware keeps forward-then-decode for overlap;
-            # its slot occupancy is credit-protected.
-            decode_arrival()
-            @_when(fwd, unrolled)
-            def _fwd_i():
-                start_forward()
-        else:
-            @_when(fwd, unrolled)
-            def _fwd_c():
-                start_forward()
-            decode_arrival()
-        @_when(fwd, unrolled)
-        def _fwd_done():                  # recv slot is upstream's next
-            wait_send(jf)                 # target: drain my forward first
-        if flow_control:
-            pltpu.semaphore_signal(credit_sem, inc=1, device_id=left,
-                                   device_id_type=pltpu.DeviceIdType.LOGICAL)
-        wb_dma(m).start()
+    # The schedule — the interleaved emission order, pre-wait rule,
+    # credit placement, st/ownwb windows, tail drains — is NOT written
+    # here: the kernel consumes the shared emitter
+    # (`verify.opstream.AgStreamEmitter`), the same object graftmc
+    # explores exhaustively with asynchronous landings (lockstep=True
+    # is the interpreter primitive-lockstep ordering: all reads before
+    # any same-step emission; hardware keeps forward-then-decode for
+    # overlap, its slot occupancy credit-protected).
+    sink = _KernelSink(
+        unrolled=unrolled, flow_control=flow_control,
+        barrier=lambda: _neighbor_barrier(left, right),
+        send=send, wait_send=wait_send, wait_recv=wait_recv,
+        credit_wait=lambda: pltpu.semaphore_wait(credit_sem, 1),
+        credit_signal=lambda: pltpu.semaphore_signal(
+            credit_sem, inc=1, device_id=left,
+            device_id_type=pltpu.DeviceIdType.LOGICAL),
+        credit_drain=lambda k: pltpu.semaphore_wait(credit_sem, k),
+        encode=lambda j, k: encode_own(j, k), decode=decode_arrival,
+        dma_start=dma_start, dma_wait=dma_wait, local=local_op)
 
-    send_own(0)
-
-    def step(m):
-        consume(m)
-        k = own_at(m)                     # next own-slice emission, if this
-        @_when(k >= 0, unrolled)          # arrival step schedules one
-        def _own():
-            send_own(k)
-
+    emitter.prologue(sink, acc_sched)
     if unrolled:
         for m in range(total):
-            step(m)
+            emitter.step(sink, m, acc_sched, lockstep=True)
     else:
         def body(m, _):
-            step(m)
+            emitter.step(sink, m, acc_sched, lockstep=False)
             return 0
         lax.fori_loop(0, total, body, 0)
-
-    wb_dma(total - 1).wait()
-    own_wb_dma(S - 1).wait()
-    if S >= 2:
-        own_wb_dma(S - 2).wait()
-    for jk in tail_own_js:                # own sends with no same-slot
-        wait_send(jk)                     # successor (static list)
-    if flow_control:
-        # residual credits: consumes signal `total`, sends with
-        # j >= n_slots consumed `total - n_slots` of them
-        pltpu.semaphore_wait(credit_sem, min(total, n_slots))
+    emitter.epilogue(sink)
 
 
 @functools.partial(jax.jit, static_argnames=(
@@ -1588,27 +1492,31 @@ def _ag_stream_call(own2, axis_name: Optional[str], block_size: int,
     pkt_rows = _frame_rows(R, block_size)
     ids = _ring_ids(axis_name)
     # slot window sized to the slice plan: covers the own phase's maximum
-    # emission lead (== S, _ag_schedule P2) with one slot of margin
-    n_slots = min((n - 1) * S, S + 2)
+    # emission lead (== S, ag_schedule P2) with one slot of margin — THE
+    # rule lives in the IR (opstream.ag_n_slots), next to the emitter
+    # graftmc explores
+    n_slots = _opstream.ag_n_slots(n, S)
     _interp, _flow, _unrolled = _interp_args(interpret)
-    schedule = _ag_schedule(n, S, n_slots)
-    content_t, fwd_j_t, own_at_t, own_j_t, own_js, _tails = schedule
+    emitter = _opstream.AgStreamEmitter(n, S)
+    assert emitter.n_slots == n_slots, (emitter.n_slots, n_slots)
+    sc = emitter.sched
     total = (n - 1) * S
-    # SMEM copy of the schedule for the rolled (hardware) path; rows:
-    # content / fwd_j / own_at / own-mask / own_j (padded with -1)
+    # SMEM copy of the emitter's schedule for the rolled (hardware)
+    # path; rows: content / fwd_j / own_at / own-mask / own_j (padded
+    # with -1) — read back through _SmemAgSchedule
     import numpy as np
     sched_np = np.full((5, total), -1, np.int32)
-    sched_np[0] = content_t
-    sched_np[1] = fwd_j_t
-    sched_np[2] = own_at_t
-    sched_np[3] = [1 if j in own_js else 0 for j in range(total)]
-    sched_np[4, :S] = own_j_t
+    sched_np[0] = sc.content_t
+    sched_np[1] = sc.fwd_j_t
+    sched_np[2] = sc.own_at_t
+    sched_np[3] = [1 if j in sc.own_js else 0 for j in range(total)]
+    sched_np[4, :S] = sc.own_j_t
     sched = jnp.asarray(sched_np)
     kern = functools.partial(
         _ag_stream_kernel, n=n, n_slices=S, n_slots=n_slots, slice_rows=R,
         block_size=block_size, mantissa_bits=mantissa_bits,
         rounding=rounding, flow_control=_flow, unrolled=_unrolled,
-        schedule=schedule)
+        emitter=emitter)
     vma = jax.typeof(own2).vma | jax.typeof(ids).vma
     return pl.pallas_call(
         kern,

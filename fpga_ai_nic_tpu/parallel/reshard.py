@@ -67,6 +67,11 @@ from . import mesh as mesh_lib
 from ..ops import fused_update
 from ..ops import integrity as integrity_lib
 from ..ops import ring as ring_ops
+# the shared protocol IR: the intersection table, owner map, union
+# layout and the transfer-action program (with its conservation message
+# ids) are defined once there and consumed both by the lowering below
+# and by graftmc's checked streams — no second definition to drift
+from ..verify import opstream as _opstream
 
 __all__ = [
     "Transfer", "FlatPlan", "ResidualPlan", "ReshardPlan",
@@ -95,41 +100,17 @@ def split_state_leaves(leaves: Dict[str, Any]
                              if k.startswith("opt.")}
 
 
-class Transfer(NamedTuple):
-    """One intersection-table segment: ``length`` contiguous live elements
-    moving from source device ``src`` (at chunk-local ``src_off``) to
-    target device ``dst`` (at chunk-local ``dst_off``).  ``src == dst``
-    means the bytes stay resident — a local copy, zero wire."""
-
-    src: int
-    dst: int
-    src_off: int
-    dst_off: int
-    length: int
-
-
-def intersection_table(live: int, chunk_src: int,
-                       chunk_tgt: int) -> Tuple[Transfer, ...]:
-    """Source->target shard intersections of a [live] flat vector chunked
-    ``chunk_src`` per source device vs ``chunk_tgt`` per target device:
-    cut [0, live) at every chunk boundary of either layout; each segment
-    between consecutive cuts has exactly one owner on each side.  The
-    segments PARTITION the live range (asserted), so nothing is moved
-    twice and nothing is dropped."""
-    assert live > 0 and chunk_src > 0 and chunk_tgt > 0
-    cuts = {0, live}
-    cuts.update(range(chunk_src, live, chunk_src))
-    cuts.update(range(chunk_tgt, live, chunk_tgt))
-    edges = sorted(cuts)
-    table = []
-    for a, b in zip(edges, edges[1:]):
-        src, dst = a // chunk_src, a // chunk_tgt
-        table.append(Transfer(src=src, dst=dst,
-                              src_off=a - src * chunk_src,
-                              dst_off=a - dst * chunk_tgt,
-                              length=b - a))
-    assert sum(t.length for t in table) == live
-    return tuple(table)
+# One intersection-table segment: ``length`` contiguous live elements
+# moving from source device ``src`` (at chunk-local ``src_off``) to
+# target device ``dst`` (at chunk-local ``dst_off``); ``src == dst``
+# means the bytes stay resident.  Transfer IS the IR's segment type,
+# and `intersection_table` IS the IR's partition function (cut [0, live)
+# at every chunk boundary of either layout; the segments PARTITION the
+# live range, asserted there) — one definition, consumed by this
+# lowering and explored by graftmc.  tests pin the delegation by
+# identity.
+Transfer = _opstream.Seg
+intersection_table = _opstream.reshard_segments
 
 
 class FlatPlan(NamedTuple):
@@ -190,13 +171,11 @@ class ResidualPlan(NamedTuple):
                                if i != o)
 
 
-def residual_owners(n_src: int, n_tgt: int) -> Tuple[int, ...]:
-    """Old device -> new owner assignment: contiguous groups, every old
-    residual has exactly one new home (mass is conserved), fresh devices
-    beyond the assignment start at zero (a new replica has dropped
-    nothing yet)."""
-    assert n_src > 0 and n_tgt > 0
-    return tuple(i * n_tgt // n_src for i in range(n_src))
+# Old device -> new owner assignment: contiguous groups, every old
+# residual has exactly one new home (mass is conserved), fresh devices
+# beyond the assignment start at zero (a new replica has dropped
+# nothing yet).  THE definition lives in the IR.
+residual_owners = _opstream.reshard_owners
 
 
 class ReshardPlan(NamedTuple):
@@ -244,21 +223,14 @@ def make_plan(live: int, n_src: int, padded_src: int, n_tgt: int,
     vectors (source layout [padded_src] over n_src devices, target
     [padded_tgt] over n_tgt) plus, with ``residual=True``, per-device EF
     residuals ([padded_src] each -> [padded_tgt] each)."""
-    assert padded_src % n_src == 0, (padded_src, n_src)
-    assert padded_tgt % n_tgt == 0, (padded_tgt, n_tgt)
     assert 0 < live <= min(padded_src, padded_tgt)
     assert n_flat_leaves >= 1
-    n_union = max(n_src, n_tgt)
-    if n_tgt <= n_src:
-        # shrink: the union layout IS the source layout — no seeding
-        chunk_src, seed_len = padded_src // n_src, padded_src
-    else:
-        # grow: the source vector is re-laid onto n_union devices first
-        # (seed device_put); the smallest even chunking that holds the
-        # live elements keeps the seed cheap
-        chunk_src = -(-live // n_union)
-        seed_len = n_union * chunk_src
-    chunk_tgt = padded_tgt // n_tgt
+    # shrink: the union layout IS the source layout — no seeding; grow:
+    # the source re-lays onto n_union devices first (seed device_put).
+    # THE arithmetic lives in the IR (one definition with the checker's
+    # grid cells).
+    chunk_src, chunk_tgt, n_union, seed_len = _opstream.union_layout(
+        live, n_src, padded_src, n_tgt, padded_tgt)
     flat = FlatPlan(live=live, n_src=n_src, n_tgt=n_tgt, n_union=n_union,
                     chunk_src=chunk_src, chunk_tgt=chunk_tgt,
                     padded_src=padded_src, padded_tgt=padded_tgt,
@@ -301,27 +273,30 @@ def _move_chunk(plan: FlatPlan, ax: str, chunk: jax.Array,
     of two odd per-axis weights would collide across leaves).  Resident
     copies never touch a wire and are not checksummed.  No checksum
     rides the wire: the J8 ppermute byte accounting is identical either
-    way."""
+    way.  The segment order, wire-vs-resident classification and message
+    ids are CONSUMED from the IR's action program
+    (`opstream.reshard_leaf_actions`) — the same list the checked
+    per-node streams expand."""
     out = jnp.zeros((plan.chunk_tgt,), chunk.dtype)
-    for ti, t in enumerate(plan.table):
-        payload = lax.dynamic_slice_in_dim(chunk, t.src_off, t.length)
-        if t.src != t.dst:
+    for act in _opstream.reshard_leaf_actions(plan.table, base):
+        payload = lax.dynamic_slice_in_dim(chunk, act.src_off, act.length)
+        if act.kind == "xfer":
             if chk is not None:
-                w = integrity_lib.hop_weight(base + ti)
+                w = integrity_lib.hop_weight(act.msg)
                 sa, ra = chk
                 sa = sa + jnp.where(
-                    idx == t.src,
+                    idx == act.src,
                     w * integrity_lib.word_checksum(payload), jnp.uint32(0))
-            payload = lax.ppermute(payload, ax, [(t.src, t.dst)])
+            payload = lax.ppermute(payload, ax, [(act.src, act.dst)])
             payload = ring_ops._tap_wire((payload,), "reshard.wire",
-                                         consumed=idx == t.dst)[0]
+                                         consumed=idx == act.dst)[0]
             if chk is not None:
                 ra = ra + jnp.where(
-                    idx == t.dst,
+                    idx == act.dst,
                     w * integrity_lib.word_checksum(payload), jnp.uint32(0))
                 chk = (sa, ra)
-        upd = lax.dynamic_update_slice_in_dim(out, payload, t.dst_off, 0)
-        out = jnp.where(idx == t.dst, upd, out)
+        upd = lax.dynamic_update_slice_in_dim(out, payload, act.dst_off, 0)
+        out = jnp.where(idx == act.dst, upd, out)
     return out if chk is None else (out, chk)
 
 
@@ -337,26 +312,26 @@ def _move_residual(plan: ResidualPlan, ax: str, resid: jax.Array,
     program-wide message counter past the flat leaves' segments)."""
     live = lax.dynamic_slice_in_dim(resid, 0, plan.live)
     out = jnp.zeros((plan.pad_tgt,), resid.dtype)
-    for i, owner in enumerate(plan.owners):
-        if i == owner:
+    for ra_ in _opstream.reshard_residual_actions(plan.owners, base):
+        if ra_.kind == "keep":
             payload = live
         else:
             if chk is not None:
-                w = integrity_lib.hop_weight(base + i)
+                w = integrity_lib.hop_weight(ra_.msg)
                 sa, ra = chk
                 sa = sa + jnp.where(
-                    idx == i,
+                    idx == ra_.src,
                     w * integrity_lib.word_checksum(live), jnp.uint32(0))
-            payload = lax.ppermute(live, ax, [(i, owner)])
+            payload = lax.ppermute(live, ax, [(ra_.src, ra_.dst)])
             payload = ring_ops._tap_wire((payload,), "reshard.wire",
-                                         consumed=idx == owner)[0]
+                                         consumed=idx == ra_.dst)[0]
             if chk is not None:
                 ra = ra + jnp.where(
-                    idx == owner,
+                    idx == ra_.dst,
                     w * integrity_lib.word_checksum(payload), jnp.uint32(0))
                 chk = (sa, ra)
         upd = out.at[:plan.live].add(payload)
-        out = jnp.where(idx == owner, upd, out)
+        out = jnp.where(idx == ra_.dst, upd, out)
     return out if chk is None else (out, chk)
 
 
@@ -383,20 +358,25 @@ def lower_apply(plan: ReshardPlan, union_mesh: Mesh, ax: str, *,
     fp = plan.flat
     n_ops = plan.n_flat_leaves + (1 if plan.residual is not None else 0)
 
+    # the program-wide message counter (one DISTINCT odd weight per
+    # message across all leaves + residual) — from the IR, shared with
+    # the checked streams and audited by M2
+    leaf_bases, resid_base = _opstream.reshard_msg_bases(
+        len(fp.table), plan.n_flat_leaves)
+
     def body(*chunks: jax.Array) -> Tuple[jax.Array, ...]:
         idx = lax.axis_index(ax)
         chk = integrity_lib.zero_carry() if integrity else None
         outs = []
         for li, c in enumerate(chunks[:plan.n_flat_leaves]):
             res = _move_chunk(fp, ax, c, idx, chk=chk,
-                              base=li * len(fp.table))
+                              base=leaf_bases[li])
             if integrity:
                 res, chk = res
             outs.append(res)
         if plan.residual is not None:
             res = _move_residual(plan.residual, ax, chunks[-1], idx,
-                                 chk=chk,
-                                 base=plan.n_flat_leaves * len(fp.table))
+                                 chk=chk, base=resid_base)
             if integrity:
                 res, chk = res
             outs.append(res)

@@ -53,7 +53,17 @@ from ..models import llama_decode
 from ..models.llama import LlamaConfig
 from ..ops import integrity as integrity_lib
 from ..ops import ring as ring_ops
+# the shared protocol IR: the block order of a migration (and with it
+# the per-block ledger-compare weights) is emitted once there and
+# consumed both by the lowering below and by graftmc's checked handoff
+# streams (verify.opstream.handoff_op_stream)
+from ..verify import opstream as _opstream
 from .paged import ServeConfig
+
+# THE block order of one KV migration — tests pin the delegation by
+# identity (a reorder would silently re-pair ledger weights: the M2
+# class)
+handoff_program = _opstream.handoff_program
 
 __all__ = ["HandoffPlan", "make_plan", "plan_for", "lower_apply",
            "abstract_operands", "apply_handoff", "pair_mesh"]
@@ -165,7 +175,11 @@ def lower_apply(plan: HandoffPlan, mesh: Mesh, ax: str = REP_AXIS, *,
         i = lax.axis_index(ax)
         outs = []
         blocks = []
-        for p in pools:
+        # block order CONSUMED from the IR program: position == the
+        # block's odd multiplier in gathered_page_checksums, so the
+        # ledger weights here and in the checked stream are one fact
+        for mv in handoff_program(plan.n_layers):
+            p = pools[mv.pool]
             # exact-length payload: ONLY the migrating pages cross —
             # [n_move, kv_local, page_size, hd] per layer per K/V
             payload = jnp.take(p[0], src_idx, axis=0)

@@ -211,51 +211,87 @@ def run_random(model: Any, seed: int = 0,
 # route builders: one model per grid cell
 # ---------------------------------------------------------------------------
 
-def build_flat(n: int, S: int, depth: int) -> RingModel:
-    ops, n_slots = opstream.rs_op_stream(n, S, depth)
+def build_flat(n: int, S: int, depth: int,
+               integrity: bool = False) -> RingModel:
+    ops, n_slots = opstream.rs_op_stream(n, S, depth,
+                                         integrity=integrity)
     return RingModel(n, ops, n_slots,
-                     meta={"route": "flat", "n": n, "S": S, "depth": depth})
+                     meta={"route": "flat", "n": n, "S": S, "depth": depth,
+                           **({"integrity": True} if integrity else {})})
 
 
 def build_streaming(n: int, S: int, depth: int,
-                    opt_kind: Optional[str] = None) -> RingModel:
+                    opt_kind: Optional[str] = None,
+                    integrity: bool = False) -> RingModel:
     ops, n_slots = opstream.rs_stream_op_stream(n, S, depth,
-                                                opt_kind=opt_kind)
+                                                opt_kind=opt_kind,
+                                                integrity=integrity)
     return RingModel(n, ops, n_slots,
                      meta={"route": "streaming", "n": n, "S": S,
-                           "depth": depth, "opt": opt_kind or "none"})
+                           "depth": depth, "opt": opt_kind or "none",
+                           **({"integrity": True} if integrity else {})})
 
 
-def build_hier(n: int, ni: int, s_inter: int) -> PairModel:
-    streams = opstream.hier_op_stream(n, ni, s_inter)
+def build_ag(n: int, S: int,
+             phys_slots: Optional[int] = None) -> RingModel:
+    """The streaming all-gather's interleaved emission schedule under
+    the full wait/credit protocol.  ``phys_slots`` overrides the
+    MODEL's slot window only (the protocol stream keeps its planned
+    window) — the anti-vacuity shrink: one physical slot fewer than the
+    plan must overwrite."""
+    ops, n_slots = opstream.ag_op_stream(n, S)
+    return RingModel(n, ops,
+                     n_slots if phys_slots is None else phys_slots,
+                     meta={"route": "ag", "n": n, "S": S,
+                           **({"phys_slots": phys_slots}
+                              if phys_slots is not None else {})})
+
+
+def build_hier(n: int, ni: int, s_inter: int,
+               integrity: bool = False) -> PairModel:
+    streams = opstream.hier_op_stream(n, ni, s_inter,
+                                      integrity=integrity)
     return PairModel(streams, meta={"route": "hier", "n": n, "ni": ni,
-                                    "S": s_inter})
+                                    "S": s_inter,
+                                    **({"integrity": True}
+                                       if integrity else {})})
+
+
+def build_handoff(n_layers: int, integrity: bool = False) -> PairModel:
+    streams = opstream.handoff_op_stream(n_layers, integrity=integrity)
+    return PairModel(streams, meta={"route": "handoff",
+                                    "n_layers": n_layers,
+                                    "integrity": integrity})
 
 
 def reshard_layout(live: int, n_src: int, n_tgt: int
                    ) -> Tuple[int, int, int]:
-    """(chunk_src, chunk_tgt, n_union) — the union layout arithmetic of
-    `parallel.reshard.make_plan` (jax-free twin; equivalence pinned by
-    tests/test_verify.py)."""
-    n_union = max(n_src, n_tgt)
+    """(chunk_src, chunk_tgt, n_union) of a grid cell under the default
+    ceil-padding — a thin view over THE union arithmetic
+    (`opstream.union_layout`, which `parallel.reshard.make_plan` also
+    consumes: one definition)."""
     padded_src = -(-live // n_src) * n_src
     padded_tgt = -(-live // n_tgt) * n_tgt
-    if n_tgt <= n_src:
-        chunk_src = padded_src // n_src
-    else:
-        chunk_src = -(-live // n_union)
-    return chunk_src, padded_tgt // n_tgt, n_union
+    cs, ct, nu, _seed = opstream.union_layout(live, n_src, padded_src,
+                                              n_tgt, padded_tgt)
+    return cs, ct, nu
 
 
 def build_reshard(live: int, n_src: int, n_tgt: int,
-                  residual: bool = False) -> PairModel:
+                  residual: bool = False,
+                  integrity: bool = False,
+                  n_flat_leaves: int = 1) -> PairModel:
     chunk_src, chunk_tgt, n_union = reshard_layout(live, n_src, n_tgt)
     owners = reshard_owners(n_src, n_tgt) if residual else None
     streams = opstream.reshard_op_stream(live, chunk_src, chunk_tgt,
-                                         n_union, owners)
+                                         n_union, owners,
+                                         n_flat_leaves=n_flat_leaves,
+                                         integrity=integrity)
     return PairModel(streams, meta={"route": "reshard", "live": live,
                                     "n_src": n_src, "n_tgt": n_tgt,
-                                    "residual": residual})
+                                    "residual": residual,
+                                    **({"integrity": True}
+                                       if integrity else {})})
 
 
 def flat_cells() -> List[Tuple[int, int, int]]:
@@ -263,10 +299,21 @@ def flat_cells() -> List[Tuple[int, int, int]]:
             for S in range(1, S_MAX + 1) for D in range(1, D_MAX + 1)]
 
 
+def ag_cells() -> List[Tuple[int, int]]:
+    return [(n, S) for n in range(2, N_MAX + 1)
+            for S in range(1, S_MAX + 1)]
+
+
 def hier_cells() -> List[Tuple[int, int, int]]:
     return [(n, ni, s) for n in range(2, N_MAX + 1)
             for ni in range(1, n + 1) if n % ni == 0
             for s in (1, 2)]
+
+
+def handoff_cells() -> List[Tuple[int, bool]]:
+    # n_layers spans trivial -> multi-block; integrity adds the ledger
+    # chk pairs + the verdict exchange (the route M2 audits)
+    return [(L, integ) for L in (1, 2, 3) for integ in (False, True)]
 
 
 def reshard_cells() -> List[Tuple[int, int, int, bool]]:
@@ -298,41 +345,76 @@ class CellReport:
 
 
 @dataclass
+class RouteStats:
+    """One route's share of the corpus — the envelope artifact's rows
+    (MC_ENVELOPE_r*.json), gated two-sided by obs-gate mc.* keys so a
+    silent envelope shrink is a CI failure, not a diff nobody reads."""
+
+    route: str
+    cells: int = 0
+    states: int = 0
+    branch_points: int = 0
+    wall_s: float = 0.0
+
+
+@dataclass
 class CorpusStats:
     cells: int = 0
     states: int = 0
     branch_points: int = 0
     fuzz_runs: int = 0
+    routes: List[RouteStats] = field(default_factory=list)
     compare: List[Dict[str, Any]] = field(default_factory=list)
     failures: List[CellReport] = field(default_factory=list)
+    wall_s: float = 0.0
 
 
-def _mc_findings(route: str, cell: Tuple[Any, ...], message: str
-                 ) -> "Any":
+def _mc_findings(route: str, cell: Tuple[Any, ...], message: str,
+                 code: str = "M1") -> "Any":
     from ..lint.findings import Finding
-    return Finding("M1", f"<mc:{route}>", 0,
+    return Finding(code, f"<mc:{route}>", 0,
                    f"cell {cell}: {message}")
+
+
+def _static_violations(model: Any) -> List[Tuple[str, str]]:
+    """The static pre-passes over a model's streams, no interleaving
+    needed: per-node DMA discipline (single wait, ordered hazards, full
+    drain) on RingModel streams, and the M2 checksum-weight pass
+    (oddness, 1:1 emit/arrive pairing, program-distinctness) on every
+    stream.  Returns (kind, message) pairs."""
+    out: List[Tuple[str, str]] = []
+    if isinstance(model, RingModel):
+        dma = opstream.check_dma_discipline(model.ops)
+        if dma:
+            out.append(("dma", "; ".join(dma)))
+        m2 = opstream.check_weight_conservation(model.ops)
+    else:
+        m2 = opstream.check_weight_conservation(model.streams)
+    if m2:
+        out.append(("weights", "; ".join(m2)))
+    return out
 
 
 def run_cell(route: str, cell: Tuple[Any, ...],
              max_states: int = DEFAULT_MAX_STATES
              ) -> Tuple[CheckResult, Any]:
     """Build and exhaustively check one grid cell; returns the
-    CheckResult and the model (for replay)."""
+    CheckResult and the model (for replay).  The static passes (DMA
+    discipline, M2 weight conservation) run first — deterministic, no
+    interleaving needed."""
     builder: Dict[str, Callable[..., Any]] = {
         "flat": build_flat, "streaming": build_streaming,
-        "hier": build_hier, "reshard": build_reshard}
+        "ag": build_ag, "hier": build_hier, "reshard": build_reshard,
+        "handoff": build_handoff}
     model = builder[route](*cell)
-    # static per-node DMA discipline first: deterministic, no
-    # interleaving needed (streaming's ld/st/wb + fused-opt windows)
-    if isinstance(model, RingModel):
-        dma = opstream.check_dma_discipline(model.ops)
-        if dma:
-            res = CheckResult(ok=False, states=0, branch_points=0,
-                              terminal_paths=0, por=True,
-                              meta=dict(model.meta))
-            res.violation = Violation("dma", "; ".join(dma))
-            return res, model
+    static = _static_violations(model)
+    if static:
+        res = CheckResult(ok=False, states=0, branch_points=0,
+                          terminal_paths=0, por=True,
+                          meta=dict(model.meta))
+        res.violation = Violation(static[0][0],
+                                  "; ".join(m for _, m in static))
+        return res, model
     return check(model, por=True, max_states=max_states), model
 
 
@@ -343,41 +425,56 @@ def run_corpus(emit: Optional[Callable[[str], None]] = None,
     four routes, POR-vs-naive comparison on the reported cells, and the
     randomized seed-sweep fuzz beyond the envelope (n = 8).  Returns
     (findings, stats); findings non-empty => `make modelcheck` fails."""
+    import time
+    t_corpus = time.perf_counter()
     log = emit or (lambda s: None)
     findings: List[Any] = []
     stats = CorpusStats()
 
     def sweep(route: str, cells: Iterable[Tuple[Any, ...]]) -> None:
-        n_cells = 0
-        t_states = 0
+        t0 = time.perf_counter()
+        rs = RouteStats(route=route)
         for cell in cells:
             res, model = run_cell(route, cell)
-            n_cells += 1
-            t_states += res.states
+            rs.cells += 1
+            rs.states += res.states
+            rs.branch_points += res.branch_points
             stats.branch_points += res.branch_points
             if not res.ok:
                 assert res.violation is not None
                 msg = f"{res.violation.kind}: {res.violation.message}"
+                code = "M2" if res.violation.kind == "weights" else "M1"
                 stats.failures.append(CellReport(
                     route, cell, res.states, res.branch_points, False,
                     msg))
-                findings.append(_mc_findings(route, cell, msg))
+                findings.append(_mc_findings(route, cell, msg, code=code))
                 if counterexample_dir is not None \
                         and not res.inconclusive \
                         and res.violation.trace:
                     from . import replay
                     replay.export_counterexample(
                         model, res.violation, counterexample_dir)
-        stats.cells += n_cells
-        stats.states += t_states
-        log(f"[graftmc] route {route}: {n_cells} cells exhaustive, "
-            f"{t_states} states")
+        rs.wall_s = time.perf_counter() - t0
+        stats.routes.append(rs)
+        stats.cells += rs.cells
+        stats.states += rs.states
+        log(f"[graftmc] route {route}: {rs.cells} cells exhaustive, "
+            f"{rs.states} states, {rs.wall_s:.2f}s")
 
-    sweep("flat", flat_cells())
-    sweep("streaming", [c + (o,) for c in flat_cells()
-                        for o in (None, "adamw")])
-    sweep("hier", hier_cells())
-    sweep("reshard", reshard_cells())
+    # integrity variants ride every route whose lowering carries the
+    # PR-12 checksum ops — the chk pairs join the explored streams and
+    # the M2 static pass audits their weights per cell
+    sweep("flat", [c + (integ,) for c in flat_cells()
+                   for integ in (False, True)])
+    sweep("streaming", [c + v for c in flat_cells()
+                        for v in ((None, False), ("adamw", False),
+                                  (None, True), ("adamw", True))])
+    sweep("ag", ag_cells())
+    sweep("hier", [c + (integ,) for c in hier_cells()
+                   for integ in (False, True)])
+    sweep("reshard", [c + (integ,) for c in reshard_cells()
+                      for integ in (False, True)])
+    sweep("handoff", handoff_cells())
 
     # POR-vs-naive comparison on the reported cells (flat route; the
     # naive full DFS is only tractable on small cells)
@@ -415,7 +512,36 @@ def run_corpus(emit: Optional[Callable[[str], None]] = None,
                             f"fuzz {v.kind}: {v.message}"))
     log(f"[graftmc] fuzz beyond envelope: {stats.fuzz_runs} runs at "
         f"n={FUZZ_N}")
+    stats.wall_s = time.perf_counter() - t_corpus
     return findings, stats
+
+
+def envelope_record(stats: CorpusStats) -> Dict[str, Any]:
+    """The corpus as a bankable artifact (MC_ENVELOPE_r*.json): per-route
+    cell counts / states / branch points / wall time, the POR-vs-naive
+    comparison rows, fuzz count, totals.  tools/obs_gate.py extracts
+    mc.* metrics from it — cells/states two-sided exact (a silent
+    envelope shrink fails CI), wall time lower-is-better against the
+    explosion budget."""
+    return {
+        "schema_version": 1,
+        "routes": [{"route": r.route, "cells": r.cells,
+                    "states": r.states,
+                    "branch_points": r.branch_points,
+                    "wall_s": round(r.wall_s, 3)}
+                   for r in stats.routes],
+        "compare": [{"cell": list(c["cell"]),
+                     "por_states": c["por_states"],
+                     "naive_states": c["naive_states"],
+                     "reduction": round(c["reduction"], 2),
+                     "agree": c["agree"]} for c in stats.compare],
+        "fuzz_runs": stats.fuzz_runs,
+        "total_cells": stats.cells,
+        "total_states": stats.states,
+        "total_branch_points": stats.branch_points,
+        "failures": len(stats.failures),
+        "wall_s": round(stats.wall_s, 3),
+    }
 
 
 def run_fixture(path: str,
@@ -423,7 +549,8 @@ def run_fixture(path: str,
     """Load a fixture module (env hook GRAFTMC_FIXTURE — the J7-style
     anti-vacuity pattern): the module's ``build()`` returns a mutated
     model that MUST violate.  The violation surfaces as an M1 finding
-    (nonzero exit); a fixture that does NOT violate is itself a finding
+    (M2 for the static weight pass — same pass order as `run_cell`:
+    static first); a fixture that does NOT violate is itself a finding
     (the checker would be vacuous)."""
     import importlib.util
     spec = importlib.util.spec_from_file_location("graftmc_fixture", path)
@@ -431,11 +558,12 @@ def run_fixture(path: str,
     mod = importlib.util.module_from_spec(spec)
     spec.loader.exec_module(mod)
     model = mod.build()
-    if isinstance(model, RingModel):
-        dma = opstream.check_dma_discipline(model.ops)
-        if dma:
-            return [_mc_findings("fixture", (path,),
-                                 "dma: " + "; ".join(dma))]
+    static = _static_violations(model)
+    if static:
+        return [_mc_findings(
+            "fixture", (path,), f"{kind}: {msg}",
+            code="M2" if kind == "weights" else "M1")
+            for kind, msg in static]
     res = check(model)
     if res.ok:
         return [_mc_findings(

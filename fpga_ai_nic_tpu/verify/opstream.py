@@ -1,27 +1,59 @@
-"""The shared protocol IR: one op-stream definition per collective route.
+"""The shared protocol IR: one op-stream EMITTER per collective route.
 
 An *op stream* is the per-node wait/signal/transfer order of a protocol,
-as plain data — the exact program the emitted kernel executes, factored
-out of the kernel so the checked model and the shipped schedule cannot
-drift (`ops.ring_pallas._rs_op_stream` and `._rs_plan` are now thin
-delegates to this module).  Four routes are extracted:
+as plain data.  Since PR 14 every checked route is a **true delegate**
+of its kernel/lowering: the schedule is emitted exactly once, by an
+emitter in this module, and consumed twice —
+
+  - by `ListSink` here, producing the abstract op list the exhaustive
+    checker (`verify.mc.check`) and the randomized fuzz backend
+    (`verify.mc.run_random`, which IS `simulate_rs_protocol`) explore;
+  - by the real lowering's sink, mapping the SAME abstract ops onto
+    DMA starts/waits, semaphore signals, ppermute hops and VPU calls
+    (`ops.ring_pallas._KernelSink` inside the Pallas kernels;
+    `ops.ring_hier`, `parallel.reshard` and `serve.handoff` consume the
+    phase/action programs below for their XLA lowerings).
+
+Transcription drift is therefore structurally impossible: there is no
+second definition to drift (tests pin the delegation by identity, not
+by structural comparison).  Six routes:
 
   flat       the depth-D pipelined ring reduce-scatter
              (`ops.ring_pallas._rs_kernel`): barrier, prologue sends,
-             per-step launch/consume with the (D+1)-slot credit window.
+             per-step launch/consume with the (D+1)-slot credit window
+             (`RsEmitter`; optional fused-opt update + integrity ops).
   streaming  the HBM-streaming variant (`_rs_stream_kernel`): the same
              wire protocol plus the slice-load prefetch window (ld),
              the recv-side store-load/writeback pair (st/wb) with the
              single-wait discipline, and — with a fused optimizer — the
-             w/m/v 2-deep state window (optld/optwb per tensor).
+             w/m/v 2-deep state window (`RsStreamEmitter`).
+  ag         the HBM-streaming interleaved-emission ring all-gather
+             (`_ag_stream_kernel`): the `ag_schedule` emission order
+             (P1/P2) under the S+2 slot window with credits
+             (`AgStreamEmitter`) — the schedule that until PR 14 was
+             only *statically asserted*, now explored exhaustively.
   hier       `ops.ring_hier`'s two-hop schedule: the raw intra subring
              hops, the program-order intra->inter handoff, then the
              sliced double-buffered codec hops across groups
-             (`ops.ring._send`'s scan), RS then AG.
+             (`ops.ring._send`'s scan), RS then AG — phases, perms and
+             conservation message ids all from `hier_program`.
   reshard    `parallel.reshard`'s transfer program: one exact-length
              single-pair ppermute per owner-changing intersection
              segment, in table order, plus the EF-residual ownership
-             moves.
+             moves (`reshard_leaf_actions`/`reshard_residual_actions`,
+             message ids included).
+  handoff    `serve.handoff`'s KV-migration pair program: one gathered
+             page block per layer per K/V crossing the 2-device pair
+             mesh, plus the integrity verdict exchange
+             (`handoff_program`).
+
+With ``integrity=True`` the emitters add the PR-12 checksum ops as
+paired ``chk_emit``/``chk_arrive`` IR ops carrying their conservation
+message id and odd weight — the static M2 pass
+(`check_weight_conservation`) verifies every emission has exactly one
+arrival partner with the SAME weight, all weights odd and
+program-distinct, freezing the weight-collision bug class review caught
+twice in PR 12 as a tool.
 
 Two execution models give the streams small-step semantics shared by the
 exhaustive checker (`verify.mc.check`) and the randomized fuzz backend
@@ -67,6 +99,153 @@ OPT_N_STATE: Dict[str, int] = {"sgd": 0, "momentum": 1, "adamw": 2}
 DEFAULT_PIPE_DEPTH = 2
 
 
+def msg_weight(msg: int) -> int:
+    """THE odd conservation weight of message ``msg`` — the jax-free
+    twin of `ops.integrity.hop_weight` (2*msg + 1 mod 2^32; odd, hence
+    invertible, so a single corrupted word can never vanish from the
+    weighted sum).  tests/test_verify.py pins the equivalence; the M2
+    pass (`check_weight_conservation`) checks oddness and
+    program-distinctness of the weights the emitters attach."""
+    return (2 * msg + 1) & 0xFFFFFFFF
+
+
+# ---------------------------------------------------------------------------
+# the sink interface: every emitter emits through one of these
+# ---------------------------------------------------------------------------
+
+class OpSink:
+    """Abstract-op consumer.  An emitter calls exactly these methods, in
+    per-node program order; `ListSink` collects them as the checked op
+    stream, and each lowering implements a sink that maps them onto its
+    real DMA/semaphore/collective calls
+    (`ops.ring_pallas._KernelSink`).  ``when(cond)`` is the predication seam: with a python
+    bool it either runs or skips the decorated thunk (the checker and
+    the unrolled interpreter schedule); with a traced bool the kernel
+    sink lowers it to `pl.when` (the rolled hardware schedule) — one
+    emitter text therefore serves both execution styles."""
+
+    def when(self, cond: Any) -> Any:
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        raise NotImplementedError
+
+    def send(self, q: Any, src: Any = None) -> None:
+        raise NotImplementedError
+
+    def wait_send(self, j: Any) -> None:
+        raise NotImplementedError
+
+    def wait_recv(self, g: Any) -> None:
+        raise NotImplementedError
+
+    def credit_wait(self) -> None:
+        raise NotImplementedError
+
+    def credit_signal(self) -> None:
+        raise NotImplementedError
+
+    def credit_drain(self, k: int) -> None:
+        raise NotImplementedError
+
+    def encode(self, q: Any, src: Any = None) -> None:
+        raise NotImplementedError
+
+    def decode(self, g: Any) -> None:
+        raise NotImplementedError
+
+    def update(self, g: Any) -> None:
+        raise NotImplementedError
+
+    def local(self, name: str, *args: Any) -> None:
+        raise NotImplementedError
+
+    def dma_start(self, chan: str, i: Any, *conf: Tuple[str, Any]) -> None:
+        raise NotImplementedError
+
+    def dma_wait(self, chan: str, i: Any) -> None:
+        raise NotImplementedError
+
+    def chk_emit(self, msg: Any, carry: str = "wire",
+                 weight: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+    def chk_arrive(self, msg: Any, carry: str = "wire",
+                   weight: Optional[int] = None) -> None:
+        raise NotImplementedError
+
+
+class ListSink(OpSink):
+    """Collects the abstract op stream (the checker's view).  Driven
+    only with concrete indices/conditions — ``when`` evaluates its bool
+    immediately.  Checksum ops record ``(kind, carry, msg, weight)``
+    with the weight resolved through `msg_weight` unless overridden (the
+    override exists for M2's bad fixtures, which must be able to inject
+    a weight collision)."""
+
+    def __init__(self) -> None:
+        self.ops: List[Op] = []
+
+    def when(self, cond: Any) -> Any:
+        def deco(f: Any) -> None:
+            if cond:
+                f()
+        return deco
+
+    def barrier(self) -> None:
+        self.ops.append(("barrier",))
+
+    def send(self, q: Any, src: Any = None) -> None:
+        # ``src`` is a lowering hint (which buffer the frame leaves
+        # from — the AG forward reuses its arrival's recv slot); the
+        # wire protocol is src-agnostic, so the IR op records only q
+        self.ops.append(("send", q))
+
+    def wait_send(self, j: Any) -> None:
+        self.ops.append(("wait_send", j))
+
+    def wait_recv(self, g: Any) -> None:
+        self.ops.append(("wait_recv", g))
+
+    def credit_wait(self) -> None:
+        self.ops.append(("credit_wait",))
+
+    def credit_signal(self) -> None:
+        self.ops.append(("credit_signal",))
+
+    def credit_drain(self, k: int) -> None:
+        self.ops.append(("credit_drain", k))
+
+    def encode(self, q: Any, src: Any = None) -> None:
+        self.ops.append(("encode", q))
+
+    def decode(self, g: Any) -> None:
+        self.ops.append(("decode", g))
+
+    def update(self, g: Any) -> None:
+        self.ops.append(("update", g))
+
+    def local(self, name: str, *args: Any) -> None:
+        self.ops.append(("local", name, tuple(args)))
+
+    def dma_start(self, chan: str, i: Any, *conf: Tuple[str, Any]) -> None:
+        self.ops.append(("dma_start", chan, i,
+                         tuple((c, j) for c, j in conf if j >= 0)))
+
+    def dma_wait(self, chan: str, i: Any) -> None:
+        self.ops.append(("dma_wait", chan, i))
+
+    def chk_emit(self, msg: Any, carry: str = "wire",
+                 weight: Optional[int] = None) -> None:
+        self.ops.append(("chk_emit", carry, msg,
+                         msg_weight(msg) if weight is None else weight))
+
+    def chk_arrive(self, msg: Any, carry: str = "wire",
+                   weight: Optional[int] = None) -> None:
+        self.ops.append(("chk_arrive", carry, msg,
+                         msg_weight(msg) if weight is None else weight))
+
+
 class ProtocolError(Exception):
     """A protocol violation raised by a model's apply/terminal check.
     ``kind`` is one of: deadlock, recv_overwrite, send_overwrite,
@@ -110,59 +289,103 @@ def rs_plan(n: int, S: int, depth: Optional[int],
     return D, n_slots, launch_first
 
 
-def rs_op_stream(n: int, S: int, depth: Optional[int],
-                 default_depth: int = DEFAULT_PIPE_DEPTH
-                 ) -> Tuple[List[Op], int]:
-    """The per-node op stream of the deep-pipelined (VMEM-resident) RS
-    schedule — the exact wait/signal/transfer order `_rs_kernel`
-    executes (every node runs the identical program)."""
-    total = (n - 1) * S
-    D, n_slots, launch_first = rs_plan(n, S, depth, default_depth)
-    ops: List[Op] = [("barrier",)]
-    for q in range(D):                    # prologue: fill the pipe
-        ops.append(("send", q))
+class RsEmitter:
+    """THE deep-pipelined (VMEM-resident) RS program — the exact
+    wait/signal/transfer order `_rs_kernel` executes (every node runs
+    the identical program).  The kernel consumes this emitter through
+    its `_KernelSink`; the checker consumes it through `ListSink`
+    (`rs_op_stream`); there is no second copy of the schedule.
 
-    def launch(q: int) -> None:
-        if q >= total:
-            return
-        if q >= n_slots:
-            ops.append(("wait_send", q - n_slots))
-        if q >= n_slots:
-            ops.append(("credit_wait",))
-        ops.append(("send", q))
+    ``opt_kind`` adds the fused-optimizer final-hop ``update`` ops;
+    ``integrity`` adds the paired ``chk_emit``/``chk_arrive`` checksum
+    ops exactly where the kernel reads the frames (post-encode on the
+    send side, post-wait_recv on the receive side)."""
 
-    def consume(g: int) -> None:
-        ops.append(("wait_recv", g))
-        ops.append(("decode", g))
-        ops.append(("credit_signal",))
+    def __init__(self, n: int, S: int, depth: Optional[int],
+                 opt_kind: Optional[str] = None, integrity: bool = False,
+                 default_depth: int = DEFAULT_PIPE_DEPTH) -> None:
+        self.n = n
+        self.S = S
+        self.total = (n - 1) * S
+        self.D, self.n_slots, self.launch_first = rs_plan(
+            n, S, depth, default_depth)
+        self.final_g0 = (n - 2) * S
+        self.opt_kind = opt_kind
+        self.integrity = integrity
 
-    for g in range(total):
-        if launch_first:
-            launch(g + D)
-            consume(g)
+    def launch(self, sink: OpSink, q: Any) -> None:
+        @sink.when(q < self.total)
+        def _launch() -> None:
+            @sink.when(q >= self.n_slots)
+            def _reuse() -> None:         # frame slot q % n_slots drained?
+                sink.wait_send(q - self.n_slots)
+            sink.encode(q)
+            if self.integrity:
+                sink.chk_emit(q)
+            @sink.when(q >= self.n_slots)
+            def _credit() -> None:        # downstream freed the slot?
+                sink.credit_wait()
+            sink.send(q)
+
+    def consume(self, sink: OpSink, g: Any) -> None:
+        sink.wait_recv(g)
+        if self.integrity:
+            sink.chk_arrive(g)
+        sink.decode(g)
+        if self.opt_kind is not None:
+            @sink.when(g >= self.final_g0)
+            def _update() -> None:        # this slice lands in OUR chunk
+                sink.update(g)
+        sink.credit_signal()
+
+    def prologue(self, sink: OpSink) -> None:
+        sink.barrier()
+        for q in range(self.D):           # fill the pipe (no reuse:
+            self.launch(sink, q)          # D < n_slots, guards all false)
+
+    def step(self, sink: OpSink, g: Any) -> None:
+        if self.launch_first:
+            self.launch(sink, g + self.D)
+            self.consume(sink, g)
         else:
-            consume(g)
-            launch(g + D)
-    for j in range(max(0, total - n_slots), total):
-        ops.append(("wait_send", j))
-    ops.append(("credit_drain", min(total, n_slots)))
-    return ops, n_slots
+            self.consume(sink, g)
+            self.launch(sink, g + self.D)
+
+    def epilogue(self, sink: OpSink) -> None:
+        for j in range(max(0, self.total - self.n_slots), self.total):
+            sink.wait_send(j)
+        sink.credit_drain(min(self.total, self.n_slots))
+
+    def stream(self) -> Tuple[List[Op], int]:
+        sink = ListSink()
+        self.prologue(sink)
+        for g in range(self.total):
+            self.step(sink, g)
+        self.epilogue(sink)
+        return sink.ops, self.n_slots
+
+
+def rs_op_stream(n: int, S: int, depth: Optional[int],
+                 default_depth: int = DEFAULT_PIPE_DEPTH,
+                 opt_kind: Optional[str] = None,
+                 integrity: bool = False) -> Tuple[List[Op], int]:
+    """The checked view of `RsEmitter` (one emitter, two consumers)."""
+    return RsEmitter(n, S, depth, opt_kind=opt_kind, integrity=integrity,
+                     default_depth=default_depth).stream()
 
 
 # ---------------------------------------------------------------------------
 # op-stream extraction: HBM-streaming RS (+ fused-optimizer state window)
 # ---------------------------------------------------------------------------
 
-def rs_stream_op_stream(n: int, S: int, depth: Optional[int],
-                        opt_kind: Optional[str] = None,
-                        default_depth: int = DEFAULT_PIPE_DEPTH
-                        ) -> Tuple[List[Op], int]:
-    """The per-node op stream of `_rs_stream_kernel`: the flat-ring wire
-    protocol plus the streaming-only DMA windows —
+class RsStreamEmitter:
+    """THE HBM-streaming RS program — the flat-ring wire protocol plus
+    the streaming-only DMA windows, consumed by `_rs_stream_kernel`'s
+    sink AND by the checker:
 
       ld      send-side slice load, 2-deep, prefetched ONE emission
               ahead when ``launch_first and D + 2 <= S`` (the prefetch
-              RAW gate stated in the kernel);
+              RAW gate);
       st/wb   recv-side store-load + writeback pair, 2-deep, single-wait
               discipline (1-lag head wait when launch_first, in-loop
               wait at D == S);
@@ -173,98 +396,137 @@ def rs_stream_op_stream(n: int, S: int, depth: Optional[int],
     DMA ops carry their static hazard predecessors:
     ``("dma_start", chan, i, ((chan', j), ...))`` asserts each (chan',
     j) was *waited* before this start (VMEM slot reuse + the wb->ld RAW)
-    — `check_dma_discipline` verifies the discipline per node.
-    """
-    total = (n - 1) * S
-    D, n_slots, launch_first = rs_plan(n, S, depth, default_depth)
-    final_g0 = (n - 2) * S
-    prefetch = launch_first and D + 2 <= S
-    n_t = 0 if opt_kind is None else 1 + OPT_N_STATE[opt_kind]
-    ops: List[Op] = [("barrier",)]
+    — `check_dma_discipline` verifies the discipline per node (the
+    lowering sink ignores the predecessor annotations; they are the
+    checker's evidence, not schedule)."""
 
-    def dma_start(chan: str, i: int, *conf: Tuple[str, int]) -> None:
-        ops.append(("dma_start", chan, i,
-                    tuple((c, j) for c, j in conf if j >= 0)))
+    def __init__(self, n: int, S: int, depth: Optional[int],
+                 opt_kind: Optional[str] = None, integrity: bool = False,
+                 default_depth: int = DEFAULT_PIPE_DEPTH) -> None:
+        self.n = n
+        self.S = S
+        self.total = (n - 1) * S
+        self.D, self.n_slots, self.launch_first = rs_plan(
+            n, S, depth, default_depth)
+        self.final_g0 = (n - 2) * S
+        self.prefetch = self.launch_first and self.D + 2 <= S
+        self.opt_kind = opt_kind
+        self.n_t = 0 if opt_kind is None else 1 + OPT_N_STATE[opt_kind]
+        self.integrity = integrity
 
-    def dma_wait(chan: str, i: int) -> None:
-        ops.append(("dma_wait", chan, i))
-
-    def ld_start(i: int) -> None:
+    def _ld(self, sink: OpSink, i: Any) -> None:
         # window: ld(i-2) drained; RAW: ld reads what wb(i-S) wrote
-        dma_start("ld", i, ("ld", i - 2), ("wb", i - S))
+        sink.dma_start("ld", i, ("ld", i - 2), ("wb", i - self.S))
 
-    # prologue: fill the pipeline with emissions 0..D-1
-    if prefetch:
-        ld_start(0)
-    for q in range(D):
-        if prefetch:
-            if q + 1 < total:
-                ld_start(q + 1)
+    def prologue(self, sink: OpSink) -> None:
+        sink.barrier()
+        if self.prefetch:
+            self._ld(sink, 0)
+        for q in range(self.D):           # fill the pipeline: emissions
+            if self.prefetch:             # 0..D-1, no slot reuse yet
+                if q + 1 < self.total:
+                    self._ld(sink, q + 1)
+            else:
+                self._ld(sink, q)
+            sink.dma_wait("ld", q)
+            sink.encode(q)
+            if self.integrity:
+                sink.chk_emit(q)
+            sink.send(q)
+
+    def launch(self, sink: OpSink, q: Any) -> None:
+        @sink.when(q < self.total)
+        def _launch() -> None:
+            if self.prefetch:
+                @sink.when(q + 1 < self.total)
+                def _prefetch() -> None:  # hide the next HBM read
+                    self._ld(sink, q + 1)
+            else:
+                self._ld(sink, q)
+            @sink.when(q >= self.n_slots)
+            def _reuse() -> None:         # frame slot drained?
+                sink.wait_send(q - self.n_slots)
+            sink.dma_wait("ld", q)
+            sink.encode(q)
+            if self.integrity:
+                sink.chk_emit(q)
+            @sink.when(q >= self.n_slots)
+            def _credit() -> None:
+                sink.credit_wait()
+            sink.send(q)
+
+    def consume(self, sink: OpSink, g: Any) -> None:
+        if self.opt_kind is not None:
+            @sink.when(g >= self.final_g0 + 2)
+            def _opt_slot_free() -> None:  # VMEM window slot reuse guard
+                for t in range(self.n_t):
+                    sink.dma_wait(f"optwb{t}", g - 2)
+
+            @sink.when(g >= self.final_g0)
+            def _opt_ld() -> None:         # hide the state read under
+                for t in range(self.n_t):  # the wire wait + decode
+                    sink.dma_start(f"optld{t}", g,
+                                   (f"optld{t}", g - 2),
+                                   (f"optwb{t}", g - 2))
+        sink.dma_start("st", g, ("st", g - 2), ("wb", g - 2))
+        sink.wait_recv(g)
+        if self.integrity:
+            sink.chk_arrive(g)
+        sink.dma_wait("st", g)
+        sink.decode(g)
+        sink.credit_signal()
+        sink.dma_start("wb", g, ("wb", g - 2))
+        if self.opt_kind is not None:
+            @sink.when(g >= self.final_g0)
+            def _opt_update() -> None:     # grad wb streams out above
+                for t in range(self.n_t):  # while the VPU updates here
+                    sink.dma_wait(f"optld{t}", g)
+                sink.update(g)
+                for t in range(self.n_t):
+                    sink.dma_start(f"optwb{t}", g, (f"optwb{t}", g - 2))
+
+    def step(self, sink: OpSink, g: Any) -> None:
+        if self.launch_first:
+            @sink.when(g >= 1)
+            def _wb_prev() -> None:        # single wait, 1-iteration lag
+                sink.dma_wait("wb", g - 1)
+            self.launch(sink, g + self.D)
+            self.consume(sink, g)
         else:
-            ld_start(q)
-        dma_wait("ld", q)
-        ops.append(("encode", q))
-        ops.append(("send", q))
+            self.consume(sink, g)          # RAW is immediate at D == S
+            sink.dma_wait("wb", g)
+            self.launch(sink, g + self.D)
 
-    def launch(q: int) -> None:
-        if q >= total:
-            return
-        if prefetch:
-            if q + 1 < total:
-                ld_start(q + 1)       # hide the next HBM read
-        else:
-            ld_start(q)
-        if q >= n_slots:
-            ops.append(("wait_send", q - n_slots))
-        dma_wait("ld", q)
-        ops.append(("encode", q))
-        if q >= n_slots:
-            ops.append(("credit_wait",))
-        ops.append(("send", q))
+    def epilogue(self, sink: OpSink) -> None:
+        if self.launch_first:
+            sink.dma_wait("wb", self.total - 1)
+        if self.opt_kind is not None:
+            for gg in range(max(self.final_g0, self.total - 2),
+                            self.total):
+                for t in range(self.n_t):
+                    sink.dma_wait(f"optwb{t}", gg)
+        for j in range(max(0, self.total - self.n_slots), self.total):
+            sink.wait_send(j)
+        sink.credit_drain(min(self.total, self.n_slots))
 
-    def consume(g: int) -> None:
-        if opt_kind is not None and g >= final_g0 + 2:
-            for t in range(n_t):      # VMEM window slot reuse guard
-                dma_wait(f"optwb{t}", g - 2)
-        if opt_kind is not None and g >= final_g0:
-            for t in range(n_t):      # hide the state read under the
-                dma_start(f"optld{t}", g,     # wire wait + decode
-                          (f"optld{t}", g - 2), (f"optwb{t}", g - 2))
-        dma_start("st", g, ("st", g - 2), ("wb", g - 2))
-        ops.append(("wait_recv", g))
-        dma_wait("st", g)
-        ops.append(("decode", g))
-        ops.append(("credit_signal",))
-        dma_start("wb", g, ("wb", g - 2))
-        if opt_kind is not None and g >= final_g0:
-            for t in range(n_t):
-                dma_wait(f"optld{t}", g)
-            ops.append(("update", g))
-            for t in range(n_t):
-                dma_start(f"optwb{t}", g, (f"optwb{t}", g - 2))
+    def stream(self) -> Tuple[List[Op], int]:
+        sink = ListSink()
+        self.prologue(sink)
+        for g in range(self.total):
+            self.step(sink, g)
+        self.epilogue(sink)
+        return sink.ops, self.n_slots
 
-    if launch_first:
-        for g in range(total):
-            if g >= 1:                # single wait, 1-iteration lag
-                dma_wait("wb", g - 1)
-            launch(g + D)
-            consume(g)
-    else:
-        for g in range(total):        # RAW is immediate at D == S
-            consume(g)
-            dma_wait("wb", g)
-            launch(g + D)
 
-    if launch_first:
-        dma_wait("wb", total - 1)
-    if opt_kind is not None:
-        for gg in range(max(final_g0, total - 2), total):
-            for t in range(n_t):
-                dma_wait(f"optwb{t}", gg)
-    for j in range(max(0, total - n_slots), total):
-        ops.append(("wait_send", j))
-    ops.append(("credit_drain", min(total, n_slots)))
-    return ops, n_slots
+def rs_stream_op_stream(n: int, S: int, depth: Optional[int],
+                        opt_kind: Optional[str] = None,
+                        default_depth: int = DEFAULT_PIPE_DEPTH,
+                        integrity: bool = False) -> Tuple[List[Op], int]:
+    """The checked view of `RsStreamEmitter` (one emitter, two
+    consumers)."""
+    return RsStreamEmitter(n, S, depth, opt_kind=opt_kind,
+                           integrity=integrity,
+                           default_depth=default_depth).stream()
 
 
 # ---------------------------------------------------------------------------
@@ -311,61 +573,138 @@ def check_dma_discipline(ops: Sequence[Op]) -> List[str]:
 
 
 # ---------------------------------------------------------------------------
-# op-stream extraction: hierarchical two-hop schedule
+# the hierarchical two-hop program (consumed by ops.ring_hier AND the
+# checker — phases, perms and conservation message ids, one definition)
 # ---------------------------------------------------------------------------
 
+def intra_perm(n: int, ni: int) -> List[Tuple[int, int]]:
+    """Next-neighbor inside each group of ni consecutive ranks — THE
+    intra-subring permutation (`ops.ring_hier._intra_perm` delegates
+    here; the checker derives per-node src/dst from the same list)."""
+    return [(g * ni + j, g * ni + (j + 1) % ni)
+            for g in range(n // ni) for j in range(ni)]
+
+
+def inter_perm(n: int, ni: int) -> List[Tuple[int, int]]:
+    """Next-group, same intra position: the inter rings (THE
+    definition, as `intra_perm`)."""
+    ng = n // ni
+    return [(g * ni + j, ((g + 1) % ng) * ni + j)
+            for g in range(ng) for j in range(ni)]
+
+
+class HierPhase(NamedTuple):
+    """One phase of the hierarchical schedule: ``hops`` ring hops over
+    ``perm``, each hop carrying ``slices`` wire messages.  ``msg(s, k)``
+    is hop s / slice k's id in the owning conservation carry — the SAME
+    arithmetic `ops.ring_hier` feeds `integrity.hop_weight` (traced hop
+    indices welcome), so the checksum weights the lowering uses and the
+    weights M2 checks cannot diverge."""
+
+    kind: str                  # rs_intra | rs_inter | ag_inter | ag_intra
+    hops: int
+    slices: int                # wire messages per hop (s_inter on rs_inter)
+    base: int                  # carry message id of (hop 0, slice 0)
+    perm: Tuple[Tuple[int, int], ...]
+
+    def msg(self, s: Any, k: Any = 0) -> Any:
+        return self.base + s * self.slices + k
+
+
+class HierProgram(NamedTuple):
+    """The full two-hop schedule of `ops.ring_hier` over n = ni * ng
+    devices.  The RS phases share one conservation carry ("rs": intra
+    hop s is message s, inter hop s slice k is (ni-1) + s*s_inter + k);
+    the AG phases share another ("ag": inter hop s is message s, intra
+    hop s is (ng-1) + s) — exactly the counters `hier_reduce_scatter` /
+    `hier_all_gather` consume."""
+
+    n: int
+    ni: int
+    ng: int
+    s_inter: int
+    rs_intra: HierPhase
+    rs_inter: HierPhase
+    ag_inter: HierPhase
+    ag_intra: HierPhase
+
+
+def hier_program(n: int, ni: int, s_inter: int = 1) -> HierProgram:
+    """Build THE hierarchical phase program (validates the declared
+    factorization, as `ops.ring_hier.check_factorization`)."""
+    if ni < 1 or n % ni:
+        raise ValueError(f"intra size {ni} does not factor n={n}")
+    ng = n // ni
+    pa = tuple(intra_perm(n, ni))
+    pb = tuple(inter_perm(n, ni))
+    return HierProgram(
+        n=n, ni=ni, ng=ng, s_inter=s_inter,
+        rs_intra=HierPhase("rs_intra", ni - 1, 1, 0, pa),
+        rs_inter=HierPhase("rs_inter", ng - 1, s_inter, ni - 1, pb),
+        ag_inter=HierPhase("ag_inter", ng - 1, 1, 0, pb),
+        ag_intra=HierPhase("ag_intra", ni - 1, 1, ng - 1, pa))
+
+
+def _perm_neighbors(perm: Sequence[Tuple[int, int]],
+                    d: int) -> Tuple[int, int]:
+    """(dst, src) of node d under a permutation list."""
+    dst = next(b for a, b in perm if a == d)
+    src = next(a for a, b in perm if b == d)
+    return dst, src
+
+
 def hier_op_stream(n: int, ni: int, s_inter: int = 1,
-                   include_ag: bool = True) -> List[List[Op]]:
-    """Per-node op streams of `ops.ring_hier`'s two-hop schedule over a
-    flat axis of n = ni * ng devices (device d: group d // ni, intra
-    position d % ni).
+                   include_ag: bool = True,
+                   integrity: bool = False) -> List[List[Op]]:
+    """Per-node op streams of the hierarchical schedule, derived from
+    `hier_program` (the same phases/perms/message-ids `ops.ring_hier`
+    lowers — no second definition).
 
     RS: (ni-1) raw intra subring hops -> program-order handoff -> (ng-1)
     inter codec hops, each sliced into ``s_inter`` double-buffered
     payloads (`ops.ring._send`'s scan: send slice k, encode k+1, recv
     k).  AG (``include_ag``): the phases in reverse — (ng-1) inter
     gather hops (encode once, forward verbatim: one payload per hop)
-    then (ni-1) raw intra gather hops."""
-    if ni < 1 or n % ni:
-        raise ValueError(f"intra size {ni} does not factor n={n}")
-    ng = n // ni
+    then (ni-1) raw intra gather hops.  ``integrity`` adds the paired
+    chk ops per wire message (pre-send / post-recv, the `ops.ring._send`
+    placement) with the program's carry ("rs"/"ag") message ids."""
+    prog = hier_program(n, ni, s_inter)
     streams: List[List[Op]] = []
     for d in range(n):
-        g, j = d // ni, d % ni
-        ops: List[Op] = []
-        # phase A — raw intra reduce-scatter hops
-        for s in range(ni - 1):
-            dst = g * ni + (j + 1) % ni
-            src = g * ni + (j - 1) % ni
-            ops.append(("send_to", dst, ("rs_intra", s)))
-            ops.append(("recv_from", src, ("rs_intra", s)))
-            ops.append(("local", "accumulate", ("rs_intra", s)))
-        ops.append(("local", "handoff", ("intra->inter",)))
-        # phase B — sliced double-buffered codec hops across groups
-        for s in range(ng - 1):
-            dst = ((g + 1) % ng) * ni + j
-            src = ((g - 1) % ng) * ni + j
-            ops.append(("local", "encode", ("rs_inter", s, 0)))
-            for k in range(s_inter):
-                ops.append(("send_to", dst, ("rs_inter", s, k)))
-                if k + 1 < s_inter:   # encode k+1 while k is on the wire
-                    ops.append(("local", "encode", ("rs_inter", s, k + 1)))
-                ops.append(("recv_from", src, ("rs_inter", s, k)))
-                ops.append(("local", "decode", ("rs_inter", s, k)))
+        sink = ListSink()
+
+        def ring_hop(phase: HierPhase, s: int, carry: str,
+                     decode: bool = False, accumulate: bool = False,
+                     sliced: bool = False) -> None:
+            dst, src = _perm_neighbors(phase.perm, d)
+            if sliced:
+                sink.local("encode", phase.kind, s, 0)
+            for k in range(phase.slices):
+                if integrity:
+                    sink.chk_emit(phase.msg(s, k), carry=carry)
+                tag = ((phase.kind, s, k) if sliced else (phase.kind, s))
+                sink.ops.append(("send_to", dst, tag))
+                if sliced and k + 1 < phase.slices:
+                    sink.local("encode", phase.kind, s, k + 1)
+                sink.ops.append(("recv_from", src, tag))
+                if integrity:
+                    sink.chk_arrive(phase.msg(s, k), carry=carry)
+                if sliced and decode:
+                    sink.local("decode", phase.kind, s, k)
+            if accumulate:
+                sink.local("accumulate", phase.kind, s)
+
+        for s in range(prog.rs_intra.hops):       # phase A: raw intra RS
+            ring_hop(prog.rs_intra, s, "rs", accumulate=True)
+        sink.local("handoff", "intra->inter")
+        for s in range(prog.rs_inter.hops):       # phase B: sliced codec
+            ring_hop(prog.rs_inter, s, "rs", decode=True, sliced=True)
         if include_ag:
-            # phase B' — inter all-gather (encode once, forward verbatim)
-            for s in range(ng - 1):
-                dst = ((g + 1) % ng) * ni + j
-                src = ((g - 1) % ng) * ni + j
-                ops.append(("send_to", dst, ("ag_inter", s)))
-                ops.append(("recv_from", src, ("ag_inter", s)))
-            # phase A' — raw intra all-gather
-            for s in range(ni - 1):
-                dst = g * ni + (j + 1) % ni
-                src = g * ni + (j - 1) % ni
-                ops.append(("send_to", dst, ("ag_intra", s)))
-                ops.append(("recv_from", src, ("ag_intra", s)))
-        streams.append(ops)
+            for s in range(prog.ag_inter.hops):   # B': inter all-gather
+                ring_hop(prog.ag_inter, s, "ag")
+            for s in range(prog.ag_intra.hops):   # A': raw intra gather
+                ring_hop(prog.ag_intra, s, "ag")
+        streams.append(sink.ops)
     return streams
 
 
@@ -405,40 +744,528 @@ def reshard_segments(live: int, chunk_src: int,
 
 
 def reshard_owners(n_src: int, n_tgt: int) -> Tuple[int, ...]:
-    """EF-residual old-device -> new-owner map (jax-free twin of
-    `parallel.reshard.residual_owners`)."""
+    """EF-residual old-device -> new-owner map — THE definition
+    (`parallel.reshard.residual_owners` delegates here): contiguous
+    groups, every old residual has exactly one new home (mass is
+    conserved), fresh devices beyond the assignment start at zero."""
     assert n_src > 0 and n_tgt > 0
     return tuple(i * n_tgt // n_src for i in range(n_src))
 
 
+def union_layout(live: int, n_src: int, padded_src: int, n_tgt: int,
+                 padded_tgt: int) -> Tuple[int, int, int, int]:
+    """(chunk_src, chunk_tgt, n_union, seed_len) — THE union-mesh layout
+    arithmetic of a mesh-shape change (`parallel.reshard.make_plan`
+    consumes this; `verify.mc.reshard_layout` derives its grid cells
+    from it).  Shrink: the union layout IS the source layout, no
+    seeding; grow: the source re-lays onto n_union devices first with
+    the smallest even chunking that holds the live elements."""
+    assert padded_src % n_src == 0, (padded_src, n_src)
+    assert padded_tgt % n_tgt == 0, (padded_tgt, n_tgt)
+    n_union = max(n_src, n_tgt)
+    if n_tgt <= n_src:
+        chunk_src, seed_len = padded_src // n_src, padded_src
+    else:
+        chunk_src = -(-live // n_union)
+        seed_len = n_union * chunk_src
+    return chunk_src, padded_tgt // n_tgt, n_union, seed_len
+
+
+class SegMove(NamedTuple):
+    """One intersection segment as a transfer-program action: a
+    ``"xfer"`` crosses the wire (single-pair send/recv, conservation
+    message ``msg``), a ``"copy"`` stays resident (never checksummed)."""
+
+    kind: str                  # "xfer" | "copy"
+    seg_index: int
+    src: int
+    dst: int
+    src_off: int
+    dst_off: int
+    length: int
+    msg: int
+
+
+class ResidMove(NamedTuple):
+    """One EF-residual ownership move (``"keep"`` stays resident)."""
+
+    kind: str                  # "xfer" | "keep"
+    src: int
+    dst: int
+    msg: int
+
+
+def reshard_msg_bases(n_segs: int,
+                      n_flat_leaves: int) -> Tuple[Tuple[int, ...], int]:
+    """(per-leaf message bases, residual base) of the single
+    program-wide conservation counter: leaf li's segments are messages
+    [li*n_segs, (li+1)*n_segs), the residual moves follow — every
+    message in the transfer gets a DISTINCT odd weight (a product of
+    two odd per-axis weights would collide across leaves: the PR-12
+    class M2 freezes)."""
+    return (tuple(li * n_segs for li in range(n_flat_leaves)),
+            n_flat_leaves * n_segs)
+
+
+def reshard_leaf_actions(table: Sequence[Any],
+                         base: int = 0) -> List[SegMove]:
+    """One flat leaf's transfer actions in table order — THE program
+    `parallel.reshard._move_chunk` executes (message ids included) and
+    the checker expands."""
+    return [SegMove("copy" if t.src == t.dst else "xfer", ti,
+                    t.src, t.dst, t.src_off, t.dst_off, t.length,
+                    base + ti)
+            for ti, t in enumerate(table)]
+
+
+def reshard_residual_actions(owners: Sequence[int],
+                             base: int = 0) -> List[ResidMove]:
+    """The EF-residual moves in ascending-source order (the golden
+    twin's sum order) — THE program `parallel.reshard._move_residual`
+    executes."""
+    return [ResidMove("keep" if i == owner else "xfer", i, owner,
+                      base + i)
+            for i, owner in enumerate(owners)]
+
+
 def reshard_op_stream(live: int, chunk_src: int, chunk_tgt: int,
                       n_union: int,
-                      residual_owners_map: Optional[Sequence[int]] = None
-                      ) -> List[List[Op]]:
+                      residual_owners_map: Optional[Sequence[int]] = None,
+                      n_flat_leaves: int = 1,
+                      integrity: bool = False) -> List[List[Op]]:
     """Per-node op streams of the lowered reshard program
-    (`parallel.reshard.lower_apply`): the intersection segments in table
-    order — an exact-length single-pair send/recv when the owner
+    (`parallel.reshard.lower_apply`), derived from the SAME action
+    lists the lowering consumes: per leaf, the intersection segments in
+    table order — an exact-length single-pair send/recv when the owner
     changes, a resident copy when it does not — then the EF-residual
-    ownership moves in ascending-source order (the golden twin's sum
-    order)."""
+    ownership moves in ascending-source order.  ``integrity`` adds the
+    paired chk ops with the program-wide message counter
+    (`reshard_msg_bases`)."""
     segs = reshard_segments(live, chunk_src, chunk_tgt)
-    streams: List[List[Op]] = [[] for _ in range(n_union)]
-    for si, t in enumerate(segs):
-        if t.src == t.dst:
-            if t.src < n_union:
-                streams[t.src].append(("local", "copy", ("seg", si)))
-            continue
-        assert t.src < n_union and t.dst < n_union, (t, n_union)
-        streams[t.src].append(("send_to", t.dst, ("seg", si)))
-        streams[t.dst].append(("recv_from", t.src, ("seg", si)))
-    if residual_owners_map is not None:
-        for i, owner in enumerate(residual_owners_map):
-            if i == owner:
-                streams[i].append(("local", "resid_keep", ("resid", i)))
+    bases, resid_base = reshard_msg_bases(len(segs), n_flat_leaves)
+    sinks = [ListSink() for _ in range(n_union)]
+
+    def xfer(src: int, dst: int, tag: Op, msg: int) -> None:
+        assert src < n_union and dst < n_union, (tag, n_union)
+        if integrity:
+            sinks[src].chk_emit(msg)
+        sinks[src].ops.append(("send_to", dst, tag))
+        sinks[dst].ops.append(("recv_from", src, tag))
+        if integrity:
+            sinks[dst].chk_arrive(msg)
+
+    for li in range(n_flat_leaves):
+        for act in reshard_leaf_actions(segs, bases[li]):
+            if act.kind == "copy":
+                if act.src < n_union:
+                    sinks[act.src].local("copy", "seg", li, act.seg_index)
                 continue
-            streams[i].append(("send_to", owner, ("resid", i)))
-            streams[owner].append(("recv_from", i, ("resid", i)))
-    return streams
+            xfer(act.src, act.dst, ("seg", li, act.seg_index), act.msg)
+    if residual_owners_map is not None:
+        for ra in reshard_residual_actions(residual_owners_map,
+                                           resid_base):
+            if ra.kind == "keep":
+                sinks[ra.src].local("resid_keep", "resid", ra.src)
+                continue
+            xfer(ra.src, ra.dst, ("resid", ra.src), ra.msg)
+    return [s.ops for s in sinks]
+
+
+# ---------------------------------------------------------------------------
+# the streaming all-gather: schedule + emitter (consumed by
+# ops.ring_pallas._ag_stream_kernel AND the checker)
+# ---------------------------------------------------------------------------
+
+def ag_schedule(n: int, S: int, n_slots: int) -> Tuple[
+        List[int], List[int], List[int], List[int], Set[int], List[int]]:
+    """Explicit interleaved emission schedule for the streaming gather —
+    THE definition (`ops.ring_pallas._ag_schedule` is this function;
+    the kernel consumes it directly and via its SMEM copy).
+
+    Every node runs the SAME emission sequence E (the reference's
+    SEND_LOCAL/FORWARD beat multiplexing, hw/all_reduce.sv:891-1086),
+    built by simulating one node: per arrival step m, emit own slice m+1
+    (while the own phase lasts) and forward arrival m onward unless its
+    content is at the last hop.  Because arrivals ARE the upstream's
+    emissions in E order, wire slots and semaphores cycle by EMISSION
+    index j (mod n_slots on BOTH ends), and a node's m-th arrival has the
+    content of E[m] one hop deeper.  Simple closed forms exist only for
+    n >= 4 or S <= 2 (for n == 3, S >= 3 the terminal arrivals interleave
+    non-contiguously and punch holes in any arithmetic j assignment), so
+    the schedule is built explicitly — it is static per (n, S).
+
+    Two properties are asserted here per (n, S) because the kernel's
+    safety rests on them:
+
+      P1  m_e(m) < m: arrival m's emission is issued at a consume step
+          STRICTLY before step m on the identical upstream program — so
+          in the interpreter's lockstep-primitive model the data has
+          landed before consume(m) decodes it, and on hardware wait_recv
+          can always be satisfied.
+      P2  j - m_e(j) <= S: no emission runs more than S ahead of its
+          consume step (the own phase emits two frames per step for S-1
+          steps, which is the worst case).  With n_slots >= S + 1, the
+          overwrite of wire slot j % n_slots (emission j) therefore comes
+          after the decode of arrival j - n_slots in program order
+          (interpreter safety), and the credit window never dead-ends
+          (hardware): emission j's credit waits on downstream consume
+          j - n_slots <= m_e(j) - 1, a strictly earlier step, so every
+          cross-node dependency edge points from (step m, node) to
+          (step < m, neighbor) and the dependency graph is acyclic for
+          ARBITRARY S and n.  n_slots = S + 2 adds one slot of margin.
+
+    Since PR 14 the static sweep is no longer the only evidence: the
+    full wait/credit protocol over this schedule (`AgStreamEmitter`) is
+    explored exhaustively by graftmc over the standard envelope, with
+    asynchronous landings — the "statically asserted" ledger row is
+    retired (docs/KNOWN_FAILURES.md).
+
+    Returns (content[m], fwd_j[m], own_at[m], own_j[k], own_js,
+    tail_own_js):
+      content[m]   (chunk_depth_hops - 1) * S + slice of arrival m
+      fwd_j[m]     emission index of arrival m's onward forward, -1 if
+                   terminal (content at depth n-2)
+      own_at[m]    own slice emitted AFTER consuming arrival m (-1 none)
+      own_j[k]     emission index of own slice k
+      own_js       set(own_j) — membership drives the pre-wait rule
+      tail_own_js  own emissions never followed by a same-slot emission
+                   (their send semaphores drain at kernel exit)
+    """
+    total = (n - 1) * S
+    own_j = [0] * S
+    content = [0] * total
+    fwd_j = [-1] * total
+    own_at = [-1] * total
+    step_at = {0: -1}                   # emission index -> consume step
+    j = 0
+
+    def emit_own(k: int) -> None:
+        nonlocal j
+        own_j[k] = j
+        j += 1
+
+    emit_own(0)
+    # arrival m's content: my arrival stream is the upstream's emission
+    # stream; its k-th own is my depth-0 content (chunk idx-1, slice k),
+    # and its forward of ITS arrival m' is my (content[m'] + one hop)
+    emissions: List[Tuple[str, int]] = [("own", 0)]     # E, in order
+
+    for m in range(total):
+        kind, val = emissions[m]
+        content[m] = val if kind == "own" else content[val] + S
+        # EXECUTED order within a step: the forward fires inside
+        # consume(m), the next own slice after it — emission indices
+        # MUST follow that order or the credit pairing slips.  The
+        # original transcription assigned own(m+1) the smaller index
+        # while the kernel sends fwd(m) first; graftmc's first
+        # exhaustive run over this route found the resulting
+        # one-credit under-wait as a recv-slot overwrite at
+        # (n=5, S=5) — the bug class the static P1/P2 sweep is blind
+        # to, and the reason this schedule is now model-checked.
+        if content[m] < (n - 2) * S:    # not yet at the last hop
+            fwd_j[m] = j
+            step_at[j] = m
+            j += 1
+            emissions.append(("fwd", m))
+        if m + 1 < S:
+            own_at[m] = m + 1
+            step_at[j] = m
+            emit_own(m + 1)
+            emissions.append(("own", m + 1))
+    assert j == total and len(emissions) == total, (j, len(emissions))
+    assert sorted(content) == list(range(total))
+    assert all(step_at[m] < m for m in range(total)), (n, S)        # P1
+    assert all(jj - st <= S for jj, st in step_at.items()), (n, S)  # P2
+    # P3 (the invariant the graftmc run added): emission indices follow
+    # the EXECUTED per-step order (fwd(m) before own(m+1)), so credit
+    # waits happen in ascending j and "emission j waits on downstream
+    # consume j - n_slots" holds count-exactly.
+    assert all(fwd_j[m] < own_j[own_at[m]] for m in range(total)
+               if fwd_j[m] >= 0 and own_at[m] >= 0), (n, S)
+
+    # single-wait bookkeeping for send semaphores: a forward's send is
+    # waited at its own consume step; an own send is waited by the NEXT
+    # same-slot emission's pre-wait iff that emission exists AND the
+    # preceding same-slot emission was an own (forwards self-wait)
+    own_js = set(own_j)
+    tail_own_js = [oj for oj in own_j
+                   if oj + n_slots >= total]   # no same-slot successor
+    return content, fwd_j, own_at, own_j, own_js, tail_own_js
+
+
+def ag_n_slots(n: int, S: int) -> int:
+    """THE slot-window rule of the streaming gather: covers the own
+    phase's maximum emission lead (== S, P2) with one slot of margin
+    (`_ag_stream_call` consumes this)."""
+    return min((n - 1) * S, S + 2)
+
+
+class AgSchedule:
+    """Python-table accessor over `ag_schedule` — the checker's and the
+    unrolled kernel path's schedule view.  The rolled kernel path
+    substitutes an SMEM-reading twin with the same four methods
+    (`ops.ring_pallas._SmemAgSchedule`), built from THIS object's
+    tables, so there is one schedule and two reading styles."""
+
+    def __init__(self, n: int, S: int, n_slots: int) -> None:
+        (self.content_t, self.fwd_j_t, self.own_at_t, self.own_j_t,
+         self.own_js, self.tail_own_js) = ag_schedule(n, S, n_slots)
+
+    def content(self, m: int) -> int:
+        return self.content_t[m]
+
+    def fwd_j(self, m: int) -> int:
+        return self.fwd_j_t[m]
+
+    def own_at(self, m: int) -> int:
+        return self.own_at_t[m]
+
+    def own_j(self, k: int) -> int:
+        return self.own_j_t[k]
+
+    def is_own_j(self, j: int) -> bool:
+        return j >= 0 and j in self.own_js
+
+
+class AgStreamEmitter:
+    """THE HBM-streaming interleaved-emission all-gather program — the
+    exact wait/signal/transfer order `_ag_stream_kernel` executes
+    (every node runs the identical program; wire slots and semaphores
+    cycle by emission index j % n_slots on BOTH ends).  The kernel
+    consumes this emitter through its `_KernelSink` with either
+    schedule accessor; the checker consumes it through `ListSink`
+    (`ag_op_stream`).
+
+    Per arrival m: 1-lag writeback wait, wire wait, the onward forward
+    (emission fwd_j(m): pre-wait if the previous same-slot emission was
+    an un-waited own send, credit past the window, send), decode into
+    the st window, the forward's own send-drain wait, credit signal,
+    writeback start — then the next own-slice emission if this step
+    schedules one (ld window, pre-wait, encode, own-store window,
+    credit, send).  ``lockstep=True`` swaps decode ahead of the forward
+    (the interpreter's primitive-lockstep ordering; hardware keeps
+    forward-then-decode for overlap — both orders are checked)."""
+
+    def __init__(self, n: int, S: int,
+                 n_slots: Optional[int] = None) -> None:
+        self.n = n
+        self.S = S
+        self.total = (n - 1) * S
+        self.n_slots = ag_n_slots(n, S) if n_slots is None else n_slots
+        self.sched = AgSchedule(n, S, self.n_slots)
+
+    def send_own(self, sink: OpSink, k: Any, acc: Any) -> None:
+        j = acc.own_j(k)
+        sink.dma_start("ld", k, ("ld", k - 2))
+        @sink.when(acc.is_own_j(j - self.n_slots))
+        def _pre_wait() -> None:      # previous same-slot emission was an
+            sink.wait_send(j - self.n_slots)   # own send (unwaited) AND
+                                      # its frame lives in this buffer
+                                      # slot: drain before overwriting
+        sink.dma_wait("ld", k)
+        sink.encode(j, src=k)
+        @sink.when(k >= 2)
+        def _own_slot() -> None:      # own-store VMEM window reuse
+            sink.dma_wait("ownwb", k - 2)
+        sink.local("own_store", k)    # the replica stores its own wire
+        sink.dma_start("ownwb", k, ("ownwb", k - 2))      # bytes
+        @sink.when(j >= self.n_slots)
+        def _credit() -> None:
+            sink.credit_wait()
+        sink.send(j)
+
+    def consume(self, sink: OpSink, m: Any, acc: Any,
+                lockstep: bool = False) -> None:
+        @sink.when(m >= 1)
+        def _wb_prev() -> None:       # 1-lag single wait: st slot reuse
+            sink.dma_wait("wb", m - 1)      # at m covers wb(m-2)
+        sink.wait_recv(m)
+        jf = acc.fwd_j(m)
+        fwd = jf >= 0                 # -1 when arrival m is terminal
+
+        def start_forward() -> None:
+            @sink.when(acc.is_own_j(jf - self.n_slots))
+            def _pre_wait() -> None:
+                sink.wait_send(jf - self.n_slots)
+            @sink.when(jf >= self.n_slots)
+            def _credit() -> None:
+                sink.credit_wait()
+            sink.send(jf, src=m)      # forward straight out of the
+                                      # arrival's recv slot
+
+        if lockstep:
+            # interpreter primitive-lockstep ordering: all reads first,
+            # then emissions (see the kernel docstring); hardware keeps
+            # forward-then-decode for overlap
+            sink.decode(m)
+            sink.when(fwd)(start_forward)
+        else:
+            sink.when(fwd)(start_forward)
+            sink.decode(m)
+        @sink.when(fwd)
+        def _fwd_done() -> None:      # recv slot is upstream's next
+            sink.wait_send(jf)        # target: drain my forward first
+        sink.credit_signal()
+        sink.dma_start("wb", m, ("wb", m - 2))
+
+    def prologue(self, sink: OpSink, acc: Any) -> None:
+        sink.barrier()
+        self.send_own(sink, 0, acc)
+
+    def step(self, sink: OpSink, m: Any, acc: Any,
+             lockstep: bool = False) -> None:
+        self.consume(sink, m, acc, lockstep=lockstep)
+        k = acc.own_at(m)             # next own-slice emission, if this
+        @sink.when(k >= 0)            # arrival step schedules one
+        def _own() -> None:
+            self.send_own(sink, k, acc)
+
+    def epilogue(self, sink: OpSink) -> None:
+        sink.dma_wait("wb", self.total - 1)
+        sink.dma_wait("ownwb", self.S - 1)
+        if self.S >= 2:
+            sink.dma_wait("ownwb", self.S - 2)
+        for jk in self.sched.tail_own_js:     # own sends with no
+            sink.wait_send(jk)                # same-slot successor
+        sink.credit_drain(min(self.total, self.n_slots))
+
+    def stream(self, lockstep: bool = False) -> Tuple[List[Op], int]:
+        sink = ListSink()
+        self.prologue(sink, self.sched)
+        for m in range(self.total):
+            self.step(sink, m, self.sched, lockstep=lockstep)
+        self.epilogue(sink)
+        return sink.ops, self.n_slots
+
+
+def ag_op_stream(n: int, S: int, n_slots: Optional[int] = None,
+                 lockstep: bool = False) -> Tuple[List[Op], int]:
+    """The checked view of `AgStreamEmitter` (one emitter, two
+    consumers).  ``n_slots`` overrides the protocol window (the
+    anti-vacuity mutants shrink it); the default is `ag_n_slots`."""
+    return AgStreamEmitter(n, S, n_slots=n_slots).stream(
+        lockstep=lockstep)
+
+
+# ---------------------------------------------------------------------------
+# the KV-handoff pair program (consumed by serve.handoff AND the checker)
+# ---------------------------------------------------------------------------
+
+class HandoffMove(NamedTuple):
+    """One gathered page block crossing the pair: pool index in
+    layer-major K-then-V order (== its odd-multiplier index in
+    `ops.integrity.gathered_page_checksums`, so a block-order change is
+    a weight change M2 sees)."""
+
+    pool: int
+    msg: int
+
+
+def handoff_program(n_layers: int) -> List[HandoffMove]:
+    """THE block order of one KV migration — `serve.handoff.lower_apply`
+    iterates exactly this list to drive its gather/ppermute/scatter
+    trio per block, and the ledger-compare weights are the same ``msg``
+    indices."""
+    return [HandoffMove(i, i) for i in range(2 * n_layers)]
+
+
+def handoff_op_stream(n_layers: int,
+                      integrity: bool = False) -> List[List[Op]]:
+    """Per-node op streams of the KV-handoff pair program, derived from
+    `handoff_program`: the source gathers and sends each page block in
+    block order; the destination receives and scatters each.  With
+    ``integrity`` the per-block ledger compare rides as paired chk ops
+    (carry "page", weight = the block's gathered_page_checksums odd
+    multiplier) and the replicated verdict psum as a symmetric vote
+    exchange — the destination's vote depends on every landed block
+    (it is computed from the scattered pages), the source's only on its
+    ledger."""
+    src, dst = ListSink(), ListSink()
+    for mv in handoff_program(n_layers):
+        if integrity:
+            src.chk_emit(mv.msg, carry="page")
+        src.local("gather", mv.pool)
+        src.ops.append(("send_to", 1, ("pool", mv.pool)))
+        dst.ops.append(("recv_from", 0, ("pool", mv.pool)))
+        if integrity:
+            dst.chk_arrive(mv.msg, carry="page")
+        dst.local("scatter", mv.pool)
+    if integrity:
+        # the conservation/verdict psum: each side contributes its vote
+        # and consumes the peer's — the destination's vote is data-
+        # dependent on every scattered block above (program order)
+        src.ops.append(("send_to", 1, ("vote", 0)))
+        src.ops.append(("recv_from", 1, ("vote", 1)))
+        dst.ops.append(("send_to", 0, ("vote", 1)))
+        dst.ops.append(("recv_from", 0, ("vote", 0)))
+    return [src.ops, dst.ops]
+
+
+# ---------------------------------------------------------------------------
+# M2: the static checksum-weight conservation pass
+# ---------------------------------------------------------------------------
+
+def check_weight_conservation(streams: Sequence[Any]) -> List[str]:
+    """M2 — the static pass over a checked program's ``chk_emit`` /
+    ``chk_arrive`` ops (PR-12's weight-collision bug class, caught by
+    review twice, frozen as a tool): per conservation carry,
+
+      - every emission message has arrival partners, 1:1 by count, and
+        every partner carries the SAME weight (a send/recv weighted
+        differently can never telescope to zero — the verdict would
+        trip on clean wires, or worse, stay green on corrupt ones);
+      - every weight is ODD (odd = invertible mod 2^32: single-word
+        corruption can never vanish from the weighted sum);
+      - weights are program-distinct: two DIFFERENT messages sharing a
+        weight alias in the conservation sum — a swap of their payloads
+        cancels exactly (the collision class).
+
+    ``streams``: a single op list (RingModel — every node runs it) or a
+    per-node list of op lists (PairModel).  Returns violation messages
+    (empty = clean); a program with no chk ops is trivially clean —
+    COVERAGE is J12's job, soundness of the weights is M2's."""
+    if streams and streams[0] and isinstance(streams[0][0], str):
+        node_streams: Sequence[Sequence[Op]] = [streams]  # single program
+    else:
+        node_streams = streams
+    emits: Dict[Tuple[str, Any], List[int]] = {}
+    arrives: Dict[Tuple[str, Any], List[int]] = {}
+    out: List[str] = []
+    for ops in node_streams:
+        for op in ops:
+            if op[0] not in ("chk_emit", "chk_arrive"):
+                continue
+            _, carry, msg, w = op
+            (emits if op[0] == "chk_emit" else arrives).setdefault(
+                (carry, msg), []).append(w)
+            if w % 2 == 0:
+                out.append(f"M2: message {carry}/{msg} has EVEN weight "
+                           f"{w} — a single-word corruption at an even "
+                           "weight can vanish mod 2^32")
+    for key in sorted(set(emits) | set(arrives), key=str):
+        es, ar = emits.get(key, []), arrives.get(key, [])
+        carry, msg = key
+        if len(es) != len(ar):
+            out.append(f"M2: message {carry}/{msg} has {len(es)} "
+                       f"emission(s) but {len(ar)} arrival(s) — every "
+                       "emission needs exactly one arrival partner")
+        ws = set(es) | set(ar)
+        if len(ws) > 1:
+            out.append(f"M2: message {carry}/{msg} weighted "
+                       f"inconsistently across emit/arrive: {sorted(ws)}")
+    by_carry: Dict[str, Dict[int, Set[Any]]] = {}
+    for (carry, msg), ws in list(emits.items()) + list(arrives.items()):
+        for w in ws:
+            by_carry.setdefault(carry, {}).setdefault(w, set()).add(msg)
+    for carry, wmap in sorted(by_carry.items()):
+        for w, msgs in sorted(wmap.items()):
+            if len(msgs) > 1:
+                out.append(
+                    f"M2: weight collision in carry {carry!r}: messages "
+                    f"{sorted(msgs, key=str)} all weighted {w} — their "
+                    "corruptions alias in the conservation sum (the "
+                    "PR-12 class)")
+    return out
 
 
 # ---------------------------------------------------------------------------

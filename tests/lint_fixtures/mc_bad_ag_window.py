@@ -1,0 +1,17 @@
+"""graftmc bad fixture: the streaming all-gather's interleaved
+emission schedule run against a slot window ONE smaller than the plan
+(S+1 physical slots under the S+2 protocol) — the own phase's emission
+lead plus the credit margin no longer fit, and a frame lands on an
+undecoded predecessor.  `make modelcheck` with GRAFTMC_FIXTURE pointing
+here MUST fail with a recv-slot-overwrite counterexample
+(tests/test_verify.py rides the subprocess exit-code pattern)."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+
+def build():
+    ops, n_slots = opstream.ag_op_stream(4, 4)      # plan window S+2 = 6
+    return opstream.RingModel(
+        4, ops, n_slots - 1,
+        meta={"route": "fixture", "n": 4, "S": 4,
+              "mutation": "ag-window-shrunk-to-S+1"})

@@ -1,0 +1,20 @@
+"""graftmc bad fixture: the KV-handoff pair program with the SOURCE's
+verdict wait hoisted ahead of its page sends — the source blocks on the
+destination's vote, the destination blocks on page blocks the source
+never sent: a wait-for cycle across the pair.  `make modelcheck` with
+GRAFTMC_FIXTURE pointing here MUST fail with a protocol-deadlock
+counterexample (the mismatched-SPMD-order class PairModel exists to
+catch, on the newest pair route)."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+
+def build():
+    src, dst = opstream.handoff_op_stream(2, integrity=True)
+    vote_wait = ("recv_from", 1, ("vote", 1))
+    assert vote_wait in src
+    mutated = [vote_wait] + [op for op in src if op != vote_wait]
+    return opstream.PairModel(
+        [mutated, dst],
+        meta={"route": "fixture", "n_layers": 2,
+              "mutation": "handoff-verdict-wait-hoisted"})

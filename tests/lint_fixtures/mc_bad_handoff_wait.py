@@ -1,0 +1,22 @@
+"""graftmc bad fixture: the KV-handoff pair program with the
+destination's scatter-waits (its per-block ``recv_from`` ops) dropped —
+the destination scatters unlanded data and completes, so every page
+block the source sent is left landed-but-never-consumed.  In the pair
+semantics that is the ordering-corruption class: `make modelcheck` with
+GRAFTMC_FIXTURE pointing here MUST fail with an orphan-payload
+termination counterexample (a ppermute's consumer vanishing can never
+deadlock the SOURCE — sends don't block — which is exactly why the
+orphan check exists; the wait-order deadlock twin is
+mc_bad_handoff_order.py)."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+
+def build():
+    src, dst = opstream.handoff_op_stream(2, integrity=True)
+    mutated = [op for op in dst
+               if not (op[0] == "recv_from" and op[2][0] == "pool")]
+    return opstream.PairModel(
+        [src, mutated],
+        meta={"route": "fixture", "n_layers": 2,
+              "mutation": "handoff-dropped-scatter-wait"})

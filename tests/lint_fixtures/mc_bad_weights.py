@@ -1,0 +1,26 @@
+"""graftmc bad fixture for the M2 static weight pass: a two-hop sliced
+transfer whose conservation weights are built as the PRODUCT of two
+odd per-axis weights — (2s+1)*(2k+1) — so messages (hop 0, slice 1)
+and (hop 1, slice 0) both weigh 3.  This is byte-for-byte the PR-12
+collision class review caught twice by hand: a swap of the two
+payloads cancels exactly in the weighted conservation sum, so the
+verdict stays green on a corrupt wire.  The interleaving is CLEAN —
+only M2 can reject this model.  `make modelcheck` with GRAFTMC_FIXTURE
+pointing here MUST fail with an M2 weight-collision finding."""
+
+from fpga_ai_nic_tpu.verify import opstream
+
+
+def build():
+    a, b = opstream.ListSink(), opstream.ListSink()
+    for s in range(2):
+        for k in range(2):
+            w = (2 * s + 1) * (2 * k + 1)     # (0,1) and (1,0) -> 3
+            a.chk_emit((s, k), weight=w)
+            a.ops.append(("send_to", 1, ("hop", s, k)))
+            b.ops.append(("recv_from", 0, ("hop", s, k)))
+            b.chk_arrive((s, k), weight=w)
+    return opstream.PairModel(
+        [a.ops, b.ops],
+        meta={"route": "fixture",
+              "mutation": "per-axis-weight-product-collision"})

@@ -146,9 +146,24 @@ def _run_fused_kernel(x, w, st, hyper, kind, bcfg, slice_elems, streaming,
     return g_got, w_got, st_got
 
 
-@pytest.mark.parametrize("streaming", [False, True],
-                         ids=["vmem", "streaming"])
-@pytest.mark.parametrize("kind", KINDS)
+# tier-1 wall-budget split: the fast tier keeps the two most
+# informative corners — adamw-streaming (2 state tensors + every
+# streaming DMA window) and sgd-vmem (the cheapest other corner) — and
+# the four redundant (kind, residency) combinations ride -m slow, which
+# `make test` (the full CI gate) still runs.  Coverage is unchanged;
+# only the fast tier's cost is.
+@pytest.mark.parametrize("kind,streaming", [
+    pytest.param("sgd", False, id="sgd-vmem"),
+    pytest.param("sgd", True, id="sgd-streaming",
+                 marks=pytest.mark.slow),
+    pytest.param("momentum", False, id="momentum-vmem",
+                 marks=pytest.mark.slow),
+    pytest.param("momentum", True, id="momentum-streaming",
+                 marks=pytest.mark.slow),
+    pytest.param("adamw", False, id="adamw-vmem",
+                 marks=pytest.mark.slow),
+    pytest.param("adamw", True, id="adamw-streaming"),
+])
 def test_kernel_update_bitexact_vs_composed_golden(kind, streaming, rng):
     """{sgd, momentum, adamw} x {vmem, streaming} x depth: the fused
     Pallas kernels == codec ring golden -> optimizer twin, bit for bit,

@@ -1,18 +1,29 @@
 """graftmc: the exhaustive protocol model checker (fpga_ai_nic_tpu.verify).
 
-Covers the ISSUE-9 battery:
-  - op-stream equivalence: the extracted streams against the in-kernel
-    `_rs_plan` invariants (RAW/SLOT/CAP) for every route, the jax-free
-    twins against their jax-side definitions (intersection_table,
-    residual_owners, OptimizerSpec.n_state, plan_hier hop counts);
-  - exhaustive-grid green cells (the full envelope behind -m slow);
+Covers the ISSUE-9 battery plus the ISSUE-14 promotion (graftmc v2):
+  - one-definition delegation: every route's kernel/lowering consumes
+    the SAME emitter/program object the checker explores — pinned by
+    IDENTITY (and consumption-site inspection), not by structural
+    comparison of two copies (there is no second copy left to compare);
+  - plan invariants (RAW/SLOT/CAP) as properties of the emitted streams;
+  - exhaustive-grid green cells across all six routes, integrity
+    variants included (the full envelope behind -m slow);
+  - the streaming-AG model (the retired "statically asserted" row):
+    green over the envelope in both orderings, a recv-slot overwrite on
+    the S+1 window shrink, POR-vs-naive agreement on the mutants;
+  - the handoff pair model: green cells, deadlock on the hoisted
+    verdict wait, orphan on the dropped scatter-wait;
+  - M2, the static checksum-weight pass: green on every integrity
+    route, red on the per-axis weight-product collision (the PR-12
+    class), weights pinned to ops.integrity.hop_weight;
   - POR-vs-naive state count (>= 5x) and verdict agreement, on clean
     AND mutated cells;
-  - counterexample replay: per-node pretty print + Perfetto export;
+  - counterexample replay: per-node pretty print + Perfetto export, now
+    for AG- and handoff-shaped streams too;
   - the H1 lockset pass fires on the seeded fixture and stays silent on
     the tree;
-  - `make modelcheck` exit codes: green on HEAD, loud on both bad
-    fixtures (the J6-style subprocess pattern).
+  - `make modelcheck` exit codes: green on HEAD, loud on all six bad
+    fixtures (the J6-style subprocess pattern), envelope record banked.
 """
 
 import json
@@ -29,29 +40,111 @@ FIXTURES = os.path.join(REPO, "tests", "lint_fixtures")
 
 
 # ---------------------------------------------------------------------------
-# op-stream extraction: plan invariants + single-definition equivalence
+# one-definition delegation: the lowerings consume THE emitters
+# ---------------------------------------------------------------------------
+
+class TestDelegationIdentity:
+    """The PR-14 contract: zero surviving hand-transcribed stream
+    builders.  Where the lowering can share the object, identity is
+    asserted; where it consumes an emitter inside a kernel, the
+    consumption site is asserted and local schedule text is banned."""
+
+    def test_rs_plan_is_the_kernel_plan(self):
+        from fpga_ai_nic_tpu.ops import ring_pallas as rp
+        for n, S, D in [(n, S, D) for n in (2, 3, 4, 6)
+                        for S in (1, 2, 4, 6) for D in (1, 2, 4, None)]:
+            assert rp._rs_plan(n, S, D) == opstream.rs_plan(
+                n, S, D, default_depth=rp._PIPE_DEPTH)
+
+    def test_rs_op_stream_is_the_kernel_stream(self):
+        from fpga_ai_nic_tpu.ops import ring_pallas as rp
+        for n, S, D in [(4, 2, 2), (6, 6, 4), (3, 4, None)]:
+            assert rp._rs_op_stream(n, S, D) == opstream.rs_op_stream(
+                n, S, D, default_depth=rp._PIPE_DEPTH)
+
+    def test_ag_schedule_is_the_shared_definition(self):
+        from fpga_ai_nic_tpu.ops import ring_pallas as rp
+        assert rp._ag_schedule is opstream.ag_schedule
+
+    def test_hier_perms_are_the_shared_definitions(self):
+        from fpga_ai_nic_tpu.ops import ring_hier as rh
+        assert rh._intra_perm is opstream.intra_perm
+        assert rh._inter_perm is opstream.inter_perm
+
+    def test_reshard_table_owners_layout_are_shared(self):
+        from fpga_ai_nic_tpu.parallel import reshard
+        assert reshard.Transfer is opstream.Seg
+        assert reshard.intersection_table is opstream.reshard_segments
+        assert reshard.residual_owners is opstream.reshard_owners
+
+    def test_handoff_program_is_shared(self):
+        from fpga_ai_nic_tpu.serve import handoff
+        assert handoff.handoff_program is opstream.handoff_program
+
+    def test_kernels_consume_the_emitters(self):
+        """The Pallas kernels must drive their schedule through the
+        shared emitters (prologue/step/epilogue over a sink) and carry
+        no local launch/consume/step schedule text of their own — the
+        structural-equivalence pins this replaces had exactly that
+        drift window."""
+        import inspect
+        from fpga_ai_nic_tpu.ops import ring_pallas as rp
+        for kern, emitter in ((rp._rs_kernel, "RsEmitter"),
+                              (rp._rs_stream_kernel, "RsStreamEmitter"),
+                              (rp._ag_stream_kernel, "AgStreamEmitter")):
+            src = inspect.getsource(kern)
+            assert (f"_opstream.{emitter}" in src
+                    or "emitter.prologue" in src), emitter
+            assert "emitter.prologue(" in src and \
+                "emitter.step(" in src and "emitter.epilogue(" in src
+            for banned in ("def launch(", "def consume(", "def step("):
+                assert banned not in src, (emitter, banned)
+
+    def test_lowerings_consume_the_action_programs(self):
+        import inspect
+        from fpga_ai_nic_tpu.parallel import reshard
+        from fpga_ai_nic_tpu.ops import ring_hier as rh
+        assert "_opstream.reshard_leaf_actions" in \
+            inspect.getsource(reshard._move_chunk)
+        assert "_opstream.reshard_residual_actions" in \
+            inspect.getsource(reshard._move_residual)
+        assert "_opstream.reshard_msg_bases" in \
+            inspect.getsource(reshard.lower_apply)
+        assert "_opstream.union_layout" in \
+            inspect.getsource(reshard.make_plan)
+        assert "_opstream.hier_program" in \
+            inspect.getsource(rh.hier_reduce_scatter)
+        assert "_opstream.hier_program" in \
+            inspect.getsource(rh.hier_all_gather)
+
+    def test_msg_weight_is_hop_weight(self):
+        """The IR's jax-free weight formula == ops.integrity.hop_weight
+        (one weight scheme, kernel side and host side)."""
+        import jax
+        from fpga_ai_nic_tpu.ops import integrity
+        with jax.default_device(jax.devices("cpu")[0]):
+            for msg in (0, 1, 7, 1000, 2**31 - 1):
+                assert opstream.msg_weight(msg) == int(
+                    integrity.hop_weight(msg))
+
+    def test_ag_n_slots_is_the_call_rule(self):
+        import inspect
+        from fpga_ai_nic_tpu.ops import ring_pallas as rp
+        assert "_opstream.ag_n_slots" in \
+            inspect.getsource(rp._ag_stream_call)
+
+
+# ---------------------------------------------------------------------------
+# plan invariants as properties of the emitted streams
 # ---------------------------------------------------------------------------
 
 class TestOpStreamInvariants:
     CELLS = [(n, S, D) for n in (2, 3, 4, 6)
              for S in (1, 2, 4, 6) for D in (1, 2, 4, None)]
 
-    def test_rs_plan_is_the_kernel_plan(self):
-        """ring_pallas._rs_plan is a delegate: ONE plan definition."""
-        from fpga_ai_nic_tpu.ops import ring_pallas as rp
-        for n, S, D in self.CELLS:
-            assert rp._rs_plan(n, S, D) == opstream.rs_plan(
-                n, S, D, default_depth=rp._PIPE_DEPTH)
-
-    def test_rs_op_stream_is_the_kernel_stream(self):
-        from fpga_ai_nic_tpu.ops import ring_pallas as rp
-        for n, S, D in self.CELLS:
-            assert rp._rs_op_stream(n, S, D) == opstream.rs_op_stream(
-                n, S, D, default_depth=rp._PIPE_DEPTH)
-
     @pytest.mark.parametrize("streaming", [False, True])
     def test_raw_slot_cap_invariants(self, streaming):
-        """The extracted stream satisfies the three `_rs_plan` schedule
+        """The emitted stream satisfies the three `rs_plan` schedule
         invariants STRUCTURALLY: CAP (exactly (n-1)*S emissions, each
         send-waited exactly once), RAW (send q after decode q-S), SLOT
         (send q after decode q-n_slots, and guarded by wait_send +
@@ -81,28 +174,25 @@ class TestOpStreamInvariants:
 
     @pytest.mark.parametrize("opt", [None, "sgd", "momentum", "adamw"])
     def test_streaming_dma_discipline_clean(self, opt):
-        """The extracted streaming stream passes its own DMA discipline
-        (single wait, ordered hazards, full drain) at every cell — the
-        round-3 hardware-only semaphore deadlock classes, mechanically
-        checked."""
+        """The emitted streaming stream passes its own DMA discipline
+        (single wait, ordered hazards, full drain) at every cell,
+        integrity on or off — the round-3 hardware-only semaphore
+        deadlock classes, mechanically checked."""
         for n, S, D in self.CELLS:
-            ops, _ = opstream.rs_stream_op_stream(n, S, D, opt_kind=opt)
-            assert opstream.check_dma_discipline(ops) == [], (n, S, D)
+            for integ in (False, True):
+                ops, _ = opstream.rs_stream_op_stream(
+                    n, S, D, opt_kind=opt, integrity=integ)
+                assert opstream.check_dma_discipline(ops) == [], \
+                    (n, S, D, integ)
 
-    def test_streaming_prefetch_gate(self):
-        """ld(q+1) starts before encode(q) exactly when the kernel's
-        prefetch gate (launch_first and D+2 <= S) allows it."""
-        for n, S, D in self.CELLS:
-            ops, _ = opstream.rs_stream_op_stream(n, S, D)
-            Dr, _, launch_first = opstream.rs_plan(n, S, D)
-            lds = {op[2]: i for i, op in enumerate(ops)
-                   if op[0] == "dma_start" and op[1] == "ld"}
-            encs = {op[1]: i for i, op in enumerate(ops)
-                    if op[0] == "encode"}
-            total = (n - 1) * S
-            prefetch = launch_first and Dr + 2 <= S
-            if total > 1:
-                assert (lds[1] < encs[0]) == prefetch, (n, S, D)
+    def test_ag_dma_discipline_clean(self):
+        for n in (2, 3, 4, 6):
+            for S in (1, 2, 4, 6):
+                for lockstep in (False, True):
+                    ops, _ = opstream.ag_op_stream(n, S,
+                                                   lockstep=lockstep)
+                    assert opstream.check_dma_discipline(ops) == [], \
+                        (n, S, lockstep)
 
     def test_opt_state_counts_match_optimizer_spec(self):
         from fpga_ai_nic_tpu.optim import OptimizerSpec
@@ -128,29 +218,42 @@ class TestOpStreamInvariants:
         res = mc.check(model)
         assert not res.ok
 
+    def test_ag_schedule_emission_order_matches_execution(self):
+        """P3: emission indices follow the executed per-step order (the
+        forward fires inside consume(m), the next own slice after) —
+        the one-credit under-wait graftmc's first AG run caught would
+        reappear exactly here."""
+        for n in (3, 4, 5, 6):
+            for S in (2, 4, 5, 6):
+                (content, fwd_j, own_at, own_j, _own_js,
+                 _tails) = opstream.ag_schedule(n, S,
+                                                opstream.ag_n_slots(n, S))
+                for m in range((n - 1) * S):
+                    if fwd_j[m] >= 0 and own_at[m] >= 0:
+                        assert fwd_j[m] < own_j[own_at[m]], (n, S, m)
+
 
 class TestHierStream:
-    @pytest.mark.parametrize("n,ni", [(4, 2), (6, 2), (6, 3), (6, 1),
-                                      (6, 6), (4, 4)])
-    def test_hop_counts_match_plan(self, n, ni):
-        """The stream's per-node send counts equal the
-        HierarchicalPlan's hop structure: (ni-1) intra hops per
-        direction, (ng-1) inter hops (sliced on the RS side)."""
-        from fpga_ai_nic_tpu.ops import ring_hier
-        ng = ring_hier.check_factorization(n, ni)
-        for s_inter in (1, 3):
-            streams = opstream.hier_op_stream(n, ni, s_inter)
+    def test_streams_expand_the_program(self):
+        """The checker's per-node expansion is internally consistent
+        with `hier_program` (hops x slices per phase) — a sanity check
+        on the derivation, NOT an equivalence pin against a second
+        definition (ring_hier consumes the same program)."""
+        for n, ni, s in [(4, 2, 1), (6, 2, 3), (6, 3, 2), (6, 1, 1),
+                         (6, 6, 1)]:
+            prog = opstream.hier_program(n, ni, s)
+            streams = opstream.hier_op_stream(n, ni, s)
             assert len(streams) == n
             for ops in streams:
                 sends = [op for op in ops if op[0] == "send_to"]
-                intra = [op for op in sends if op[2][0] == "rs_intra"]
-                inter = [op for op in sends if op[2][0] == "rs_inter"]
-                ag_inter = [op for op in sends if op[2][0] == "ag_inter"]
-                ag_intra = [op for op in sends if op[2][0] == "ag_intra"]
-                assert len(intra) == ni - 1
-                assert len(inter) == (ng - 1) * s_inter
-                assert len(ag_inter) == ng - 1
-                assert len(ag_intra) == ni - 1
+                per = {k: sum(1 for op in sends if op[2][0] == k)
+                       for k in ("rs_intra", "rs_inter", "ag_inter",
+                                 "ag_intra")}
+                assert per["rs_intra"] == prog.rs_intra.hops
+                assert per["rs_inter"] == \
+                    prog.rs_inter.hops * prog.rs_inter.slices
+                assert per["ag_inter"] == prog.ag_inter.hops
+                assert per["ag_intra"] == prog.ag_intra.hops
 
     def test_handoff_orders_intra_before_inter(self):
         streams = opstream.hier_op_stream(6, 3, 2)
@@ -160,30 +263,21 @@ class TestHierStream:
                 assert kinds.index("rs_inter") > max(
                     i for i, k in enumerate(kinds) if k == "rs_intra")
 
+    def test_rs_carry_messages_are_distinct(self):
+        """The program's shared RS carry (intra + sliced inter) never
+        reuses a message id — the aliasing class M2 freezes."""
+        prog = opstream.hier_program(6, 2, 3)
+        msgs = [prog.rs_intra.msg(s) for s in range(prog.rs_intra.hops)]
+        msgs += [prog.rs_inter.msg(s, k)
+                 for s in range(prog.rs_inter.hops)
+                 for k in range(prog.rs_inter.slices)]
+        assert len(msgs) == len(set(msgs))
+
 
 class TestReshardStream:
-    LAYOUTS = [(48, 6, 8), (48, 8, 6), (37, 5, 7), (37, 7, 5),
-               (100, 12, 5), (1, 1, 4), (17, 3, 3)]
-
-    def test_segments_match_intersection_table(self):
-        """The jax-free twin partitions exactly like
-        parallel.reshard.intersection_table."""
-        from fpga_ai_nic_tpu.parallel import reshard
-        for live, cs, ct in self.LAYOUTS:
-            ours = opstream.reshard_segments(live, cs, ct)
-            theirs = reshard.intersection_table(live, cs, ct)
-            assert [tuple(t) for t in ours] == [tuple(t) for t in theirs]
-
-    def test_owners_match_residual_owners(self):
-        from fpga_ai_nic_tpu.parallel import reshard
-        for ns in range(1, 9):
-            for nt in range(1, 9):
-                assert opstream.reshard_owners(ns, nt) == \
-                    reshard.residual_owners(ns, nt)
-
     def test_layout_matches_make_plan(self):
-        """reshard_layout mirrors make_plan's union arithmetic for
-        shrink AND grow."""
+        """mc.reshard_layout (the grid-cell view) and make_plan both
+        consume opstream.union_layout — pinned end to end."""
         from fpga_ai_nic_tpu.parallel import reshard
         for live in (37, 48, 100):
             for ns in (2, 3, 4, 6, 8):
@@ -213,6 +307,97 @@ class TestReshardStream:
             assert rsends == sum(1 for i, o in enumerate(owners)
                                  if i != o)
 
+    def test_multi_leaf_messages_are_distinct(self):
+        """Across leaves + residual, every wire message id is unique
+        (reshard_msg_bases) — the cross-leaf weight-product collision
+        class."""
+        streams = opstream.reshard_op_stream(
+            37, *mc.reshard_layout(37, 6, 4),
+            residual_owners_map=opstream.reshard_owners(6, 4),
+            n_flat_leaves=3, integrity=True)
+        msgs = [op[2] for ops in streams for op in ops
+                if op[0] == "chk_emit"]
+        assert msgs and len(msgs) == len(set(msgs))
+
+
+# ---------------------------------------------------------------------------
+# M2: the static checksum-weight pass
+# ---------------------------------------------------------------------------
+
+class TestM2WeightPass:
+    def test_green_on_every_integrity_route(self):
+        assert opstream.check_weight_conservation(
+            opstream.rs_op_stream(4, 2, 2, integrity=True)[0]) == []
+        assert opstream.check_weight_conservation(
+            opstream.rs_stream_op_stream(4, 4, 2, opt_kind="adamw",
+                                         integrity=True)[0]) == []
+        assert opstream.check_weight_conservation(
+            opstream.hier_op_stream(6, 2, 3, integrity=True)) == []
+        assert opstream.check_weight_conservation(
+            opstream.reshard_op_stream(
+                37, *mc.reshard_layout(37, 6, 4),
+                residual_owners_map=opstream.reshard_owners(6, 4),
+                n_flat_leaves=2, integrity=True)) == []
+        assert opstream.check_weight_conservation(
+            opstream.handoff_op_stream(2, integrity=True)) == []
+
+    def test_collision_rejected(self):
+        """Two distinct messages sharing a weight — the PR-12 per-axis
+        product class — must be an M2 finding."""
+        a, b = opstream.ListSink(), opstream.ListSink()
+        for s in range(2):
+            for k in range(2):
+                w = (2 * s + 1) * (2 * k + 1)
+                a.chk_emit((s, k), weight=w)
+                b.chk_arrive((s, k), weight=w)
+        msgs = opstream.check_weight_conservation([a.ops, b.ops])
+        assert any("weight collision" in m for m in msgs)
+
+    def test_even_weight_rejected(self):
+        a = opstream.ListSink()
+        a.chk_emit(0, weight=4)
+        a.chk_arrive(0, weight=4)
+        msgs = opstream.check_weight_conservation(a.ops)
+        assert any("EVEN weight" in m for m in msgs)
+
+    def test_unpaired_emission_rejected(self):
+        a = opstream.ListSink()
+        a.chk_emit(0)
+        msgs = opstream.check_weight_conservation(a.ops)
+        assert any("arrival" in m for m in msgs)
+
+    def test_mismatched_pair_weight_rejected(self):
+        a = opstream.ListSink()
+        a.chk_emit(0, weight=1)
+        a.chk_arrive(0, weight=3)
+        msgs = opstream.check_weight_conservation(a.ops)
+        assert any("inconsistently" in m for m in msgs)
+
+    def test_carries_are_independent(self):
+        """hier's RS and AG carries legally reuse msg 0 — M2 must not
+        cross-flag them (weights are program-distinct PER CARRY)."""
+        a = opstream.ListSink()
+        a.chk_emit(0, carry="rs")
+        a.chk_arrive(0, carry="rs")
+        a.chk_emit(0, carry="ag")
+        a.chk_arrive(0, carry="ag")
+        assert opstream.check_weight_conservation(a.ops) == []
+
+    def test_runs_inside_run_cell(self):
+        """run_cell applies M2 statically — a weight-colliding model is
+        rejected with kind 'weights' before any exploration."""
+        a, b = opstream.ListSink(), opstream.ListSink()
+        for s in range(2):
+            for k in range(2):
+                w = (2 * s + 1) * (2 * k + 1)
+                a.chk_emit((s, k), weight=w)
+                a.ops.append(("send_to", 1, ("hop", s, k)))
+                b.ops.append(("recv_from", 0, ("hop", s, k)))
+                b.chk_arrive((s, k), weight=w)
+        model = opstream.PairModel([a.ops, b.ops])
+        static = mc._static_violations(model)
+        assert static and static[0][0] == "weights"
+
 
 # ---------------------------------------------------------------------------
 # the exhaustive checker: green cells, POR, violations
@@ -220,11 +405,16 @@ class TestReshardStream:
 
 class TestExhaustive:
     @pytest.mark.parametrize("route,cell", [
-        ("flat", (6, 6, 4)), ("flat", (2, 1, 1)), ("flat", (5, 3, 3)),
-        ("streaming", (6, 6, 4, None)), ("streaming", (6, 6, 4, "adamw")),
-        ("streaming", (4, 4, 4, "momentum")),      # D == S branch
-        ("hier", (6, 2, 2)), ("hier", (6, 3, 1)),
-        ("reshard", (37, 6, 4, True)), ("reshard", (37, 4, 6, True)),
+        ("flat", (6, 6, 4, False)), ("flat", (2, 1, 1, False)),
+        ("flat", (5, 3, 3, True)),
+        ("streaming", (6, 6, 4, None, False)),
+        ("streaming", (6, 6, 4, "adamw", True)),
+        ("streaming", (4, 4, 4, "momentum", False)),   # D == S branch
+        ("ag", (6, 6)), ("ag", (2, 1)), ("ag", (5, 5)), ("ag", (3, 3)),
+        ("hier", (6, 2, 2, True)), ("hier", (6, 3, 1, False)),
+        ("reshard", (37, 6, 4, True, True)),
+        ("reshard", (37, 4, 6, True, False)),
+        ("handoff", (2, True)), ("handoff", (3, False)),
     ])
     def test_corner_cells_green(self, route, cell):
         res, _model = mc.run_cell(route, cell)
@@ -234,7 +424,7 @@ class TestExhaustive:
     def test_por_vs_naive_agree_and_reduce(self):
         """On the reported comparison cells the naive full DFS and the
         POR exploration agree on the verdict and POR explores >= 5x
-        fewer states (the acceptance bar; measured ~24-810x)."""
+        fewer states (the acceptance bar; measured ~28-1142x)."""
         for cell in mc.COMPARE_CELLS:
             por = mc.check(mc.build_flat(*cell), por=True)
             naive = mc.check(mc.build_flat(*cell), por=False)
@@ -259,24 +449,39 @@ class TestExhaustive:
         """Single-op-drop adversarial sweep on small cells: POR and
         naive DFS must agree on EVERY mutant's verdict — the reduction
         may never hide a violation (nor invent one)."""
-        self._sweep_cell(cell)
+        ops, n_slots = opstream.rs_op_stream(*cell)
+        self._sweep(cell[0], ops, n_slots)
 
     @pytest.mark.slow
     @pytest.mark.parametrize("cell", [(2, 3, 2), (3, 2, 1), (3, 2, 2)])
     def test_mutation_sweep_verdict_agreement_full(self, cell):
-        self._sweep_cell(cell)
+        ops, n_slots = opstream.rs_op_stream(*cell)
+        self._sweep(cell[0], ops, n_slots)
+
+    def test_ag_mutation_sweep_verdict_agreement_fast(self):
+        """The same adversarial single-op-drop sweep on the NEW route:
+        POR-vs-naive agreement pinned on the AG mutants too."""
+        ops, n_slots = opstream.ag_op_stream(2, 2)
+        self._sweep(2, ops, n_slots)
+
+    @pytest.mark.slow
+    def test_ag_mutation_sweep_verdict_agreement_full(self):
+        """The n=3 AG sweep: some mutants explode the naive DFS (three
+        nodes x interleaved emissions), so this rides -m slow with a
+        bigger naive budget."""
+        ops, n_slots = opstream.ag_op_stream(3, 2)
+        self._sweep(3, ops, n_slots, max_states=3_000_000)
 
     @staticmethod
-    def _sweep_cell(cell):
-        ops, n_slots = opstream.rs_op_stream(*cell)
+    def _sweep(n, ops, n_slots, max_states=300_000):
         for drop in range(len(ops)):
             mut = ops[:drop] + ops[drop + 1:]
-            p = mc.check(opstream.RingModel(cell[0], mut, n_slots),
-                         por=True, max_states=300_000)
-            q = mc.check(opstream.RingModel(cell[0], mut, n_slots),
-                         por=False, max_states=300_000)
-            assert not (p.inconclusive or q.inconclusive), (cell, drop)
-            assert p.ok == q.ok, (cell, drop, ops[drop],
+            p = mc.check(opstream.RingModel(n, mut, n_slots),
+                         por=True, max_states=max_states)
+            q = mc.check(opstream.RingModel(n, mut, n_slots),
+                         por=False, max_states=max_states)
+            assert not (p.inconclusive or q.inconclusive), (n, drop)
+            assert p.ok == q.ok, (n, drop, ops[drop],
                                   p.violation, q.violation)
 
     def test_budget_exhaustion_is_inconclusive_not_a_violation(self):
@@ -328,6 +533,51 @@ class TestExhaustive:
         res = mc.check(opstream.RingModel(4, bad, n_slots - 1))
         assert not res.ok and "overwrite" in str(res.violation)
 
+    def test_ag_window_shrunk_to_s_plus_1_overwrites(self):
+        """The ISSUE-14 AG mutant: the emitted S+2-window protocol run
+        against S+1 physical slots must produce a recv-slot-overwrite
+        counterexample, with POR and naive DFS agreeing there is a
+        violation."""
+        ops, n_slots = opstream.ag_op_stream(4, 4)
+        por = mc.check(opstream.RingModel(4, ops, n_slots - 1))
+        assert not por.ok and por.violation.kind == "recv_overwrite"
+        assert "recv-slot overwrite" in str(por.violation)
+        naive = mc.check(opstream.RingModel(4, ops, n_slots - 1),
+                         por=False, max_states=1_500_000)
+        assert not naive.ok and not naive.inconclusive
+
+    def test_ag_integrity_of_fixed_schedule(self):
+        """Regression for the fwd/own emission-index inversion graftmc
+        caught on its first AG run (a one-credit under-wait -> recv
+        overwrite at (5,5)/(6,5)/(6,6) under the OLD schedule): those
+        exact cells must now be green."""
+        for cell in ((5, 5), (6, 5), (6, 6)):
+            res, _ = mc.run_cell("ag", cell)
+            assert res.ok, (cell, res.violation)
+
+    def test_handoff_dropped_scatter_wait_orphans(self):
+        """Dropping the destination's per-block recvs leaves every sent
+        page block landed-but-never-consumed — the ordering-corruption
+        class (sends never block, so the SOURCE cannot deadlock); POR
+        and naive agree."""
+        src, dst = opstream.handoff_op_stream(2, integrity=True)
+        bad_dst = [op for op in dst
+                   if not (op[0] == "recv_from" and op[2][0] == "pool")]
+        for por in (True, False):
+            res = mc.check(opstream.PairModel([src, bad_dst]), por=por)
+            assert not res.ok and res.violation.kind == "termination"
+            assert "orphan" in str(res.violation)
+
+    def test_handoff_hoisted_verdict_wait_deadlocks(self):
+        """Hoisting the source's verdict wait ahead of its page sends is
+        a wait-for cycle across the pair — deadlock, in both modes."""
+        src, dst = opstream.handoff_op_stream(2, integrity=True)
+        vote_wait = ("recv_from", 1, ("vote", 1))
+        bad_src = [vote_wait] + [op for op in src if op != vote_wait]
+        for por in (True, False):
+            res = mc.check(opstream.PairModel([bad_src, dst]), por=por)
+            assert not res.ok and res.violation.kind == "deadlock"
+
     def test_mismatched_pair_order_deadlocks(self):
         """PairModel: two nodes receiving before sending (a mismatched
         SPMD order) deadlock."""
@@ -356,13 +606,19 @@ class TestExhaustive:
     @pytest.mark.slow
     def test_full_envelope_green(self):
         """The whole `make modelcheck` corpus inside pytest: every cell
-        of every route exhaustively clean, POR >= 5x on the reported
-        cells, fuzz clean at n=8."""
+        of every route (integrity variants included) exhaustively
+        clean, POR >= 5x on the reported cells, fuzz clean at n=8, the
+        envelope record well-formed."""
         findings, stats = mc.run_corpus()
         assert findings == [], [f.format() for f in findings]
-        assert stats.cells >= 400
+        assert stats.cells >= 900
+        assert {r.route for r in stats.routes} == {
+            "flat", "streaming", "ag", "hier", "reshard", "handoff"}
         for cmp in stats.compare:
             assert cmp["agree"] and cmp["reduction"] >= 5.0
+        rec = mc.envelope_record(stats)
+        assert rec["total_cells"] == stats.cells
+        assert sum(r["states"] for r in rec["routes"]) == stats.states
 
 
 # ---------------------------------------------------------------------------
@@ -403,6 +659,53 @@ class TestReplay:
         with open(js) as fh:
             loaded = json.load(fh)
         assert loaded["traceEvents"]
+
+    def test_ag_violation_replays_with_lane_and_tickets(self, tmp_path):
+        """The ISSUE-14 replay satellite: an AG counterexample (RingModel
+        trace with dma/local/interleaved-emission ops) exports with
+        per-node lanes AND wire ticket spans."""
+        ops, n_slots = opstream.ag_op_stream(4, 4)
+        model = opstream.RingModel(4, ops, n_slots - 1,
+                                   meta={"route": "ag", "n": 4, "S": 4})
+        res = mc.check(model)
+        assert not res.ok and res.violation.kind == "recv_overwrite"
+        text = replay.format_trace(res.violation)
+        assert "per-node op trace" in text and "node 3:" in text
+        trace = replay.perfetto_trace(res.violation)
+        events = trace["traceEvents"]
+        # every node appears as a host-thread lane (the exporter's tids
+        # are 1-based); wire tickets carry the emission between send
+        # and landing on the queue lane
+        lanes = {e.get("tid") for e in events
+                 if e.get("pid") != 2 and e.get("tid") is not None}
+        assert len(lanes) >= 4, lanes
+        tickets = [e for e in events
+                   if e.get("pid") == 2 and e.get("ph") == "X"]
+        assert tickets
+        txt, js = replay.export_counterexample(model, res.violation,
+                                               str(tmp_path))
+        assert os.path.exists(txt) and os.path.exists(js)
+
+    def test_handoff_violation_replays_with_pair_tickets(self, tmp_path):
+        """A handoff counterexample (PairModel trace, tagged payloads)
+        exports with (src->dst, tag) ticket structure."""
+        src, dst = opstream.handoff_op_stream(2, integrity=True)
+        vote_wait = ("recv_from", 1, ("vote", 1))
+        bad_src = [vote_wait] + [op for op in src if op != vote_wait]
+        model = opstream.PairModel([bad_src, dst],
+                                   meta={"route": "handoff",
+                                         "n_layers": 2})
+        res = mc.check(model)
+        assert not res.ok and res.violation.kind == "deadlock"
+        text = replay.format_trace(res.violation)
+        assert "per-node op trace" in text
+        trace = replay.perfetto_trace(res.violation)
+        events = trace["traceEvents"]
+        assert any("VIOLATION" in e.get("name", "") for e in events)
+        txt, js = replay.export_counterexample(model, res.violation,
+                                               str(tmp_path))
+        assert os.path.exists(txt) and os.path.exists(js)
+        assert "handoff" in os.path.basename(txt)
 
 
 # ---------------------------------------------------------------------------
@@ -521,7 +824,8 @@ class TestStrictAnnotations:
 # ---------------------------------------------------------------------------
 
 def _run_mc(env_extra=None):
-    env = dict(os.environ, JAX_PLATFORMS="cpu", **(env_extra or {}))
+    env = dict(os.environ, JAX_PLATFORMS="cpu", GRAFTMC_NO_BANK="1",
+               **(env_extra or {}))
     return subprocess.run(
         [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
          "--mc"], cwd=REPO, env=env, capture_output=True, text=True,
@@ -541,23 +845,70 @@ class TestMakeModelcheckExitCodes:
         assert proc.returncode == 0, proc.stdout + proc.stderr
         assert "cells exhaustive" in proc.stdout
         assert "POR reduction" in proc.stdout
+        for route in ("flat", "streaming", "ag", "hier", "reshard",
+                      "handoff"):
+            assert f"route {route}:" in proc.stdout
+
+    def _fixture_fails(self, name, needle, env_extra=None):
+        # fixture-only runs skip the corpus (it is green-tested once
+        # above; re-paying ~5 s per mutant would push tier-1 past its
+        # wall budget) — the exit-code contract is the fixture's
+        try:
+            proc = _run_mc({"GRAFTMC_FIXTURE":
+                            os.path.join(FIXTURES, name),
+                            "GRAFTMC_SKIP_CORPUS": "1",
+                            **(env_extra or {})})
+            assert proc.returncode != 0, proc.stdout + proc.stderr
+            assert needle in proc.stdout, proc.stdout
+            return proc
+        finally:
+            _clean_fixture_artifacts()
 
     def test_dropped_credit_signal_fixture_fails_loudly(self):
-        try:
-            proc = _run_mc({"GRAFTMC_FIXTURE":
-                            os.path.join(FIXTURES, "mc_bad_credit.py")})
-            assert proc.returncode != 0, proc.stdout + proc.stderr
-            assert "M1:" in proc.stdout
-            assert "protocol deadlock" in proc.stdout
-        finally:
-            _clean_fixture_artifacts()
+        proc = self._fixture_fails("mc_bad_credit.py",
+                                   "protocol deadlock")
+        assert "M1:" in proc.stdout
 
     def test_shrunk_window_fixture_fails_loudly(self):
-        try:
-            proc = _run_mc({"GRAFTMC_FIXTURE":
-                            os.path.join(FIXTURES, "mc_bad_window.py")})
-            assert proc.returncode != 0, proc.stdout + proc.stderr
-            assert "M1:" in proc.stdout
-            assert "recv-slot overwrite" in proc.stdout
-        finally:
-            _clean_fixture_artifacts()
+        proc = self._fixture_fails("mc_bad_window.py",
+                                   "recv-slot overwrite")
+        assert "M1:" in proc.stdout
+
+    def test_ag_window_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_bad_ag_window.py",
+                                   "recv-slot overwrite")
+        assert "M1:" in proc.stdout
+
+    def test_handoff_wait_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_bad_handoff_wait.py",
+                                   "orphan payload")
+        assert "M1:" in proc.stdout
+
+    def test_handoff_order_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_bad_handoff_order.py",
+                                   "protocol deadlock")
+        assert "M1:" in proc.stdout
+
+    def test_weight_collision_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_bad_weights.py",
+                                   "weight collision")
+        assert "M2:" in proc.stdout
+
+    def test_envelope_artifact_schema(self):
+        """The committed envelope record (MC_ENVELOPE_r*.json) carries
+        the per-route rows obs-gate's mc.* keys extract."""
+        import glob
+        banked = sorted(glob.glob(os.path.join(REPO,
+                                               "MC_ENVELOPE_r*.json")))
+        assert banked, "make modelcheck must bank MC_ENVELOPE_r*.json"
+        with open(banked[-1]) as fh:
+            d = json.load(fh)
+        routes = {r["route"] for r in d["routes"]}
+        assert routes == {"flat", "streaming", "ag", "hier", "reshard",
+                          "handoff"}
+        for r in d["routes"]:
+            assert r["cells"] > 0 and r["states"] > 0
+        assert d["failures"] == 0 and d["ok"]
+        assert d["wall_s"] <= d["wall_budget_s"]
+        assert all(c["agree"] and c["reduction"] >= 5.0
+                   for c in d["compare"])

@@ -102,6 +102,13 @@ _WATCH = {
               "fpga_ai_nic_tpu/ops/ring_cost.py",
               "fpga_ai_nic_tpu/obs/metrics.py",
               "fpga_ai_nic_tpu/runtime/chaos.py"],
+    # the graftmc envelope measures the checked protocol IR + the
+    # checker itself + the kernels/lowerings that consume the emitters
+    "mc": ["tools/graftlint.py", "fpga_ai_nic_tpu/verify/",
+           "fpga_ai_nic_tpu/ops/ring_pallas.py",
+           "fpga_ai_nic_tpu/ops/ring_hier.py",
+           "fpga_ai_nic_tpu/parallel/reshard.py",
+           "fpga_ai_nic_tpu/serve/handoff.py"],
     # the telemetry summary is an extraction over the other artifacts, so
     # its staleness watch is the extractor + the telemetry plane itself
     "obs": ["tools/obs_gate.py", "fpga_ai_nic_tpu/obs/",
@@ -885,6 +892,47 @@ def main():
                       f"{cands}.  Calibration: inter "
                       f"{cal.get('inter_gbps')} GB/s "
                       f"({cal.get('inter_source')}).", ""]
+
+    # -- graftmc verification envelope (PR 14) -------------------------------
+    mc_art = (_newest("artifacts/mc_envelope_*.json")
+              or _newest("MC_ENVELOPE_r*.json"))
+    if mc_art:
+        d = _load(mc_art)
+        routes = d.get("routes", [])
+        if routes:
+            L += ["## Protocol verification envelope (graftmc, PR 14)",
+                  "",
+                  f"Source: `{_rel(mc_art)}`{_badge(d, 'mc')} "
+                  "(`make modelcheck`).  Every route's kernel/lowering "
+                  "schedule and its checked op stream derive from ONE "
+                  "emitter in `verify/opstream.py` (drift is "
+                  "structurally impossible); graftmc explores every "
+                  "inequivalent interleaving of every cell below, plus "
+                  "the M2 static checksum-weight pass on the integrity "
+                  "variants.  obs-gate `mc.*` keys hold future runs to "
+                  "these counts TWO-SIDED: a silent envelope shrink "
+                  "fails CI.", ""]
+            L += ["| route | cells (exhaustive) | states | branch "
+                  "points | wall (s) |", "|---|---|---|---|---|"]
+            for r in routes:
+                L.append(f"| {r['route']} | {r['cells']} "
+                         f"| {r['states']} | {r['branch_points']} "
+                         f"| {r['wall_s']} |")
+            L.append(f"| **total** | **{d.get('total_cells')}** "
+                     f"| **{d.get('total_states')}** "
+                     f"| **{d.get('total_branch_points')}** "
+                     f"| **{d.get('wall_s')}** |")
+            L.append("")
+            cmps = ", ".join(
+                f"flat({'x'.join(str(c) for c in row['cell'])}): "
+                f"{row['reduction']}x"
+                f"{'' if row['agree'] else ' (DISAGREE)'}"
+                for row in d.get("compare", []))
+            L += [f"POR-vs-naive reduction (verdicts agree): {cmps}.  "
+                  f"Fuzz beyond the envelope: {d.get('fuzz_runs')} "
+                  f"seeded runs at n = 8.  Wall budget: "
+                  f"{d.get('wall_budget_s')} s (state-explosion "
+                  "tripwire).", ""]
 
     # -- telemetry summary (obs gate) ----------------------------------------
     obs_art = _newest("artifacts/obs_summary_*.json")

@@ -110,20 +110,50 @@ def run_ext() -> int:
     return rc
 
 
+# State-explosion tripwire: a corpus that blows past this wall time
+# fails loudly even before the banked-artifact gate sees it (the whole
+# corpus runs in ~4 s today; 120 s is ~30x headroom, not a perf SLO).
+MC_WALL_BUDGET_S = float(os.environ.get("GRAFTMC_WALL_BUDGET_S", "120"))
+
+
 def run_mc() -> int:
     """graftmc: the exhaustive protocol corpus + the H1 lockset pass
     (`make modelcheck`).  GRAFTMC_FIXTURE names a mutated-model fixture
     module whose violation MUST surface (the J7-style anti-vacuity
     hook); any violation leaves a pretty-printed + Perfetto
-    counterexample pair under artifacts/."""
+    counterexample pair under artifacts/.  Every run banks its envelope
+    (per-route cell counts, states, POR reduction, wall time) as
+    artifacts/mc_envelope_*.json — `make modelcheck` snapshots the
+    newest into MC_ENVELOPE_r*.json, and obs-gate's mc.* keys hold
+    future runs to it two-sided (a silent envelope shrink is a CI
+    failure, not a diff nobody reads)."""
     from fpga_ai_nic_tpu.verify import mc as graftmc
     from fpga_ai_nic_tpu.verify.lockset import run_lockset
+    from fpga_ai_nic_tpu.lint.findings import Finding
     cdir = os.path.join(REPO, "artifacts")
-    findings, stats = graftmc.run_corpus(emit=print,
-                                         counterexample_dir=cdir)
     fixture = os.environ.get("GRAFTMC_FIXTURE")
+    # GRAFTMC_SKIP_CORPUS=1 is honored ONLY alongside a fixture: the
+    # per-fixture exit-code test battery re-runs --mc once per mutant
+    # and must not pay the (separately green-tested) corpus each time.
+    # A bare --mc can never skip the corpus — that would be a silently
+    # vacuous gate.
+    skip_corpus = (fixture is not None
+                   and os.environ.get("GRAFTMC_SKIP_CORPUS") == "1")
+    if skip_corpus:
+        print("[graftmc] corpus SKIPPED (fixture-only run)")
+        findings, stats = [], graftmc.CorpusStats()
+    else:
+        findings, stats = graftmc.run_corpus(emit=print,
+                                             counterexample_dir=cdir)
     if fixture:
         findings += graftmc.run_fixture(fixture, counterexample_dir=cdir)
+    if stats.wall_s > MC_WALL_BUDGET_S:
+        findings.append(Finding(
+            "M1", "<mc:budget>", 0,
+            f"corpus wall time {stats.wall_s:.1f}s exceeds the "
+            f"{MC_WALL_BUDGET_S:.0f}s explosion budget — a state-space "
+            "regression, not a slow machine (raise "
+            "GRAFTMC_WALL_BUDGET_S only with a banked justification)"))
     h1 = run_lockset(repo_root=REPO)
     findings += h1
     for f in findings:
@@ -135,6 +165,17 @@ def run_mc() -> int:
               f"{cmp['reduction']:.1f}x ({cmp['por_states']} vs "
               f"{cmp['naive_states']} states), verdicts "
               f"{'agree' if cmp['agree'] else 'DISAGREE'}")
+    record = graftmc.envelope_record(stats)
+    record["wall_budget_s"] = MC_WALL_BUDGET_S
+    record["ok"] = not live
+    if skip_corpus:
+        pass                  # no envelope to bank from a fixture-only run
+    elif os.environ.get("GRAFTMC_NO_BANK") != "1":
+        # GRAFTMC_NO_BANK=1: the exit-code test battery runs --mc many
+        # times per pytest session and must not litter artifacts/
+        from bench_common import save_artifact
+        path = save_artifact("mc_envelope", record)
+        print(f"[graftmc] envelope banked: {path}")
     print(f"[graftmc] {stats.cells} cells exhaustive "
           f"({stats.states} states, {stats.branch_points} branch "
           f"points), {stats.fuzz_runs} fuzz runs, "

@@ -148,6 +148,19 @@ ADAPT_EXACT_KEYS = ("detected", "switches", "false_switches",
                     "recompiles_across_switch", "n_candidates")
 TOL_ADAPT_TIME = 0.40
 
+# graftmc envelope rows (MC_ENVELOPE_r*.json): per-route cell counts
+# and states explored are exact two-sided — the corpus is deterministic,
+# so ANY drift means the envelope or the models changed, and a silent
+# envelope SHRINK (fewer cells claimed verified) must fail CI exactly
+# like a growth nobody re-banked.  The POR reduction factor gates
+# higher-is-better (a collapsing reduction signals an unsound-or-
+# degraded persistent set), and wall time gates lower-is-better with a
+# wide tolerance: it is the state-explosion tripwire, not a perf SLO
+# (graftlint additionally enforces an absolute budget in-process).
+MC_ROUTE_EXACT = ("cells", "states")
+TOL_MC_TIME = 1.00
+TOL_MC_REDUCTION = 0.50
+
 
 def collective_metric(key: str) -> str:
     return f"collective.{key}"
@@ -183,6 +196,10 @@ def integrity_metric(route: str, key: str) -> str:
 
 def adapt_metric(scenario: str, key: str) -> str:
     return f"adapt.{scenario}.{key}"
+
+
+def mc_metric(route: str, key: str) -> str:
+    return f"mc.{route}.{key}"
 
 
 def _load(path):
@@ -410,6 +427,32 @@ def build_banked_summary() -> dict:
                 else:
                     m = _metric(v, src, higher=False, tol=TOL_ADAPT_TIME)
                 metrics[adapt_metric(row["scenario"], key)] = m
+
+    # -- graftmc envelope (protocol-verification coverage) --------------------
+    p = (_newest("artifacts/mc_envelope_*.json")
+         or _newest("MC_ENVELOPE_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        for row in d.get("routes", []):
+            for key in MC_ROUTE_EXACT:
+                v = row.get(key)
+                if v is None:
+                    continue
+                metrics[mc_metric(row["route"], key)] = _metric(
+                    v, src, tol=TOL_EXACT, two_sided=True)
+        for cmp_row in d.get("compare", []):
+            cell = "x".join(str(c) for c in cmp_row.get("cell", []))
+            v = cmp_row.get("reduction")
+            if v:
+                metrics[f"mc.compare.{cell}.reduction"] = _metric(
+                    v, src, tol=TOL_MC_REDUCTION)
+        if d.get("total_cells"):
+            metrics["mc.total_cells"] = _metric(
+                d["total_cells"], src, tol=TOL_EXACT, two_sided=True)
+        if d.get("wall_s"):
+            metrics["mc.wall_s"] = _metric(d["wall_s"], src,
+                                           higher=False, tol=TOL_MC_TIME)
 
     return {"schema_version": SCHEMA_VERSION, "metrics": metrics}
 
