@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench adapt-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench adapt-bench ckpt-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -69,7 +69,7 @@ bench:
 # run the collective/codec benchmark and snapshot its newest artifact as
 # the round's committed record (the round-2 review's item 3: the
 # first-named BASELINE metric must land in a committed file every round)
-ROUND ?= r14
+ROUND ?= r15
 collective:
 	python bench_collective.py
 	@latest=$$(ls -t artifacts/collective_tpu_*.json artifacts/collective_2*.json 2>/dev/null | head -1); \
@@ -189,6 +189,20 @@ adapt-bench:
 	@latest=$$(ls -t artifacts/adapt_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest ADAPT_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> ADAPT_BENCH_$(ROUND).json"
+
+# durable-state bench (docs/DURABILITY.md): the checkpoint plane's
+# save-stall (sync vs async with the BFP encode in the background
+# thread), audit overhead, and restore-MTTR with/without peer repair —
+# plus the exact storage/repair accounting (bytes, shard/mirror files,
+# repair_wire_bytes == shard bytes, walk-back steps_lost, refusal);
+# snapshot the newest artifact as the round's committed record
+# (obs-gate consumes it — dryrun CPU rows gate only the exact
+# byte/counter keys, ckpt.* keys)
+ckpt-bench:
+	python tools/ckpt_bench.py
+	@latest=$$(ls -t artifacts/ckpt_bench_*.json 2>/dev/null | head -1); \
+	  cp $$latest CKPT_BENCH_$(ROUND).json; \
+	  echo "saved $$latest -> CKPT_BENCH_$(ROUND).json"
 
 # reshard-vs-restore MTTR per trainer x codec (docs/RESHARD.md):
 # the same mid-run preemption recovered by the live-reshard tier and by

@@ -47,6 +47,13 @@ RULE_DOCS: Dict[str, str] = {
            "exactly once, up front at construction, and a runtime plan "
            "switch must cause ZERO new traces — the J10 counted-trace "
            "discipline applied to training (tune.adapt)",
+    "J14": "durable-state integrity: every checkpoint restore path must "
+           "AUDIT (a single flipped stored bit refuses or peer-repairs "
+           "bit-exactly, never restores silently), the walk-back must "
+           "land on the previous verified step, and the peer-repair "
+           "pair program must move exactly the shard bytes callback-"
+           "free with the source donated — or an explicit J14_WAIVERS "
+           "entry (pinned empty; the J12 discipline applied to disk)",
     "H1": "happens-before/lockset: an instance attribute written from two "
           "threads (trainer / watchdog worker / callback) needs a common "
           "lock — R1 generalized to cross-thread order",
@@ -57,7 +64,8 @@ RULE_DOCS: Dict[str, str] = {
 
 AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
-                                "J8", "J9", "J10", "J11", "J12", "J13")
+                                "J8", "J9", "J10", "J11", "J12", "J13",
+                                "J14")
 
 
 @dataclass(frozen=True)

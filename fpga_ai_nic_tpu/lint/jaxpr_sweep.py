@@ -1449,6 +1449,245 @@ def run_j13(verbose: bool = False) -> List[Finding]:
     return findings
 
 
+# ---------------------------------------------------------------------------
+# J14 — durable-state integrity (utils.checkpoint).  The J12 discipline
+# applied to disk: every restore path must AUDIT the stored bytes
+# against the manifest's exact checksums, so a single flipped stored
+# bit either REFUSES (CheckpointIntegrityError) or peer-repairs
+# bit-exactly — never restores silently; the walk-back
+# (restore_latest_verified / latest_step(verified=True)) must land on
+# the previous verified step past a torn one; and the peer-repair pair
+# transfer program must be callback-free, donate its source operand and
+# move EXACTLY the shard bytes (the J8/J11 accounting applied to the
+# repair wire).  Like J10/J13 the rule runs CONCRETELY: each surface
+# saves a checkpoint into a temp dir, damages one stored bit, and
+# drives the real restore path; a surface whose damage provably landed
+# nowhere proves nothing and is itself a finding.  J14_WAIVERS is the
+# only sanctioned skip and the shipped tree keeps it EMPTY
+# (tests/test_lint.py pins that).
+# ---------------------------------------------------------------------------
+
+# name -> reason.  SHIPPED TREE: EMPTY — every restore path is audited.
+J14_WAIVERS: Dict[str, str] = {}
+
+
+def _j14_refuse_build() -> Callable:
+    def run() -> Dict[str, Any]:
+        import os
+        import tempfile
+        import numpy as np
+        from ..utils import checkpoint as ckpt_lib
+        with tempfile.TemporaryDirectory(prefix="j14_refuse_") as d:
+            c = ckpt_lib.Checkpointer(d)      # no mirror: refusal path
+            golden = np.random.default_rng(0).standard_normal(256) \
+                .astype(np.float32)
+            c.save(1, {"w": golden})
+            ckpt_lib.flip_stored_bit(
+                os.path.join(c._path(1), "leaf_00000.npy"))
+            out: Dict[str, Any] = {"surface": "Checkpointer.restore",
+                                   "detected": 0, "silently_restored": 0,
+                                   "_exercised": 1}
+            try:
+                tree = c.restore(1)
+                # a byte flipped on disk and restore handed bytes back:
+                # silent restore whether or not they happen to differ
+                out["silently_restored"] = 1
+                out["_exercised"] = int(
+                    not np.array_equal(tree["w"], golden))
+            except ckpt_lib.CheckpointIntegrityError:
+                out["detected"] = 1
+            return out
+    return run
+
+
+def _j14_repair_build() -> Callable:
+    def run() -> Dict[str, Any]:
+        import os
+        import tempfile
+        import numpy as np
+        from ..utils import checkpoint as ckpt_lib
+        with tempfile.TemporaryDirectory(prefix="j14_repair_") as d:
+            c = ckpt_lib.Checkpointer(d, shards=4, mirror=True)
+            golden = np.random.default_rng(1).standard_normal(1024) \
+                .astype(np.float32)
+            c.save(1, {"w": golden})
+            ckpt_lib.flip_stored_bit(
+                os.path.join(c._path(1), "leaf_00000.s01.npy"))
+            rep = c.audit_step(1, repair=True)
+            shard_bytes = golden[256:512].nbytes
+            out: Dict[str, Any] = {
+                "surface": "Checkpointer.restore(repair)",
+                "detected": int(bool(rep.repaired or rep.failures)),
+                "silently_restored": int(not rep.repaired
+                                         and not rep.failures),
+                "repaired": len(rep.repaired),
+                "bit_exact": int(rep.restorable
+                                 and np.array_equal(rep.tree["w"],
+                                                    golden)),
+                "runtime_wire_bytes": rep.repair_wire_bytes,
+                "declared_bytes": shard_bytes,
+                "_exercised": 1,
+            }
+        # static half: the pair transfer program itself (J8/J11-style
+        # accounting on the repair wire)
+        import jax
+        fn, _mesh = ckpt_lib.pair_transfer_fn(shard_bytes)
+        if fn is None:
+            out["_exercised"] = 0       # single-device runtime
+            return out
+        jx = jax.make_jaxpr(fn)(
+            jax.ShapeDtypeStruct((2, shard_bytes), np.uint8))
+        co = _collect(jx.jaxpr)
+        out["callbacks"] = co["callbacks"]
+        out["wire_bytes"] = co["wire_bytes"]
+        donated = co["donated"] or ()
+        out["donated"] = int(sum(donated)) if donated else 0
+        return out
+    return run
+
+
+def _j14_walkback_build() -> Callable:
+    def run() -> Dict[str, Any]:
+        import os
+        import tempfile
+        import numpy as np
+        from ..utils import checkpoint as ckpt_lib
+        with tempfile.TemporaryDirectory(prefix="j14_walk_") as d:
+            c = ckpt_lib.Checkpointer(d)
+            g1 = np.random.default_rng(2).standard_normal(128) \
+                .astype(np.float32)
+            c.save(1, {"w": g1})
+            c.save(2, {"w": g1 + 1.0})
+            # tear the newest step's manifest (the kill-during-save
+            # shape)
+            with open(os.path.join(c._path(2), ckpt_lib.MANIFEST_FILE),
+                      "w") as f:
+                f.write("{\"format\": 2, \"truncat")
+            step, tree = c.restore_latest_verified()
+            return {
+                "surface": "Checkpointer.restore_latest_verified",
+                "detected": int(step == 1),
+                "silently_restored": int(step == 2),
+                "bit_exact": int(np.array_equal(tree["w"], g1)),
+                "verified_step": int(c.latest_step(verified=True) or -1),
+                "_exercised": int(c.latest_step() == 2),
+            }
+    return run
+
+
+def check_restore_audit(name: str, build: Callable) -> List[Finding]:
+    """Evaluate one J14 surface.  ``build()`` returns a zero-arg runner
+    that saves/damages/restores a real checkpoint and reports:
+    ``detected`` (the damage refused, repaired or walked past),
+    ``silently_restored`` (damaged bytes handed to the caller — THE
+    violation), optional ``repaired``/``bit_exact``/``wire_bytes``/
+    ``declared_bytes``/``callbacks``/``donated`` for the repair
+    program, and ``_exercised`` (falsy = the damage provably landed
+    nowhere, which proves nothing)."""
+    findings: List[Finding] = []
+    cell = f"jaxpr[ckpt {name}]"
+    out = dict(build()())
+    if not out.pop("_exercised", 1):
+        findings.append(Finding(
+            "J14", cell, 0,
+            "the scripted damage landed nowhere (or the runtime cannot "
+            "exercise the surface) — the audit check is vacuous; widen "
+            "the scenario"))
+        return findings
+    if out.get("silently_restored"):
+        findings.append(Finding(
+            "J14", cell, 0,
+            f"{out.get('surface', name)} handed back bytes from a "
+            "checkpoint with a flipped stored bit without refusing or "
+            "repairing — the disk-corruption blind spot (a corrupt "
+            "master silently becomes the restore target); every restore "
+            "path must audit against the manifest checksums"))
+    elif not out.get("detected"):
+        findings.append(Finding(
+            "J14", cell, 0,
+            f"{out.get('surface', name)} neither detected nor survived "
+            "the stored-bit damage — the audit/walk-back contract is "
+            "broken"))
+    if "bit_exact" in out and not out["bit_exact"]:
+        findings.append(Finding(
+            "J14", cell, 0,
+            "the repaired/walked-back state is not bit-identical to the "
+            "uncorrupted golden — repair must hand back EXACTLY the "
+            "bytes the manifest describes"))
+    if "repaired" in out and out["repaired"] < 1:
+        findings.append(Finding(
+            "J14", cell, 0,
+            "a corrupt primary with a clean peer mirror was not "
+            "repaired — the peer-repair tier never fired"))
+    if "wire_bytes" in out and out["wire_bytes"] != out["declared_bytes"]:
+        findings.append(Finding(
+            "J14", cell, 0,
+            f"the pair repair program's ppermute operands move "
+            f"{out['wire_bytes']} bytes but the shard is "
+            f"{out['declared_bytes']} — the repair wire accounting "
+            "(CKPT_BENCH repair_wire_bytes) is lying"))
+    if "runtime_wire_bytes" in out and \
+            out["runtime_wire_bytes"] != out["declared_bytes"]:
+        findings.append(Finding(
+            "J14", cell, 0,
+            f"the executed repair recorded {out['runtime_wire_bytes']} "
+            f"wire bytes for a {out['declared_bytes']}-byte shard"))
+    if out.get("callbacks"):
+        findings.append(Finding(
+            "J14", cell, 0,
+            f"{out['callbacks']} callback primitive(s) inside the pair "
+            "repair program — the transfer must be pure device code"))
+    if "donated" in out and out["donated"] < 1:
+        findings.append(Finding(
+            "J14", cell, 0,
+            "the pair repair program does not donate its source operand "
+            "— repair would hold two copies of the shard in memory"))
+    return findings
+
+
+def j14_surfaces() -> List[Tuple[str, Callable]]:
+    """(name, build) pairs.  GRAFTLINT_J14_FIXTURE appends a surface
+    from a module path exposing ``build()`` — the bad-fixture /
+    exit-code hook, same contract as J7–J13's."""
+    surfaces: List[Tuple[str, Callable]] = [
+        ("refuse unmirrored bit flip", _j14_refuse_build),
+        ("peer-repair mirrored shard", _j14_repair_build),
+        ("walk back past torn step", _j14_walkback_build),
+    ]
+    import os
+    fixture = os.environ.get("GRAFTLINT_J14_FIXTURE")
+    if fixture:
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("_j14_fixture",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        surfaces.append((f"fixture:{os.path.basename(fixture)}",
+                         mod.build))
+    return surfaces
+
+
+def run_j14(verbose: bool = False) -> List[Finding]:
+    findings: List[Finding] = []
+    for name, build in j14_surfaces():
+        waiver = J14_WAIVERS.get(name)
+        if waiver:
+            if verbose:
+                print(f"[graftlint:jaxpr] ckpt {name}: WAIVED ({waiver})")
+            continue
+        try:
+            fs = check_restore_audit(name, build)
+        except Exception as e:  # noqa: BLE001 — a surface must fail LOUDLY
+            fs = [Finding("J14", f"jaxpr[ckpt {name}]", 0,
+                          f"surface failed to evaluate: "
+                          f"{type(e).__name__}: {str(e)[:300]}")]
+        findings.extend(fs)
+        if verbose:
+            print(f"[graftlint:jaxpr] ckpt {name}: "
+                  f"{'FAIL' if fs else 'ok'}")
+    return findings
+
+
 def sweep_grid() -> List[Tuple[Optional[str], str, bool]]:
     """(codec, trainer, obs) cells — registry-driven, so a future codec
     is auto-covered; None = uncompressed ring baseline."""
@@ -1548,4 +1787,5 @@ def run_sweep(verbose: bool = False) -> List[Finding]:
     findings.extend(run_j11(verbose=verbose))
     findings.extend(run_j12(verbose=verbose))
     findings.extend(run_j13(verbose=verbose))
+    findings.extend(run_j14(verbose=verbose))
     return findings

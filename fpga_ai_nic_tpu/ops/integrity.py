@@ -42,6 +42,13 @@ the wire plane leans on:
 Numpy golden twins live in `compress.golden` (``golden_word_checksum``,
 ``golden_payload_checksum``) — the same spec-first discipline as every
 codec (tests/test_integrity.py holds them bit-for-bit equal).
+
+The durable-state plane reuses the SAME checksum spec at rest:
+`utils.checkpoint` manifests checksum every stored leaf/shard with the
+odd-weighted u32 word sum over the post-compress bytes (u8-widened,
+``bytes_checksum`` delegating to the golden twin), so the wire tier
+and the disk tier (graftlint J12 / J14) trip on exactly the same
+algebra — docs/DURABILITY.md.
 """
 
 from __future__ import annotations

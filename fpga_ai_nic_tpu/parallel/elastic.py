@@ -146,6 +146,16 @@ class ElasticConfig:
     max_retries: int = 3              # recoveries per step before giving up
     backoff_s: float = 0.05           # exponential backoff base
     ckpt_every: int = 1               # checkpoint cadence (steps)
+    # durability plane (utils.checkpoint v2): retention GC bound (None =
+    # keep everything), peer mirroring of the stored shards (the
+    # redundancy the repair tier fetches from — ON for the supervised
+    # loop: a restore target that cannot survive a single flipped bit
+    # is not a recovery tier), and the watchdog-trip emergency dump
+    # ("dump before dying": when the ladder exhausts, persist the live
+    # state if its buffers survived, flagged emergency in the manifest)
+    ckpt_keep_last: Optional[int] = None
+    ckpt_mirror: bool = True
+    emergency_dump: bool = True
     drift_factor: float = 1e3         # NormDriftGuard trip factor
     drift_warmup: int = 3             # clean samples before drift arms
     # master-shard guard: validate what the checkpoint will persist
@@ -197,7 +207,14 @@ class ElasticTrainer:
         self.profiler = profiler or Profiler()
         self.watchdog = Watchdog(self.cfg.step_timeout_s)
         self.heartbeat = Heartbeat(stall_after_s=self.cfg.stall_after_s)
-        self.ckpt = Checkpointer(ckpt_dir)
+        # the hardened last tier: audited manifests, per-shard peer
+        # mirrors (trainer.n dp peers), bounded retention, durability
+        # chaos sites armed from the same plan as every other site
+        self.ckpt = Checkpointer(
+            ckpt_dir, shards=getattr(trainer, "n", None),
+            mirror=self.cfg.ckpt_mirror, keep_last=self.cfg.ckpt_keep_last,
+            chaos=plan, recovery=self.profiler.recovery,
+            events=self.profiler.events)
         self.loss_guard = chaos_lib.NormDriftGuard(
             factor=self.cfg.drift_factor, warmup=self.cfg.drift_warmup)
         self.gnorm_guard = chaos_lib.NormDriftGuard(
@@ -382,18 +399,72 @@ class ElasticTrainer:
     # -- tier 2: checkpoint restore -----------------------------------------
 
     def _restore(self):
-        """Last-good state from the checkpoint directory.  The loop saved
-        one before the first step, so this always has a target."""
-        step = self.ckpt.latest_step()
-        if step is None:
+        """Last-good VERIFIED state from the checkpoint directory: every
+        leaf audited against its manifest, corrupt shards peer-repaired
+        where a clean mirror exists, and the walk falling back past
+        corrupt/torn steps to the previous verified one.  A restore
+        target that fails its audit with no clean source is REFUSED
+        (CheckpointIntegrityError propagates — training on silently
+        corrupted masters is worse than dying loudly).  The loop saved a
+        checkpoint before the first step, so this normally has a
+        target."""
+        if self.ckpt.latest_step() is None:
             raise RuntimeError(
                 f"no checkpoint under {self.ckpt.directory} to restore "
                 "from (run() saves step 0 before the loop; direct step() "
                 "callers must checkpoint() first)")
-        return self.trainer.restore_state(self.ckpt.restore(step))
+        _step, tree = self.ckpt.restore_latest_verified()
+        return self.trainer.restore_state(tree)
 
-    def checkpoint(self, state) -> str:
-        return self.ckpt.save(int(state.step), state)
+    def checkpoint(self, state) -> Optional[str]:
+        """Persist ``state`` under the audited commit protocol.  A save
+        interrupted by an injected durability fault (kill-during-save /
+        disk-full) or a real OSError is absorbed and recorded — the
+        commit protocol guarantees the directory still restores to the
+        previous verified step, and the next cadence save retries —
+        rather than killing a training loop that is otherwise healthy.
+        The absorption is LEGAL only while a verified restore target
+        exists: a failed FIRST save (no step on disk at all) re-raises,
+        because swallowing it would let run() proceed uncheckpointed
+        and die unrecoverably at the first fault, steps away from the
+        disk problem that caused it."""
+        try:
+            return self.ckpt.save(int(state.step), state,
+                                  shards=getattr(self.trainer, "n", None))
+        except (OSError, chaos_lib.InjectedFault) as err:
+            if isinstance(err, chaos_lib.InjectedFault) and \
+                    err.kind not in chaos_lib.DURABILITY_KINDS:
+                raise
+            self.profiler.recovery.record_ckpt_save_failure()
+            self.profiler.events.instant(
+                "ckpt.save_failed", step=int(state.step),
+                error=repr(err)[:200])
+            if self.ckpt.latest_step(verified=True) is None:
+                raise
+            return None
+
+    def _emergency_dump(self, state, step_i: int) -> Optional[str]:
+        """The 'dump before dying' tier: when the recovery ladder
+        exhausts, persist the live pre-step state (if its buffers were
+        not donated into the failed attempt) flagged ``emergency`` in
+        the manifest, so a post-mortem restart can resume from the trip
+        point instead of the last cadence checkpoint."""
+        if not self.cfg.emergency_dump or state is None \
+                or not chaos_lib.state_buffers_alive(state):
+            return None
+        try:
+            path = self.ckpt.save(int(state.step), state, emergency=True,
+                                  shards=getattr(self.trainer, "n", None))
+        except Exception as err:  # noqa: BLE001 — dying anyway; stay loud
+            self.profiler.events.instant(
+                "ckpt.emergency_failed", step=step_i,
+                error=repr(err)[:200])
+            return None
+        self.ckpt.wait_until_finished()
+        self.profiler.recovery.record_emergency_dump()
+        self.profiler.events.instant("ckpt.emergency", step=step_i,
+                                     path=path)
+        return path
 
     # -- the supervised step ------------------------------------------------
 
@@ -443,6 +514,7 @@ class ElasticTrainer:
                 self.queue.abandon()
                 if attempt >= self.cfg.max_retries:
                     self.profiler.recovery.record_failed_recovery()
+                    self._emergency_dump(state, step_i)
                     raise RecoveryExhausted(
                         f"step {step_i} failed {attempt + 1} times "
                         f"(last: {kind}); giving up after max_retries="
@@ -525,8 +597,11 @@ class ElasticTrainer:
         else:
             batches = list(batch_fn)
             get_batch = lambda i: batches[i]  # noqa: E731
-        if self.ckpt.latest_step() is None:
-            self.checkpoint(state)           # a restore target always exists
+        if self.ckpt.latest_step(verified=True) is None:
+            # a VERIFIED restore target always exists before the loop (a
+            # directory holding only corrupt/torn leftovers counts as
+            # empty — restoring from it would refuse anyway)
+            self.checkpoint(state)
         metrics: Dict = {}
         while int(state.step) < n_steps:
             step_i = int(state.step)

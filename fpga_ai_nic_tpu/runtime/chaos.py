@@ -57,7 +57,8 @@ from typing import Any, Callable, Dict, Iterable, List, Optional, Sequence, Tupl
 import numpy as np
 
 __all__ = [
-    "FAULT_KINDS", "SITES", "TRAIN_SITES", "SERVE_SITES", "WIRE_SITES",
+    "FAULT_KINDS", "DURABILITY_KINDS", "SITES", "TRAIN_SITES",
+    "SERVE_SITES", "WIRE_SITES", "CKPT_SITES",
     "CORRUPTION_MODES",
     "InjectedFault", "InjectedPreemption", "IntegrityError",
     "WireIntegrityError",
@@ -89,7 +90,19 @@ FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
 # integrity corruption cells in tools/chaos_bench.py own it.
 TRAIN_SITES = ("queue.issue", "queue.wait", "staging", "collective")
 SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
-SITES = TRAIN_SITES + SERVE_SITES + ("reshard.transfer",)
+# "ckpt.save" / "ckpt.restore" are the DURABILITY sites
+# (utils.checkpoint): the save file-op sequence and the restore audit
+# boundary.  Their fault kinds model what disks and processes actually
+# do to checkpoints — kill-during-save (the op stream truncated at a
+# planned prefix), disk-full (ENOSPC mid-sequence), file bit-flip at
+# rest (corruption mode="wirebit" through damage_checkpoint) and a
+# stale manifest (mode="stale_manifest": a previous step's manifest
+# copied over the new one).  Not in TRAIN_SITES: they can only fire
+# while a Checkpointer armed with the plan is saving/restoring, so the
+# generic matrix/soak would plan unfireable specs; the dedicated
+# durability cells in tools/chaos_bench.py own them.
+CKPT_SITES = ("ckpt.save", "ckpt.restore")
+SITES = TRAIN_SITES + SERVE_SITES + ("reshard.transfer",) + CKPT_SITES
 # "wirebit" is the FINITE corruption class the wire checksums exist for
 # (the blind spot of every value-space guard): a low bit flipped in the
 # ENCODED frame (int8 mantissa / int16 index / f32 low-mantissa word)
@@ -97,7 +110,14 @@ SITES = TRAIN_SITES + SERVE_SITES + ("reshard.transfer",)
 # excursion.  At WIRE_SITES it fires through the encoded-payload wire
 # tap; at host sites (serve.step payloads, staging) it flips low
 # mantissa bits of the float tree in place.
-CORRUPTION_MODES = ("nan", "bitflip", "scale", "wirebit")
+CORRUPTION_MODES = ("nan", "bitflip", "scale", "wirebit", "stale_manifest")
+
+# durability-only fault kinds (ckpt.save): "kill" truncates the save's
+# file-op sequence at a planned prefix (``fraction`` of the op count) —
+# the simulated mid-save crash the commit protocol must absorb;
+# "diskfull" raises ENOSPC at the same point.  Neither is legal at any
+# other site (a host boundary has no op stream to truncate).
+DURABILITY_KINDS = ("kill", "diskfull")
 
 # faults that can run inside an XLA callback (no raising in there)
 _CALLBACK_KINDS = ("hang", "slowdown", "corruption")
@@ -179,9 +199,35 @@ class FaultSpec:
     fraction: float = 0.01        # corrupted element fraction (>= 1 elem)
 
     def __post_init__(self):
-        assert self.kind in FAULT_KINDS, self.kind
+        assert self.kind in FAULT_KINDS + DURABILITY_KINDS, self.kind
         assert self.site in SITES, self.site
         assert self.mode in CORRUPTION_MODES, self.mode
+        if self.kind in DURABILITY_KINDS and self.site != "ckpt.save":
+            raise ValueError(
+                f"{self.kind!r} only exists at the 'ckpt.save' site: it "
+                "truncates/fails the save file-op sequence at a planned "
+                "prefix (fraction of the op count) — no other site has "
+                "an op stream to interrupt")
+        if self.site in CKPT_SITES and self.kind not in \
+                DURABILITY_KINDS + ("corruption",):
+            raise ValueError(
+                f"{self.kind!r} cannot fire at the {self.site!r} site: "
+                "durability sites take kill/diskfull (save only) and "
+                "corruption (mode='wirebit' file bit-flip at rest, "
+                "mode='stale_manifest') — hang/exception belong to the "
+                "host boundaries around the checkpoint call")
+        if self.site in CKPT_SITES and self.kind == "corruption" \
+                and self.mode not in ("wirebit", "stale_manifest"):
+            raise ValueError(
+                f"corruption mode {self.mode!r} cannot fire at "
+                f"{self.site!r}: stored-file damage is 'wirebit' (a low "
+                "stored bit flips at rest) or 'stale_manifest' — the "
+                "value modes corrupt live payload trees, not files")
+        if self.mode == "stale_manifest" and self.site not in CKPT_SITES:
+            raise ValueError(
+                "mode='stale_manifest' only exists at the durability "
+                "sites (ckpt.save / ckpt.restore): it swaps a step's "
+                "manifest for a previous step's")
         if self.site in _CALLBACK_ONLY_SITES \
                 and self.kind not in _CALLBACK_KINDS:
             raise ValueError(
@@ -423,6 +469,73 @@ class FaultPlan:
                                modes=("wirebit",)):
             arr = self._corrupt_wire_array(np.array(arr), spec)
         return arr
+
+    # -- durability (checkpoint file) path ----------------------------------
+
+    def take_save_interrupts(self) -> List[FaultSpec]:
+        """Pop the pending kill/diskfull spec at ``ckpt.save`` for the
+        save whose file-op sequence is about to execute
+        (utils.checkpoint._exec_ops maps the spec's ``fraction`` to an
+        op index and stops there — the simulated mid-save crash).
+        ``limit=1``: ONE interrupt per save — a save dies once, so
+        sibling specs at the same step stay armed for LATER saves
+        instead of being popped-as-fired without ever firing (the
+        wire tap's one-event-per-crossing discipline)."""
+        return self._take("ckpt.save", DURABILITY_KINDS, limit=1)
+
+    def damage_checkpoint(self, site: str, step_dir: str,
+                          prev_manifest: Optional[str] = None) -> None:
+        """Fire pending corruption specs at a durability site against a
+        COMMITTED step directory — damage at rest, applied after the
+        save commit (``ckpt.save``) or just before the restore audit
+        (``ckpt.restore``).
+
+        ``mode="wirebit"``: the lowest stored bit of one word in the
+        data region of a deterministically chosen PRIMARY leaf file
+        flips — a plausible, in-band value (f32 low-mantissa byte /
+        int8 LSB) that no magnitude or finiteness guard can see; only
+        the manifest's exact checksum audit proves it.
+        ``mode="stale_manifest"``: the step's manifest is replaced with
+        the PREVIOUS step's (operator error / misdirected copy) — the
+        audit must reject it as describing other bytes (the step-field
+        and self-checksum validation), never validate against it."""
+        import os
+        import shutil
+        # lazy: runtime.chaos must stay importable without the utils
+        # layer; utils.checkpoint only imports chaos lazily too
+        from ..utils.checkpoint import (MANIFEST_FILE, flip_stored_bit,
+                                        npy_data_offset)
+        for spec in self._take(site, ("corruption",),
+                               modes=("wirebit", "stale_manifest")):
+            if spec.mode == "stale_manifest":
+                if prev_manifest is not None and \
+                        os.path.exists(prev_manifest):
+                    shutil.copyfile(
+                        prev_manifest,
+                        os.path.join(step_dir, MANIFEST_FILE))
+                continue
+            # primary npy files only (mirror copies end ".m.npy"): the
+            # repair tier exists exactly for a damaged primary
+            try:
+                names = sorted(
+                    f for f in os.listdir(step_dir)
+                    if f.endswith(".npy") and not f.endswith(".m.npy"))
+            except FileNotFoundError:
+                continue
+            if not names:
+                continue
+            big = [f for f in names
+                   if os.path.getsize(os.path.join(step_dir, f)) >= 1024]
+            pool = big or names
+            rng = np.random.default_rng((self.seed, spec.step, 0xD15C0))
+            p = os.path.join(step_dir, str(rng.choice(pool)))
+            with open(p, "rb") as f:
+                header = f.read(16)
+            # flip bit 0 of a 4-byte-aligned data byte (f32 low-mantissa
+            # byte / int8 LSB — always finite, always in-band)
+            n_words = max(1, (os.path.getsize(p)
+                              - npy_data_offset(header)) // 4)
+            flip_stored_bit(p, byte_off=4 * int(rng.integers(n_words)))
 
     def _corrupt_wire_array(self, arr: np.ndarray,
                             spec: FaultSpec) -> np.ndarray:

@@ -1,13 +1,49 @@
-"""Checkpoint / resume — absent in the reference (SURVEY.md §5:
-"Checkpoint / resume: none anywhere"), required by the larger BASELINE
-configs (Llama-3 8B ZeRO-1 with BFP optimizer-state compression).
+"""Durable-state integrity — audited, crash-consistent, peer-repairable
+checkpointing (the hardened LAST recovery tier; docs/DURABILITY.md).
 
-Two layers:
-- ``save/restore``: orbax-backed full TrainState checkpointing.
-- ``compress_state/decompress_state``: optional BFP compression of the f32
-  master/optimizer shards (BASELINE.json config 5) using the native C++
-  codec when available (runtime.native), else the numpy golden model —
-  4 bytes -> ~1.06 bytes per element at a bounded quantization error.
+Checkpoint / resume is absent in the reference (SURVEY.md §5:
+"Checkpoint / resume: none anywhere").  Earlier revisions backed this
+module with orbax; the durability plane v2 replaces that black box with
+an explicit, auditable store, because every property the recovery
+ladder leans on has to be *provable*:
+
+  manifest     every ``save`` writes per-leaf (and per-shard) EXACT
+               checksums over the stored representation — post-BFP-
+               compress, the same odd-weighted u32 word sums the wire
+               plane uses (`ops.integrity` / `compress.golden`), bit-
+               exact with no tolerance band — committed atomically with
+               the step bytes.
+  commit       ``save`` is an explicit file-op sequence (the opstream
+               emitter discipline applied to the filesystem): all files
+               land in a ``step_N.tmp-write`` dir — leaves, layout
+               sidecar, manifest — and ONE ``os.replace`` publishes the
+               step.  Truncated at ANY op prefix, restore yields exactly
+               the previous verified step or exactly the new one (the
+               crash-point sweep in tests/test_checkpoint.py proves it
+               exhaustively; ``op_hook`` is the sweep's seam).
+  audit        every restore path re-checksums every leaf against the
+               manifest before handing bytes to a trainer.  A single
+               flipped stored bit can never restore silently (frozen as
+               graftlint J14 — zero waivers, the J12 discipline applied
+               to disk).
+  peer repair  with ``mirror=True`` each ZeRO-1 shard is also stored
+               under its dp PEER ((j+1) % n — the redundancy the
+               replicated-params plane gives up when checkpoints persist
+               masters only).  A corrupt primary shard is re-fetched
+               from the peer via a reshard-style single-pair ppermute
+               transfer program whose wire bytes equal EXACTLY the shard
+               bytes (J8-style accounting, checked by J14), verified
+               against the manifest, and healed in place.
+  walk-back    ``restore_latest_verified`` falls back past corrupt/torn
+               steps to the previous VERIFIED step — and REFUSES
+               (CheckpointIntegrityError) when no clean source exists.
+               It never silently restores damaged bytes.
+
+``compress_state``-layer helpers (``compress_array`` /
+``decompress_array``) are unchanged: optional BFP compression of the
+f32 master/optimizer shards (BASELINE.json config 5) using the native
+C++ codec when available (runtime.native), else the numpy golden model —
+4 bytes -> ~1.06 bytes per element at a bounded quantization error.
 """
 
 from __future__ import annotations
@@ -15,7 +51,11 @@ from __future__ import annotations
 import json
 import os
 import re
-from typing import Any, Dict, Optional
+import shutil
+import threading
+from dataclasses import dataclass, field
+from functools import lru_cache
+from typing import Any, Callable, Dict, List, NamedTuple, Optional, Tuple
 
 import jax
 import numpy as np
@@ -24,8 +64,39 @@ from ..ops import bfp_golden
 from ..runtime import native
 from .config import BFPConfig
 
+__all__ = [
+    "Checkpointer", "CheckpointIntegrityError", "AuditReport", "FileOp",
+    "compress_array", "decompress_array", "bytes_checksum", "peer_fetch",
+    "pair_transfer_fn", "MANIFEST_FILE", "RESTORE_SURFACES",
+    "npy_data_offset", "flip_stored_bit",
+]
 
-def _codec():
+MANIFEST_FILE = "manifest.json"
+_FORMAT = 2
+_ALGO = "odd-weighted-u32-word-sum/v1"
+# arrays below this size are never shard-split (the split exists for
+# per-shard peer repair of the big flat masters, not for scalars)
+_MIN_SHARD_BYTES = 512
+
+# Every restore entrypoint in the tree.  graftlint J14 proves each one
+# audits (a corrupted byte must refuse/repair, never restore silently);
+# adding a path here without audit coverage is a J14 finding, and the
+# waiver registry (lint.jaxpr_sweep.J14_WAIVERS) is pinned EMPTY.
+RESTORE_SURFACES = (
+    "Checkpointer.restore",
+    "Checkpointer.restore_latest_verified",
+    "ElasticTrainer._restore",
+)
+
+
+class CheckpointIntegrityError(RuntimeError):
+    """A stored checkpoint failed its bit-exact audit and could not be
+    repaired from a peer copy — restoring it would silently train on
+    corrupted masters, so the restore path REFUSES instead (the caller
+    walks back to the previous verified step, or surfaces the loss)."""
+
+
+def _codec() -> Tuple[Callable[..., Any], Callable[..., Any]]:
     if native.available():
         return native.bfp_encode, native.bfp_decode
     return (lambda x, b, m, r: bfp_golden.bfp_encode(x, b, m, r),
@@ -55,50 +126,404 @@ def decompress_array(blob: Dict[str, Any]) -> np.ndarray:
         blob["dtype"] if isinstance(blob["dtype"], str) else str(blob["dtype"]))
 
 
-class Checkpointer:
-    """Orbax-backed checkpoint manager with optional BFP-compressed
-    optimizer/master state.
+# ---------------------------------------------------------------------------
+# checksums over the STORED representation
+# ---------------------------------------------------------------------------
 
-    ``async_save=True`` writes in a background thread (orbax
-    AsyncCheckpointer): ``save`` returns as soon as the host copy is
-    snapshotted, so checkpoint IO overlaps the next training steps; call
-    ``wait_until_finished()`` (or just the next ``save``, which waits on
-    the previous one) before reading the files.  Caveat: with ``compress``
-    set, the BFP encode of the master/optimizer shards still runs
-    synchronously inside ``save`` — only the file IO overlaps — so for
-    GB-scale compressed state the async win is the write, not the
-    encode."""
+# weighted-sum chunk: 4 Mi words (16 MiB of payload) bounds the u64
+# temporaries to ~tens of MB regardless of leaf size; per-chunk sums of
+# <= 2^22 masked-u32 terms stay < 2^54, far inside u64
+_CHK_CHUNK_WORDS = 1 << 22
+
+
+def _u32_words_checksum(words: np.ndarray) -> int:
+    """Odd-weighted wraparound u32 word sum over a u32 vector, chunked
+    so GB-scale leaves never allocate GB-scale temporaries.  Bit-equal
+    to `compress.golden.golden_word_checksum` on the same words (pinned
+    by test) — chunking only regroups an associative modular sum."""
+    acc = 0
+    for k in range(0, words.size, _CHK_CHUNK_WORDS):
+        w = words[k:k + _CHK_CHUNK_WORDS].astype(np.uint64)
+        idx = np.arange(k, k + w.size, dtype=np.uint64)
+        weights = ((idx << np.uint64(1)) | np.uint64(1)) \
+            & np.uint64(0xFFFFFFFF)
+        acc += int(np.sum((w * weights) & np.uint64(0xFFFFFFFF),
+                          dtype=np.uint64))
+    return acc & 0xFFFFFFFF
+
+
+def _u8_checksum(a: np.ndarray) -> int:
+    """Checksum of a flat u8 view: bytes pack 4-per-u32-word
+    (little-endian, zero-padded tail) — the SAME u32 word decomposition
+    the wire plane's checksums ride (`ops.integrity.words_u32` bitcasts
+    4-byte payloads word-for-word), at 1/4 the word count of per-byte
+    widening."""
+    pad = (-a.size) % 4
+    if pad:
+        a = np.concatenate([a, np.zeros(pad, np.uint8)])
+    return _u32_words_checksum(a.view("<u4"))
+
+
+def bytes_checksum(buf: bytes) -> int:
+    """The manifest checksum: the wire plane's odd-weighted wraparound
+    u32 word sum (`ops.integrity` / `compress.golden`) over a raw byte
+    stream packed little-endian 4 bytes per u32 word (zero-padded tail)
+    — dtype-agnostic, so ONE spec covers f32 masters, int8 BFP mantissa
+    tiles and the manifest's own canonical JSON; equal by construction
+    to ``golden_word_checksum`` over the u32 word view (pinned by
+    test).  Exact integer arithmetic, no tolerance band; odd weights
+    are invertible mod 2^32, so any single corrupted byte changes its
+    word and hence the sum."""
+    return _u8_checksum(np.frombuffer(buf, np.uint8))
+
+
+def npy_data_offset(header: bytes) -> int:
+    """Data-region offset of a v1 ``.npy`` file (u16 header length at
+    bytes 8..9, data at 10+hlen) — THE single definition shared by the
+    chaos/lint/bench/test tooling that flips stored bits; a future
+    stored-format change lands here once."""
+    return 10 + int.from_bytes(header[8:10], "little")
+
+
+def flip_stored_bit(path: str, byte_off: int = 0, bit: int = 0) -> int:
+    """Flip one DATA-region bit of a stored npy file in place (the
+    damage-at-rest primitive the durability batteries inject); returns
+    the absolute file offset flipped."""
+    with open(path, "rb") as f:
+        buf = bytearray(f.read())
+    off = min(npy_data_offset(buf) + byte_off, len(buf) - 1)
+    buf[off] ^= (1 << bit)
+    with open(path, "wb") as f:
+        f.write(buf)
+    return off
+
+
+def _c_contig(arr: np.ndarray) -> np.ndarray:
+    """C-contiguous view/copy that PRESERVES ndim (np.ascontiguousarray
+    silently promotes 0-d scalars to shape (1,), which would corrupt the
+    stored shape of e.g. the step counter)."""
+    return np.ascontiguousarray(arr).reshape(arr.shape)
+
+
+def _array_checksum(arr: np.ndarray) -> int:
+    # u8 view, not tobytes(): no full-buffer copy per checksum
+    return _u8_checksum(_c_contig(arr).reshape(-1).view(np.uint8))
+
+
+def _canonical_json(obj: Any) -> bytes:
+    return json.dumps(obj, sort_keys=True, separators=(",", ":")).encode()
+
+
+# ---------------------------------------------------------------------------
+# the peer-repair transfer program (reshard-style single pair)
+# ---------------------------------------------------------------------------
+
+_PAIR_AXIS = "ckpt_pair"
+
+
+@lru_cache(maxsize=32)
+def pair_transfer_fn(nbytes: int) -> Tuple[Optional[Any], Optional[Any]]:
+    """The repair program for an ``nbytes`` shard: ONE jitted shard_map
+    over a 2-device pair mesh moving the peer-held mirror bytes to the
+    owner with a single exact-length ``lax.ppermute`` — the reshard/
+    handoff discipline applied to checkpoint repair.  The payload rides
+    as raw u8 words (dtype-agnostic, bit-exact at any itemsize), the
+    wire bytes equal EXACTLY the shard bytes (J14 checks the jaxpr the
+    way J8/J11 check reshard/handoff), the source operand is donated,
+    and the program is callback-free.  Returns ``(fn, mesh)``."""
+    from jax import lax
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devs = jax.devices()
+    if len(devs) < 2:
+        return None, None
+    mesh = Mesh(np.array(devs[:2]), (_PAIR_AXIS,))
+
+    def body(x: jax.Array) -> jax.Array:
+        return lax.ppermute(x, _PAIR_AXIS, [(0, 1)])
+
+    fn = jax.jit(jax.shard_map(body, mesh=mesh, in_specs=P(_PAIR_AXIS),
+                               out_specs=P(_PAIR_AXIS), check_vma=False),
+                 donate_argnums=(0,))
+    return fn, mesh
+
+
+def peer_fetch(arr: np.ndarray) -> Tuple[np.ndarray, int]:
+    """Land a peer-held mirror shard on the owner device.  Row 0 (the
+    peer) holds the mirror bytes, row 1 (the owner) zeros; one single-
+    pair ppermute delivers exactly ``arr.nbytes`` and the landed row is
+    returned bit-for-bit.  Returns ``(landed, wire_bytes)``; on a
+    single-device runtime the fetch degenerates to a host copy with
+    ``wire_bytes == 0`` (recorded honestly — nothing crossed a wire)."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    arr = _c_contig(arr)
+    raw = arr.reshape(-1).view(np.uint8) if arr.ndim else arr[None].view(np.uint8)
+    fn, mesh = pair_transfer_fn(raw.shape[0])
+    if fn is None:
+        return np.array(arr, copy=True), 0
+    stacked = np.stack([raw, np.zeros_like(raw)])
+    x = jax.device_put(stacked, NamedSharding(mesh, P(_PAIR_AXIS)))
+    out = np.asarray(jax.block_until_ready(fn(x)))
+    landed = out[1].view(arr.dtype).reshape(arr.shape)
+    return landed, int(raw.nbytes)
+
+
+# ---------------------------------------------------------------------------
+# the save file-op stream
+# ---------------------------------------------------------------------------
+
+class FileOp(NamedTuple):
+    """One filesystem action of a save/GC sequence.  ``save`` is planned
+    as a list of these and executed in order — the opstream emitter
+    discipline applied to the filesystem, so the crash-point sweep can
+    truncate the sequence at every prefix and assert the commit protocol
+    (tests/test_checkpoint.py).  Kinds:
+
+      mkdir      create ``path`` (parents ok)
+      write_npy  write ``data`` (np.ndarray) to ``path``
+      write_json write ``data`` (json-able) to ``path``
+      replace    atomic ``os.replace(path, data)`` — THE commit op
+      remove     unlink ``path`` (missing ok)
+      rmtree     remove the tree at ``path`` (missing ok)
+      rmdir      remove the (now empty) dir at ``path`` (missing ok)
+      gc_guard   read-back audit of the just-committed step (``data`` =
+                 step): retention deletions only run if the NEW step
+                 verifies on disk — a lying write can never leave the
+                 directory with zero restorable steps
+    """
+
+    kind: str
+    path: str
+    data: Any = None
+
+
+def _apply_op(op: FileOp) -> None:
+    if op.kind == "mkdir":
+        os.makedirs(op.path, exist_ok=True)
+    elif op.kind == "write_npy":
+        with open(op.path, "wb") as f:
+            np.save(f, _c_contig(op.data))
+    elif op.kind == "write_json":
+        with open(op.path, "w") as f:
+            json.dump(op.data, f)
+    elif op.kind == "replace":
+        os.replace(op.path, op.data)
+    elif op.kind == "remove":
+        try:
+            os.remove(op.path)
+        except FileNotFoundError:
+            pass
+    elif op.kind == "rmtree":
+        shutil.rmtree(op.path, ignore_errors=True)
+    elif op.kind == "rmdir":
+        try:
+            os.rmdir(op.path)
+        except OSError:
+            pass
+    else:  # pragma: no cover - planner bug
+        raise ValueError(f"unknown file op kind {op.kind!r}")
+
+
+# ---------------------------------------------------------------------------
+# audit report
+# ---------------------------------------------------------------------------
+
+@dataclass
+class AuditReport:
+    """Verdict of one step's bit-exact audit against its manifest."""
+
+    step: int
+    ok: bool = True                    # every primary byte matched
+    restorable: bool = False           # clean, or every failure repaired
+    failures: List[Dict[str, Any]] = field(default_factory=list)
+    repaired: List[Dict[str, Any]] = field(default_factory=list)
+    repair_wire_bytes: int = 0
+    emergency: bool = False
+    # the assembled (still-compressed) tree when restorable — restore
+    # reuses it so audited bytes are the restored bytes, read once
+    tree: Optional[Any] = None
+
+    def describe(self) -> str:
+        probs = "; ".join(
+            f"{'/'.join(map(str, f['path']))}"
+            + (f"[shard {f['shard']}]" if f.get("shard") is not None else "")
+            + f": {f['reason']}" for f in self.failures) or "clean"
+        return (f"step {self.step}: ok={self.ok} "
+                f"restorable={self.restorable} repaired={len(self.repaired)}"
+                f" ({probs})")
+
+
+# ---------------------------------------------------------------------------
+# tree <-> template flattening
+# ---------------------------------------------------------------------------
+
+def _template(tree: Any, leaves: List[Tuple[Tuple[Any, ...], np.ndarray]],
+              path: Tuple[Any, ...] = ()) -> Any:
+    """JSON template of ``tree`` with array leaves replaced by
+    ``{"__leaf__": i}`` refs (appended to ``leaves``); container shape
+    (dict/list/tuple) and inline scalars survive verbatim."""
+    if isinstance(tree, dict):
+        clash = {"__leaf__", "__tuple__", "__str__"} & set(map(str, tree))
+        if clash:
+            # the template's sentinel names: a user payload carrying one
+            # would rebuild as the WRONG data (e.g. {'__leaf__': 0}
+            # resolves to leaf 0's array) — a silent misrestore the
+            # audited store must refuse at save time
+            raise TypeError(
+                f"cannot checkpoint dict at {path}: key(s) {sorted(clash)} "
+                "collide with the manifest template's reserved names")
+        return {str(k): _template(v, leaves, path + (str(k),))
+                for k, v in tree.items()}
+    if isinstance(tree, (list, tuple)):
+        body = [_template(v, leaves, path + (i,))
+                for i, v in enumerate(tree)]
+        return {"__tuple__": body} if isinstance(tree, tuple) else body
+    if isinstance(tree, (np.ndarray, np.generic)):
+        arr = np.asarray(tree)
+        if arr.dtype.kind in "USO":
+            if arr.ndim == 0:
+                return {"__str__": str(arr.item())}
+            raise TypeError(f"cannot checkpoint non-numeric array at "
+                            f"{path} (dtype {arr.dtype})")
+        leaves.append((path, arr))
+        return {"__leaf__": len(leaves) - 1}
+    if tree is None or isinstance(tree, (bool, int, float, str)):
+        return tree
+    raise TypeError(f"cannot checkpoint leaf of type {type(tree).__name__} "
+                    f"at {path}")
+
+
+def _rebuild(template: Any, arrays: List[np.ndarray]) -> Any:
+    if isinstance(template, dict):
+        if "__leaf__" in template:
+            return arrays[template["__leaf__"]]
+        if "__str__" in template:
+            return template["__str__"]
+        if "__tuple__" in template:
+            return tuple(_rebuild(v, arrays) for v in template["__tuple__"])
+        return {k: _rebuild(v, arrays) for k, v in template.items()}
+    if isinstance(template, list):
+        return [_rebuild(v, arrays) for v in template]
+    return template
+
+
+class Checkpointer:
+    """Audited, crash-consistent checkpoint manager with optional BFP-
+    compressed optimizer/master state, per-shard peer mirrors, bounded
+    retention and chaos hooks (the durability plane v2 — see the module
+    docstring and docs/DURABILITY.md for the protocol).
+
+    ``async_save=True`` writes in a background thread: ``save`` returns
+    as soon as the host copy is snapshotted (``jax.device_get``) — the
+    BFP encode of the master/optimizer shards AND all file IO run in
+    the background thread, so for GB-scale compressed state the caller
+    stalls only for the device pull.  Call ``wait_until_finished()`` (or
+    just the next ``save``, which waits on the previous one) before
+    reading the files; background errors re-raise at the next sync
+    point.
+
+    ``shards=n`` splits big first-dim-divisible stored arrays (the flat
+    ZeRO-1 masters/moments) into n per-device shard files; with
+    ``mirror=True`` every shard (and every unsharded array) is ALSO
+    stored under its dp peer, which is what makes a corrupt primary
+    repairable (``peer_fetch``).  ``keep_last=N`` arms retention GC that
+    never deletes the newest *verified* step.  ``chaos`` (a
+    ``runtime.chaos.FaultPlan``) arms the durability fault sites
+    ``ckpt.save`` / ``ckpt.restore``."""
+
+    _LAYOUT_FILE = "layer_layout.json"
 
     def __init__(self, directory: str,
                  compress: Optional[BFPConfig] = None,
-                 async_save: bool = False):
-        import orbax.checkpoint as ocp
-
-        self._ocp = ocp
+                 async_save: bool = False, *,
+                 shards: Optional[int] = None,
+                 mirror: bool = False,
+                 keep_last: Optional[int] = None,
+                 chaos: Any = None,
+                 recovery: Any = None,
+                 events: Any = None) -> None:
         self.directory = os.path.abspath(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.compress = compress
         self._async = async_save
-        self._ckptr = (ocp.AsyncCheckpointer(ocp.PyTreeCheckpointHandler())
-                       if async_save else ocp.PyTreeCheckpointer())
+        self.shards = shards
+        self.mirror = mirror
+        self.keep_last = keep_last
+        self.chaos = chaos
+        self.recovery = recovery      # observability.RecoveryStats or None
+        self.events = events          # obs EventStream or None
+        # crash-point sweep seam: called (op_index, FileOp) BEFORE each
+        # op of a save/GC sequence executes; an exception it raises
+        # leaves exactly the prefix applied (the simulated crash)
+        self.op_hook: Optional[Callable[[int, FileOp], None]] = None
+        self._bg: Optional[threading.Thread] = None
+        self._bg_exc: Optional[BaseException] = None
+        self._recover_leftovers()
 
-    _LAYOUT_FILE = "layer_layout.json"
+    # -- paths --------------------------------------------------------------
 
     def _path(self, step: int) -> str:
         return os.path.join(self.directory, f"step_{step:08d}")
 
+    def _tmp_path(self, step: int) -> str:
+        return self._path(step) + ".tmp-write"
+
+    def _manifest_path(self, step: int) -> str:
+        return os.path.join(self._path(step), MANIFEST_FILE)
+
     def _layout_path(self, step: int) -> str:
         # INSIDE the step directory: the sidecar describes that step's
-        # bytes and travels (and dies) with them.  A directory-scoped
-        # sidecar lets a later plain-order save clear the layout an
-        # earlier step's restore still depends on — restore(earlier)
-        # would then silently permute layers.
+        # bytes and travels (and dies) with them — and under the v2
+        # commit protocol it is written into the tmp dir BEFORE the
+        # publishing rename, so step bytes and sidecar commit in ONE
+        # atomic op (no crash window can strand a sidecar for a step
+        # that never appeared, or publish a step missing its sidecar).
         return os.path.join(self._path(step), self._LAYOUT_FILE)
 
     def _legacy_layout_path(self) -> str:
         # directory-scoped sidecar location used by older revisions; read
         # as a fallback and migrated into the step dirs on the next save
         return os.path.join(self.directory, self._LAYOUT_FILE)
+
+    def _all_steps(self) -> List[int]:
+        steps = []
+        for d in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)", d)
+            if m:
+                steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _recover_leftovers(self) -> None:
+        """Journal recovery for the same-step re-save window.  A crash
+        between 'old dir steps aside' and 'tmp commits' leaves
+        ``step_N.replaced`` (the old, fully verified copy) with no
+        ``step_N`` — if that step was the directory's ONLY one, restore
+        would otherwise refuse despite an intact copy on disk.  Roll
+        the old copy back (one atomic rename); when the commit DID land
+        the leftover trash is simply removed.  Uncommitted
+        ``.tmp-write`` dirs are garbage by definition (their commit
+        never happened — adopting one would resurrect a save the
+        caller was told failed) and are cleaned here too.  Runs at
+        construction (the restarting process) and at every sync point;
+        never while a background save is in flight (callers join
+        first)."""
+        for d in os.listdir(self.directory):
+            m = re.fullmatch(r"step_(\d+)\.replaced", d)
+            if not m:
+                continue
+            trash = os.path.join(self.directory, d)
+            committed = self._path(int(m.group(1)))
+            if os.path.isdir(committed):
+                shutil.rmtree(trash, ignore_errors=True)
+            else:
+                os.replace(trash, committed)   # roll the old step back
+        for d in os.listdir(self.directory):
+            if re.fullmatch(r"step_(\d+)\.tmp-write", d):
+                shutil.rmtree(os.path.join(self.directory, d),
+                              ignore_errors=True)
+
+    # -- legacy sidecar migration (unchanged semantics) ---------------------
 
     def _migrate_legacy_layout(self) -> None:
         """Copy a directory-scoped sidecar (older revisions wrote one per
@@ -110,12 +535,11 @@ class Checkpointer:
             return
         with open(legacy) as f:
             layout = json.load(f)
-        for d in os.listdir(self.directory):
-            if re.fullmatch(r"step_\d+", d):
-                p = os.path.join(self.directory, d, self._LAYOUT_FILE)
-                if not os.path.exists(p):
-                    with open(p, "w") as f:
-                        json.dump(layout, f)
+        for s in self._all_steps():
+            p = self._layout_path(s)
+            if not os.path.exists(p):
+                with open(p, "w") as f:
+                    json.dump(layout, f)
         os.remove(legacy)
 
     def _apply_sidecar(self, step: int,
@@ -132,14 +556,14 @@ class Checkpointer:
                 pass
 
     # -- async-save sidecar staging -----------------------------------------
-    # The sidecar must live INSIDE the step dir, but an async save only
-    # materializes that dir when the background write commits (orbax
-    # writes a tmp dir and renames).  So save() stages the layout in a
-    # DURABLE pending file next to the step dir — not in memory — and any
-    # sync point moves it in.  A crash between commit and flush leaves
-    # checkpoint + pending file on disk, and saved_layout()/restore()
-    # honor the pending file, so the layout is never silently lost (the
-    # silent-permute hazard the sidecar exists to prevent).
+    # The sidecar commits atomically INSIDE the step rename, but an async
+    # save only materializes the step dir when the background write
+    # commits.  So save() stages the layout in a DURABLE pending file
+    # next to the step dir — not in memory — and any sync point moves it
+    # in.  A crash between commit and flush leaves checkpoint + pending
+    # file on disk, and saved_layout()/restore() honor the pending file,
+    # so the layout is never silently lost (the silent-permute hazard the
+    # sidecar exists to prevent).
 
     def _pending_path(self, step: int) -> str:
         return os.path.join(self.directory,
@@ -235,62 +659,467 @@ class Checkpointer:
                 f"{mismatched} — restoring these bytes under the requested "
                 "pp/virtual_stages/schedule would silently permute layers")
 
-    def save(self, step: int, state,
-             layout: Optional[Dict[str, Any]] = None) -> str:
-        """Persist a trainer state.  TRAINER STATES (NamedTuples) carrying
-        a flat master copy (w_own / w_master) drop their working ``params``
-        tree: every trainer's ``restore_state`` rematerializes params from
-        the masters, so persisting both would double checkpoint size (and
-        wipe out the BFP compression win for bf16 models).  Plain dicts are
-        saved verbatim — the masters-only heuristic never applies to user
-        payloads whose keys merely resemble a trainer state's."""
+    # -- save ---------------------------------------------------------------
+
+    def _host_tree(self, state: Any) -> Any:
+        """The masters-only host snapshot of a trainer state.  TRAINER
+        STATES (NamedTuples) carrying a flat master copy (w_own /
+        w_master) drop their working ``params`` tree: every trainer's
+        ``restore_state`` rematerializes params from the masters, so
+        persisting both would double checkpoint size (and wipe out the
+        BFP compression win for bf16 models).  The error-feedback
+        residual (codec_state) is likewise dropped — a bounded
+        per-device accumulator every restore_state re-zeros.  Plain
+        dicts are saved verbatim — the masters-only heuristic never
+        applies to user payloads whose keys merely resemble a trainer
+        state's."""
         is_trainer_state = hasattr(state, "_asdict")
         tree = dict(state._asdict()) if is_trainer_state else state
         if is_trainer_state and "params" in tree and (
                 "w_own" in tree or "w_master" in tree):
             tree = {k: v for k, v in tree.items() if k != "params"}
         if is_trainer_state and ("w_own" in tree or "w_master" in tree):
-            # the error-feedback residual (codec_state) is a bounded
-            # per-device accumulator every restore_state re-zeros — for a
-            # top-k run it is n x full-model f32, so persisting it would
-            # balloon the checkpoint ~(n+1)x for bytes thrown away on
-            # restore (EF is self-healing; see TrainState.codec_state)
             tree = {k: v for k, v in tree.items() if k != "codec_state"}
-        tree = jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+        return jax.tree_util.tree_map(np.asarray, jax.device_get(tree))
+
+    def _shard_plan(self, arr: np.ndarray, shards: Optional[int]) -> int:
+        """How many shard files this stored array splits into (1 = whole
+        file).  Split iff a dp width is declared, the first dim divides
+        by it, and the array is big enough for per-shard repair to mean
+        anything — the flat padded ZeRO-1 masters/moments by
+        construction, never the step scalar."""
+        n = shards or 1
+        if (n > 1 and arr.ndim >= 1 and arr.shape[0] % n == 0
+                and arr.nbytes >= _MIN_SHARD_BYTES):
+            return n
+        return 1
+
+    def _plan_write_ops(self, step: int, tree: Any,
+                        layout: Optional[Dict[str, Any]],
+                        emergency: bool, shards: Optional[int]
+                        ) -> List[FileOp]:
+        """The save as an explicit file-op sequence.  Protocol: all
+        files — leaf/shard/mirror npys, the layout sidecar, the manifest
+        — land in ``step_N.tmp-write``; ONE ``os.replace`` publishes the
+        step; post-commit ops (pending-sidecar flush, same-step-replace
+        trash removal, retention GC) follow.  Any prefix leaves either
+        the previous verified state or the fully committed new step."""
+        path, tmp = self._path(step), self._tmp_path(step)
+        leaves: List[Tuple[Tuple[Any, ...], np.ndarray]] = []
+        template = _template(tree, leaves)
+        ops: List[FileOp] = [FileOp("rmtree", tmp), FileOp("mkdir", tmp)]
+        manifest_leaves: List[Dict[str, Any]] = []
+        for i, (lpath, arr) in enumerate(leaves):
+            arr = _c_contig(arr)
+            name = f"leaf_{i:05d}"
+            n_shards = self._shard_plan(arr, shards)
+            entry: Dict[str, Any] = {
+                "path": list(lpath), "name": name,
+                "dtype": str(arr.dtype), "shape": list(arr.shape),
+                "nbytes": int(arr.nbytes),
+                "checksum": _array_checksum(arr),
+            }
+            if n_shards > 1:
+                rows = arr.shape[0] // n_shards
+                shard_entries = []
+                for j in range(n_shards):
+                    piece = arr[j * rows:(j + 1) * rows]
+                    fname = f"{name}.s{j:02d}.npy"
+                    ops.append(FileOp("write_npy",
+                                      os.path.join(tmp, fname), piece))
+                    srec = {"file": fname, "owner": j,
+                            "checksum": _array_checksum(piece),
+                            "nbytes": int(piece.nbytes)}
+                    if self.mirror:
+                        mname = f"{name}.s{j:02d}.m.npy"
+                        ops.append(FileOp("write_npy",
+                                          os.path.join(tmp, mname), piece))
+                        srec["mirror"] = mname
+                        srec["mirror_owner"] = (j + 1) % n_shards
+                    shard_entries.append(srec)
+                entry["shards"] = shard_entries
+            else:
+                fname = f"{name}.npy"
+                ops.append(FileOp("write_npy",
+                                  os.path.join(tmp, fname), arr))
+                entry["file"] = fname
+                if self.mirror:
+                    mname = f"{name}.m.npy"
+                    ops.append(FileOp("write_npy",
+                                      os.path.join(tmp, mname), arr))
+                    entry["mirror"] = mname
+            manifest_leaves.append(entry)
+        if layout is not None:
+            ops.append(FileOp("write_json",
+                              os.path.join(tmp, self._LAYOUT_FILE), layout))
+        body = {
+            "format": _FORMAT, "algo": _ALGO, "step": int(step),
+            "emergency": bool(emergency),
+            "compress": (None if self.compress is None else
+                         {"block_size": self.compress.block_size,
+                          "mantissa_bits": self.compress.mantissa_bits}),
+            "shards": shards, "mirror": bool(self.mirror),
+            "tree": template, "leaves": manifest_leaves,
+        }
+        body["self_checksum"] = bytes_checksum(
+            _canonical_json(dict(body, self_checksum=0)))
+        ops.append(FileOp("write_json",
+                          os.path.join(tmp, MANIFEST_FILE), body))
+        # -- the commit -----------------------------------------------------
+        trash = None
+        if os.path.isdir(path):
+            # same-step re-save: the old dir steps aside first (os.replace
+            # cannot atomically replace a non-empty dir).  A crash in the
+            # window between the two renames leaves step_N.replaced with
+            # no step_N; _recover_leftovers rolls the old verified copy
+            # back at the next construction/sync point, so the step is
+            # never lost — and never a mixed old/new dir (the trash name
+            # never matches step_\d+).
+            trash = path + ".replaced"
+            ops.append(FileOp("rmtree", trash))
+            ops.append(FileOp("replace", path, trash))
+        ops.append(FileOp("replace", tmp, path))
+        if trash is not None:
+            ops.append(FileOp("rmtree", trash))
+        if self._async:
+            # this save's own staged sidecar is committed by the rename:
+            # retire the pending file
+            ops.append(FileOp("remove", self._pending_path(step)))
+        ops.extend(self._plan_gc_ops(new_step=step))
+        return ops
+
+    def _plan_gc_ops(self, new_step: Optional[int]) -> List[FileOp]:
+        """Retention ops: delete steps beyond ``keep_last``, NEVER the
+        newest verified step.  On the save path the deletions sit
+        behind a ``gc_guard`` op — a read-back audit of the freshly
+        committed step, so a write the disk lied about can never cost
+        the directory its only restorable step; a standalone ``gc()``
+        (no new step) protects the newest step that audits restorable
+        instead.  Victim manifests are removed FIRST, so a crash mid-GC
+        leaves the half-deleted step definitively torn (unverified)
+        instead of plausibly restorable."""
+        if not self.keep_last:
+            return []
+        existing = self._all_steps()
+        all_steps = sorted(set(existing) |
+                           ({new_step} if new_step is not None else set()),
+                           reverse=True)
+        keep = set(all_steps[:self.keep_last])
+        victims = [s for s in existing if s not in keep]
+        if not victims:
+            return []
+        ops: List[FileOp] = []
+        if new_step is not None:
+            ops.append(FileOp("gc_guard", self._path(new_step), new_step))
+        else:
+            # no fresh write to verify: the newest step that audits
+            # restorable survives even outside the window (the kept
+            # window steps may themselves be corrupt — the walk must
+            # not stop at them)
+            for s in sorted(existing, reverse=True):
+                if self.audit_step(s, repair="probe").restorable:
+                    keep.add(s)
+                    break
+            victims = [s for s in existing if s not in keep]
+            if not victims:
+                return []
+        for s in sorted(existing, reverse=True):
+            if s in keep:
+                continue
+            d = self._path(s)
+            ops.append(FileOp("remove", os.path.join(d, MANIFEST_FILE)))
+            for fname in sorted(os.listdir(d)):
+                if fname != MANIFEST_FILE:
+                    ops.append(FileOp("remove", os.path.join(d, fname)))
+            ops.append(FileOp("remove", self._pending_path(s)))
+            ops.append(FileOp("rmdir", d))
+        return ops
+
+    def _exec_ops(self, ops: List[FileOp],
+                  interruptible: bool = True) -> None:
+        """Run a planned op sequence with the chaos + sweep seams: the
+        op_hook fires before each op; an armed FaultPlan's
+        kill/diskfull specs at ``ckpt.save`` interrupt at their planned
+        op index (``fraction`` of the sequence), leaving exactly that
+        prefix on disk — the injected crash.  Only SAVE sequences are
+        interruptible: a standalone gc() must never pop (and thereby
+        silently discard) a kill spec planned for the next save."""
+        kill_at: Dict[int, Any] = {}
+        if self.chaos is not None and interruptible and ops:
+            for spec in self.chaos.take_save_interrupts():
+                idx = min(max(int(spec.fraction * len(ops)), 0),
+                          len(ops) - 1)
+                kill_at.setdefault(idx, spec)
+        for i, op in enumerate(ops):
+            if self.op_hook is not None:
+                self.op_hook(i, op)
+            spec = kill_at.get(i)
+            if spec is not None:
+                from ..runtime import chaos as chaos_lib
+                if spec.kind == "diskfull":
+                    import errno
+                    raise OSError(errno.ENOSPC,
+                                  f"injected disk-full during {op.kind} "
+                                  f"{os.path.basename(op.path)}")
+                raise chaos_lib.InjectedFault(spec)
+            if op.kind == "gc_guard":
+                # read-back verify before retention deletes old copies:
+                # a new step that does not audit restorable on disk
+                # aborts the remaining (deletion-only) ops — the save
+                # itself already committed and stays valid
+                if not self.audit_step(int(op.data),
+                                       repair="probe").restorable:
+                    if self.events is not None:
+                        self.events.instant("ckpt.gc_aborted",
+                                            step=int(op.data))
+                    return
+                continue
+            _apply_op(op)
+
+    def _write_step(self, step: int, tree: Any,
+                    layout: Optional[Dict[str, Any]],
+                    emergency: bool, shards: Optional[int]) -> None:
+        """Compress (if configured) + plan + execute the op stream.  In
+        async mode this whole body runs on the background thread — the
+        GB-scale BFP encode included, so ``save`` stalls the trainer
+        only for the device_get snapshot."""
         if self.compress is not None and isinstance(tree, dict):
             for key in ("w_own", "w_master"):
                 if key in tree:
-                    tree[key] = compress_array(tree[key], self.compress)
+                    tree = dict(tree, **{
+                        key: compress_array(tree[key], self.compress)})
             if "opt_state" in tree:
-                tree["opt_state"] = {
+                tree = dict(tree, opt_state={
                     k: compress_array(v, self.compress)
-                    for k, v in tree["opt_state"].items()}
+                    for k, v in tree["opt_state"].items()})
+        self._exec_ops(self._plan_write_ops(step, tree, layout,
+                                            emergency, shards))
+        if self.chaos is not None:
+            # durability damage-at-rest (file bit-flip / stale manifest)
+            # fires AFTER the commit: the fault models rot/operator
+            # error on a fully written checkpoint
+            self.chaos.damage_checkpoint("ckpt.save", self._path(step),
+                                         self._prev_manifest(step))
+
+    def _prev_manifest(self, step: int) -> Optional[str]:
+        prev = [s for s in self._all_steps() if s < step]
+        return self._manifest_path(max(prev)) if prev else None
+
+    def save(self, step: int, state: Any,
+             layout: Optional[Dict[str, Any]] = None, *,
+             emergency: bool = False,
+             shards: Optional[int] = None) -> str:
+        """Persist a trainer state (see ``_host_tree`` for what is
+        dropped) under the audited commit protocol.  Returns the step
+        path (async: the path it will commit to)."""
+        tree = self._host_tree(state)
+        self.wait_until_finished()       # serialize with the previous save
         self._migrate_legacy_layout()
-        path = self._path(step)
-        # layout=None on a force=True re-save of the SAME step must clear
-        # that step's earlier sidecar (plain-order bytes must never
-        # validate against a stale layout); other steps' sidecars are
-        # theirs and stay untouched
+        shards = self.shards if shards is None else shards
         if self._async:
             # stage the sidecar durably BEFORE the background write: a
             # crash between the commit and the next sync point must leave
             # the layout recoverable next to the committed bytes
             self._stage_sidecar(step, layout)
-        self._ckptr.save(path, tree, force=True)
-        if self._async:
-            # orbax serialized any EARLIER async save before starting this
-            # one, so earlier staged sidecars are committed — flush them
-            self._flush_pending_sidecars(skip_step=step)
-        else:
-            self._apply_sidecar(step, layout)
-        return path
 
-    def restore(self, step: int,
-                expect_layout: Optional[Dict[str, Any]] = None):
-        self.wait_until_finished()       # commit in-flight saves + sidecars
-        self._check_layout(step, expect_layout)
-        tree = self._ckptr.restore(self._path(step))
-        if self.compress is not None:
+            def work() -> None:
+                try:
+                    self._write_step(step, tree, layout, emergency, shards)
+                except BaseException as e:  # noqa: BLE001 — re-raised at sync
+                    self._bg_exc = e
+
+            self._bg = threading.Thread(target=work, daemon=True,
+                                        name="ckpt-save")
+            self._bg.start()
+        else:
+            self._write_step(step, tree, layout, emergency, shards)
+        return self._path(step)
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed to disk,
+        recover any crash leftovers, flush the committed steps' staged
+        layout sidecars, and re-raise any background-save error (a
+        silently failed save would leave the caller trusting a
+        checkpoint that never landed)."""
+        t, self._bg = self._bg, None
+        if t is not None:
+            t.join()
+        self._recover_leftovers()
+        self._flush_pending_sidecars()
+        exc, self._bg_exc = self._bg_exc, None
+        if exc is not None:
+            raise exc
+
+    # -- audit + repair -----------------------------------------------------
+
+    def read_manifest(self, step: int) -> Optional[Dict[str, Any]]:
+        """The step's manifest, validated (format, self-checksum, step
+        field vs directory name).  None when absent/torn/stale — the
+        step is then unverified by definition."""
+        try:
+            with open(self._manifest_path(step)) as f:
+                man = json.load(f)
+        except (FileNotFoundError, NotADirectoryError,
+                json.JSONDecodeError, UnicodeDecodeError):
+            return None
+        if not isinstance(man, dict) or man.get("format") != _FORMAT:
+            return None
+        declared = man.get("self_checksum")
+        body = dict(man, self_checksum=0)
+        if declared != bytes_checksum(_canonical_json(body)):
+            return None
+        if int(man.get("step", -1)) != step:
+            # a STALE manifest (copied from another step) must not
+            # validate bytes it never described
+            return None
+        return man
+
+    def _load_piece(self, d: str, fname: str, checksum: int,
+                    dtype: str, rows_shape: Tuple[int, ...]
+                    ) -> Tuple[Optional[np.ndarray], str]:
+        """(array, '') on a bit-exact load, (None, reason) otherwise."""
+        p = os.path.join(d, fname)
+        try:
+            arr = np.load(p, allow_pickle=False)
+        except FileNotFoundError:
+            return None, "missing file"
+        except Exception as e:  # noqa: BLE001 — torn/garbled npy
+            return None, f"unreadable ({type(e).__name__})"
+        if str(arr.dtype) != dtype or tuple(arr.shape) != rows_shape:
+            return None, (f"dtype/shape drift ({arr.dtype}{arr.shape} "
+                          f"vs {dtype}{rows_shape})")
+        if _array_checksum(arr) != checksum:
+            return None, "checksum mismatch"
+        return arr, ""
+
+    def _heal(self, path: str, arr: np.ndarray) -> None:
+        """Atomically rewrite a damaged primary from repaired bytes."""
+        tmp = path + ".heal"
+        with open(tmp, "wb") as f:
+            np.save(f, _c_contig(arr))
+        os.replace(tmp, path)
+
+    def audit_step(self, step: int, repair: Any = False) -> AuditReport:
+        """Bit-exact audit of one step against its manifest: every
+        primary leaf/shard file is re-checksummed.  With ``repair=True``
+        a corrupt primary whose PEER mirror verifies is fetched over the
+        single-pair transfer program, re-verified against the manifest,
+        and healed in place; ``repair="probe"`` verifies the mirror and
+        counts the shard repairable WITHOUT moving bytes or healing (the
+        non-mutating query latest_step(verified=True)/GC use).
+        ``restorable`` means every byte of the assembled tree is
+        manifest-verified (clean, repaired or probe-verified mirror) —
+        the only state ``restore`` will hand to a trainer."""
+        rep = AuditReport(step=step)
+        man = self.read_manifest(step)
+        if man is None:
+            rep.ok = False
+            rep.failures.append({"path": [MANIFEST_FILE], "shard": None,
+                                 "reason": "manifest absent/torn/stale"})
+            return rep
+        rep.emergency = bool(man.get("emergency"))
+        d = self._path(step)
+        arrays: List[Optional[np.ndarray]] = []
+        fatal = False
+        for entry in man["leaves"]:
+            dtype, shape = entry["dtype"], tuple(entry["shape"])
+            if "shards" in entry:
+                n = len(entry["shards"])
+                rows = shape[0] // n
+                pieces: List[Optional[np.ndarray]] = []
+                for j, srec in enumerate(entry["shards"]):
+                    pshape = (rows,) + shape[1:]
+                    arr, why = self._load_piece(d, srec["file"],
+                                                srec["checksum"], dtype,
+                                                pshape)
+                    if arr is None:
+                        rep.ok = False
+                        fail = {"path": entry["path"], "shard": j,
+                                "reason": why}
+                        if repair:
+                            arr = self._repair_piece(
+                                d, srec, dtype, pshape, rep, fail,
+                                probe=repair == "probe")
+                        if arr is None:
+                            rep.failures.append(fail)
+                    pieces.append(arr)
+                if any(p is None for p in pieces):
+                    fatal = True
+                    arrays.append(None)
+                elif repair == "probe":
+                    arrays.append(None)   # verdict-only: no assembly
+                else:
+                    arrays.append(np.concatenate(pieces, axis=0))
+            else:
+                arr, why = self._load_piece(d, entry["file"],
+                                            entry["checksum"], dtype, shape)
+                if arr is None:
+                    rep.ok = False
+                    fail = {"path": entry["path"], "shard": None,
+                            "reason": why}
+                    if repair and entry.get("mirror"):
+                        arr = self._repair_piece(
+                            d, {"file": entry["file"],
+                                "mirror": entry["mirror"],
+                                "checksum": entry["checksum"]},
+                            dtype, shape, rep, fail,
+                            probe=repair == "probe")
+                    if arr is None:
+                        rep.failures.append(fail)
+                        fatal = True
+                arrays.append(arr)
+        if not fatal:
+            rep.restorable = True
+            if repair != "probe":
+                # probe callers (gc_guard, latest_step(verified=True))
+                # need only the verdict — skipping assembly avoids a
+                # second full in-memory copy of a GB-scale state
+                rep.tree = _rebuild(man["tree"],
+                                    [a for a in arrays])  # type: ignore[misc]
+        return rep
+
+    def _repair_piece(self, d: str, srec: Dict[str, Any], dtype: str,
+                      shape: Tuple[int, ...], rep: AuditReport,
+                      fail: Dict[str, Any],
+                      probe: bool = False) -> Optional[np.ndarray]:
+        """Peer repair of one corrupt primary: verify the mirror copy
+        bit-exactly against the manifest, fetch it onto the owner via
+        the pair transfer program, re-verify the LANDED bytes, heal the
+        primary file.  ``probe`` stops after the mirror verification
+        (repairability without mutation).  None (with ``fail['reason']``
+        extended) when no clean source exists — the caller then refuses
+        or walks back, never restores."""
+        mname = srec.get("mirror")
+        if not mname:
+            fail["reason"] += "; no peer mirror to repair from"
+            return None
+        mirror, why = self._load_piece(d, mname, srec["checksum"],
+                                       dtype, shape)
+        if mirror is None:
+            fail["reason"] += f"; peer mirror also bad ({why})"
+            return None
+        if probe:
+            return mirror
+        landed, wire = peer_fetch(mirror)
+        if _array_checksum(landed) != srec["checksum"]:
+            fail["reason"] += "; peer fetch landed corrupt"
+            return None
+        self._heal(os.path.join(d, srec["file"]), landed)
+        rep.repair_wire_bytes += wire
+        rec = {"path": fail["path"], "shard": fail.get("shard"),
+               "file": srec["file"], "wire_bytes": wire}
+        rep.repaired.append(rec)
+        if self.events is not None:
+            self.events.instant("ckpt.repair", step=rep.step,
+                                file=srec["file"], wire_bytes=wire)
+        if self.recovery is not None:
+            self.recovery.record_ckpt_repair(wire_bytes=wire)
+        return landed
+
+    # -- restore ------------------------------------------------------------
+
+    def _decompress_tree(self, tree: Any) -> Any:
+        if self.compress is not None and isinstance(tree, dict):
             for key in ("w_own", "w_master"):
                 if key in tree and isinstance(tree[key], dict):
                     tree[key] = decompress_array(tree[key])
@@ -300,19 +1129,80 @@ class Checkpointer:
                     for k, v in tree["opt_state"].items()}
         return tree
 
-    def wait_until_finished(self) -> None:
-        """Block until any in-flight async save has committed to disk,
-        then flush the committed steps' staged layout sidecars."""
-        if hasattr(self._ckptr, "wait_until_finished"):
-            self._ckptr.wait_until_finished()
-        self._flush_pending_sidecars()
+    def restore(self, step: int,
+                expect_layout: Optional[Dict[str, Any]] = None) -> Any:
+        """Audited restore of one step: every leaf re-checksummed
+        against the manifest, corrupt shards peer-repaired when a clean
+        mirror exists, and REFUSED (CheckpointIntegrityError) otherwise
+        — bytes that fail their audit never reach a trainer.  There is
+        no unaudited restore path (graftlint J14, zero waivers)."""
+        self.wait_until_finished()       # commit in-flight saves + sidecars
+        self._check_layout(step, expect_layout)
+        if self.chaos is not None:
+            # durability faults at the restore boundary (damage-at-rest
+            # discovered on read): fire BEFORE the audit so the audit is
+            # what catches them
+            self.chaos.damage_checkpoint("ckpt.restore", self._path(step),
+                                         self._prev_manifest(step))
+        rep = self.audit_step(step, repair=True)
+        if not rep.restorable:
+            if self.events is not None:
+                self.events.instant("ckpt.refused", step=step,
+                                    detail=rep.describe()[:200])
+            raise CheckpointIntegrityError(
+                f"refusing to restore {self._path(step)}: "
+                f"{rep.describe()} — no clean source for the failed "
+                "leaves (restore never silently hands corrupt bytes to "
+                "a trainer; fall back to an earlier verified step via "
+                "restore_latest_verified)")
+        return self._decompress_tree(rep.tree)
 
-    def latest_step(self) -> Optional[int]:
-        # ignore orbax atomic-write temp dirs (step_N.orbax-checkpoint-tmp-*)
-        # left behind by an interrupted save — this is the crash-recovery path
-        steps = []
-        for d in os.listdir(self.directory):
-            m = re.fullmatch(r"step_(\d+)", d)
-            if m:
-                steps.append(int(m.group(1)))
-        return max(steps) if steps else None
+    def restore_latest_verified(
+            self, expect_layout: Optional[Dict[str, Any]] = None,
+    ) -> Tuple[int, Any]:
+        """Walk the step directory BACKWARD past corrupt/torn steps to
+        the newest step that audits clean (repairing where a peer copy
+        allows), and restore it.  Raises CheckpointIntegrityError when
+        no verified step exists — refusal, never a silent restore of
+        damaged state."""
+        self.wait_until_finished()
+        steps = self._all_steps()
+        tried = []
+        for step in sorted(steps, reverse=True):
+            try:
+                return step, self.restore(step, expect_layout=expect_layout)
+            except CheckpointIntegrityError as e:
+                tried.append((step, str(e).splitlines()[0][:160]))
+        raise CheckpointIntegrityError(
+            f"no verified checkpoint under {self.directory}: "
+            f"{len(steps)} step dir(s), every audit failed "
+            f"({tried if tried else 'directory empty'})")
+
+    def latest_step(self, verified: bool = False) -> Optional[int]:
+        """Newest step number — by directory name (``verified=False``,
+        the cheap legacy behavior; orbax-style atomic-write temp dirs
+        and the v2 ``.tmp-write``/``.replaced`` names never match), or
+        the newest step whose AUDIT passes (``verified=True``: walks
+        backward past corrupt/torn steps; a step is counted when clean
+        OR peer-repairable, since either restores bit-exactly)."""
+        steps = self._all_steps()
+        if not verified:
+            return max(steps) if steps else None
+        for step in sorted(steps, reverse=True):
+            if self.audit_step(step, repair="probe").restorable:
+                return step
+        return None
+
+    def is_emergency(self, step: int) -> bool:
+        man = self.read_manifest(step)
+        return bool(man and man.get("emergency"))
+
+    # -- retention ----------------------------------------------------------
+
+    def gc(self) -> List[int]:
+        """Run retention now (``keep_last`` steps kept, plus the newest
+        verified step unconditionally).  Returns the deleted steps."""
+        before = set(self._all_steps())
+        self._exec_ops(self._plan_gc_ops(new_step=None),
+                       interruptible=False)
+        return sorted(before - set(self._all_steps()))

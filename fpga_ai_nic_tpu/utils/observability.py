@@ -134,6 +134,12 @@ class RecoveryStats:
     mttr_restore_sum_s: float = 0.0
     mttr_restore_max_s: float = 0.0
     mttr_restore_n: int = 0
+    # durability-plane counters (utils.checkpoint v2): peer repairs of
+    # corrupt stored shards, absorbed save failures, emergency dumps
+    ckpt_repairs: int = 0
+    ckpt_repair_wire_bytes: int = 0
+    ckpt_save_failures: int = 0
+    emergency_dumps: int = 0
     # bounded event log: [{step, kind, site, error, recovered_in_s}]
     events: List[Dict] = field(default_factory=list)
     max_events: int = 128
@@ -191,6 +197,27 @@ class RecoveryStats:
         with self._lock:
             self.failed_recoveries += 1
 
+    def record_ckpt_repair(self, wire_bytes: int = 0) -> None:
+        """One stored shard healed from its peer mirror at restore time
+        (utils.checkpoint peer repair; ``wire_bytes`` = the pair
+        transfer program's exact payload)."""
+        with self._lock:
+            self.ckpt_repairs += 1
+            self.ckpt_repair_wire_bytes += int(wire_bytes)
+
+    def record_ckpt_save_failure(self) -> None:
+        """A checkpoint save failed mid-sequence (disk-full / injected
+        kill) and was absorbed — the commit protocol kept the directory
+        restorable, and the next cadence save retries."""
+        with self._lock:
+            self.ckpt_save_failures += 1
+
+    def record_emergency_dump(self) -> None:
+        """The ladder exhausted and the live state was persisted as an
+        emergency checkpoint ('dump before dying')."""
+        with self._lock:
+            self.emergency_dumps += 1
+
     def as_dict(self) -> Dict:
         with self._lock:
             n = self.recoveries
@@ -202,6 +229,10 @@ class RecoveryStats:
                 "failed_recoveries": self.failed_recoveries,
                 "checkpoint_restores": self.checkpoint_restores,
                 "reshards": self.reshards,
+                "ckpt_repairs": self.ckpt_repairs,
+                "ckpt_repair_wire_bytes": self.ckpt_repair_wire_bytes,
+                "ckpt_save_failures": self.ckpt_save_failures,
+                "emergency_dumps": self.emergency_dumps,
                 "mttr_mean_s": (self.mttr_sum_s / n) if n else 0.0,
                 "mttr_max_s": self.mttr_max_s,
                 "mttr_reshard_mean_s": (self.mttr_reshard_sum_s / nrs)

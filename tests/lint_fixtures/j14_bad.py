@@ -1,0 +1,38 @@
+"""J14 bad fixture: an unaudited restore path.
+
+The tempting-but-wrong restore — "the files were written by us, why
+re-read them twice?" — loads the stored leaf npys straight off disk and
+hands them to the trainer without ever consulting the manifest.  A
+single flipped stored bit (cosmic ray, torn write, fs bug) then
+restores SILENTLY: the corrupted master becomes the ground truth every
+later recovery converges to, undoing everything the wire-integrity
+ledger guarantees.  J14 must flag the path as silently restoring."""
+
+
+def build():
+    def run():
+        import os
+        import tempfile
+
+        import numpy as np
+
+        from fpga_ai_nic_tpu.utils import checkpoint as ckpt_lib
+
+        with tempfile.TemporaryDirectory(prefix="j14_bad_") as d:
+            c = ckpt_lib.Checkpointer(d)
+            golden = np.random.default_rng(0).standard_normal(256) \
+                .astype(np.float32)
+            c.save(1, {"w": golden})
+            # one stored data bit flips at rest
+            p = os.path.join(c._path(1), "leaf_00000.npy")
+            ckpt_lib.flip_stored_bit(p)
+            # the anti-pattern: raw np.load, no manifest audit — returns
+            # plausibly-shaped garbage without a whisper
+            tree = {"w": np.load(p, allow_pickle=False)}
+            return {
+                "surface": "raw np.load restore (unaudited)",
+                "detected": 0,
+                "silently_restored": 1,
+                "_exercised": int(not np.array_equal(tree["w"], golden)),
+            }
+    return run

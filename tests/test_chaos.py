@@ -363,3 +363,121 @@ def test_recovery_stats_shape():
     assert d["mttr_mean_s"] == pytest.approx(0.5)
     assert d["events"][0]["kind"] == "hang"
     assert d["events"][0]["recovered_in_s"] == pytest.approx(0.5)
+
+
+# ---------------------------------------------------------------------------
+# the durability sites (ckpt.save / ckpt.restore): faults at the
+# checkpoint plane itself (docs/DURABILITY.md; the full battery is
+# tools/chaos_bench.py run_durability_cells)
+# ---------------------------------------------------------------------------
+
+def test_durability_spec_validation():
+    # kill/diskfull only exist at ckpt.save (the op stream to truncate)
+    chaos.FaultSpec("kill", "ckpt.save", step=0, fraction=0.5)
+    chaos.FaultSpec("diskfull", "ckpt.save", step=0)
+    with pytest.raises(ValueError, match="ckpt.save"):
+        chaos.FaultSpec("kill", "queue.issue", step=0)
+    with pytest.raises(ValueError, match="ckpt.save"):
+        chaos.FaultSpec("diskfull", "ckpt.restore", step=0)
+    # durability corruption is file damage: wirebit / stale_manifest
+    chaos.FaultSpec("corruption", "ckpt.save", step=0, mode="wirebit")
+    chaos.FaultSpec("corruption", "ckpt.restore", step=0,
+                    mode="stale_manifest")
+    with pytest.raises(ValueError, match="wirebit"):
+        chaos.FaultSpec("corruption", "ckpt.save", step=0, mode="nan")
+    with pytest.raises(ValueError, match="durability"):
+        chaos.FaultSpec("corruption", "staging", step=0,
+                        mode="stale_manifest")
+    with pytest.raises(ValueError, match="durability sites"):
+        chaos.FaultSpec("hang", "ckpt.save", step=0)
+
+
+def test_durability_bitflip_repaired_bit_exact(tap, tmp_path):
+    """wirebit at ckpt.save (a stored bit rots right after the commit)
+    followed by a preemption: the restore must peer-repair the shard
+    from its dp mirror and the finished run's loss must be BIT-equal to
+    the fault-free twin."""
+    finals, recs = [], []
+    for faults in ([],
+                   [chaos.FaultSpec("corruption", "ckpt.save", step=2,
+                                    mode="wirebit"),
+                    chaos.FaultSpec("preemption", "queue.issue", step=3)]):
+        tr, state, batch = _make_trainer()
+        plan = chaos.FaultPlan(faults, seed=11)
+        with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+            et = ElasticTrainer(tr, d, _ECFG, plan=plan)
+            state, metrics = et.run(state, lambda i: batch, 5)
+        finals.append(float(metrics["loss"]))
+        recs.append(et.profiler.recovery.as_dict())
+    assert finals[0] == finals[1], finals           # BIT-equal recovery
+    assert recs[1]["ckpt_repairs"] >= 1, recs[1]
+    assert recs[1]["ckpt_repair_wire_bytes"] > 0
+    assert recs[1]["checkpoint_restores"] >= 1
+
+
+def test_durability_stale_manifest_walks_back(tap, tmp_path):
+    """stale_manifest at ckpt.save: the poisoned newest step must read
+    as torn and the restore walk back to the previous verified step,
+    replaying to a BIT-equal final loss — zero repairs (nothing to
+    repair, the bytes were never trusted)."""
+    finals, recs = [], []
+    for faults in ([],
+                   [chaos.FaultSpec("corruption", "ckpt.save", step=2,
+                                    mode="stale_manifest"),
+                    chaos.FaultSpec("preemption", "queue.issue", step=3)]):
+        tr, state, batch = _make_trainer()
+        plan = chaos.FaultPlan(faults, seed=11)
+        with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+            et = ElasticTrainer(tr, d, _ECFG, plan=plan)
+            state, metrics = et.run(state, lambda i: batch, 5)
+        finals.append(float(metrics["loss"]))
+        recs.append(et.profiler.recovery.as_dict())
+    assert finals[0] == finals[1], finals
+    assert recs[1]["ckpt_repairs"] == 0
+    assert recs[1]["checkpoint_restores"] >= 1
+
+
+@pytest.mark.parametrize("kind", ["kill", "diskfull"])
+def test_durability_save_interrupt_absorbed(tap, tmp_path, kind):
+    """A save killed mid-op-sequence (or starved by ENOSPC) is absorbed
+    and recorded; the commit protocol keeps the directory restoring the
+    previous verified step, so a later preemption still recovers to a
+    BIT-equal final loss."""
+    finals, recs = [], []
+    for faults in ([],
+                   [chaos.FaultSpec(kind, "ckpt.save", step=2,
+                                    fraction=0.5),
+                    chaos.FaultSpec("preemption", "queue.issue", step=3)]):
+        tr, state, batch = _make_trainer()
+        plan = chaos.FaultPlan(faults, seed=11)
+        with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+            et = ElasticTrainer(tr, d, _ECFG, plan=plan)
+            state, metrics = et.run(state, lambda i: batch, 5)
+        finals.append(float(metrics["loss"]))
+        recs.append(et.profiler.recovery.as_dict())
+    assert finals[0] == finals[1], finals
+    assert recs[1]["ckpt_save_failures"] == 1, recs[1]
+    assert recs[1]["checkpoint_restores"] >= 1
+
+
+def test_emergency_dump_on_ladder_exhaustion(tap, tmp_path):
+    """'Dump before dying': when every retry of a step fails, the
+    supervisor persists the live state as an emergency-flagged,
+    audit-clean checkpoint before raising RecoveryExhausted."""
+    tr, state, batch = _make_trainer()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("exception", "queue.issue", step=2)
+         for _ in range(_ECFG.max_retries + 1)], seed=11)
+    with chaos.activate(plan):
+        et = ElasticTrainer(tr, str(tmp_path), _ECFG, plan=plan)
+        with pytest.raises(RecoveryExhausted):
+            et.run(state, lambda i: batch, 5)
+    rec = et.profiler.recovery.as_dict()
+    assert rec["emergency_dumps"] == 1, rec
+    dump_step = et.ckpt.latest_step(verified=True)
+    assert dump_step == 2                     # the trip-point state
+    assert et.ckpt.is_emergency(dump_step)
+    assert et.ckpt.audit_step(dump_step, repair="probe").restorable
+    # the dump restores through the audited path like any checkpoint
+    restored = tr.restore_state(et.ckpt.restore(dump_step))
+    assert int(restored.step) == 2

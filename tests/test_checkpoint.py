@@ -322,3 +322,424 @@ def test_layout_sidecar_cleared_by_plain_save(tmp_path):
     assert c.saved_layout(1) is None
     np.testing.assert_array_equal(c.restore(1)["w"],
                                   np.full(2, 3.0, np.float32))
+
+
+# ---------------------------------------------------------------------------
+# durability plane v2: manifests, audits, peer repair, crash sweep, GC
+# (docs/DURABILITY.md)
+# ---------------------------------------------------------------------------
+
+import os
+import shutil
+
+from fpga_ai_nic_tpu.utils.checkpoint import (
+    MANIFEST_FILE, CheckpointIntegrityError, bytes_checksum,
+    flip_stored_bit, peer_fetch)
+
+
+def _flip_data_bit(step_dir, fname, byte_off=0):
+    """One data-region bit of a stored npy flips (the shared
+    damage-at-rest primitive — utils.checkpoint.flip_stored_bit)."""
+    flip_stored_bit(os.path.join(step_dir, fname), byte_off=byte_off)
+
+
+def _primary_files(step_dir):
+    return sorted(f for f in os.listdir(step_dir)
+                  if f.endswith(".npy") and not f.endswith(".m.npy"))
+
+
+def test_manifest_committed_with_step_and_audit_clean(tmp_path):
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    g = np.arange(300, dtype=np.float32)
+    c.save(1, {"w": g, "step": np.int32(1)})
+    man = c.read_manifest(1)
+    assert man is not None and man["step"] == 1 and not man["emergency"]
+    # per-leaf exact checksums over the stored representation
+    by_path = {tuple(e["path"]): e for e in man["leaves"]}
+    assert by_path[("w",)]["checksum"] == bytes_checksum(g.tobytes())
+    rep = c.audit_step(1)
+    assert rep.ok and rep.restorable and rep.failures == []
+    assert c.latest_step(verified=True) == 1
+
+
+def test_single_bit_flip_every_leaf_refused_unmirrored(tmp_path):
+    """THE acceptance matrix, refusal half: a single bit flip in ANY
+    stored primary file (plain or BFP-compressed representation) is
+    detected at restore and refused — never silently restored."""
+    base = str(tmp_path / "base")
+    c = ckpt.Checkpointer(base, compress=BFPConfig())
+    g = np.linspace(-3, 3, 2048).astype(np.float32)
+    c.save(1, {"w_own": g, "opt_state": {"m": g * 0.5},
+               "step": np.int32(1)})
+    files = _primary_files(c._path(1))
+    assert len(files) >= 5        # mant/scale x2 + metadata leaves
+    for fname in files:
+        d = str(tmp_path / f"flip_{fname}")
+        shutil.copytree(base, d)
+        c2 = ckpt.Checkpointer(d, compress=BFPConfig())
+        _flip_data_bit(c2._path(1), fname)
+        with pytest.raises(CheckpointIntegrityError, match="refusing"):
+            c2.restore(1)
+        assert c2.latest_step(verified=True) is None
+
+
+def test_single_bit_flip_repaired_bit_exact_from_peer(tmp_path):
+    """THE acceptance matrix, repair half: with dp-peer mirrors armed, a
+    flipped bit in ANY primary shard is repaired from the peer copy —
+    restored bytes BIT-equal the uncorrupted golden, the primary healed
+    in place, and the repair wire moved exactly the shard bytes."""
+    base = str(tmp_path / "base")
+    c = ckpt.Checkpointer(base, shards=4, mirror=True)
+    g = np.arange(1024, dtype=np.float32)
+    c.save(1, {"w": g})
+    shard_files = [f for f in _primary_files(c._path(1)) if ".s" in f]
+    assert len(shard_files) == 4
+    for fname in shard_files:
+        d = str(tmp_path / f"rep_{fname}")
+        shutil.copytree(base, d)
+        c2 = ckpt.Checkpointer(d, shards=4, mirror=True)
+        _flip_data_bit(c2._path(1), fname)
+        rep = c2.audit_step(1, repair=True)
+        assert rep.restorable and len(rep.repaired) == 1
+        assert rep.repair_wire_bytes == g.nbytes // 4
+        np.testing.assert_array_equal(rep.tree["w"], g)     # bit-exact
+        # healed in place: a fresh audit is fully clean
+        assert c2.audit_step(1).ok
+        np.testing.assert_array_equal(c2.restore(1)["w"], g)
+
+
+def test_primary_and_mirror_both_corrupt_refuses(tmp_path):
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), shards=4, mirror=True)
+    g = np.arange(1024, dtype=np.float32)
+    c.save(1, {"w": g})
+    _flip_data_bit(c._path(1), "leaf_00000.s02.npy")
+    _flip_data_bit(c._path(1), "leaf_00000.s02.m.npy")
+    with pytest.raises(CheckpointIntegrityError, match="also bad"):
+        c.restore(1)
+
+
+def test_stale_manifest_never_validates(tmp_path):
+    """A previous step's (self-consistent!) manifest copied over a later
+    step must read as torn — the step field pins a manifest to the
+    directory whose bytes it describes."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    g = np.arange(64, dtype=np.float32)
+    c.save(1, {"w": g})
+    c.save(2, {"w": g + 1})
+    shutil.copyfile(os.path.join(c._path(1), MANIFEST_FILE),
+                    os.path.join(c._path(2), MANIFEST_FILE))
+    assert c.read_manifest(2) is None
+    assert c.latest_step(verified=True) == 1
+    step, tree = c.restore_latest_verified()
+    assert step == 1
+    np.testing.assert_array_equal(tree["w"], g)
+
+
+def test_peer_fetch_bit_exact_any_dtype():
+    for arr in (np.arange(257, dtype=np.float32),
+                np.arange(-8, 8, dtype=np.int8).reshape(4, 4),
+                np.arange(6, dtype=np.int16)):
+        landed, wire = peer_fetch(arr)
+        np.testing.assert_array_equal(landed, arr)
+        assert landed.dtype == arr.dtype and wire == arr.nbytes
+
+
+class _SimCrash(Exception):
+    """The sweep's injected mid-save process death."""
+
+
+def _sweep_seed(d):
+    c = ckpt.Checkpointer(d, shards=4, mirror=True, keep_last=1)
+    g1 = np.arange(1024, dtype=np.float32)
+    g2 = g1 * 2.0 + 1.0
+    L1 = {"layers_order": "plain", "pp": 1}
+    L2 = {"layers_order": "interleaved-device-major", "pp": 2}
+    c.save(1, {"w": g1}, layout=L1)
+    return c, (g1, L1), (g2, L2)
+
+
+def test_crash_point_sweep_exhaustive(tmp_path):
+    """THE crash-consistency acceptance: the save of step 2 (over an
+    existing step 1, with a layout sidecar AND retention GC armed) as
+    an explicit file-op sequence, truncated at EVERY op prefix.  At
+    every truncation point a fresh Checkpointer must restore exactly
+    step 1 or exactly step 2 — bit-exact, matching sidecar, never
+    garbage, never a stranded layout — and a follow-up save must
+    recover over the torn leftovers."""
+    ref, _, (g2, L2) = _sweep_seed(str(tmp_path / "ref"))
+    kinds = []
+    ref.op_hook = lambda i, op: kinds.append(op.kind)
+    ref.save(2, {"w": g2}, layout=L2)
+    n_ops = len(kinds)
+    assert n_ops > 12 and "gc_guard" in kinds     # GC armed, guard planned
+    assert ref.latest_step(verified=True) == 2
+
+    outcomes = set()
+    for k in range(n_ops + 1):
+        d = str(tmp_path / f"k{k:03d}")
+        c, (g1, L1), _ = _sweep_seed(d)
+
+        def hook(i, op, k=k):
+            if i == k:
+                raise _SimCrash()
+
+        c.op_hook = hook
+        try:
+            c.save(2, {"w": g2}, layout=L2)
+        except _SimCrash:
+            pass
+        # a FRESH Checkpointer = the restarting process
+        c2 = ckpt.Checkpointer(d, shards=4, mirror=True, keep_last=1)
+        step = c2.latest_step(verified=True)
+        assert step in (1, 2), f"prefix {k}/{n_ops}: verified={step}"
+        golden, layout = ((g1, L1) if step == 1 else (g2, L2))
+        assert c2.saved_layout(step) == layout, f"prefix {k}"
+        got_step, tree = c2.restore_latest_verified(
+            expect_layout=dict(layout))
+        assert got_step == step
+        np.testing.assert_array_equal(tree["w"], golden)    # bit-exact
+        if step == 1:
+            # pre-commit crash: step 2 must be fully ABSENT (no torn
+            # dir, no stranded sidecar a later commit would mismatch)
+            assert not os.path.isdir(c2._path(2)), f"prefix {k}"
+        outcomes.add(step)
+        # the torn tmp/trash leftovers must not wedge the next save
+        c2.save(3, {"w": g2 + 1.0})
+        assert c2.latest_step(verified=True) == 3, f"prefix {k}"
+    assert outcomes == {1, 2}     # both protocol outcomes exercised
+
+
+def test_same_step_resave_crash_window_rolls_back(tmp_path):
+    """Re-saving an EXISTING step steps the old dir aside before the
+    commit rename; a crash in that window must not lose the step —
+    journal recovery (_recover_leftovers) rolls the old verified copy
+    back, so restore lands the step's OLD content, never a mixed dir
+    and never a refusal.  Exercised with the step as the directory's
+    ONLY one (the emergency-dump / keep_last=1 shape, where losing it
+    would mean zero restorable steps)."""
+    d = str(tmp_path / "ck")
+    c = ckpt.Checkpointer(d)
+    g = np.arange(128, dtype=np.float32)
+    c.save(2, {"w": g})                       # the ONLY step
+
+    crash_at = []
+
+    def hook(i, op):
+        if op.kind == "replace" and op.path == c._path(2):
+            # the old step 2 just stepped aside; die before the commit
+            crash_at.append(i)
+        if crash_at and i == crash_at[0] + 1:
+            raise _SimCrash()
+
+    c.op_hook = hook
+    with pytest.raises(_SimCrash):
+        c.save(2, {"w": g + 99})
+    c.op_hook = None
+    # mid-window state on disk: step_2.replaced + step_2.tmp-write
+    assert os.path.isdir(c._path(2) + ".replaced")
+    # a fresh Checkpointer (the restarting process) heals at construction
+    c2 = ckpt.Checkpointer(d)
+    assert not os.path.isdir(c2._path(2) + ".replaced")   # rolled back
+    assert not os.path.isdir(c2._tmp_path(2))             # garbage cleaned
+    step, tree = c2.restore_latest_verified()
+    assert step == 2
+    np.testing.assert_array_equal(tree["w"], g)           # the OLD bytes
+    # the same-process sync point heals too
+    c.save(2, {"w": g + 7})
+    np.testing.assert_array_equal(c.restore(2)["w"], g + 7)
+
+
+def test_keep_last_gc_bounds_directory(tmp_path):
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), keep_last=2)
+    g = np.arange(64, dtype=np.float32)
+    for s in range(1, 5):
+        c.save(s, {"w": g + s})
+    assert c._all_steps() == [3, 4]
+    np.testing.assert_array_equal(c.restore(4)["w"], g + 4)
+
+
+def test_gc_never_deletes_newest_verified_step(tmp_path):
+    """Standalone gc(): when the steps inside the retention window are
+    corrupt, the newest VERIFIED step outside it must survive — deleting
+    it would leave the directory with zero restorable steps."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    g = np.arange(256, dtype=np.float32)
+    for s in (1, 2, 3):
+        c.save(s, {"w": g + s})
+    _flip_data_bit(c._path(2), "leaf_00000.npy")
+    _flip_data_bit(c._path(3), "leaf_00000.npy")
+    c.keep_last = 1
+    deleted = c.gc()
+    assert deleted == [2]                  # corrupt AND outside window
+    assert c._all_steps() == [1, 3]        # 3 = window, 1 = last verified
+    step, tree = c.restore_latest_verified()
+    assert step == 1
+    np.testing.assert_array_equal(tree["w"], g + 1)
+
+
+def test_save_time_gc_guard_aborts_on_lying_write(tmp_path):
+    """The save-path GC read-back guard: if the freshly committed step
+    does not audit restorable on disk, the retention deletions must NOT
+    run (the old step would have been the only restorable copy)."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), keep_last=1)
+    g = np.arange(256, dtype=np.float32)
+    c.save(1, {"w": g})
+
+    def hook(i, op):
+        if op.kind == "gc_guard":
+            # the disk 'lies': damage the committed bytes before the
+            # read-back verification
+            _flip_data_bit(c._path(2), "leaf_00000.npy")
+
+    c.op_hook = hook
+    c.save(2, {"w": g + 2})
+    c.op_hook = None
+    assert c._all_steps() == [1, 2]        # deletion aborted
+    step, tree = c.restore_latest_verified()
+    assert step == 1
+    np.testing.assert_array_equal(tree["w"], g)
+
+
+def test_async_save_encodes_in_background_thread(tmp_path, monkeypatch):
+    """The async+compress stall satellite: the BFP encode of the
+    master/optimizer shards runs INSIDE the background thread (pinned by
+    thread identity, not timing), so save() stalls only for the
+    device_get snapshot."""
+    import threading
+    encode_threads = []
+    orig = ckpt.compress_array
+
+    def probe(x, cfg):
+        encode_threads.append(threading.get_ident())
+        return orig(x, cfg)
+
+    monkeypatch.setattr(ckpt, "compress_array", probe)
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), compress=BFPConfig(),
+                          async_save=True)
+    g = np.arange(4096, dtype=np.float32)
+    c.save(1, {"w_own": g, "opt_state": {"m": g}, "step": np.int32(1)})
+    c.wait_until_finished()
+    assert len(encode_threads) == 2       # w_own + one moment
+    assert all(t != threading.get_ident() for t in encode_threads)
+    out = c.restore(1)
+    assert out["w_own"].shape == g.shape
+    # sync saves keep the encode on the caller (the comparison arm)
+    encode_threads.clear()
+    cs = ckpt.Checkpointer(str(tmp_path / "ck2"), compress=BFPConfig())
+    cs.save(1, {"w_own": g, "opt_state": {}, "step": np.int32(1)})
+    assert encode_threads == [threading.get_ident()]
+
+
+def test_async_save_background_error_reraised_at_sync(tmp_path):
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), async_save=True)
+
+    def hook(i, op):
+        raise OSError("injected ENOSPC")
+
+    c.op_hook = hook
+    c.save(1, {"w": np.arange(8, dtype=np.float32)})
+    with pytest.raises(OSError, match="ENOSPC"):
+        c.wait_until_finished()
+    assert c.latest_step(verified=True) is None
+
+
+def test_restore_latest_verified_refuses_when_nothing_clean(tmp_path):
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    g = np.arange(64, dtype=np.float32)
+    c.save(1, {"w": g})
+    _flip_data_bit(c._path(1), "leaf_00000.npy")
+    with pytest.raises(CheckpointIntegrityError, match="no verified"):
+        c.restore_latest_verified()
+    with pytest.raises(CheckpointIntegrityError, match="no verified"):
+        ckpt.Checkpointer(str(tmp_path / "empty")).restore_latest_verified()
+
+
+def test_elastic_restore_walks_back_and_repairs(tmp_path, rng):
+    """End-to-end through the trainer: a DPTrainer state checkpointed
+    with mirrors, a primary shard flipped at rest, restored through the
+    elastic tier's path — repaired, and the restored state trains with
+    bytes BIT-equal to an undamaged restore."""
+    mcfg = MLPConfig(layer_sizes=(16, 32, 8), dtype="float32")
+    cfg = TrainConfig(iters=1, global_batch=16, mesh=MeshConfig(dp=8),
+                      optimizer=OptimizerConfig(kind="momentum"))
+    tr = DPTrainer(lambda p, b: mlp.loss_fn(p, b, mcfg),
+                   make_mesh(cfg.mesh), cfg)
+    state = tr.init_state(mlp.init(jax.random.PRNGKey(0), mcfg))
+    batch = (jnp.asarray(rng.standard_normal((16, 16)), jnp.float32),
+             jnp.asarray(rng.integers(0, 8, 16), jnp.int32))
+    state, _ = tr.step(state, tr.shard_batch(batch))
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), shards=8, mirror=True)
+    c.save(1, state)
+    golden = np.asarray(jax.device_get(state.w_own))
+    shard = next(f for f in _primary_files(c._path(1)) if ".s" in f)
+    _flip_data_bit(c._path(1), shard)
+    step, tree = c.restore_latest_verified()
+    assert step == 1
+    np.testing.assert_array_equal(tree["w_own"], golden)
+    restored = tr.restore_state(tree)
+    np.testing.assert_array_equal(np.asarray(restored.w_own), golden)
+
+
+def test_bytes_checksum_is_the_wire_plane_word_sum():
+    """The manifest checksum == compress.golden.golden_word_checksum
+    over the little-endian u32 word view (the chunked implementation
+    only regroups an associative modular sum), and any single flipped
+    byte changes it (odd weights invertible mod 2^32)."""
+    from fpga_ai_nic_tpu.compress.golden import golden_word_checksum
+    from fpga_ai_nic_tpu.utils import checkpoint as ckpt_mod
+    r = np.random.default_rng(0)
+    for n in (0, 1, 3, 4, 5, 1024, 4097):
+        buf = r.integers(0, 256, n, dtype=np.uint8).tobytes()
+        pad = (-len(buf)) % 4
+        words = np.frombuffer(buf + b"\x00" * pad, "<u4")
+        assert bytes_checksum(buf) == int(golden_word_checksum(words)), n
+    # chunk boundaries regroup but never change the sum
+    big = r.integers(0, 256, 8 * 1024, dtype=np.uint8)
+    whole = ckpt_mod._u8_checksum(big)
+    try:
+        ckpt_mod._CHK_CHUNK_WORDS = 128
+        assert ckpt_mod._u8_checksum(big) == whole
+    finally:
+        ckpt_mod._CHK_CHUNK_WORDS = 1 << 22
+    # single-byte-flip never vanishes
+    base = bytearray(r.integers(0, 256, 64, dtype=np.uint8).tobytes())
+    ref = bytes_checksum(bytes(base))
+    for off in (0, 1, 31, 63):
+        for bit in (0, 7):
+            mut = bytearray(base)
+            mut[off] ^= (1 << bit)
+            assert bytes_checksum(bytes(mut)) != ref, (off, bit)
+
+
+def test_reserved_template_keys_rejected_at_save(tmp_path):
+    """A user payload dict carrying a template sentinel name would
+    rebuild as the WRONG data — the audited store refuses it at save
+    time instead of misrestoring silently."""
+    c = ckpt.Checkpointer(str(tmp_path / "ck"))
+    g = np.arange(8, dtype=np.float32)
+    with pytest.raises(TypeError, match="reserved"):
+        c.save(1, {"a": g, "b": {"__leaf__": 0}})
+    with pytest.raises(TypeError, match="reserved"):
+        c.save(1, {"nested": {"x": {"__tuple__": []}}})
+    assert c.latest_step() is None        # nothing half-written
+
+
+def test_one_save_interrupt_per_save(tmp_path):
+    """Two kill/diskfull specs planned for the same step fire across
+    TWO saves — popping both for one save would mark a fault as
+    exercised that never happened."""
+    from fpga_ai_nic_tpu.runtime import chaos
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("kill", "ckpt.save", step=0, fraction=0.2),
+         chaos.FaultSpec("diskfull", "ckpt.save", step=0, fraction=0.2)])
+    plan.begin_step(0)
+    c = ckpt.Checkpointer(str(tmp_path / "ck"), chaos=plan)
+    g = np.arange(64, dtype=np.float32)
+    with pytest.raises(chaos.InjectedFault):
+        c.save(1, {"w": g})
+    assert len(plan.fired) == 1           # the sibling stays armed
+    with pytest.raises(OSError):
+        c.save(1, {"w": g})
+    assert len(plan.fired) == 2
+    c.save(1, {"w": g})                   # both spent: clean save
+    np.testing.assert_array_equal(c.restore(1)["w"], g)

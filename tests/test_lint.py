@@ -406,8 +406,8 @@ class TestJ7GradScale:
         # fixture hooks ride it: J7 (grad scale), J8 (reshard wire
         # accounting), J9 (hierarchical hop accounting), J10 (serve
         # recompile-freedom), J11 (KV-handoff wire accounting), J12
-        # (wire-integrity coverage) and J13 (adaptive counted traces)
-        # must each fire and fail the CLI
+        # (wire-integrity coverage), J13 (adaptive counted traces) and
+        # J14 (restore-path audit) must each fire and fail the CLI
         env = dict(os.environ, JAX_PLATFORMS="cpu",
                    GRAFTLINT_J7_FIXTURE=self.FIXTURE,
                    GRAFTLINT_J8_FIXTURE=TestJ8Reshard.FIXTURE,
@@ -415,7 +415,8 @@ class TestJ7GradScale:
                    GRAFTLINT_J10_FIXTURE=TestJ10ServeRecompile.FIXTURE,
                    GRAFTLINT_J11_FIXTURE=TestJ11Handoff.FIXTURE,
                    GRAFTLINT_J12_FIXTURE=TestJ12Integrity.FIXTURE,
-                   GRAFTLINT_J13_FIXTURE=TestJ13AdaptiveTraces.FIXTURE)
+                   GRAFTLINT_J13_FIXTURE=TestJ13AdaptiveTraces.FIXTURE,
+                   GRAFTLINT_J14_FIXTURE=TestJ14DurableState.FIXTURE)
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "tools", "graftlint.py"),
              "--jaxpr"], cwd=REPO, env=env, capture_output=True,
@@ -428,6 +429,7 @@ class TestJ7GradScale:
         assert "J11:" in proc.stdout
         assert "J12:" in proc.stdout
         assert "J13:" in proc.stdout
+        assert "J14:" in proc.stdout
 
 
 class TestJ8Reshard:
@@ -820,4 +822,86 @@ class TestJ13AdaptiveTraces:
                             lambda: [("broken", boom)])
         fs = jaxpr_sweep.run_j13()
         assert len(fs) == 1 and fs[0].code == "J13"
+        assert "boom" in fs[0].message
+
+
+class TestJ14DurableState:
+    """J14: every checkpoint restore path must audit the stored bytes
+    (refuse or peer-repair a flipped bit, never restore silently), the
+    walk-back must land on the previous verified step, and the pair
+    repair program must move exactly the shard bytes callback-free with
+    the source donated (docs/LINT.md, docs/DURABILITY.md)."""
+
+    FIXTURE = os.path.join(FIXTURES, "j14_bad.py")
+
+    def test_green_on_head(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import run_j14
+        findings = run_j14()
+        assert findings == [], "\n".join(f.format() for f in findings)
+
+    def test_zero_waivers_in_shipped_tree(self):
+        """The waiver table is the ONLY sanctioned skip, and the shipped
+        tree keeps it EMPTY — every restore path is audited."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import J14_WAIVERS
+        assert J14_WAIVERS == {}
+
+    def test_bad_fixture_fires_silent_restore(self):
+        import importlib.util
+        spec = importlib.util.spec_from_file_location("j14_bad",
+                                                      self.FIXTURE)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_restore_audit
+        fs = check_restore_audit("j14_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J14"}
+        assert any("without refusing or repairing" in f.message
+                   for f in fs), fs
+
+    def test_wire_mismatch_is_a_finding(self):
+        """A repair program shipping more than the shard (the
+        ship-the-whole-leaf anti-pattern) must be named with both byte
+        numbers — the J8/J11 accounting applied to the repair wire."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_restore_audit
+
+        def build():
+            return lambda: {"surface": "fat repair", "detected": 1,
+                            "repaired": 1, "bit_exact": 1,
+                            "wire_bytes": 4096, "declared_bytes": 1024,
+                            "runtime_wire_bytes": 1024,
+                            "callbacks": 0, "donated": 1,
+                            "_exercised": 1}
+
+        fs = check_restore_audit("fat", build)
+        assert len(fs) == 1 and fs[0].code == "J14"
+        assert "4096" in fs[0].message and "1024" in fs[0].message
+
+    def test_unrepaired_mirror_is_a_finding(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_restore_audit
+
+        def build():
+            return lambda: {"surface": "dead repair tier", "detected": 1,
+                            "repaired": 0, "bit_exact": 1,
+                            "_exercised": 1}
+
+        fs = check_restore_audit("dead", build)
+        assert len(fs) == 1 and fs[0].code == "J14"
+        assert "never fired" in fs[0].message
+
+    def test_vacuous_run_is_a_finding(self):
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_restore_audit
+        fs = check_restore_audit(
+            "noop", lambda: (lambda: {"detected": 1, "_exercised": 0}))
+        assert len(fs) == 1 and fs[0].code == "J14"
+        assert "vacuous" in fs[0].message
+
+    def test_surface_failure_lands_as_j14_finding(self, monkeypatch):
+        from fpga_ai_nic_tpu.lint import jaxpr_sweep
+
+        def boom():
+            raise RuntimeError("boom")
+
+        monkeypatch.setattr(jaxpr_sweep, "j14_surfaces",
+                            lambda: [("broken", boom)])
+        fs = jaxpr_sweep.run_j14()
+        assert len(fs) == 1 and fs[0].code == "J14"
         assert "boom" in fs[0].message

@@ -762,6 +762,7 @@ class TestLockset:
 # ---------------------------------------------------------------------------
 
 NEW_STRICT = ["fpga_ai_nic_tpu/parallel/reshard.py",
+              "fpga_ai_nic_tpu/utils/checkpoint.py",
               "fpga_ai_nic_tpu/tune", "fpga_ai_nic_tpu/verify",
               "fpga_ai_nic_tpu/serve",
               "fpga_ai_nic_tpu/runtime/requests.py"]

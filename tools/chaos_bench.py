@@ -837,6 +837,163 @@ def run_integrity_cells(ecfg: ElasticConfig, n_steps: int,
 
 
 # ---------------------------------------------------------------------------
+# durability cells: faults at the checkpoint plane itself (docs/
+# DURABILITY.md).  The last recovery tier every ladder falls back to is
+# the one place a fault is not allowed to be survivable-by-luck: a
+# stored bit flipped at rest must be repaired from the dp peer mirror
+# (bit-exact) or refused with a walk-back to the previous verified step
+# — never restored silently; a save killed mid-sequence (or starved by
+# ENOSPC) must leave the directory restoring exactly the previous
+# verified step; and a ladder that exhausts must still dump the live
+# state as an emergency checkpoint.  Every completing cell's final loss
+# is BIT-equal to the fault-free reference (deterministic replay
+# through the audited restore).
+# ---------------------------------------------------------------------------
+
+def _run_durability_cell(rig: WireRig, name: str, specs, ecfg,
+                         n_steps: int, ref_loss: float,
+                         expect: dict) -> dict:
+    """One supervised run under durability specs; verdict = completion +
+    BIT-exact final loss + the expected durability counters."""
+    t0 = time.time()
+    plan = chaos.FaultPlan(list(specs), seed=SEED)
+    cell = {"cell": name, "site": "ckpt.save", "wire": rig.wire,
+            "steps": n_steps}
+    state = rig.fresh_state()
+    with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+        et = ElasticTrainer(rig.trainer, d, ecfg, plan=plan,
+                            stage_fn=plan.stage)
+        try:
+            state, metrics = et.run(state, lambda i: rig.batch, n_steps)
+        except Exception as err:  # noqa: BLE001 — the verdict IS the point
+            cell.update(ok=False, error=repr(err),
+                        recovery=et.profiler.recovery.as_dict(),
+                        wall_s=round(time.time() - t0, 2))
+            return cell
+        rec = et.profiler.recovery.as_dict()
+        verified = et.ckpt.latest_step(verified=True)
+    loss = float(metrics["loss"])
+    bit_exact = loss == ref_loss
+    # expect: {counter: exact int} or {counter: (min,)} for >=
+    counters_ok = all(
+        rec.get(k, 0) >= v[0] if isinstance(v, tuple)
+        else rec.get(k, 0) == v
+        for k, v in expect.items())
+    cell["recovered"] = (int(state.step) == n_steps
+                         and len(plan.fired) == len(list(specs))
+                         and counters_ok)
+    cell.update(
+        ok=bool(cell["recovered"] and bit_exact),
+        bit_exact=bit_exact, final_loss=loss, ref_loss=ref_loss,
+        latest_verified_step=verified,
+        faults=rec["faults"], recoveries=rec["recoveries"],
+        checkpoint_restores=rec["checkpoint_restores"],
+        ckpt_repairs=rec["ckpt_repairs"],
+        ckpt_repair_wire_bytes=rec["ckpt_repair_wire_bytes"],
+        ckpt_save_failures=rec["ckpt_save_failures"],
+        emergency_dumps=rec["emergency_dumps"],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_durability_emergency_cell(rig: WireRig, ecfg,
+                                  n_steps: int) -> dict:
+    """Ladder exhaustion: every retry of one step fails (max_retries+1
+    exception specs) -> RecoveryExhausted is EXPECTED, and the 'dump
+    before dying' tier must leave an emergency-flagged, audit-clean
+    checkpoint of the live state behind."""
+    from fpga_ai_nic_tpu.parallel.elastic import RecoveryExhausted
+    t0 = time.time()
+    plan = chaos.FaultPlan(
+        [chaos.FaultSpec("exception", "queue.issue", step=FAULT_STEP)
+         for _ in range(ecfg.max_retries + 1)], seed=SEED)
+    cell = {"cell": "emergency-dump", "site": "ckpt.save",
+            "wire": rig.wire, "steps": n_steps}
+    state = rig.fresh_state()
+    raised = False
+    with tempfile.TemporaryDirectory() as d, chaos.activate(plan):
+        et = ElasticTrainer(rig.trainer, d, ecfg, plan=plan,
+                            stage_fn=plan.stage)
+        try:
+            et.run(state, lambda i: rig.batch, n_steps)
+        except RecoveryExhausted:
+            raised = True
+        rec = et.profiler.recovery.as_dict()
+        dump_step = et.ckpt.latest_step(verified=True)
+        flagged = (dump_step is not None
+                   and et.ckpt.is_emergency(dump_step))
+        restorable = (dump_step is not None
+                      and et.ckpt.audit_step(dump_step,
+                                             repair="probe").restorable)
+    cell.update(
+        ok=bool(raised and rec["emergency_dumps"] == 1 and flagged
+                and restorable and dump_step == FAULT_STEP),
+        recovered=raised, emergency_dumps=rec["emergency_dumps"],
+        emergency_flagged=flagged, emergency_restorable=restorable,
+        dump_step=dump_step, failed_recoveries=rec["failed_recoveries"],
+        chaos_fired=len(plan.fired),
+        wall_s=round(time.time() - t0, 2))
+    return cell
+
+
+def run_durability_cells(ecfg, n_steps: int, rig: WireRig = None) -> list:
+    rig = rig or WireRig("f32", n_steps)
+    ref = _ref_loss(rig, ecfg, n_steps)
+    save_step = FAULT_STEP - 1   # the save that commits state FAULT_STEP
+    matrix = [
+        ("bitflip-repair",
+         # a stored primary bit flips at rest right after the commit;
+         # the preemption's restore must peer-repair it bit-exactly
+         [chaos.FaultSpec("corruption", "ckpt.save", step=save_step,
+                          mode="wirebit"),
+          chaos.FaultSpec("preemption", "queue.issue", step=FAULT_STEP)],
+         {"ckpt_repairs": (1,), "checkpoint_restores": (1,),
+          "ckpt_save_failures": 0}),
+        ("stale-manifest-walkback",
+         # the newest step's manifest is swapped for the previous
+         # step's; the audit must reject it and the restore walk back
+         [chaos.FaultSpec("corruption", "ckpt.save", step=save_step,
+                          mode="stale_manifest"),
+          chaos.FaultSpec("preemption", "queue.issue", step=FAULT_STEP)],
+         {"ckpt_repairs": 0, "checkpoint_restores": (1,)}),
+        ("kill-during-save",
+         # the save's file-op sequence truncated mid-write (pre-commit):
+         # absorbed, and the later restore lands the previous step
+         [chaos.FaultSpec("kill", "ckpt.save", step=save_step,
+                          fraction=0.5),
+          chaos.FaultSpec("preemption", "queue.issue", step=FAULT_STEP)],
+         {"ckpt_save_failures": 1, "checkpoint_restores": (1,)}),
+        ("disk-full",
+         # ENOSPC mid-sequence: absorbed and recorded, the run finishes,
+         # later cadence saves succeed
+         [chaos.FaultSpec("diskfull", "ckpt.save", step=save_step,
+                          fraction=0.5)],
+         {"ckpt_save_failures": 1, "checkpoint_restores": 0}),
+    ]
+    cells = []
+    for name, specs, expect in matrix:
+        cell = _run_durability_cell(rig, name, specs, ecfg, n_steps,
+                                    ref, expect)
+        log(f"cell durability {name:24s}: "
+            f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+            f"bit_exact={cell.get('bit_exact')} "
+            f"repairs={cell.get('ckpt_repairs')} "
+            f"save_failures={cell.get('ckpt_save_failures')} "
+            f"({cell['wall_s']:.1f}s)")
+        cells.append(cell)
+    cell = run_durability_emergency_cell(rig, ecfg, n_steps)
+    log(f"cell durability {'emergency-dump':24s}: "
+        f"{'recovered' if cell.get('recovered') else 'FAILED':9s} "
+        f"dumps={cell.get('emergency_dumps')} "
+        f"flagged={cell.get('emergency_flagged')} "
+        f"restorable={cell.get('emergency_restorable')} "
+        f"({cell['wall_s']:.1f}s)")
+    cells.append(cell)
+    return cells
+
+
+# ---------------------------------------------------------------------------
 # adaptive-tuning cells: the forced regime shift (docs/TUNING.md "Online
 # plan adaptation").  A SUSTAINED slowdown@collective — one spec per
 # step, FaultPlan.sustained — is the chaos stand-in for the wire whose
@@ -1166,6 +1323,14 @@ def main() -> int:
                          "with zero new traces, plus the zero-switch "
                          "steady guard; the CI-sized gate — the full "
                          "matrix also includes them)")
+    ap.add_argument("--durability-only", action="store_true",
+                    help="run ONLY the durability cells (faults at the "
+                         "checkpoint plane: stored-bit flip -> peer "
+                         "repair, stale manifest -> walk-back, "
+                         "kill-during-save / disk-full absorbed by the "
+                         "commit protocol, ladder exhaustion -> "
+                         "emergency dump; the CI-sized gate — the full "
+                         "matrix also includes them)")
     ap.add_argument("--reshard-bench", action="store_true",
                     help="run the trainer x codec reshard-vs-restore MTTR "
                          "matrix instead of the fault matrix (banked as "
@@ -1201,8 +1366,32 @@ def main() -> int:
     # keep their banked MTTR rows tap-free (comparable with the
     # pre-tap rounds' artifacts)
     if not (args.serve_only or args.fleet_only or args.reshard_bench
-            or args.adapt_only):
+            or args.adapt_only or args.durability_only):
         chaos.install_wire_tap()
+
+    if args.durability_only:
+        durability_cells = run_durability_cells(ecfg, n_steps)
+        result = {
+            "bench": "chaos_durability",
+            "fast": args.fast,
+            "platform": plat,
+            "n_devices": len(jax.devices()),
+            "dryrun": plat != "tpu",
+            "durability_cells": durability_cells,
+            "ok": all(c["ok"] for c in durability_cells),
+        }
+        if args.out:
+            with open(args.out, "w") as f:
+                json.dump(result, f, indent=1)
+        if not args.no_artifact:
+            save_artifact("chaos_durability", result)
+        print(json.dumps({k: v for k, v in result.items()
+                          if k != "durability_cells"} |
+                         {"durability_cells_ok":
+                          sum(c["ok"] for c in durability_cells),
+                          "durability_cells_total":
+                          len(durability_cells)}, indent=1))
+        return 0 if result["ok"] else 1
 
     if args.adapt_only:
         adapt_cells = run_adapt_cells()
@@ -1359,6 +1548,11 @@ def main() -> int:
     integrity_cells = run_integrity_cells(
         ecfg, n_steps, timeout_s, wire_rigs=wire_rig_map,
         serve_rig=serve_rig, fleet_rig=fleet_rig)
+    # the durability battery: faults at the checkpoint plane itself
+    # (stored-bit flip -> peer repair, stale manifest -> walk-back,
+    # kill-during-save / disk-full, ladder exhaustion -> emergency dump)
+    durability_cells = run_durability_cells(
+        ecfg, n_steps, rig=wire_rig_map.get("f32"))
     # the adaptive-tuning battery: forced regime shift -> detection ->
     # recompile-free plan switch, plus the zero-switch steady guard
     adapt_cells = run_adapt_cells()
@@ -1375,12 +1569,14 @@ def main() -> int:
                    "fleet_sites": ["fleet.membership", "serve.handoff"],
                    "integrity_sites": ["collective", "reshard.transfer",
                                        "serve.step", "serve.handoff"],
-                   "adapt_cells": ["steady", "slowdown-shift"]},
+                   "adapt_cells": ["steady", "slowdown-shift"],
+                   "durability_sites": list(chaos.CKPT_SITES)},
         "cells": cells,
         "shrink_cells": shrink_cells,
         "serve_cells": serve_cells,
         "fleet_cells": fleet_cells,
         "integrity_cells": integrity_cells,
+        "durability_cells": durability_cells,
         "adapt_cells": adapt_cells,
         "soak": soaks,
         "ok": (all(c["ok"] for c in cells)
@@ -1388,6 +1584,7 @@ def main() -> int:
                and all(c["ok"] for c in serve_cells)
                and all(c["ok"] for c in fleet_cells)
                and all(c["ok"] for c in integrity_cells)
+               and all(c["ok"] for c in durability_cells)
                and all(c["ok"] for c in adapt_cells)
                and all(s["ok"] for s in soaks)),
     }

@@ -96,6 +96,11 @@ _WATCH = {
                   "fpga_ai_nic_tpu/serve/",
                   "fpga_ai_nic_tpu/runtime/chaos.py",
                   "fpga_ai_nic_tpu/compress/golden.py"],
+    "ckpt": ["tools/ckpt_bench.py", "tools/chaos_bench.py",
+             "fpga_ai_nic_tpu/utils/checkpoint.py",
+             "fpga_ai_nic_tpu/parallel/elastic.py",
+             "fpga_ai_nic_tpu/runtime/chaos.py",
+             "fpga_ai_nic_tpu/compress/golden.py"],
     "adapt": ["tools/adapt_bench.py", "tools/chaos_bench.py",
               "fpga_ai_nic_tpu/tune/",
               "fpga_ai_nic_tpu/parallel/train.py",
@@ -840,6 +845,73 @@ def main():
                         f"| {r['ok']} | {r.get('mttr_s')} "
                         f"| {json.dumps(extra)} |")
                 L.append("")
+
+    # -- durable-state integrity (audited checkpoint plane, PR 15) -----------
+    ck_art = (_newest("artifacts/ckpt_bench_*.json")
+              or _newest("CKPT_BENCH_r*.json"))
+    if ck_art:
+        d = _load(ck_art)
+        rows = {r["row"]: r for r in d.get("rows", [])}
+        if rows:
+            dry = bool(d.get("dryrun"))
+            L += ["## Durable-state integrity (audited checkpoints, "
+                  "PR 15)", "",
+                  f"Source: `{_rel(ck_art)}`{_badge(d, 'ckpt')} "
+                  f"(platform: {d.get('platform')}; `make ckpt-bench`). "
+                  "The hardened last recovery tier "
+                  "(`utils/checkpoint.py`, docs/DURABILITY.md): every "
+                  "save commits a manifest of exact odd-weighted-u32 "
+                  "checksums over the stored representation atomically "
+                  "with the step, every restore audits against it "
+                  "(graftlint J14, zero waivers), and a corrupt shard "
+                  "is peer-repaired over a single-pair transfer moving "
+                  "EXACTLY the shard bytes — or refused, never "
+                  "silently restored.", ""]
+            if dry:
+                L += ["**Dryrun rows** (virtual CPU mesh): the "
+                      "stall/audit/MTTR timings carry oversubscription "
+                      "noise — `make obs-gate` gates only the exact "
+                      "byte/counter keys (two-sided); the timing "
+                      "verdicts need a TPU-attached host.", ""]
+            sv, au, rp = (rows.get("save"), rows.get("audit"),
+                          rows.get("repair"))
+            if sv:
+                L += ["| save stall sync | async | commit wall "
+                      "| bytes | shard files | mirror files "
+                      "| encode in bg |",
+                      "|---|---|---|---|---|---|---|",
+                      f"| {sv.get('save_stall_sync_ms')} ms "
+                      f"| {sv.get('save_stall_async_ms')} ms "
+                      f"| {sv.get('commit_wall_ms')} ms "
+                      f"| {sv.get('bytes_written'):,} "
+                      f"| {sv.get('n_shard_files')} "
+                      f"| {sv.get('mirror_files')} "
+                      f"| {sv.get('encode_in_background')} |", ""]
+            if au:
+                L += [f"Audit overhead: {au.get('audit_ms')} ms over "
+                      f"{au.get('audit_leaves')} manifest leaves "
+                      f"(restore total {au.get('restore_ms')} ms, "
+                      f"audit fraction {au.get('audit_frac')}); "
+                      f"false trips on a clean save: "
+                      f"{au.get('trips')}.", ""]
+            if rp:
+                L += ["Restore-MTTR under a flipped stored bit "
+                      "(the disk-corruption class):", "",
+                      "| path | MTTR ms | facts |",
+                      "|---|---|---|",
+                      f"| peer repair (mirrored) "
+                      f"| {rp.get('mttr_repair_ms')} "
+                      f"| repaired={rp.get('repaired')} "
+                      f"wire={rp.get('repair_wire_bytes'):,} B "
+                      f"(= shard bytes), healed={rp.get('healed')}, "
+                      f"bit_exact={rp.get('bit_exact')} |",
+                      f"| walk-back (no mirror) "
+                      f"| {rp.get('mttr_walkback_ms')} "
+                      f"| steps_lost={rp.get('steps_lost')}, "
+                      f"bit_exact={rp.get('walkback_bit_exact')} |",
+                      f"| refusal (no clean source) | — "
+                      f"| refused={rp.get('refused')} (never a silent "
+                      "restore) |", ""]
 
     # -- adaptive tuning (drift observatory, PR 13) --------------------------
     ad_art = (_newest("artifacts/adapt_bench_*.json")

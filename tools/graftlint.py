@@ -48,6 +48,7 @@ from fpga_ai_nic_tpu.lint import default_targets, lint_paths  # noqa: E402
 # pyproject [tool.mypy] files= — invoked bare so the two cannot drift)
 STRICT_CORE = ["fpga_ai_nic_tpu/compress", "fpga_ai_nic_tpu/obs",
                "fpga_ai_nic_tpu/utils/config.py",
+               "fpga_ai_nic_tpu/utils/checkpoint.py",
                "fpga_ai_nic_tpu/runtime/queue.py",
                "fpga_ai_nic_tpu/parallel/reshard.py",
                "fpga_ai_nic_tpu/tune",

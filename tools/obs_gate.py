@@ -148,6 +148,30 @@ ADAPT_EXACT_KEYS = ("detected", "switches", "false_switches",
                     "recompiles_across_switch", "n_candidates")
 TOL_ADAPT_TIME = 0.40
 
+# durable-state rows (CKPT_BENCH_r*.json, one per scenario): the
+# storage accounting and audit/repair facts are exact two-sided —
+# bytes_written / shard / mirror file counts drifting means the stored
+# layout changed (a silent shrink is a lost mirror, i.e. a lost repair
+# source), encode_in_background banked 1 is the async-stall satellite
+# as an artifact fact (0 = the GB-scale encode moved back into the
+# caller's save stall), trips banked 0 means a clean save never
+# false-trips its own audit, repaired/bit_exact/healed banked 1 +
+# repair_wire_bytes == declared_shard_bytes is the peer-repair contract
+# (J14 as an artifact), steps_lost == 1 pins the walk-back landing on
+# the PREVIOUS step, and refused == 1 pins the no-clean-source refusal.
+# Stall/audit/MTTR timings gate on non-dryrun artifacts only, the
+# fused-opt honesty rule.
+CKPT_GATE_KEYS = ("save_stall_sync_ms", "save_stall_async_ms",
+                  "commit_wall_ms", "audit_ms", "restore_ms",
+                  "mttr_repair_ms", "mttr_walkback_ms")
+CKPT_EXACT_KEYS = ("bytes_written", "n_leaf_files", "n_shard_files",
+                   "mirror_files", "encode_in_background",
+                   "audit_leaves", "trips", "repaired",
+                   "repair_wire_bytes", "declared_shard_bytes", "healed",
+                   "bit_exact", "steps_lost", "walkback_bit_exact",
+                   "refused")
+TOL_CKPT_TIME = 0.40
+
 # graftmc envelope rows (MC_ENVELOPE_r*.json): per-route cell counts
 # and states explored are exact two-sided — the corpus is deterministic,
 # so ANY drift means the envelope or the models changed, and a silent
@@ -196,6 +220,10 @@ def integrity_metric(route: str, key: str) -> str:
 
 def adapt_metric(scenario: str, key: str) -> str:
     return f"adapt.{scenario}.{key}"
+
+
+def ckpt_metric(row: str, key: str) -> str:
+    return f"ckpt.{row}.{key}"
 
 
 def mc_metric(route: str, key: str) -> str:
@@ -427,6 +455,25 @@ def build_banked_summary() -> dict:
                 else:
                     m = _metric(v, src, higher=False, tol=TOL_ADAPT_TIME)
                 metrics[adapt_metric(row["scenario"], key)] = m
+
+    # -- durable-state integrity (audited checkpoint plane) -------------------
+    p = (_newest("artifacts/ckpt_bench_*.json")
+         or _newest("CKPT_BENCH_r*.json"))
+    if p:
+        d = _load(p)
+        src = os.path.relpath(p, ROOT)
+        keys = (CKPT_EXACT_KEYS if d.get("dryrun")
+                else CKPT_EXACT_KEYS + CKPT_GATE_KEYS)
+        for row in d.get("rows", []):
+            for key in keys:
+                v = row.get(key)
+                if v is None:
+                    continue
+                if key in CKPT_EXACT_KEYS:
+                    m = _metric(v, src, tol=TOL_EXACT, two_sided=True)
+                else:
+                    m = _metric(v, src, higher=False, tol=TOL_CKPT_TIME)
+                metrics[ckpt_metric(row["row"], key)] = m
 
     # -- graftmc envelope (protocol-verification coverage) --------------------
     p = (_newest("artifacts/mc_envelope_*.json")
