@@ -789,6 +789,54 @@ def _j10_engine_build() -> Callable:
     return run
 
 
+def _j10_engine_tp_build() -> Callable:
+    """The same scripted schedule over the TP-SHARDED tick: one replica
+    spanning a 2-way mesh via shard_map (pool kv-sharded, kernel attend
+    path on).  shard_map must not add a trace axis of its own — page
+    reassignment, slot churn and the mesh wrapper together still leave
+    exactly one trace per program."""
+    def run() -> Dict[str, int]:
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        from ..models import llama
+        from ..serve import ServeConfig, ServeEngine
+
+        cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=1, ffn_dim=64)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        # page_integrity off: the checksum ledger is global-pool-only
+        # and the tp tick rejects it at construction
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=5,
+                           max_pages_per_seq=4, prefill_chunk=4,
+                           page_integrity=False)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        # reference attend keeps this surface ~3s cheaper per sweep on
+        # the 1-core CI box; the pallas-impl tp tick's trace count is
+        # asserted by tests/test_paged_attend.py (TestTpParity
+        # test_tp_engine_tick_tokens_and_traces), so the kernel axis
+        # stays covered without paying its interpret-mode compile here
+        eng = ServeEngine(params, cfg, scfg, tp_mesh=mesh,
+                          attend_impl="reference")
+        rng = np.random.default_rng(11)
+        for _ in range(5):
+            eng.submit(rng.integers(0, cfg.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=int(rng.integers(2, 6)))
+        eng.run()
+        for i in range(4):
+            eng.submit(rng.integers(0, cfg.vocab,
+                                    int(rng.integers(3, 10))).astype(
+                np.int32), max_new=3, not_before_s=0.01 * i)
+        eng.run()
+        counts = dict(eng.trace_counts())
+        counts["_exercised"] = int(eng.batcher.evictions > 0
+                                   and eng.stats.as_dict()["completed"] == 9)
+        return counts
+    return run
+
+
 def check_serve_trace(name: str, build: Callable) -> List[Finding]:
     """Evaluate one J10 surface.  ``build()`` returns a zero-arg runner
     executing the scripted schedule and returning {phase: traces}
@@ -822,6 +870,7 @@ def j10_surfaces() -> List[Tuple[str, Callable]]:
     hook, same contract as J7/J8/J9's."""
     surfaces: List[Tuple[str, Callable]] = [
         ("engine admit/evict schedule", _j10_engine_build),
+        ("tp-sharded engine admit/evict schedule", _j10_engine_tp_build),
     ]
     import os
     fixture = os.environ.get("GRAFTLINT_J10_FIXTURE")

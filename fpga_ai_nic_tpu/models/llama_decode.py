@@ -190,7 +190,8 @@ def _rope_rows(x: jax.Array, pos: jax.Array, cfg: LlamaConfig) -> jax.Array:
 def forward_paged(params: Dict, tokens: jax.Array, pool: List[Dict],
                   page_table: jax.Array, pos: jax.Array, cfg: LlamaConfig,
                   *, page_size: int, tp_axis: Optional[str] = None,
-                  active: Optional[jax.Array] = None
+                  active: Optional[jax.Array] = None,
+                  attend_impl: str = "reference"
                   ) -> Tuple[jax.Array, List[Dict]]:
     """Paged-KV forward — the serving plane's decode path.
 
@@ -216,7 +217,20 @@ def forward_paged(params: Dict, tokens: jax.Array, pool: List[Dict],
     Every shape is static in (R, T, P, page_size): admissions, evictions
     and page re-assignments change VALUES only, so a jitted step is
     trace-stable across any admit/evict schedule (frozen as graftlint
-    J10)."""
+    J10).
+
+    ``attend_impl`` picks how the pool is scored: ``"reference"``
+    (default) materializes the gathered view below — the portable XLA
+    path and the bitwise oracle; ``"pallas"`` runs
+    `ops.paged_attend_pallas.paged_gather_attend`, which walks the page
+    table and DMAs live pages HBM->VMEM inside the kernel instead.  The
+    two are bitwise-identical on a given backend
+    (tests/test_paged_attend.py), so the contract above holds for
+    both."""
+    if attend_impl not in ("reference", "pallas"):
+        raise ValueError(
+            f"forward_paged: unknown attend_impl={attend_impl!r}; "
+            "expected 'reference' or 'pallas'")
     R, T = tokens.shape
     Hd = cfg.head_dim
     P = page_table.shape[1]
@@ -274,16 +288,26 @@ def forward_paged(params: Dict, tokens: jax.Array, pool: List[Dict],
         pv = pl["v"].at[flat_pages, :, flat_offs, :].set(
             vw.reshape(R * T, n_kv, Hd))
         new_pool.append({"k": pk, "v": pv})
-        # gather each slot's paged view [R, kv, P*page_size, hd] — the
-        # array forward() reads straight out of the contiguous cache.
-        # XLA materializes it (the portable reference path); a Pallas
-        # gather-attend that never forms it is the on-hardware follow-up
-        # (docs/SERVING.md).
-        ck = pk[page_table].transpose(0, 2, 1, 3, 4).reshape(
-            R, n_kv, P * page_size, Hd)
-        cv = pv[page_table].transpose(0, 2, 1, 3, 4).reshape(
-            R, n_kv, P * page_size, Hd)
-        att = _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale)
+        if attend_impl == "pallas":
+            # Pallas gather-attend: the gathered view is never formed —
+            # the kernel walks page_table and DMAs each LIVE page
+            # HBM->VMEM, so decode bytes/token follow the live KV
+            # rather than the allocated page span (docs/SERVING.md).
+            from ..ops import paged_attend_pallas as _paged_pallas
+            att = _paged_pallas.paged_gather_attend(
+                q, pk, pv, page_table, pos, page_size=page_size,
+                sm_scale=sm_scale)
+        else:
+            # reference: gather each slot's paged view
+            # [R, kv, P*page_size, hd] — the array forward() reads
+            # straight out of the contiguous cache.  XLA materializes
+            # it; bytes scale with the ALLOCATED span, which is why
+            # this stays the portable oracle rather than the fast path.
+            ck = pk[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                R, n_kv, P * page_size, Hd)
+            cv = pv[page_table].transpose(0, 2, 1, 3, 4).reshape(
+                R, n_kv, P * page_size, Hd)
+            att = _cached_attend(q, ck, cv, pos, n_heads, n_kv, sm_scale)
         att = att.astype(x.dtype).transpose(0, 2, 1, 3).reshape(
             R, T, n_heads * Hd)
         x = x + llama._psum_if(att @ lyr["wo"], tp_axis)

@@ -35,7 +35,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..models import llama_decode
+from ..models import llama, llama_decode
 from ..models.llama import LlamaConfig
 from ..obs.metrics import RequestSpans
 from ..ops import integrity as integrity_lib
@@ -82,9 +82,40 @@ class ServeEngine:
                  dtype: Optional[str] = None,
                  device: Optional[Any] = None,
                  replica_id: int = 0,
-                 role: str = "both") -> None:
+                 role: str = "both",
+                 tp_mesh: Optional[Any] = None,
+                 tp_axis: str = "tp",
+                 attend_impl: str = "reference") -> None:
         if role not in ("both", "prefill", "decode"):
             raise ValueError(f"role must be both|prefill|decode: {role!r}")
+        if attend_impl not in ("reference", "pallas"):
+            raise ValueError("attend_impl must be reference|pallas: "
+                             f"{attend_impl!r}")
+        # tp_mesh: a jax.sharding.Mesh whose ``tp_axis`` dimension this
+        # ONE replica spans — the tick programs are shard_map'd over it
+        # (pool + params sharded, host-visible operands replicated), so
+        # admissions/evictions/page churn still change VALUES only and
+        # the J10 counted-trace discipline is unchanged: exactly one
+        # trace per program for any schedule.
+        self.tp_mesh = tp_mesh
+        self.tp_axis = tp_axis
+        self.attend_impl = attend_impl
+        self.tp_size = (int(tp_mesh.shape[tp_axis])
+                        if tp_mesh is not None else 1)
+        # the axis name the tick programs hand to forward_paged: a real
+        # mesh axis only inside the shard_map'd body
+        self._impl_tp_axis = tp_axis if tp_mesh is not None else None
+        if tp_mesh is not None and scfg.page_integrity:
+            # the checksum ledger is defined over the GLOBAL pool; a
+            # tp-sharded tick sees only its kv shard, and stitching
+            # per-rank partial checksums back into the global ledger
+            # would need a cross-rank reduction the integrity tier does
+            # not model — run the integrity cells on single-shard
+            # replicas
+            raise ValueError(
+                "page_integrity is not supported with a tp-sharded tick "
+                "(the page-checksum ledger is global; shards see only "
+                "their kv slice)")
         # device pins THIS replica's pool + params (the fleet places each
         # replica on its own device so the KV handoff is a real
         # cross-device ppermute); None keeps the default placement
@@ -120,13 +151,66 @@ class ServeEngine:
         self._pages_peak = 0         # survives allocator rebuilds
         self.page_trips = 0          # exact-tier (wire/page checksum) trips
         self.logit_trips = 0         # magnitude-tier (logit guard) trips
-        self._decode_fn, self._decode_traces = counted_jit(
-            self._decode_impl, donate_argnums=(0,))
-        self._prefill_fn, self._prefill_traces = counted_jit(
-            self._prefill_impl, donate_argnums=(0,))
+        if tp_mesh is None:
+            self._decode_fn, self._decode_traces = counted_jit(
+                self._decode_impl, donate_argnums=(0,))
+            self._prefill_fn, self._prefill_traces = counted_jit(
+                self._prefill_impl, donate_argnums=(0,))
+        else:
+            self._decode_fn, self._decode_traces = self._tp_tick_fn(
+                self._decode_impl)
+            self._prefill_fn, self._prefill_traces = self._tp_tick_fn(
+                self._prefill_impl)
+
+    def _tp_tick_fn(self, impl: Callable[..., Any]
+                    ) -> Tuple[Any, Callable[[], int]]:
+        """shard_map one tick program over the tp mesh and count its
+        traces.  Pool shards on the kv-heads axis, params by
+        `llama.param_specs`; tokens/table/pos and the emitted
+        tokens/guard are replicated (forward_paged all-gathers logits
+        over ``tp_axis``, so every rank argmaxes identical rows).  The
+        trailing ledger arg of the unsharded call signature is dropped
+        here — tp + page_integrity is rejected at construction, so it
+        is always None."""
+        P = jax.sharding.PartitionSpec
+        ax = self.tp_axis
+        pool_spec = [{"k": P(None, ax), "v": P(None, ax)}
+                     for _ in range(self.cfg.n_layers)]
+        pspecs = llama.param_specs(self.cfg, tp_axis=ax,
+                                   tp_size=self.tp_size)
+
+        def body(pool: Pool, params: Dict[str, Any], *rest: Any) -> Any:
+            return impl(pool, params, *rest)
+
+        sharded = jax.shard_map(
+            body, mesh=self.tp_mesh,
+            in_specs=(pool_spec, pspecs, P(), P(), P(), P()),
+            out_specs=(P(), P(), pool_spec), check_vma=False)
+        jitted, traces = counted_jit(sharded, donate_argnums=(0,))
+
+        def call(pool: Pool, params: Dict[str, Any],
+                 *rest_and_ledger: Any) -> Any:
+            *rest, _ledger = rest_and_ledger
+            return jitted(pool, params, *rest)
+
+        return call, traces
 
     def _fresh_pool(self) -> Pool:
         pool = init_pool(self.cfg, self.scfg, dtype=self.dtype)
+        if self.tp_size > 1:
+            # the GLOBAL pool the shard_map'd tick shards on its kv
+            # axis: kv_local * tp — equal to n_kv_heads except under
+            # kv replication (n_kv_heads < tp), where every rank holds
+            # its own replicated-head slice
+            kv_global = llama_decode.kv_local_heads(
+                self.cfg, self.tp_size) * self.tp_size
+            if kv_global != pool[0]["k"].shape[1]:
+                shape = (self.scfg.n_pages, kv_global,
+                         self.scfg.page_size, self.cfg.head_dim)
+                dt = pool[0]["k"].dtype
+                pool = [{"k": jnp.zeros(shape, dt),
+                         "v": jnp.zeros(shape, dt)}
+                        for _ in range(self.cfg.n_layers)]
         if self.device is not None:
             pool = jax.device_put(pool, self.device)
         return pool
@@ -194,7 +278,8 @@ class ServeEngine:
         bad_pages = self._page_check(pool, ledger)
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, table, pos, self.cfg,
-            page_size=self.scfg.page_size, active=active)
+            page_size=self.scfg.page_size, active=active,
+            tp_axis=self._impl_tp_axis, attend_impl=self.attend_impl)
         toks = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
         if ledger is None:
             return toks, self._logit_guard(logits), pool
@@ -209,7 +294,8 @@ class ServeEngine:
         bad_pages = self._page_check(pool, ledger)
         logits, pool = llama_decode.forward_paged(
             params, tokens, pool, row, pos0, self.cfg,
-            page_size=self.scfg.page_size)
+            page_size=self.scfg.page_size,
+            tp_axis=self._impl_tp_axis, attend_impl=self.attend_impl)
         # the sampled continuation at the chunk's last TRUE token — only
         # consumed when this chunk completes a FRESH prefill
         nxt = jnp.argmax(logits[0, last], axis=-1).astype(jnp.int32)
@@ -508,6 +594,8 @@ class ServeEngine:
         return {
             "replica_id": self.replica_id,
             "role": self.role,
+            "tp_size": self.tp_size,
+            "attend_impl": self.attend_impl,
             "ticks": self.ticks,
             "wall_s": round(wall, 4),
             **stats,

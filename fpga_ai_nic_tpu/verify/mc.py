@@ -47,7 +47,7 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional,
                     Sequence, Set, Tuple)
 
 from . import opstream
-from .opstream import (PairModel, ProtocolError, RingModel,
+from .opstream import (GatherModel, PairModel, ProtocolError, RingModel,
                        reshard_owners)
 
 # The exhaustive envelope (per route; ROADMAP acceptance): every cell
@@ -316,6 +316,28 @@ def handoff_cells() -> List[Tuple[int, bool]]:
     return [(L, integ) for L in (1, 2, 3) for integ in (False, True)]
 
 
+def build_gather(n_pages: int, n_live: int, depth: int) -> GatherModel:
+    """The paged gather-attend kernel's per-(request, kv-head) DMA
+    schedule (`opstream.paged_attend_op_stream` — the one definition
+    `ops.paged_attend_pallas` also lowers) as a single-node async-DMA
+    model: `check` explores the landing interleavings for semaphore-slot
+    aliasing, and `_static_violations` runs the exact live-page coverage
+    pass (`opstream.check_gather_coverage`) plus the generic DMA
+    discipline first."""
+    ops = opstream.paged_attend_op_stream(n_pages, n_live, depth)
+    return GatherModel(ops, depth,
+                       meta={"route": "gather", "P": n_pages,
+                             "n_live": n_live, "depth": depth})
+
+
+def gather_cells() -> List[Tuple[int, int, int]]:
+    # every occupancy of every table width up to N_MAX, per buffer
+    # depth — n_live == 0 (all-dead row: an inactive slot's schedule)
+    # and depth > P (the prologue clamp) are both in-envelope
+    return [(P, nl, d) for P in range(1, N_MAX + 1)
+            for nl in range(0, P + 1) for d in (1, 2, 3)]
+
+
 def reshard_cells() -> List[Tuple[int, int, int, bool]]:
     # 48 divides evenly almost everywhere; 37 is prime — every chunk
     # boundary of either layout cuts (the nothing-divides-anything case)
@@ -388,6 +410,13 @@ def _static_violations(model: Any) -> List[Tuple[str, str]]:
         if dma:
             out.append(("dma", "; ".join(dma)))
         m2 = opstream.check_weight_conservation(model.ops)
+    elif isinstance(model, GatherModel):
+        dma = opstream.check_dma_discipline(model.ops)
+        cov = opstream.check_gather_coverage(
+            model.ops, model.meta["P"], model.meta["n_live"])
+        if dma or cov:
+            out.append(("dma", "; ".join(dma + cov)))
+        m2 = opstream.check_weight_conservation(model.ops)
     else:
         m2 = opstream.check_weight_conservation(model.streams)
     if m2:
@@ -405,7 +434,7 @@ def run_cell(route: str, cell: Tuple[Any, ...],
     builder: Dict[str, Callable[..., Any]] = {
         "flat": build_flat, "streaming": build_streaming,
         "ag": build_ag, "hier": build_hier, "reshard": build_reshard,
-        "handoff": build_handoff}
+        "handoff": build_handoff, "gather": build_gather}
     model = builder[route](*cell)
     static = _static_violations(model)
     if static:
@@ -475,6 +504,7 @@ def run_corpus(emit: Optional[Callable[[str], None]] = None,
     sweep("reshard", [c + (integ,) for c in reshard_cells()
                       for integ in (False, True)])
     sweep("handoff", handoff_cells())
+    sweep("gather", gather_cells())
 
     # POR-vs-naive comparison on the reported cells (flat route; the
     # naive full DFS is only tractable on small cells)

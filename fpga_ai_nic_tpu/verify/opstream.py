@@ -82,8 +82,8 @@ env before importing, so the checker never waits on a TPU tunnel).
 
 from __future__ import annotations
 
-from typing import (Any, Dict, List, NamedTuple, Optional, Sequence,
-                    Set, Tuple)
+from typing import (Any, Callable, Dict, List, NamedTuple, Optional,
+                    Sequence, Set, Tuple)
 
 Op = Tuple[Any, ...]
 Action = Tuple[Any, ...]
@@ -569,6 +569,170 @@ def check_dma_discipline(ops: Sequence[Op]) -> List[str]:
     for key in sorted(started - waited):
         out.append(f"exit: DMA {key[0]}[{key[1]}] started but never "
                    "waited (unsynchronized buffer at kernel exit)")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# the paged gather-attend DMA program (consumed by
+# ops.paged_attend_pallas AND the checker — the serving fast path's
+# per-page schedule, one definition)
+# ---------------------------------------------------------------------------
+
+
+def negate(cond: Any) -> Any:
+    """Logical negation across the two predication styles ``when``
+    serves: python bools (the checker and the unrolled interpreter
+    schedules) take ``not``; traced bools (the rolled kernel schedule)
+    take ``~`` — ``not`` on a tracer raises, and ``~True`` is the
+    python int -2.  Jax-free on purpose: tracers only ever reach this
+    through a kernel sink."""
+    if isinstance(cond, bool):
+        return not cond
+    return ~cond
+
+
+class PagedAttendEmitter:
+    """One definition of the paged gather-attend decode kernel's
+    per-(request, kv-head) DMA schedule (`ops.paged_attend_pallas`) —
+    the PR-14 discipline applied to the serving fast path: the SAME
+    stream drives the kernel lowering (through its sink) and the
+    graftmc ``gather`` family (`verify.mc.build_gather`), so the gather
+    protocol that ships is the protocol that was checked.
+
+    ``n_pages`` table slots per sequence; the first ``n_live`` hold
+    every visible position (``live(i)``: a python bool per slot for the
+    checker, a traced bool for the rolled kernel).  Per live page i the
+    stream is a ``depth``-deep double buffer over dedicated VMEM spans
+    (page i lands at rows [i*page_size, (i+1)*page_size) of the K/V
+    tile buffers — transfers never share a destination), with the DMA
+    *semaphores* cycling mod depth:
+
+        wait kpg[i]; wait vpg[i]        (the prologue started 0..depth-1)
+        start kpg[i+depth], vpg[i+depth] if that slot is live — declared
+                                        hazard predecessor: page i, just
+                                        waited, which shares its
+                                        semaphore slot (i mod depth)
+        attend_tile(i)                  (scores tile from the landed
+                                        K page)
+
+    Dead slots (i >= n_live) emit only ``dead_fill`` — their pages are
+    NEVER transferred.  The allocated-extent bytes the reference gather
+    pays for dead slots are exactly the bytes this schedule saves, and
+    `check_gather_coverage` pins the other direction: every live
+    (page, offset) is read exactly once, zero overlap."""
+
+    K_CHAN = "kpg"
+    V_CHAN = "vpg"
+
+    def __init__(self, n_pages: int, depth: int = 2) -> None:
+        assert n_pages >= 1 and depth >= 1, (n_pages, depth)
+        self.n_pages = n_pages
+        self.depth = depth
+
+    def stream(self, sink: OpSink, live: Callable[[int], Any]) -> None:
+        P, depth = self.n_pages, self.depth
+        for i in range(min(depth, P)):
+            @sink.when(live(i))
+            def _prologue(i: int = i) -> None:
+                # predecessors i-depth are pre-history (index < 0):
+                # stated so the semaphore-reuse invariant reads the same
+                # on every start; ListSink filters them out
+                sink.dma_start(self.K_CHAN, i, (self.K_CHAN, i - depth))
+                sink.dma_start(self.V_CHAN, i, (self.V_CHAN, i - depth))
+        for i in range(P):
+            @sink.when(live(i))
+            def _live_tile(i: int = i) -> None:
+                sink.dma_wait(self.K_CHAN, i)
+                sink.dma_wait(self.V_CHAN, i)
+                if i + depth < P:
+                    @sink.when(live(i + depth))
+                    def _launch(i: int = i) -> None:
+                        sink.dma_start(self.K_CHAN, i + depth,
+                                       (self.K_CHAN, i))
+                        sink.dma_start(self.V_CHAN, i + depth,
+                                       (self.V_CHAN, i))
+                sink.local("attend_tile", i)
+
+            @sink.when(negate(live(i)))
+            def _dead_tile(i: int = i) -> None:
+                sink.local("dead_fill", i)
+        sink.local("softmax")
+        sink.local("pv")
+
+
+def paged_attend_op_stream(n_pages: int, n_live: int,
+                           depth: int = 2) -> List[Op]:
+    """The checker's view of one (request, kv-head) grid cell's gather
+    schedule: ``n_live`` of ``n_pages`` table slots hold visible
+    positions.  Consumed by `verify.mc.build_gather` (the exhaustive
+    ``gather`` envelope family); tests/test_paged_attend.py pins it
+    against the kernel's own emission."""
+    assert 0 <= n_live <= n_pages, (n_live, n_pages)
+    sink = ListSink()
+    PagedAttendEmitter(n_pages, depth).stream(sink, lambda i: i < n_live)
+    return sink.ops
+
+
+def check_gather_coverage(ops: Sequence[Op], n_pages: int,
+                          n_live: int) -> List[str]:
+    """The gather family's coverage/exclusivity obligations, on top of
+    the generic per-node DMA discipline (`check_dma_discipline`): every
+    live page's K and V are transferred exactly once and waited before
+    its attend (each live (page, offset) read exactly once — no
+    overlap, no hole), every dead slot is dead-filled exactly once and
+    transfers NOTHING (the saved allocated-extent bytes are real), and
+    the epilogue reduces the tiles exactly once.  Returns violation
+    messages (empty = clean)."""
+    out: List[str] = []
+    starts: Dict[Tuple[str, int], int] = {}
+    waited_at: Dict[Tuple[str, int], int] = {}
+    attends: List[int] = []
+    dead: List[int] = []
+    tail: List[str] = []
+    chans = (PagedAttendEmitter.K_CHAN, PagedAttendEmitter.V_CHAN)
+    for pos, op in enumerate(ops):
+        if op[0] == "dma_start":
+            key = (op[1], op[2])
+            starts[key] = starts.get(key, 0) + 1
+        elif op[0] == "dma_wait":
+            waited_at.setdefault((op[1], op[2]), pos)
+        elif op[0] == "local":
+            name, args = op[1], op[2]
+            if name == "attend_tile":
+                i = args[0]
+                attends.append(i)
+                for chan in chans:
+                    if waited_at.get((chan, i)) is None:
+                        out.append(
+                            f"op {pos}: attend of page {i} before its "
+                            f"{chan} DMA was waited — reads an unlanded "
+                            "tile")
+            elif name == "dead_fill":
+                dead.append(args[0])
+            else:
+                tail.append(name)
+    if attends != list(range(n_live)):
+        out.append(f"live coverage broken: attends={attends}, want "
+                   f"pages 0..{n_live - 1} each exactly once, in order")
+    if dead != list(range(n_live, n_pages)):
+        out.append(f"dead slots mishandled: dead_fill={dead}, want "
+                   f"{list(range(n_live, n_pages))}")
+    for (chan, i), c in sorted(starts.items()):
+        if i >= n_live:
+            out.append(f"dead page {i} transferred on {chan} — the "
+                       "allocated-extent bytes the schedule exists to "
+                       "save")
+        elif c != 1:
+            out.append(f"{chan}[{i}] transferred {c} times — "
+                       "overlapping reads of one (page, offset) span")
+    for i in range(n_live):
+        for chan in chans:
+            if (chan, i) not in starts:
+                out.append(f"live page {i} never transferred on {chan} "
+                           "— a hole in the gathered span")
+    if tail != ["softmax", "pv"]:
+        out.append("epilogue must reduce the landed tiles exactly "
+                   f"once: got {tail}, want ['softmax', 'pv']")
     return out
 
 
@@ -1673,4 +1837,133 @@ class PairModel:
         # every action commutes with every other: tags are unique per
         # payload, sends never block, landings only enable — so the
         # first enabled action is always a singleton persistent set
+        return acts[0] if acts else None
+
+
+# ---------------------------------------------------------------------------
+# execution model 3: single-node async-DMA programs (the paged gather)
+# ---------------------------------------------------------------------------
+
+
+class GatherState:
+    """Mutable interleaving state of a GatherModel run — one program
+    counter plus the two async-DMA populations (issued-not-landed,
+    landed-not-waited)."""
+
+    __slots__ = ("pc", "flight", "landed", "trace")
+
+    def __init__(self) -> None:
+        self.pc = 0
+        self.flight: Set[Tuple[str, int]] = set()
+        self.landed: Set[Tuple[str, int]] = set()
+        self.trace: Optional[Tuple[Any, Any]] = None
+
+    def clone(self) -> "GatherState":
+        st = GatherState.__new__(GatherState)
+        st.pc = self.pc
+        st.flight = set(self.flight)
+        st.landed = set(self.landed)
+        st.trace = self.trace
+        return st
+
+    def key(self) -> Tuple[Any, ...]:
+        return (self.pc, frozenset(self.flight), frozenset(self.landed))
+
+
+class GatherModel:
+    """Small-step semantics of a single-node async-DMA program (the
+    paged gather-attend schedule): ``dma_start`` issues a transfer whose
+    completion is an ASYNCHRONOUS hardware event (a ``land`` action at
+    an arbitrary later scheduler step); ``dma_wait`` blocks until that
+    page's transfer has landed, then consumes its semaphore.  The
+    dynamic failure mode this model owns is semaphore-slot aliasing —
+    the semaphores cycle mod ``depth``, so a start whose slot still
+    holds an unconsumed (in-flight or landed-but-unwaited) transfer
+    would let the EARLIER completion satisfy the LATER wait: an
+    overlapping-slot read serving attend data that never landed.  The
+    static obligations (exact live-page coverage, per-node DMA
+    discipline) run first in `verify.mc._static_violations`."""
+
+    route = "gather"
+
+    def __init__(self, ops: Sequence[Op], depth: int,
+                 meta: Optional[Dict[str, Any]] = None) -> None:
+        self.ops = list(ops)
+        self.depth = depth
+        self.meta = dict(meta or {})
+
+    def _ctx(self) -> str:
+        return " ".join(f"{k}={v}" for k, v in self.meta.items())
+
+    def init_state(self) -> GatherState:
+        return GatherState()
+
+    def node_count(self) -> int:
+        return 1
+
+    def _runnable(self, st: GatherState) -> bool:
+        if st.pc >= len(self.ops):
+            return False
+        op = self.ops[st.pc]
+        if op[0] == "dma_wait":
+            return (op[1], op[2]) in st.landed
+        return True
+
+    def enabled(self, st: GatherState) -> List[Action]:
+        acts: List[Action] = [("node", 0)] if self._runnable(st) else []
+        acts.extend(("land", chan, i) for (chan, i) in sorted(st.flight))
+        return acts
+
+    def apply(self, st: GatherState, act: Action) -> None:
+        if act[0] == "land":
+            _, chan, i = act
+            st.trace = (act, st.trace)
+            st.flight.discard((chan, i))
+            st.landed.add((chan, i))
+            return
+        op = self.ops[st.pc]
+        st.trace = (("node", 0, op), st.trace)
+        if op[0] == "dma_start":
+            _, chan, i, _conf = op
+            slot = i % self.depth
+            clash = sorted((c, j) for (c, j) in (st.flight | st.landed)
+                           if c == chan and j % self.depth == slot)
+            if clash:
+                raise ProtocolError(
+                    "dma",
+                    f"overlapping-slot read: {chan}[{i}] starts into "
+                    f"semaphore slot {slot} while {clash[0][0]}"
+                    f"[{clash[0][1]}] is unconsumed there — its landing "
+                    f"would satisfy the wrong wait ({self._ctx()})")
+            st.flight.add((chan, i))
+        elif op[0] == "dma_wait":
+            st.landed.discard((op[1], op[2]))
+        st.pc += 1
+
+    def finished(self, st: GatherState) -> bool:
+        return (st.pc >= len(self.ops) and not st.flight
+                and not st.landed)
+
+    def check_terminal(self, st: GatherState) -> None:
+        # drain is part of `finished`; a started-never-waited stream can
+        # never terminate (its landed entry persists) and surfaces as
+        # the static exit-drain violation / a dynamic deadlock instead
+        return
+
+    def deadlock_message(self, st: GatherState) -> str:
+        nxt = self.ops[st.pc] if st.pc < len(self.ops) else None
+        return (f"protocol deadlock: {self._ctx()} pc={st.pc} next={nxt} "
+                f"in_flight={sorted(st.flight)} "
+                f"landed={sorted(st.landed)}")
+
+    def pick_action(self, st: GatherState,
+                    acts: Sequence[Action]) -> Optional[Action]:
+        # a landing only moves a transfer flight -> landed: the
+        # start-clash predicate reads the UNION of the two sets, so
+        # landings commute with every node step and with each other,
+        # and node steps are the only pc mutators.  The first enabled
+        # action is therefore always a singleton persistent set —
+        # violations included: a clashing start raises on apply in
+        # EVERY interleaving (the predicate is interleaving-invariant),
+        # so no schedule freedom is needed to witness it.
         return acts[0] if acts else None

@@ -1,0 +1,40 @@
+"""J10 bad fixture, tp-sharded flavor: a shard_map'd decode tick whose
+page table is a STATIC argument.
+
+This is the tempting-but-wrong way to write the sharded tick — "the
+table indexes the pool, indexing wants concrete pages, mark it static" —
+and it bakes the page assignment into the shard_map'd jaxpr: every page
+reassignment (each admit/evict/recycle transition) is a fresh trace.
+The counted-trace check must flag it; the real engine passes the table
+as an int32 OPERAND, so churn changes values only and the shard_map
+wrapper adds zero traces of its own."""
+
+
+def build():
+    def run():
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+        from jax.sharding import Mesh, PartitionSpec as P
+
+        from fpga_ai_nic_tpu.serve.engine import counted_jit
+
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+
+        def tick(pool, table):
+            # table is a python tuple here — a trace-time constant
+            def body(p):
+                return p[np.asarray(table, np.int32)].sum()
+            sm = jax.shard_map(body, mesh=mesh,
+                               in_specs=(P(None, "tp"),),
+                               out_specs=P(), check_vma=False)
+            return sm(pool)
+
+        step, traces = counted_jit(tick, static_argnums=(1,))
+        pool = jnp.zeros((5, 2, 4, 8), jnp.float32)
+        # the same churn the real schedule exercises: three distinct
+        # page assignments over a steady pool, each a recompile here
+        for table in ((0, 1), (2, 3), (0, 3)):
+            step(pool, table)
+        return {"decode": traces(), "_exercised": 1}
+    return run

@@ -572,6 +572,29 @@ class TestJ10ServeRecompile:
         assert "traced 3x" in fs[0].message
         assert "scheduler state" in fs[0].message
 
+    def test_tp_bad_fixture_fires_with_trace_count(self):
+        """The tp-sharded flavor: a shard_map'd tick whose page table is
+        a static argument retraces per page reassignment — the counted
+        discipline must reject it exactly like the unsharded case."""
+        import importlib.util
+        fixture = os.path.join(FIXTURES, "j10_tp_bad.py")
+        spec = importlib.util.spec_from_file_location("j10_tp_bad",
+                                                      fixture)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import check_serve_trace
+        fs = check_serve_trace("j10_tp_bad", mod.build)
+        assert fs and {f.code for f in fs} == {"J10"}
+        assert "traced 3x" in fs[0].message
+        assert "scheduler state" in fs[0].message
+
+    def test_tp_surface_listed(self):
+        """The tp-sharded engine tick is a first-class J10 surface, not
+        an optional extra."""
+        from fpga_ai_nic_tpu.lint.jaxpr_sweep import j10_surfaces
+        names = [n for n, _ in j10_surfaces()]
+        assert any("tp-sharded" in n for n in names), names
+
     def test_vacuous_schedule_is_a_finding(self):
         """A surface whose schedule exercised nothing must fail loudly,
         not pass an empty check."""

@@ -1,0 +1,372 @@
+"""Bitwise parity battery for the Pallas paged gather-attend kernel.
+
+`ops.paged_attend_pallas.paged_gather_attend` must be BITWISE equal to
+the reference `forward_paged` path (the gathered-view + `_cached_attend`
+oracle) on the same backend — not close, equal: the serving plane's
+determinism story (eviction replay, preemption recovery, the chaos SLO)
+is built on greedy argmax over exact logits, so an off-by-one-ulp kernel
+would silently fork token streams.  The battery runs the kernel in
+interpret mode on CPU against the reference over GQA/MHA head layouts,
+ragged page occupancy, dirty recycled pools, inactive null-page slots
+and tp-sharded (including kv-replicated) meshes; the one-definition DMA
+schedule it lowers is checked at the opstream layer (coverage + hazard
+discipline + the graftmc gather family) in the same file.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from fpga_ai_nic_tpu.models import llama
+from fpga_ai_nic_tpu.models import llama_decode as dec
+from fpga_ai_nic_tpu.ops import paged_attend_pallas as pa
+from fpga_ai_nic_tpu.verify import mc, opstream
+
+CFG = llama.LlamaConfig.tiny()
+DT = jnp.dtype(CFG.dtype)
+SMALL = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1, n_heads=4,
+                               n_kv_heads=2, ffn_dim=64)
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(17)
+
+
+def _table(rng, R, P_, n_pages):
+    """Unique random page assignment (never the null page)."""
+    pages = rng.permutation(np.arange(1, n_pages))[:R * P_]
+    assert pages.size == R * P_, "pool too small for a full table"
+    return pages.reshape(R, P_).astype(np.int32)
+
+
+def _kernel_vs_reference(rng, *, R, H, n_kv, T, hd, ps, PW, n_pages,
+                         poss, dirty=False):
+    """One direct kernel cell against `_cached_attend` over the gathered
+    view — the exact reference contraction `forward_paged` runs."""
+    q = jnp.asarray(rng.normal(size=(R, H, T, hd)), jnp.float32)
+    scale = 1e6 if dirty else 1.0
+    pk = jnp.asarray(rng.normal(size=(n_pages, n_kv, ps, hd)) * scale,
+                     jnp.float32)
+    pv = jnp.asarray(rng.normal(size=(n_pages, n_kv, ps, hd)) * scale,
+                     jnp.float32)
+    table = jnp.asarray(_table(rng, R, PW, n_pages))
+    pos = jnp.asarray(poss, jnp.int32)
+    ck = pk[table].transpose(0, 2, 1, 3, 4).reshape(R, n_kv, PW * ps, hd)
+    cv = pv[table].transpose(0, 2, 1, 3, 4).reshape(R, n_kv, PW * ps, hd)
+    want = dec._cached_attend(q, ck, cv, pos, H, n_kv, hd ** -0.5)
+    got = pa.paged_gather_attend(q, pk, pv, table, pos, page_size=ps)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+class TestKernelParity:
+    """Kernel (interpret) vs the gathered-view oracle, cell by cell."""
+
+    def test_gqa(self, rng):
+        _kernel_vs_reference(rng, R=2, H=4, n_kv=2, T=1, hd=8, ps=4,
+                             PW=3, n_pages=8, poss=[5, 0])
+
+    def test_mha_matvec_row(self, rng):
+        """MHA at T=1 is the G*T == 1 trap: a per-page score tiling
+        drifts by an ulp here (XLA lowers the matvec differently), which
+        is why the kernel contracts the full landed row at once."""
+        _kernel_vs_reference(rng, R=2, H=4, n_kv=4, T=1, hd=8, ps=4,
+                             PW=3, n_pages=8, poss=[11, 3])
+
+    def test_kv_single_head_full_span(self, rng):
+        _kernel_vs_reference(rng, R=3, H=4, n_kv=1, T=1, hd=8, ps=4,
+                             PW=4, n_pages=16, poss=[0, 7, 15])
+
+    def test_prefill_chunk(self, rng):
+        _kernel_vs_reference(rng, R=2, H=4, n_kv=2, T=4, hd=8, ps=4,
+                             PW=3, n_pages=8, poss=[4, 0])
+
+    def test_dirty_recycled_pool(self, rng):
+        """1e6-magnitude garbage beyond the mask: parity holds because
+        masked weights are EXACT +0, not because garbage is small."""
+        _kernel_vs_reference(rng, R=2, H=4, n_kv=2, T=1, hd=8, ps=4,
+                             PW=3, n_pages=8, poss=[5, 2], dirty=True)
+
+    def test_ragged_occupancy(self, rng):
+        """Live page counts 1..PW in one batch: each row's dead span is
+        skipped by the kernel and masked by the reference."""
+        _kernel_vs_reference(rng, R=4, H=4, n_kv=2, T=1, hd=8, ps=4,
+                             PW=4, n_pages=20, poss=[0, 4, 9, 15])
+
+    @pytest.mark.slow
+    def test_exhaustive_positions(self, rng):
+        """Every position of the table span, GQA and MHA."""
+        for n_kv in (2, 4):
+            for pos in range(12):
+                _kernel_vs_reference(rng, R=1, H=4, n_kv=n_kv, T=1,
+                                     hd=8, ps=4, PW=3, n_pages=5,
+                                     poss=[pos])
+
+
+class TestForwardPagedSeam:
+    """attend_impl= through the full model: reference is the oracle."""
+
+    def _run_both(self, rng, cfg, active=None):
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        R, T, PW, ps, n_pages = 3, 1, 3, 4, 16
+        dt = jnp.dtype(cfg.dtype)
+        shape = (n_pages, cfg.n_kv_heads, ps, cfg.head_dim)
+        pool = [{"k": jnp.asarray(rng.standard_normal(shape) * 1e6, dt),
+                 "v": jnp.asarray(rng.standard_normal(shape) * 1e6, dt)}
+                for _ in range(cfg.n_layers)]
+        table = jnp.asarray(_table(rng, R, PW, n_pages))
+        pos = jnp.asarray([5, 0, 9], jnp.int32)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (R, T)), jnp.int32)
+        outs = {}
+        for impl in ("reference", "pallas"):
+            lg, pl = dec.forward_paged(params, tokens, pool, table, pos,
+                                       cfg, page_size=ps, active=active,
+                                       attend_impl=impl)
+            outs[impl] = (lg, pl)
+        lg_r, pl_r = outs["reference"]
+        lg_p, pl_p = outs["pallas"]
+        np.testing.assert_array_equal(np.asarray(lg_p), np.asarray(lg_r))
+        for a, b in zip(pl_r, pl_p):
+            np.testing.assert_array_equal(np.asarray(a["k"]),
+                                          np.asarray(b["k"]))
+            np.testing.assert_array_equal(np.asarray(a["v"]),
+                                          np.asarray(b["v"]))
+
+    def test_bitwise_logits_and_pool_dirty(self, rng):
+        self._run_both(rng, CFG)
+
+    def test_inactive_null_page_slots(self, rng):
+        """Inactive slots aim at the null page and sit at pos 0 — both
+        impls must agree on them too (their logits are ignored, but the
+        POOL writes they gate are load-bearing)."""
+        self._run_both(rng, CFG,
+                       active=jnp.asarray([True, False, False]))
+
+    def test_rejects_unknown_impl(self, rng):
+        params = llama.init(jax.random.PRNGKey(0), SMALL)
+        shape = (4, SMALL.n_kv_heads, 4, SMALL.head_dim)
+        pool = [{"k": jnp.zeros(shape, jnp.float32),
+                 "v": jnp.zeros(shape, jnp.float32)}]
+        with pytest.raises(ValueError, match="attend_impl"):
+            dec.forward_paged(params, jnp.zeros((1, 1), jnp.int32), pool,
+                              jnp.zeros((1, 2), jnp.int32),
+                              jnp.zeros((1,), jnp.int32), SMALL,
+                              page_size=4, attend_impl="fast")
+
+
+class TestTpParity:
+    """tp-sharded cells: the kernel inside shard_map, against the
+    reference inside the SAME shard_map (same psum order both arms)."""
+
+    def _tp_cell(self, rng, tp):
+        cfg = SMALL
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        R, T, PW, ps, n_pages = 2, 1, 3, 4, 8
+        kvl = dec.kv_local_heads(cfg, tp)
+        table = jnp.asarray(_table(rng, R, PW, n_pages))
+        pos = jnp.asarray([5, 2], jnp.int32)
+        tokens = jnp.asarray(rng.integers(0, cfg.vocab, (R, T)),
+                             jnp.int32)
+        mesh = Mesh(np.asarray(jax.devices()[:tp]), ("tp",))
+        specs = llama.param_specs(cfg, tp_axis="tp", tp_size=tp)
+        dt = jnp.dtype(cfg.dtype)
+
+        def run(impl):
+            def body(p, t):
+                shape = (n_pages, kvl, ps, cfg.head_dim)
+                pool = [{"k": jnp.zeros(shape, dt),
+                         "v": jnp.zeros(shape, dt)}
+                        for _ in range(cfg.n_layers)]
+                lg, pool = dec.forward_paged(
+                    p, t, pool, table, pos, cfg, page_size=ps,
+                    tp_axis="tp", attend_impl=impl)
+                lg2, _ = dec.forward_paged(
+                    p, t, pool, table, pos + T, cfg, page_size=ps,
+                    tp_axis="tp", attend_impl=impl)
+                return jnp.stack([lg, lg2])
+            return jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(specs, P()), out_specs=P(),
+                check_vma=False))(params, tokens)
+
+        np.testing.assert_array_equal(np.asarray(run("pallas")),
+                                      np.asarray(run("reference")))
+
+    def test_tp2_divisible(self, rng):
+        self._tp_cell(rng, tp=2)
+
+    @pytest.mark.slow
+    def test_tp4_kv_replication(self, rng):
+        """tp=4 > n_kv=2: each rank pages a single replicated kv head —
+        the kernel's head-group mapping must match the kv_rep slice.
+        slow tier: tp=2 + the engine tick cover the sharded seam
+        in-gate; this buys the kv_rep corner its own shard_map compile."""
+        self._tp_cell(rng, tp=4)
+
+    def test_tp_engine_tick_tokens_and_traces(self, rng):
+        """The tp-sharded engine tick end to end: identical greedy token
+        streams vs the unsharded engine, and exactly one trace per
+        program across an admit/evict/recycle schedule (J10)."""
+        from fpga_ai_nic_tpu.serve import ServeConfig, ServeEngine
+        cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=1, ffn_dim=64)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+
+        def scfg(**kw):
+            return ServeConfig(max_reqs=3, page_size=4, n_pages=5,
+                               max_pages_per_seq=4, prefill_chunk=4,
+                               **kw)
+
+        prompts = [rng.integers(0, cfg.vocab, (n,)).astype(np.int32)
+                   for n in (5, 3, 7, 4)]
+
+        def serve(**kw):
+            eng = ServeEngine(params, cfg, scfg(page_integrity=False),
+                              **kw)
+            reqs = [eng.submit(p, 4) for p in prompts]
+            eng.run()
+            return [list(r.generated) for r in reqs], eng
+
+        want, _ = serve()
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        got, eng = serve(tp_mesh=mesh, attend_impl="pallas")
+        assert got == want
+        assert eng.batcher.evictions > 0, "schedule exercised no churn"
+        assert eng.trace_counts() == {"prefill": 1, "decode": 1}
+        assert eng.recompiles_steady() == 0
+
+    def test_tp_rejects_page_integrity(self):
+        from fpga_ai_nic_tpu.serve import ServeConfig, ServeEngine
+        cfg = llama.LlamaConfig.tiny(vocab=64, dim=32, n_layers=1,
+                                     n_heads=2, n_kv_heads=1, ffn_dim=64)
+        params = llama.init(jax.random.PRNGKey(0), cfg)
+        scfg = ServeConfig(max_reqs=3, page_size=4, n_pages=5,
+                           max_pages_per_seq=4, prefill_chunk=4,
+                           page_integrity=True)
+        mesh = Mesh(np.asarray(jax.devices()[:2]), ("tp",))
+        with pytest.raises(ValueError, match="page_integrity"):
+            ServeEngine(params, cfg, scfg, tp_mesh=mesh)
+
+
+class TestValidation:
+    """Hard, named errors — the flash_pallas Sk-check contract."""
+
+    def _args(self, ps=4, hd=8):
+        q = jnp.zeros((1, 2, 1, hd), jnp.float32)
+        pk = jnp.zeros((3, 2, ps, hd), jnp.float32)
+        table = jnp.zeros((1, 2), jnp.int32)
+        pos = jnp.zeros((1,), jnp.int32)
+        return q, pk, pk, table, pos
+
+    def test_hardware_requires_lane_tileable_page(self):
+        q, pk, pv, table, pos = self._args(ps=4, hd=128)
+        with pytest.raises(ValueError) as ei:
+            pa.paged_gather_attend(q, pk, pv, table, pos, page_size=4,
+                                   interpret=False)
+        msg = str(ei.value)
+        assert "page_size=4" in msg and "128" in msg
+        assert "attend_impl='reference'" in msg
+
+    def test_hardware_requires_lane_tileable_head_dim(self):
+        q, pk, pv, table, pos = self._args(ps=128, hd=8)
+        with pytest.raises(ValueError) as ei:
+            pa.paged_gather_attend(q, pk, pv, table, pos, page_size=128,
+                                   interpret=False)
+        assert "head_dim=8" in str(ei.value)
+
+    def test_supported_mirrors_the_check(self):
+        assert pa.supported(128, 128, interpret=False)
+        assert not pa.supported(8, 128, interpret=False)
+        assert not pa.supported(128, 96, interpret=False)
+        assert pa.supported(8, 96, interpret=True)
+
+    def test_rejects_non_int32_table(self):
+        q, pk, pv, _, pos = self._args()
+        with pytest.raises(ValueError, match="int32"):
+            pa.paged_gather_attend(q, pk, pv,
+                                   jnp.zeros((1, 2), jnp.int16), pos,
+                                   page_size=4)
+
+    def test_rejects_gqa_mismatch(self):
+        _, pk, pv, table, pos = self._args()
+        q = jnp.zeros((1, 3, 1, 8), jnp.float32)   # 3 % kv=2 != 0
+        with pytest.raises(ValueError, match="multiple"):
+            pa.paged_gather_attend(q, pk, pv, table, pos, page_size=4)
+
+    def test_rejects_pool_shape_mismatch(self):
+        q, pk, pv, table, pos = self._args()
+        with pytest.raises(ValueError, match="page_size"):
+            pa.paged_gather_attend(q, pk, pv, table, pos, page_size=8)
+
+
+class TestGatherOpstream:
+    """The one-definition DMA schedule at the checker layer: the same
+    emitter the kernel lowers must pass coverage + hazard discipline and
+    trip loudly under mutation (graftmc runs the full cell family)."""
+
+    def test_stream_green_small_cells(self):
+        for P_ in range(1, 5):
+            for nl in range(P_ + 1):
+                for d in (1, 2):
+                    ops = opstream.paged_attend_op_stream(P_, nl, d)
+                    assert opstream.check_dma_discipline(ops) == []
+                    assert opstream.check_gather_coverage(ops, P_,
+                                                          nl) == []
+
+    def test_dropped_wait_is_flagged(self):
+        ops = opstream.paged_attend_op_stream(4, 4, 2)
+        mut = [o for o in ops if o[:3] != ("dma_wait",
+                                           opstream.PagedAttendEmitter
+                                           .K_CHAN, 0)]
+        msgs = opstream.check_dma_discipline(mut)
+        assert any("hazard" in m or "never waited" in m for m in msgs)
+        cov = opstream.check_gather_coverage(mut, 4, 4)
+        assert any("before its" in m for m in cov)
+
+    def test_double_read_is_flagged(self):
+        ops = opstream.paged_attend_op_stream(3, 3, 2)
+        i = next(k for k, o in enumerate(ops) if o[0] == "local"
+                 and o[1] == "attend_tile")
+        mut = ops[:i + 1] + [ops[i]] + ops[i + 1:]
+        cov = opstream.check_gather_coverage(mut, 3, 3)
+        assert cov, "duplicated attend must break exactly-once coverage"
+
+    def test_dead_page_transfer_is_flagged(self):
+        ops = opstream.paged_attend_op_stream(4, 2, 2)
+        k = opstream.PagedAttendEmitter.K_CHAN
+        mut = list(ops) + [("dma_start", k, 3, ()), ("dma_wait", k, 3)]
+        cov = opstream.check_gather_coverage(mut, 4, 2)
+        assert any("dead" in m for m in cov)
+
+    def test_mc_gather_cell_green(self):
+        res, _ = mc.run_cell("gather", (5, 3, 2))
+        assert res.ok, res
+
+    def test_mc_flags_overlapping_slot_read(self):
+        """Hoist a start past the wait of its slot-sharing predecessor:
+        the model must catch the aliased semaphore slot dynamically."""
+        ops = opstream.paged_attend_op_stream(4, 4, 2)
+        k = opstream.PagedAttendEmitter.K_CHAN
+        i_start = next(j for j, o in enumerate(ops)
+                       if o[:3] == ("dma_start", k, 2))
+        i_wait = next(j for j, o in enumerate(ops)
+                      if o[:3] == ("dma_wait", k, 0))
+        assert i_wait < i_start
+        hoisted = ops[i_start]
+        mut = ops[:i_wait] + [hoisted] + [o for o in ops[i_wait:]
+                                          if o is not hoisted]
+        model = opstream.GatherModel(
+            mut, 2, meta={"route": "gather", "P": 4, "n_live": 4,
+                          "depth": 2})
+        res = mc.check(model, por=True)
+        assert not res.ok
+        assert res.violation.kind == "dma"
+        assert "overlapping-slot read" in res.violation.message
+
+    @pytest.mark.slow
+    def test_mc_gather_family_exhaustive(self):
+        for cell in mc.gather_cells():
+            res, _ = mc.run_cell("gather", cell)
+            assert res.ok, (cell, res)

@@ -613,7 +613,8 @@ class TestExhaustive:
         assert findings == [], [f.format() for f in findings]
         assert stats.cells >= 900
         assert {r.route for r in stats.routes} == {
-            "flat", "streaming", "ag", "hier", "reshard", "handoff"}
+            "flat", "streaming", "ag", "hier", "reshard", "handoff",
+            "gather"}
         for cmp in stats.compare:
             assert cmp["agree"] and cmp["reduction"] >= 5.0
         rec = mc.envelope_record(stats)
@@ -847,7 +848,7 @@ class TestMakeModelcheckExitCodes:
         assert "cells exhaustive" in proc.stdout
         assert "POR reduction" in proc.stdout
         for route in ("flat", "streaming", "ag", "hier", "reshard",
-                      "handoff"):
+                      "handoff", "gather"):
             assert f"route {route}:" in proc.stdout
 
     def _fixture_fails(self, name, needle, env_extra=None):
@@ -906,7 +907,7 @@ class TestMakeModelcheckExitCodes:
             d = json.load(fh)
         routes = {r["route"] for r in d["routes"]}
         assert routes == {"flat", "streaming", "ag", "hier", "reshard",
-                          "handoff"}
+                          "handoff", "gather"}
         for r in d["routes"]:
             assert r["cells"] > 0 and r["states"] > 0
         assert d["failures"] == 0 and d["ok"]

@@ -711,13 +711,15 @@ def main():
                       "gates only the exact byte accounting and "
                       "`recompiles_steady == 0`; the latency verdict "
                       "needs a TPU surface.", ""]
-            L += ["| slots | tok/s | TTFT p95 s | TPOT mean s "
+            L += ["| slots | attend | tok/s | TTFT p95 s | TPOT mean s "
                   "| latency p95 s | peak pages | util | evict "
                   "| recompiles | pool vs init_cache |",
-                  "|---|---|---|---|---|---|---|---|---|---|"]
+                  "|---|---|---|---|---|---|---|---|---|---|---|"]
             for r in rows:
                 L.append(
-                    f"| {r['max_reqs']} | {r.get('throughput_tok_s')} "
+                    f"| {r['max_reqs']} "
+                    f"| {r.get('attend_impl', 'reference')} "
+                    f"| {r.get('throughput_tok_s')} "
                     f"| {r.get('ttft_p95_s')} | {r.get('tpot_mean_s')} "
                     f"| {r.get('latency_p95_s')} "
                     f"| {r.get('pages_in_use_peak')} "
@@ -726,6 +728,56 @@ def main():
                     f"| {r.get('recompiles_steady')} "
                     f"| {r.get('hbm_vs_contiguous')}x |")
             L.append("")
+            if any(r.get("decode_roofline") for r in rows):
+                L += ["### Decode roofline (modeled bytes/token)", "",
+                      "Modeled per-decode-step HBM traffic "
+                      "(`serve_bench.decode_roofline` — deterministic "
+                      "over the seeded trace, gated exact two-sided as "
+                      "`serve.attend.*`): every step re-reads the "
+                      "weights once and each active slot re-reads its "
+                      "K/V across all layers.  The `reference` impl's "
+                      "gathered view spans the ALLOCATED table width; "
+                      "the `pallas` paged gather-attend kernel "
+                      "(`ops/paged_attend_pallas.py`) DMAs only LIVE "
+                      "pages, so its KV term follows the trace's mean "
+                      "live extent.  `hbm_bound_frac` = KV bytes / (KV "
+                      "+ weight bytes): the slice of the HBM floor the "
+                      "kernel axis shrinks.", "",
+                      "| slots | attend | bytes/token | KV bytes/step "
+                      "| hbm_bound_frac | TPOT HBM floor s |",
+                      "|---|---|---|---|---|---|"]
+                for r in rows:
+                    rl = r.get("decode_roofline") or {}
+                    if not rl:
+                        continue
+                    L.append(
+                        f"| {r['max_reqs']} "
+                        f"| {r.get('attend_impl', 'reference')} "
+                        f"| {rl.get('bytes_per_token'):,} "
+                        f"| {rl.get('kv_bytes_per_step'):,} "
+                        f"| {rl.get('hbm_bound_frac')} "
+                        f"| {rl.get('tpot_hbm_floor_s')} |")
+                L.append("")
+                att = d.get("attend") or {}
+                if att:
+                    L += [f"At concurrency {att.get('max_reqs')} the "
+                          "paged kernel's modeled bytes/token drop "
+                          f"**{att.get('bytes_per_token_reduction')}x** "
+                          "vs the gathered view "
+                          f"({att.get('reference_bytes_per_token'):,} "
+                          "-> "
+                          f"{att.get('pallas_bytes_per_token'):,} B; "
+                          "KV step bytes "
+                          f"{att.get('kv_bytes_per_step_reduction')}x "
+                          "smaller), taking modeled `hbm_bound_frac` "
+                          f"from {att.get('reference_hbm_bound_frac')} "
+                          f"to {att.get('pallas_hbm_bound_frac')} "
+                          f"against {att.get('hbm_peak_label')}.  Both "
+                          "impls are token-exact on every row — the "
+                          "kernel is bitwise-parity-gated "
+                          "(tests/test_paged_attend.py), so the curve "
+                          "is one serving plane with two byte "
+                          "profiles.", ""]
             cmp_ = d.get("init_cache_comparison") or {}
             if cmp_:
                 L += ["**The up-front `init_cache` HBM cost, measured**: "

@@ -101,6 +101,22 @@ SERVE_BYTE_KEYS = ("pool_bytes", "page_table_bytes",
                    "contiguous_cache_bytes", "recompiles_steady")
 TOL_SERVE_TIME = 0.40
 
+# the serve bench's kernel axis (artifact ``attend`` block): the modeled
+# decode roofline of the gathered-view reference vs the Pallas paged
+# kernel at the curve's top concurrency.  All MODELED numbers —
+# deterministic functions of the workload + ServeConfig + model shape —
+# so they gate exact two-sided like the byte accounting: any drift
+# means the roofline model, the workload or the pool geometry changed,
+# never noise.  Rows carry ``attend_impl``; non-reference rows gate
+# under ``serve.c{n}.{impl}.{key}`` so the kernel axis never collides
+# with the reference curve's baseline names.
+SERVE_ATTEND_KEYS = ("reference_bytes_per_token",
+                     "pallas_bytes_per_token",
+                     "bytes_per_token_reduction",
+                     "reference_hbm_bound_frac",
+                     "pallas_hbm_bound_frac",
+                     "kv_bytes_per_step_reduction")
+
 # fleet rows (FLEET_BENCH_r*.json, one per scenario): the handoff wire
 # accounting and the recovery-tier facts are exact two-sided — the
 # banked zeros for fleet_replays / serve_recoveries mean ANY replay or
@@ -370,6 +386,8 @@ def build_banked_summary() -> dict:
         keys = (SERVE_BYTE_KEYS if d.get("dryrun")
                 else SERVE_BYTE_KEYS + SERVE_GATE_KEYS)
         for row in d.get("rows", []):
+            impl = row.get("attend_impl", "reference")
+            prefix = "" if impl == "reference" else f"{impl}."
             for key in keys:
                 v = row.get(key)
                 if v is None:
@@ -380,7 +398,15 @@ def build_banked_summary() -> dict:
                     m = _metric(v, src, tol=TOL_SERVE_TIME)
                 else:
                     m = _metric(v, src, higher=False, tol=TOL_SERVE_TIME)
-                metrics[serve_metric(row["max_reqs"], key)] = m
+                metrics[serve_metric(row["max_reqs"], prefix + key)] = m
+        att = d.get("attend")
+        if att:
+            for key in SERVE_ATTEND_KEYS:
+                v = att.get(key)
+                if v is None:
+                    continue
+                metrics[f"serve.attend.{key}"] = _metric(
+                    v, src, tol=TOL_EXACT, two_sided=True)
 
     # -- fleet (replica-kill / disaggregation) --------------------------------
     p = (_newest("artifacts/fleet_bench_*.json")

@@ -19,6 +19,12 @@ latency CURVE a serving SLO is negotiated on.  Per row the bench banks:
   - token-exactness: every request's greedy continuation equals the
     isolated `generate()` reference (the correctness floor under
     batching/eviction)
+  - the KERNEL AXIS: every concurrency point runs under both
+    ``attend_impl`` values (gathered-view reference and the Pallas
+    paged gather-attend kernel), each row carrying its MODELED decode
+    roofline (bytes/token, hbm_bound_frac, TPOT HBM floor — see
+    `decode_roofline`); the artifact's ``attend`` block summarizes the
+    modeled bytes/token reduction at the top concurrency
 
 CPU rows are dryrun-class: latencies carry oversubscription noise, so
 `make obs-gate` holds dryrun artifacts only to the exact byte accounting
@@ -39,7 +45,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 sys.path.insert(0, REPO)
 
-from bench_common import cpu_env, is_tpu_platform, log, save_artifact  # noqa: E402
+from bench_common import (cpu_env, hbm_peak, is_tpu_platform, log,  # noqa: E402
+                          save_artifact)
 
 # CPU-mesh battery: re-exec once with the virtual CPU environment before
 # jax is imported (same discipline as chaos_bench — the container's
@@ -91,7 +98,53 @@ def _reference(params, prompts, max_new=MAX_NEW):
     return out
 
 
-def run_row(params, prompts, ref, max_reqs: int) -> dict:
+def decode_roofline(attend_impl: str, max_reqs: int, prompts) -> dict:
+    """MODELED decode-step HBM traffic — deterministic, computed from
+    the workload's schedule, never measured (CPU rows cannot measure
+    HBM; the model is what obs-gate pins exactly and PERF.md reports).
+
+    Model: each decode step re-reads the weights once and every active
+    slot re-reads its K+V across all layers.  The impls differ ONLY in
+    the per-slot KV extent:
+
+      reference — the gathered ``[R, kv, P*page_size, hd]`` view spans
+        the ALLOCATED table width (max_pages_per_seq pages) regardless
+        of how much KV is live; the gather builds + reads it per layer.
+      pallas    — the kernel DMAs only LIVE pages: ceil(ctx/page_size)
+        pages at context length ctx, averaged exactly over every decode
+        position of the seeded trace (all slots assumed occupied — the
+        saturated-curve model).
+
+    ``hbm_bound_frac`` = kv_bytes_per_step / (kv + weight bytes): the
+    fraction of the step's HBM floor that is KV traffic — the part the
+    kernel axis shrinks.  ``tpot_hbm_floor_s`` divides the step bytes by
+    `bench_common.hbm_peak` (PALLAS_AXON_TPU_GEN; v5e default)."""
+    dt = jnp.dtype(CFG.dtype).itemsize
+    per_pos = 2 * CFG.n_kv_heads * CFG.head_dim * dt * CFG.n_layers
+    spans = []
+    for p in prompts:
+        for t in range(1, MAX_NEW + 1):
+            ctx = int(len(p)) + t
+            spans.append(-(-ctx // PAGE_SIZE) * PAGE_SIZE)
+    live_mean = float(np.mean(spans))
+    alloc = PAGES_PER_SEQ * PAGE_SIZE
+    slot_pos = alloc if attend_impl == "reference" else live_mean
+    kv_step = max_reqs * slot_pos * per_pos
+    weight = llama.num_params(CFG) * dt
+    step = kv_step + weight
+    peak, label = hbm_peak()
+    return {
+        "kv_bytes_per_step": int(round(kv_step)),
+        "weight_read_bytes": int(weight),
+        "bytes_per_token": int(round(step / max_reqs)),
+        "hbm_bound_frac": round(kv_step / step, 4),
+        "tpot_hbm_floor_s": round(step / peak, 9),
+        "hbm_peak_label": label,
+    }
+
+
+def run_row(params, prompts, ref, max_reqs: int,
+            attend_impl: str = "reference") -> dict:
     t0 = time.time()
     # pool sized to the WORKING SET (see POOL_PAGES_PER_SLOT), not the
     # addressable worst case init_cache must provision
@@ -99,13 +152,16 @@ def run_row(params, prompts, ref, max_reqs: int) -> dict:
     scfg = ServeConfig(max_reqs=max_reqs, page_size=PAGE_SIZE,
                        n_pages=n_pages, max_pages_per_seq=PAGES_PER_SEQ,
                        prefill_chunk=PAGE_SIZE)
-    eng = ServeEngine(params, CFG, scfg)
+    eng = ServeEngine(params, CFG, scfg, attend_impl=attend_impl)
     reqs = [eng.submit(p, max_new=MAX_NEW) for p in prompts]
     s = eng.run()
     exact = all(q.generated == want for q, want in zip(reqs, ref))
     r = s["requests"]
     row = {
         "max_reqs": max_reqs,
+        "attend_impl": attend_impl,
+        "decode_roofline": decode_roofline(attend_impl, max_reqs,
+                                           prompts),
         "n_requests": len(prompts),
         "steps_total": s["ticks"],
         "throughput_tok_s": s["throughput_tok_s"],
@@ -300,13 +356,20 @@ def main() -> int:
 
     rows = []
     for c in CONCURRENCIES:
-        row = run_row(params, prompts, ref, c)
-        log(f"row max_reqs={c}: {row['throughput_tok_s']} tok/s "
-            f"ttft_p95={row['ttft_p95_s']}s evict={row['evictions']} "
-            f"recompiles={row['recompiles_steady']} "
-            f"hbm x{row['hbm_vs_contiguous']} "
-            f"{'ok' if row['ok'] else 'FAILED'} ({row['wall_s']}s)")
-        rows.append(row)
+        # the kernel axis: the same curve point under both attend impls
+        # — token-exactness pins the kernel to the reference on every
+        # row, and the modeled roofline quantifies the bytes story
+        for impl in ("reference", "pallas"):
+            row = run_row(params, prompts, ref, c, attend_impl=impl)
+            rl = row["decode_roofline"]
+            log(f"row max_reqs={c} attend={impl}: "
+                f"{row['throughput_tok_s']} tok/s "
+                f"ttft_p95={row['ttft_p95_s']}s evict={row['evictions']} "
+                f"recompiles={row['recompiles_steady']} "
+                f"B/tok={rl['bytes_per_token']} "
+                f"hbm_frac={rl['hbm_bound_frac']} "
+                f"{'ok' if row['ok'] else 'FAILED'} ({row['wall_s']}s)")
+            rows.append(row)
 
     top = rows[len(rows) - 1]
     result = {
@@ -336,6 +399,30 @@ def main() -> int:
             "savings_ratio": top["hbm_vs_contiguous"],
         },
         "ok": all(r["ok"] for r in rows),
+    }
+    # the kernel axis at the curve's top concurrency: the modeled
+    # decode roofline of the gathered view vs the paged kernel — the
+    # numbers obs-gate pins exactly (serve.attend.*) and docs/PERF.md's
+    # decode roofline table reports
+    by = {(r["max_reqs"], r["attend_impl"]): r["decode_roofline"]
+          for r in rows}
+    c_top = CONCURRENCIES[len(CONCURRENCIES) - 1]
+    rl_ref = by[(c_top, "reference")]
+    rl_pal = by[(c_top, "pallas")]
+    result["attend"] = {
+        "modeled": True,
+        "max_reqs": c_top,
+        "page_size": PAGE_SIZE,
+        "hbm_peak_label": rl_ref["hbm_peak_label"],
+        "reference_bytes_per_token": rl_ref["bytes_per_token"],
+        "pallas_bytes_per_token": rl_pal["bytes_per_token"],
+        "bytes_per_token_reduction": round(
+            rl_ref["bytes_per_token"] / rl_pal["bytes_per_token"], 3),
+        "reference_hbm_bound_frac": rl_ref["hbm_bound_frac"],
+        "pallas_hbm_bound_frac": rl_pal["hbm_bound_frac"],
+        "kv_bytes_per_step_reduction": round(
+            rl_ref["kv_bytes_per_step"] / rl_pal["kv_bytes_per_step"],
+            3),
     }
     if args.out:
         with open(args.out, "w") as f:
