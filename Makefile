@@ -1,5 +1,5 @@
 # Top-level targets mirroring CI (.github/workflows/ci.yml).
-.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench adapt-bench ckpt-bench obs-gate lint lint-fixtures modelcheck
+.PHONY: ci test codec bench collective perf multichip-bench multichip-dryrun chaos-bench codec-bench fused-opt-bench reshard-bench tune-bench serve-bench fleet-bench integrity-bench slo-bench adapt-bench ckpt-bench obs-gate lint lint-fixtures modelcheck
 
 codec:
 	$(MAKE) -C fpga_ai_nic_tpu/csrc
@@ -162,6 +162,13 @@ fleet-bench:
 	@latest=$$(ls -t artifacts/fleet_bench_*.json 2>/dev/null | head -1); \
 	  cp $$latest FLEET_BENCH_$(ROUND).json; \
 	  echo "saved $$latest -> FLEET_BENCH_$(ROUND).json"
+
+# SLO observatory bench (docs/OBSERVABILITY.md "The serving SLO
+# observatory"): alias of the fleet bench — the same artifact carries
+# the per-scenario `slo` blocks (windowed tick-domain percentiles,
+# autoscaler decision ledger) obs-gate pins exactly as fleet.slo.* keys
+# on ANY surface, dryrun included
+slo-bench: fleet-bench
 
 # wire-integrity bench (docs/CHAOS.md "Exact wire integrity"): checksum
 # on/off overhead per ppermute-bearing route (flat/hier rings per codec,

@@ -24,7 +24,9 @@ docs/OBSERVABILITY.md.
 from .events import SCHEMA_VERSION, EventStream, read_jsonl  # noqa: F401
 from .metrics import (MetricsSink, active_sink, host_observe,  # noqa: F401
                       tap, use_sink)
+from .slo import SloAggregator, SloWindow  # noqa: F401
 from . import timeline  # noqa: F401
 
 __all__ = ["SCHEMA_VERSION", "EventStream", "read_jsonl", "MetricsSink",
-           "active_sink", "host_observe", "tap", "use_sink", "timeline"]
+           "active_sink", "host_observe", "tap", "use_sink", "timeline",
+           "SloAggregator", "SloWindow"]

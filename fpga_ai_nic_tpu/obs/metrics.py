@@ -339,7 +339,8 @@ class RequestSpans:
                        "queue_wait_s": round(vals["queue_wait_s"], 6)})
 
     def summary(self) -> Dict[str, Any]:
-        """mean / p50 / p95 per series + completion/drop accounting.
+        """mean / p50 / p95 / p99 per series + completion/drop
+        accounting.
         An empty series reports not-a-number stats WITH an explicit
         ``<series>_empty: True`` flag — "no samples" must read as no
         samples, never as a silently absent (or zero) latency row.  The
@@ -359,8 +360,10 @@ class RequestSpans:
                 out[f"{base}_mean_s"] = None
                 out[f"{base}_p50_s"] = None
                 out[f"{base}_p95_s"] = None
+                out[f"{base}_p99_s"] = None
                 continue
             out[f"{base}_mean_s"] = round(sum(vals) / len(vals), 6)
             out[f"{base}_p50_s"] = round(percentile(vals, 50.0), 6)
             out[f"{base}_p95_s"] = round(percentile(vals, 95.0), 6)
+            out[f"{base}_p99_s"] = round(percentile(vals, 99.0), 6)
         return out

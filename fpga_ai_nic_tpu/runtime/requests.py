@@ -48,6 +48,7 @@ class Request:
     max_new: int
     eos_id: Optional[int] = None
     not_before_s: float = 0.0        # arrival offset (offered-load shaping)
+    tenant: Optional[str] = None     # traffic-mix label (telemetry only)
 
     # -- scheduler state (owned by serve.scheduler.ContinuousBatcher) -------
     state: str = WAITING
@@ -63,6 +64,15 @@ class Request:
     t_admit: float = float("nan")    # FIRST admission (queue wait endpoint)
     t_first: float = float("nan")    # first NEW token (TTFT endpoint)
     t_done: float = float("nan")
+
+    # -- tick-domain milestones (fleet ticks; -1 = not yet) -----------------
+    # wall clocks above are machine-dependent; the SLO observatory's
+    # windowed latency series use THESE, so a seeded fleet run banks
+    # bit-identical percentiles on CPU dryrun and TPU alike
+    submit_tick: int = -1
+    admit_tick: int = -1
+    first_tick: int = -1
+    done_tick: int = -1
 
     @property
     def prompt_len(self) -> int:

@@ -10,12 +10,19 @@ decode path (docs/SERVING.md).
     serving state)
   - `fleet` — the elastic fleet: disaggregated prefill/decode replicas,
     replica-kill recovery by KV handoff instead of replay
+  - `traffic` — deterministic replayable workload generator (diurnal
+    cycles, bursts, heavy-tailed lengths, tenant mixes on a
+    counter-based PRNG)
+  - `autoscale` — the closed-loop controller: windowed SLO metrics
+    (obs.slo) -> CUSUM/hysteresis -> gated fleet actions (scale out/in,
+    role rebalance, admission shedding)
 
 The device-side paged forward itself lives with the model
 (`models.llama_decode.forward_paged`), bit-parity-pinned against the
 contiguous cache.
 """
 
+from .autoscale import AutoscaleConfig, Autoscaler, ScaleDecision
 from .engine import ServeEngine, counted_jit
 from .fleet import FleetConfig, Replica, ServeFleet
 from .handoff import HandoffPlan, apply_handoff
@@ -23,6 +30,9 @@ from .paged import (NULL_PAGE, PageAllocator, ServeConfig,
                     contiguous_cache_bytes, init_pool, page_table_bytes,
                     pool_bytes)
 from .scheduler import ContinuousBatcher
+from .traffic import (TrafficConfig, TrafficRequest, Workload,
+                      diurnal_config, generate, spike_config,
+                      steady_config, thundering_herd_config)
 
 __all__ = [
     "ServeEngine", "counted_jit",
@@ -31,4 +41,8 @@ __all__ = [
     "ContinuousBatcher",
     "FleetConfig", "Replica", "ServeFleet",
     "HandoffPlan", "apply_handoff",
+    "AutoscaleConfig", "Autoscaler", "ScaleDecision",
+    "TrafficConfig", "TrafficRequest", "Workload", "generate",
+    "steady_config", "spike_config", "diurnal_config",
+    "thundering_herd_config",
 ]

@@ -373,6 +373,13 @@ class ServeEngine:
             self.ledger = out["ledger"]
         self._consec_failures = 0
         self._apply(pre, dec, out)
+        # pool pressure as a TIME SERIES, not just the peak scalar the
+        # summary keeps: one counter event per tick, so the Perfetto
+        # timeline (and any window over the stream) shows pages_in_use
+        # rising toward the watermark instead of a single max
+        self.profiler.events.counter("serve.pages_in_use",
+                                     self.alloc.in_use,
+                                     replica=self.replica_id)
         self.ticks += 1
         return True
 

@@ -58,6 +58,7 @@ import numpy as np
 
 from ..models.llama import LlamaConfig
 from ..obs.metrics import RequestSpans
+from ..obs.slo import SloAggregator
 from ..runtime import chaos as chaos_lib
 from ..runtime.requests import DECODE, FINISHED, PREFILL, Request
 from ..utils.observability import Profiler
@@ -127,12 +128,17 @@ class ServeFleet:
         self.chaos = chaos
         if chaos is not None and chaos.events is None:
             chaos.events = self.profiler.events
-        devices = list(devices if devices is not None
-                       else jax.devices()[:self.fcfg.n_replicas])
+        # the FULL device list is retained: devices beyond n_replicas are
+        # spares the autoscaler's scale-out claims via `add_replica`
+        # (default: every jax device, so an 8-device mesh gives a
+        # 3-replica fleet 5 spare slots for free)
+        devices = list(devices if devices is not None else jax.devices())
         if len(devices) < self.fcfg.n_replicas:
             raise ValueError(
                 f"fleet needs {self.fcfg.n_replicas} devices, have "
                 f"{len(devices)}")
+        self._params = params
+        self._spare_devices: List[Any] = devices[self.fcfg.n_replicas:]
         self.replicas: List[Replica] = []
         for i in range(self.fcfg.n_replicas):
             role = "prefill" if i < self.fcfg.n_prefill else "decode"
@@ -147,6 +153,14 @@ class ServeFleet:
         self._t0 = time.perf_counter()
         self.ticks = 0
         self._wall_s = 0.0
+        # live SLO observatory: windowed tick-domain latency series +
+        # per-tick pressure gauges, mirrored onto the event stream
+        self.slo = SloAggregator(events=self.profiler.events)
+        # the autoscaler's admission valve: True defers arrival routing
+        # (requests stay queued host-side — deferred, never dropped)
+        self.hold_admissions = False
+        self.grows = 0
+        self.role_changes = 0
         self.handoffs = 0
         self.handoff_wire_bytes = 0
         self.handoff_host_bytes = 0
@@ -170,21 +184,29 @@ class ServeFleet:
 
     def submit(self, prompt: np.ndarray, max_new: int, *,
                eos_id: Optional[int] = None,
-               not_before_s: float = 0.0) -> Request:
+               not_before_s: float = 0.0,
+               tenant: Optional[str] = None) -> Request:
         """Validate against the shared static budget, then queue for the
-        fleet router (arrival shaping as in `runtime.requests`)."""
+        fleet router (arrival shaping as in `runtime.requests`).  The
+        submit is also tick-stamped: the SLO observatory's latency
+        series live in the fleet-tick domain, where a seeded run is
+        machine-independent."""
         p = np.asarray(prompt, np.int32).reshape(-1)
         self.replicas[0].engine.batcher.validate_shape(int(p.shape[0]),
                                                        int(max_new))
         self._uid += 1
         req = Request(uid=self._uid, prompt=p, max_new=int(max_new),
                       eos_id=eos_id, not_before_s=float(not_before_s),
-                      t_submit=time.perf_counter())
+                      t_submit=time.perf_counter(), tenant=tenant,
+                      submit_tick=self.ticks)
         self._arrivals.append(req)
         self.requests.append(req)
-        self.profiler.events.instant("fleet.submit", uid=req.uid,
-                                     prompt_len=req.prompt_len,
-                                     max_new=req.max_new)
+        attrs: Dict[str, Any] = {"uid": req.uid,
+                                 "prompt_len": req.prompt_len,
+                                 "max_new": req.max_new}
+        if tenant is not None:
+            attrs["tenant"] = tenant
+        self.profiler.events.instant("fleet.submit", **attrs)
         return req
 
     def _pop_arrived(self) -> List[Request]:
@@ -395,7 +417,117 @@ class ServeFleet:
                     "fleet.promote", replica=survivor.idx,
                     lost_role=role)
 
+    # -- membership growth + role rebalance (the autoscaler's levers) --------
+
+    @property
+    def spare_devices(self) -> int:
+        return len(self._spare_devices)
+
+    def add_replica(self, role: str = "decode") -> Optional[Replica]:
+        """Scale-out: a spare device joins the fleet as a fresh replica.
+        Returns None when no spare is left (the caller falls back to
+        rebalance).  The new engine's two programs trace lazily on first
+        use — exactly one trace each, so ``recompiles_steady`` (which
+        counts traces BEYOND the first) stays 0 across a scale event:
+        the no-flapping evidence the bench banks."""
+        if not self._spare_devices:
+            return None
+        device = self._spare_devices.pop(0)
+        idx = len(self.replicas)
+        eng = ServeEngine(self._params, self.cfg, self.scfg,
+                          profiler=self.profiler, dtype=self.dtype,
+                          device=device, replica_id=idx, role=role)
+        rep = Replica(idx=idx, engine=eng, device=device)
+        self.replicas.append(rep)
+        self.grows += 1
+        self.profiler.events.instant(
+            "fleet.membership", tick=self.ticks, joined=idx, role=role,
+            survivors=[r.idx for r in self._alive()])
+        return rep
+
+    def set_role(self, idx: int, role: str) -> None:
+        """Role rebalance (e.g. a surplus prefill worker promoted to
+        role='both' when the decode pool is the bottleneck and no spare
+        device remains).  Same bounded one-off trace note as
+        `add_replica`: the newly-exercised program traces once."""
+        rep = self.replicas[idx]
+        assert rep.alive, f"replica {idx} is dead"
+        if rep.engine.role == role:
+            return
+        old = rep.engine.role
+        rep.engine.role = role
+        self.role_changes += 1
+        self.profiler.events.instant("fleet.rebalance", tick=self.ticks,
+                                     replica=idx, from_role=old,
+                                     to_role=role)
+
+    def load_signals(self) -> Dict[str, float]:
+        """The autoscaler's per-tick signal read — every value is a
+        deterministic function of the tick-domain schedule (no wall
+        clocks), so a seeded run produces the same signal sequence, and
+        the same decision sequence, on any machine."""
+        alive = self._alive()
+        waiting = sum(len(r.engine.batcher.waiting) for r in alive)
+        queue_depth = waiting + len(self._arrivals)
+        usable = max(1, len(alive)) * self.scfg.usable_pages
+        free = sum(r.engine.alloc.free for r in alive)
+        in_use = sum(r.engine.alloc.in_use for r in alive)
+        live = sum(len(r.engine.batcher.live) for r in alive)
+        pure_prefill = [r for r in alive if r.role == "prefill"]
+        pure_decode = [r for r in alive if r.role == "decode"]
+        rebalance = min(pure_prefill, key=lambda r: (r.load(), r.idx),
+                        default=None)
+        scale_in = min(pure_decode, key=lambda r: (r.load(), r.idx),
+                       default=None)
+        return {
+            "queue_depth": float(queue_depth),
+            "live": float(live),
+            "n_alive": float(len(alive)),
+            "n_prefill": float(len(self._alive("prefill"))),
+            "n_decode": float(len(self._alive("decode"))),
+            "n_prefill_pure": float(len(pure_prefill)),
+            "n_decode_pure": float(len(pure_decode)),
+            "rebalance_idx": float(rebalance.idx
+                                   if rebalance is not None else -1),
+            "scale_in_idx": float(scale_in.idx
+                                  if scale_in is not None else -1),
+            "pages_in_use": float(in_use),
+            "free_pages": float(free),
+            "free_frac": float(free) / usable,
+            "spare_devices": float(self.spare_devices),
+        }
+
     # -- the drive loop ------------------------------------------------------
+
+    def _observe_slo(self) -> None:
+        """End-of-tick observatory feed: stamp tick-domain request
+        milestones (admit / first token / done are detected by state,
+        so the stamp lands on the tick the transition happened) and push
+        the windows + pressure gauges.  O(n_requests) per tick — the
+        fleet drive loop is host-side and n is bench-scale."""
+        for r in self.requests:
+            if r.admit_tick < 0 and not math.isnan(r.t_admit):
+                r.admit_tick = self.ticks
+                self.slo.observe("queue_wait",
+                                 float(r.admit_tick - r.submit_tick))
+            if r.first_tick < 0 and r.generated:
+                r.first_tick = self.ticks
+                self.slo.observe("ttft",
+                                 float(r.first_tick - r.submit_tick))
+            if r.done_tick < 0 and r.state == FINISHED:
+                r.done_tick = self.ticks
+                n = len(r.generated)
+                self.slo.observe("tpot",
+                                 (r.done_tick - r.first_tick) / (n - 1)
+                                 if n > 1 else 0.0)
+        sig = self.load_signals()
+        self.slo.gauge("queue_depth", sig["queue_depth"])
+        self.slo.gauge("pages_in_use", sig["pages_in_use"])
+        self.slo.gauge("free_pages", sig["free_pages"])
+        for rep in self._alive():
+            self.slo.gauge("batch_occupancy",
+                           len(rep.engine.batcher.live)
+                           / self.scfg.max_reqs, replica=rep.idx)
 
     def tick(self) -> bool:
         """One fleet tick: membership chaos, routing, prefill->decode
@@ -414,8 +546,12 @@ class ServeFleet:
                 self.profiler.events.instant(
                     "fleet.membership_error", tick=self.ticks,
                     error=repr(err)[:120])
-        for req in self._pop_arrived():
-            self._route_to_prefill(req)
+        if not self.hold_admissions:
+            # the autoscaler's shed valve: while held, arrivals stay in
+            # the host-side queue (deferred, never dropped) and the pool
+            # drains toward the resume watermark
+            for req in self._pop_arrived():
+                self._route_to_prefill(req)
         # completed prefills hand off BEFORE the next engine tick, so a
         # prefill-role replica never decodes
         for rep in list(self._alive("prefill")):
@@ -436,6 +572,7 @@ class ServeFleet:
             while rep.engine.batcher.waiting:
                 req = rep.engine.batcher.waiting.pop(0)
                 self._replay_fallback(rep, req)
+        self._observe_slo()
         self.ticks += 1
         return progressed
 
@@ -510,12 +647,16 @@ class ServeFleet:
                                for r in self.replicas),
             "fleet_replays": self.fleet_replays,
             "kills": self.kills,
+            "grows": self.grows,
+            "role_changes": self.role_changes,
+            "spare_devices": self.spare_devices,
             "serve_recoveries": agg.get("serve_recoveries", 0),
             "evictions": sum(r.engine.batcher.evictions
                              for r in self.replicas),
             "recompiles_steady": recompiles,
             "replicas": per_replica,
             "requests": self.request_summary(),
+            "slo": self.slo.snapshot(),
             "recovery": {"faults": rec["faults"],
                          "recoveries": rec["recoveries"],
                          "mttr_mean_s": rec["mttr_mean_s"]},

@@ -230,6 +230,12 @@ def fleet_metric(scenario: str, key: str) -> str:
     return f"fleet.{scenario}.{key}"
 
 
+def fleet_slo_metric(scenario: str, key: str) -> str:
+    """Per-scenario SLO-observatory keys (windowed tick-domain
+    percentiles + autoscaler decision counts) — exact two-sided."""
+    return f"fleet.slo.{scenario}.{key}"
+
+
 def integrity_metric(route: str, key: str) -> str:
     return f"integrity.{route}.{key}"
 
@@ -429,6 +435,18 @@ def build_banked_summary() -> dict:
                     m = _metric(v, src, higher=False,
                                 tol=TOL_FLEET_TIME)
                 metrics[fleet_metric(row["scenario"], key)] = m
+            # the SLO observatory block: windowed tick-domain
+            # percentiles, pressure peaks and the autoscaler's decision
+            # ledger are deterministic per seed on ANY machine (request
+            # milestones are fleet-tick-stamped), so every value pins
+            # two-sided-exact even on dryrun rows — a changed decision
+            # count or shifted p99 IS a controller/scheduler change
+            for key, v in sorted((row.get("slo") or {}).items()):
+                if v is None or isinstance(v, str):
+                    continue
+                metrics[fleet_slo_metric(row["scenario"], key)] = \
+                    _metric(float(v), src, tol=TOL_EXACT,
+                            two_sided=True)
 
     # -- wire integrity (checksum overhead + trip->recovery) ------------------
     p = (_newest("artifacts/integrity_bench_*.json")

@@ -190,53 +190,151 @@ def run_row(params, prompts, ref, max_reqs: int,
     return row
 
 
-# -- fleet bench (make fleet-bench -> FLEET_BENCH artifact) ------------------
+# -- fleet bench (make fleet-bench / slo-bench -> FLEET_BENCH artifact) ------
 #
-# Two scenarios over the same seeded workload on a 1-prefill/2-decode
-# fleet: `steady` (the disaggregated pipeline, fault-free, token-exact
-# vs isolated generate) and `replica_kill` (a decode replica preempted
-# mid-run; every surviving stream must be BYTE-identical to the steady
-# fleet run, with zero replay — the handoff tier).  CPU rows are
-# dryrun-class: obs-gate holds them to the exact accounting only
-# (handoff_wire_bytes / handoffs / replays / recoveries / recompiles,
-# all two-sided) — fleet MTTR and TTFT gate on a TPU surface.
+# Six scenarios over seeded `serve.traffic` workloads.  Two run the
+# fixed 1-prefill/2-decode fleet without a controller — `steady` (the
+# disaggregated pipeline, fault-free, token-exact vs isolated generate)
+# and `replica_kill` (a decode replica preempted mid-run; every
+# surviving stream must be BYTE-identical to the steady fleet run, with
+# zero replay — the handoff tier).  Four close the loop: a
+# `serve.autoscale.Autoscaler` reads the fleet's windowed SLO metrics
+# every tick and drives scale-out / role rebalance / admission shedding
+# against `spike`, `diurnal`, `thundering_herd` and `chaos` (spike +
+# replica kill) traffic.
+#
+# Every latency the rows gate lives in the FLEET-TICK domain (request
+# milestones are tick-stamped by the fleet's SLO observatory), so a
+# seeded run banks bit-identical percentiles and decision counts on CPU
+# dryrun and TPU alike: obs-gate pins the per-row `slo` block exactly
+# (fleet.slo.* keys, two-sided) next to the exact byte accounting
+# (handoff_wire_bytes / handoffs / replays / recoveries / recompiles).
+# Wall-clock latencies stay dryrun-class — MTTR and TTFT-seconds gate
+# on a TPU surface only.
 
 FLEET_N_REQUESTS = 12
-FLEET_MAX_NEW = 6
-FLEET_KILL_TICK = 6
+FLEET_KILL_TICK = 11                   # steady traffic has live decode work
+#                                        mid-flight here (migration needs a
+#                                        victim that actually holds KV)
+CHAOS_KILL_TICK = 18                   # mid-spike: scale-out then a kill
+SPIKE_TICK = 12
+SPIKE_N = 16
+# tick-domain SLO budget for the closed-loop rows: windowed p99 TTFT
+# must stay under this even across the spike/herd/kill — the semantic
+# claim; the exact banked value is what obs-gate pins
+TTFT_P99_BUDGET_TICKS = 40.0
 
 
-def _fleet_workload():
-    rng = np.random.default_rng(SEED)
-    return [rng.integers(0, CFG.vocab, int(n)).astype(np.int32)
-            for n in rng.integers(4, 14, FLEET_N_REQUESTS)]
-
-
-def _fleet_scfg():
+def _fleet_scfg(max_reqs=8):
     # per-replica slots/pages provisioned so ONE decode survivor can
     # absorb the victim's whole live set (the zero-replay bar): 8 slots
-    # and 3 pages/slot + slack per replica
+    # and 3 pages/slot + slack per replica.  The closed-loop tier runs
+    # max_reqs=4 — tighter slots make offered load visibly BACKLOG
+    # (queue_depth) instead of soaking into batch slack, which is the
+    # signal the autoscaler's CUSUM integrates
     from fpga_ai_nic_tpu.serve import ServeConfig
-    return ServeConfig(max_reqs=8, page_size=PAGE_SIZE, n_pages=28,
-                       max_pages_per_seq=PAGES_PER_SEQ,
+    return ServeConfig(max_reqs=max_reqs, page_size=PAGE_SIZE,
+                       n_pages=28, max_pages_per_seq=PAGES_PER_SEQ,
                        prefill_chunk=PAGE_SIZE)
 
 
-def _fleet_serve(params, prompts, plan):
+def _traffic_reference(params, wl):
+    """Isolated-generate reference per traffic request (its OWN max_new
+    — traffic draws heavy-tailed lengths, unlike the fixed-max_new
+    curve workload)."""
+    out = []
+    for req, p in zip(wl.requests, wl.prompts(CFG.vocab)):
+        full = np.asarray(dec.generate(
+            params, jnp.asarray(p)[None], req.max_new, CFG))[0]
+        out.append(full[len(p):].tolist())
+    return out
+
+
+def _drive_fleet(fleet, wl, *, autoscaler=None, max_ticks=600,
+                 drain_ticks=0):
+    """Tick-driven serve loop: submit each traffic request on its
+    arrival tick, tick the fleet, then let the autoscaler observe —
+    the closed loop the bench gates.  ``drain_ticks`` keeps ticking an
+    idle fleet after the last completion so the controller's scale-IN
+    side (sustained-idle CUSUM) is witnessed too.  Returns requests in
+    uid order."""
+    by_tick = wl.arrivals_by_tick()
+    prompts = wl.prompts(CFG.vocab)
+    last_arrival = max(by_tick) if by_tick else 0
+    reqs = {}
+    drain = None
+    while True:
+        for tr in by_tick.get(fleet.ticks, ()):
+            reqs[tr.uid] = fleet.submit(prompts[tr.uid - 1],
+                                        max_new=tr.max_new,
+                                        tenant=tr.tenant)
+        fleet.tick()
+        if autoscaler is not None:
+            autoscaler.observe_tick()
+        if (drain is None and fleet.ticks > last_arrival
+                and not fleet._arrivals
+                and all(r.done for r in reqs.values())):
+            drain = drain_ticks
+        if drain is not None:
+            if drain <= 0:
+                return [reqs[u] for u in sorted(reqs)]
+            drain -= 1
+        if fleet.ticks >= max_ticks:
+            raise RuntimeError(
+                f"fleet drive exceeded {max_ticks} ticks with "
+                f"{sum(1 for r in reqs.values() if not r.done)} open")
+
+
+def _fleet_serve(params, wl, plan, *, n_prefill=1, n_decode=2,
+                 max_reqs=8, autoscale=False, drain_ticks=0):
     from fpga_ai_nic_tpu.runtime import chaos
-    from fpga_ai_nic_tpu.serve import FleetConfig, ServeFleet
-    fleet = ServeFleet(params, CFG, _fleet_scfg(),
-                       FleetConfig(n_prefill=1, n_decode=2), chaos=plan)
-    reqs = [fleet.submit(p, max_new=FLEET_MAX_NEW) for p in prompts]
+    from fpga_ai_nic_tpu.serve import (Autoscaler, FleetConfig,
+                                       ServeFleet)
+    fleet = ServeFleet(params, CFG, _fleet_scfg(max_reqs),
+                       FleetConfig(n_prefill=n_prefill,
+                                   n_decode=n_decode), chaos=plan)
+    scaler = (Autoscaler(fleet, fleet.slo,
+                         events=fleet.profiler.events)
+              if autoscale else None)
     with chaos.activate(plan):
-        s = fleet.run()
-    return fleet, reqs, s
+        reqs = _drive_fleet(fleet, wl, autoscaler=scaler,
+                            drain_ticks=drain_ticks)
+    return fleet, reqs, fleet.summary(), scaler
 
 
-def _fleet_row(scenario, s, reqs, reference, t0) -> dict:
+def _slo_block(s, reqs, scaler=None, *, spike_tick=None) -> dict:
+    """The deterministic (tick-domain) SLO sub-dict obs-gate pins
+    exactly: windowed percentiles, pressure peaks, token-loss and the
+    controller's decision ledger."""
+    w = s["slo"]["windows"]
+    g = s["slo"]["gauges"]
+    out = {
+        "ticks": s["ticks"],
+        "tokens_lost": (sum(r.max_new for r in reqs)
+                        - s["tokens_out"]),
+        "ttft_p50_ticks": w["ttft"]["p50"],
+        "ttft_p95_ticks": w["ttft"]["p95"],
+        "ttft_p99_ticks": w["ttft"]["p99"],
+        "queue_wait_p95_ticks": w["queue_wait"]["p95"],
+        "tpot_p95_ticks": w["tpot"]["p95"],
+        "queue_depth_peak": g["queue_depth"]["peak"],
+        "pages_in_use_peak": g["pages_in_use"]["peak"],
+    }
+    if scaler is not None:
+        out.update(scaler.summary())
+        if spike_tick is not None and out["first_scale_out_tick"] >= 0:
+            out["scale_latency_ticks"] = (out["first_scale_out_tick"]
+                                          - spike_tick)
+    return out
+
+
+def _fleet_row(scenario, s, reqs, reference, t0, *, scaler=None,
+               spike_tick=None, expect_kills=0,
+               allow_replays=False) -> dict:
     token_exact = all(list(q.generated) == want
                       for q, want in zip(reqs, reference))
     r = s["requests"]
+    slo = _slo_block(s, reqs, scaler, spike_tick=spike_tick)
     row = {
         "scenario": scenario,
         "n_requests": s["n_requests"],
@@ -250,73 +348,142 @@ def _fleet_row(scenario, s, reqs, reference, t0) -> dict:
         "fleet_replays": s["fleet_replays"],
         "serve_recoveries": s["serve_recoveries"],
         "kills": s["kills"],
+        "grows": s["grows"],
         "fleet_mttr_s": round(s["recovery"]["mttr_mean_s"], 4),
         "recompiles_steady": s["recompiles_steady"],
         "survivors": sum(1 for x in s["replicas"] if x["alive"]),
         "token_exact": token_exact,
+        "slo": slo,
         "wall_s": round(time.time() - t0, 2),
     }
-    row["ok"] = bool(token_exact
-                     and s["completed"] == s["n_requests"]
-                     and s["recompiles_steady"] == 0
-                     and s["fleet_replays"] == 0
-                     and s["serve_recoveries"] == 0
-                     and (s["kills"] == 1) == (scenario == "replica_kill"))
+    # kills nets out controller-driven drains: a scale-in IS a
+    # kill_replica call, but a planned one, not the chaos preemption
+    # expect_kills counts
+    ok = (token_exact
+          and s["completed"] == s["n_requests"]
+          and slo["tokens_lost"] == 0
+          and s["recompiles_steady"] == 0
+          and s["serve_recoveries"] == 0
+          and s["kills"] - slo.get("scale_ins", 0) == expect_kills
+          and (allow_replays or s["fleet_replays"] == 0))
+    if scaler is not None:
+        # the closed-loop bar: the controller must have acted, and the
+        # windowed tail must have been restored within budget
+        ok = (ok and slo["scale_outs"] >= 1
+              and slo["ttft_p99_ticks"] is not None
+              and slo["ttft_p99_ticks"] <= TTFT_P99_BUDGET_TICKS)
+    row["ok"] = bool(ok)
     return row
 
 
 def run_fleet_bench(args) -> int:
     from fpga_ai_nic_tpu.runtime import chaos
+    from fpga_ai_nic_tpu.serve import traffic
     plat = jax.devices()[0].platform
     log(f"platform={plat} devices={len(jax.devices())} bench=fleet")
     params = llama.init(jax.random.PRNGKey(0), CFG)
-    prompts = _fleet_workload()
-    log(f"phase=reference n={len(prompts)} max_new={FLEET_MAX_NEW}")
-    iso_ref = _reference(params, prompts, FLEET_MAX_NEW)
 
+    workloads = {
+        # interval 1.0: dense enough that the kill tick catches live
+        # decode work mid-flight (the migration claim needs a victim
+        # that actually holds KV)
+        "steady": traffic.generate(
+            traffic.steady_config(FLEET_N_REQUESTS, SEED,
+                                  base_interval_ticks=1.0)),
+        "spike": traffic.generate(
+            traffic.spike_config(SPIKE_N, SEED, spike_tick=SPIKE_TICK)),
+        # one full cycle: the peak overloads a 1-decode fleet (scale
+        # OUT) and the trough idles the grown fleet (scale IN)
+        "diurnal": traffic.generate(
+            traffic.diurnal_config(SPIKE_N, SEED, period=24,
+                                   amplitude=0.9,
+                                   base_interval_ticks=1.0)),
+        "thundering_herd": traffic.generate(
+            traffic.thundering_herd_config(FLEET_N_REQUESTS, SEED)),
+    }
+    refs = {}
+    for name, wl in workloads.items():
+        log(f"phase=reference scenario={name} n={len(wl)}")
+        refs[name] = _traffic_reference(params, wl)
+
+    rows = []
+
+    # fixed-fleet tier: steady + replica_kill over the SAME workload
     t0 = time.time()
-    _f, reqs, s = _fleet_serve(params, prompts, None)
-    steady = _fleet_row("steady", s, reqs, iso_ref, t0)
-    # steady must ALSO be exact vs isolated generate — pinned above via
-    # reference; the kill row's reference is the steady FLEET streams
+    _f, reqs, s, _ = _fleet_serve(params, workloads["steady"], None)
+    steady = _fleet_row("steady", s, reqs, refs["steady"], t0)
+    # the kill row's reference is the steady FLEET streams
     # (byte-identity is the migration claim)
     fleet_ref = [list(q.generated) for q in reqs]
-    log(f"row steady: {steady['throughput_tok_s']} tok/s "
-        f"handoffs={steady['handoffs']} "
-        f"wire={steady['handoff_wire_bytes']}B "
-        f"{'ok' if steady['ok'] else 'FAILED'} ({steady['wall_s']}s)")
+    rows.append(steady)
 
     t0 = time.time()
     plan = chaos.FaultPlan(
         [chaos.FaultSpec("preemption", "fleet.membership",
                          step=FLEET_KILL_TICK)], seed=SEED)
-    _f2, reqs2, s2 = _fleet_serve(params, prompts, plan)
-    kill = _fleet_row("replica_kill", s2, reqs2, fleet_ref, t0)
+    _f2, reqs2, s2, _ = _fleet_serve(params, workloads["steady"], plan)
+    kill = _fleet_row("replica_kill", s2, reqs2, fleet_ref, t0,
+                      expect_kills=1)
     kill["chaos_fired"] = len(plan.fired)
     kill["ok"] = bool(kill["ok"] and len(plan.fired) == 1
                       and s2["handoffs"] > s["handoffs"])
-    log(f"row replica_kill: mttr={kill['fleet_mttr_s']}s "
-        f"ttft_p95={kill['ttft_p95_s']}s "
-        f"handoffs={kill['handoffs']} replays={kill['fleet_replays']} "
-        f"{'ok' if kill['ok'] else 'FAILED'} ({kill['wall_s']}s)")
+    rows.append(kill)
 
-    rows = [steady, kill]
+    # closed-loop tier: 1 prefill + 1 decode + spares, autoscaler on.
+    # diurnal drains 24 idle ticks past the last completion so its
+    # trough trips the scale-IN side too (peak grows, trough shrinks —
+    # the full cycle)
+    for name, spike_tick, kill_tick, drain in (
+            ("spike", SPIKE_TICK, None, 0),
+            ("diurnal", None, None, 24),
+            ("thundering_herd", 0, None, 0),
+            ("chaos", SPIKE_TICK, CHAOS_KILL_TICK, 0)):
+        wl = workloads.get(name) or workloads["spike"]
+        ref = refs.get(name) or refs["spike"]
+        t0 = time.time()
+        cplan = None
+        if kill_tick is not None:
+            cplan = chaos.FaultPlan(
+                [chaos.FaultSpec("preemption", "fleet.membership",
+                                 step=kill_tick)], seed=SEED)
+        _fl, qs, ss, scaler = _fleet_serve(
+            params, wl, cplan, n_prefill=1, n_decode=1, max_reqs=4,
+            autoscale=True, drain_ticks=drain)
+        row = _fleet_row(name, ss, qs, ref, t0, scaler=scaler,
+                         spike_tick=spike_tick,
+                         expect_kills=0 if kill_tick is None else 1,
+                         allow_replays=kill_tick is not None)
+        if cplan is not None:
+            row["chaos_fired"] = len(cplan.fired)
+            row["ok"] = bool(row["ok"] and len(cplan.fired) == 1)
+        if drain:
+            row["ok"] = bool(row["ok"] and row["slo"]["scale_ins"] >= 1)
+        rows.append(row)
+
+    for row in rows:
+        slo = row["slo"]
+        log(f"row {row['scenario']}: ticks={slo['ticks']} "
+            f"ttft_p99={slo['ttft_p99_ticks']}t "
+            f"lost={slo['tokens_lost']} grows={row['grows']} "
+            f"handoffs={row['handoffs']} replays={row['fleet_replays']} "
+            f"{'ok' if row['ok'] else 'FAILED'} ({row['wall_s']}s)")
+
     result = {
         "bench": "fleet",
         "platform": plat,
         "n_devices": len(jax.devices()),
-        # CPU rows are dryrun-class: obs-gate holds them only to the
-        # exact accounting (FLEET_BYTE_KEYS); MTTR/TTFT gate on TPU
+        # wall-clock latencies are dryrun-class on CPU; the per-row
+        # `slo` block is tick-domain and gates EXACTLY either way
         "dryrun": not is_tpu_platform(plat),
         "model": {"dim": CFG.dim, "n_layers": CFG.n_layers,
                   "n_heads": CFG.n_heads, "n_kv_heads": CFG.n_kv_heads,
                   "vocab": CFG.vocab, "dtype": CFG.dtype},
         "fleet": {"n_prefill": 1, "n_decode": 2,
-                  "kill_tick": FLEET_KILL_TICK},
-        "workload": {"n_requests": FLEET_N_REQUESTS,
-                     "max_new": FLEET_MAX_NEW,
-                     "prompt_lens": [int(p.shape[0]) for p in prompts],
-                     "page_size": PAGE_SIZE, "seed": SEED},
+                  "kill_tick": FLEET_KILL_TICK,
+                  "chaos_kill_tick": CHAOS_KILL_TICK,
+                  "ttft_p99_budget_ticks": TTFT_P99_BUDGET_TICKS},
+        "workload": {name: wl.summary() | {"fingerprint": wl.fingerprint()}
+                     for name, wl in workloads.items()},
         "rows": rows,
         "ok": all(r["ok"] for r in rows),
     }
@@ -325,7 +492,8 @@ def run_fleet_bench(args) -> int:
             json.dump(result, f, indent=1)
     if not args.no_artifact:
         save_artifact("fleet_bench", result)
-    print(json.dumps({k: v for k, v in result.items() if k != "rows"} |
+    print(json.dumps({k: v for k, v in result.items()
+                      if k not in ("rows", "workload")} |
                      {"rows_ok": sum(r["ok"] for r in rows),
                       "rows_total": len(rows)}, indent=1))
     return 0 if result["ok"] else 1
