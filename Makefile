@@ -69,7 +69,7 @@ bench:
 # run the collective/codec benchmark and snapshot its newest artifact as
 # the round's committed record (the round-2 review's item 3: the
 # first-named BASELINE metric must land in a committed file every round)
-ROUND ?= r17
+ROUND ?= r20
 collective:
 	python bench_collective.py
 	@latest=$$(ls -t artifacts/collective_tpu_*.json artifacts/collective_2*.json 2>/dev/null | head -1); \

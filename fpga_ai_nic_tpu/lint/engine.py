@@ -89,6 +89,7 @@ RULES: Sequence[RuleFn] = (
     rules_mod.rule_r3_pallas_tiling,
     rules_mod.rule_r4_callback_gating,
     rules_mod.rule_r5_artifact_honesty,
+    rules_mod.rule_r6_site_derivation,
 )
 
 

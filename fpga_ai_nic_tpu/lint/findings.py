@@ -17,6 +17,8 @@ RULE_DOCS: Dict[str, str] = {
           "be dominated by a trace-time config gate",
     "R5": "artifact honesty: never bank value/unit from a "
           "max(..., default=0)-style fallback",
+    "R6": "chaos site tuples (*_SITES) must be derived from their "
+          "fire-point maps, never hand-written string literals",
     "J1": "jaxpr: obs off must compile to zero callback primitives",
     "J2": "jaxpr: no f64 avals may leak into the step",
     "J3": "jaxpr: donated state buffers must actually be donated",
@@ -62,7 +64,8 @@ RULE_DOCS: Dict[str, str] = {
           "termination or DMA discipline",
 }
 
-AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "H1")
+AST_CODES: Tuple[str, ...] = ("R0", "R1", "R2", "R3", "R4", "R5", "R6",
+                              "H1")
 JAXPR_CODES: Tuple[str, ...] = ("J1", "J2", "J3", "J4", "J5", "J6", "J7",
                                 "J8", "J9", "J10", "J11", "J12", "J13",
                                 "J14")

@@ -615,3 +615,38 @@ def rule_r5_artifact_honesty(ctx) -> Iterable[Finding]:
                 f"artifact headline banked from a {why} — a missing "
                 "measurement must surface as an explicit *_error field, "
                 "never a fake default (the multichip 0.0 GB/s class)")
+
+
+# ---------------------------------------------------------------------------
+# R6 — chaos site tuples must be DERIVED from their point maps
+# ---------------------------------------------------------------------------
+
+def rule_r6_site_derivation(ctx) -> Iterable[Finding]:
+    """A public module-level ``*_SITES`` constant assigned a literal
+    tuple of strings is a hand transcription: the chaos matrix/soak
+    sweeps iterate these tuples, so a fire point added to the code but
+    not the literal silently drops out of every sweep.  PR 12 caught
+    exactly this by review ("serve.handoff" missing from WIRE_SITES);
+    the fix was to derive the exported tuple from the point map
+    (``tuple(dict.fromkeys(_X_POINT_SITES.values()))``) — this rule
+    freezes that shape.  Private ``_*`` names (the point-map plumbing
+    itself) and any computed form (calls, concatenation) stay legal."""
+    for node in ctx.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1:
+            continue
+        t = node.targets[0]
+        if not isinstance(t, ast.Name) or t.id.startswith("_"):
+            continue
+        if not (t.id == "SITES" or t.id.endswith("_SITES")):
+            continue
+        v = node.value
+        if isinstance(v, ast.Tuple) and v.elts and all(
+                isinstance(e, ast.Constant) and isinstance(e.value, str)
+                for e in v.elts):
+            yield Finding(
+                "R6", ctx.path, node.lineno,
+                f"chaos site tuple {t.id} is hand-written string "
+                "literals — derive it from its fire-point map "
+                "(tuple(dict.fromkeys(_*_POINT_SITES.values()))) so a "
+                "new fire point can never silently drop out of the "
+                "chaos sweep (the WIRE_SITES drift class)")

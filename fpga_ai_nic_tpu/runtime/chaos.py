@@ -88,8 +88,28 @@ FAULT_KINDS = ("hang", "slowdown", "exception", "corruption", "preemption")
 # only fire while a reshard transfer is actually running, so the
 # generic matrix/soak would plan unfireable specs; the dedicated
 # integrity corruption cells in tools/chaos_bench.py own it.
-TRAIN_SITES = ("queue.issue", "queue.wait", "staging", "collective")
-SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
+# chaos FIRE point (the code boundary that actually calls
+# ``FaultPlan.fire`` / arms a tap) -> the chaos SITE its specs target.
+# The exported ``*_SITES`` tuples are DERIVED from these maps — never
+# hand-written — so a new fire point that lands here is automatically
+# part of the matrix/soak sweep, and one that doesn't is a one-line
+# review catch.  This is the PR-12 drift class ("serve.handoff" missing
+# from WIRE_SITES, caught by review) frozen structurally; graftlint R6
+# fails any module-level ``*_SITES`` tuple built from string literals
+# instead of a derivation like the ones below.
+_TRAIN_POINT_SITES = {
+    "runtime.queue.TicketQueue.issue": "queue.issue",
+    "runtime.queue.TicketQueue.wait": "queue.wait",
+    "runtime.staging.StagingPipeline.put": "staging",
+    "runtime.chaos.collective_tap": "collective",   # XLA callback tap
+}
+_SERVE_POINT_SITES = {
+    "serve.engine.ServeEngine.tick": "serve.step",
+    "serve.fleet.ServeFleet._handoff": "serve.handoff",
+    "serve.fleet.ServeFleet.tick": "fleet.membership",
+}
+TRAIN_SITES = tuple(dict.fromkeys(_TRAIN_POINT_SITES.values()))
+SERVE_SITES = tuple(dict.fromkeys(_SERVE_POINT_SITES.values()))
 # "ckpt.save" / "ckpt.restore" are the DURABILITY sites
 # (utils.checkpoint): the save file-op sequence and the restore audit
 # boundary.  Their fault kinds model what disks and processes actually
@@ -101,7 +121,11 @@ SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
 # while a Checkpointer armed with the plan is saving/restoring, so the
 # generic matrix/soak would plan unfireable specs; the dedicated
 # durability cells in tools/chaos_bench.py own them.
-CKPT_SITES = ("ckpt.save", "ckpt.restore")
+_CKPT_POINT_SITES = {
+    "utils.checkpoint.Checkpointer.save": "ckpt.save",
+    "utils.checkpoint.Checkpointer.restore": "ckpt.restore",
+}
+CKPT_SITES = tuple(dict.fromkeys(_CKPT_POINT_SITES.values()))
 SITES = TRAIN_SITES + SERVE_SITES + ("reshard.transfer",) + CKPT_SITES
 # "wirebit" is the FINITE corruption class the wire checksums exist for
 # (the blind spot of every value-space guard): a low bit flipped in the

@@ -49,6 +49,10 @@ from typing import Any, Dict, List, Optional, Protocol
 
 from ..obs.slo import SloAggregator
 from ..tune.adapt import DriftDetector
+# ONE definition of the residual/gate/valve rules — exhaustively
+# explored by verify.sched (the no-flap invariant rides these exact
+# functions); delegation asserted by identity in tests/test_sched.py
+from ..verify.opstream import SCHED_RULES as _RULES
 
 __all__ = ["AutoscaleConfig", "ScaleDecision", "Autoscaler",
            "FleetActions"]
@@ -159,8 +163,8 @@ class Autoscaler:
         sig = self.fleet.load_signals()
         n_decode = max(1, int(sig["n_decode"]))
         queue_depth = float(sig["queue_depth"])
-        residual = (queue_depth
-                    / (cfg.target_queue_per_decode * n_decode)) - 1.0
+        residual = _RULES.load_residual(
+            queue_depth, cfg.target_queue_per_decode, n_decode)
         p99 = self.slo.window_stat("ttft", "p99")
         evidence: Dict[str, Any] = {
             "residual": round(residual, 4),
@@ -191,7 +195,9 @@ class Autoscaler:
             return [self._decide("scale_out", evidence)]
         # no spare device: rebalance a surplus prefill worker into the
         # decode pool instead (role="both" — it keeps prefilling)
-        if int(sig["n_prefill_pure"]) >= 2 and sig["rebalance_idx"] >= 0:
+        if _RULES.scale_up_fallback(
+                int(sig["n_prefill_pure"]),
+                int(sig["rebalance_idx"])) == "rebalance":
             self.fleet.set_role(int(sig["rebalance_idx"]), "both")
             self.rebalances += 1
             return [self._decide("rebalance", evidence)]
@@ -201,8 +207,9 @@ class Autoscaler:
     def _scale_down(self, evidence: Dict[str, Any],
                     sig: Dict[str, float]) -> List[ScaleDecision]:
         idx = int(sig["scale_in_idx"])
-        if (int(sig["n_decode_pure"]) > self.cfg.min_decode
-                and sig["queue_depth"] == 0 and idx >= 0):
+        if _RULES.scale_down_ok(int(sig["n_decode_pure"]),
+                                self.cfg.min_decode,
+                                float(sig["queue_depth"]), idx):
             self.fleet.kill_replica(idx)
             self.scale_ins += 1
             return [self._decide("scale_in", evidence)]
@@ -212,13 +219,14 @@ class Autoscaler:
     def _shed_valve(self, evidence: Dict[str, Any],
                     sig: Dict[str, float]) -> List[ScaleDecision]:
         free_frac = float(sig["free_frac"])
-        if (not self.fleet.hold_admissions
-                and free_frac < self.cfg.shed_free_frac_lo):
+        shed = _RULES.shed_action(self.fleet.hold_admissions, free_frac,
+                                  self.cfg.shed_free_frac_lo,
+                                  self.cfg.shed_free_frac_hi)
+        if shed == "shed_on":
             self.fleet.hold_admissions = True
             self.sheds += 1
             return [self._decide("shed_on", evidence)]
-        if (self.fleet.hold_admissions
-                and free_frac > self.cfg.shed_free_frac_hi):
+        if shed == "shed_off":
             self.fleet.hold_admissions = False
             return [self._decide("shed_off", evidence)]
         return []

@@ -62,6 +62,10 @@ from ..obs.slo import SloAggregator
 from ..runtime import chaos as chaos_lib
 from ..runtime.requests import DECODE, FINISHED, PREFILL, Request
 from ..utils.observability import Profiler
+# ONE definition of every routing/kill/migration decision — exhaustively
+# explored by verify.sched; delegation asserted by identity in
+# tests/test_sched.py (the PR-14 emitter discipline)
+from ..verify.opstream import SCHED_RULES as _RULES
 from . import handoff as handoff_lib
 from .engine import ServeEngine
 from .paged import ServeConfig
@@ -220,8 +224,11 @@ class ServeFleet:
                           ) -> None:
         """Deterministic least-loaded routing with stable ties (list
         order) — what makes a seeded fleet run replay exactly."""
-        tgt = min(self._alive("prefill"), key=lambda r: (r.load(), r.idx))
-        tgt.engine.batcher.enqueue(req, front=front)
+        cands = self._alive("prefill")
+        pos = _RULES.route_least_loaded([(r.load(), r.idx)
+                                         for r in cands])
+        assert pos is not None, "no prefill-capable replica alive"
+        cands[pos].engine.batcher.enqueue(req, front=front)
 
     # -- KV handoff ----------------------------------------------------------
 
@@ -229,9 +236,9 @@ class ServeFleet:
         cands = [r for r in self._alive("decode")
                  if r.engine.batcher.free_slots > 0
                  and r.engine.alloc.free >= n_pages]
-        if not cands:
-            return None
-        return min(cands, key=lambda r: (r.load(), r.idx))
+        pos = _RULES.route_least_loaded([(r.load(), r.idx)
+                                         for r in cands])
+        return None if pos is None else cands[pos]
 
     def _handoff(self, src: Replica, dst: Replica, req: Request, *,
                  state: str) -> None:
@@ -333,8 +340,9 @@ class ServeFleet:
             cands = [r for r in self._alive("prefill") if r is not src
                      and r.engine.batcher.free_slots > 0
                      and r.engine.alloc.free >= n]
-            dst = min(cands, key=lambda r: (r.load(), r.idx)) \
-                if cands else None
+            pos = _RULES.route_least_loaded([(r.load(), r.idx)
+                                             for r in cands])
+            dst = None if pos is None else cands[pos]
         if dst is None and park_ok:
             return                       # retry next tick; pages stay
         if dst is None or n == 0:
@@ -364,7 +372,9 @@ class ServeFleet:
         if len(self._alive()) <= 1:
             return None
         cands = self._alive("decode") or self._alive()
-        return max(cands, key=lambda r: (r.load(), -r.idx))
+        pos = _RULES.pick_kill_victim([(r.load(), r.idx)
+                                       for r in cands])
+        return None if pos is None else cands[pos]
 
     def kill_replica(self, idx: int) -> None:
         """Planned scale-down / drain of one replica: migrate everything
@@ -384,10 +394,11 @@ class ServeFleet:
         migratable = chaos_lib.state_buffers_alive(eng.pool)
         live = sorted(eng.batcher.live, key=lambda r: r.admit_seq)
         for req in live:
-            if migratable and req.state in (DECODE, PREFILL) \
-                    and eng.batcher.pages_of(req):
+            act = _RULES.migration_action(
+                req.state, bool(eng.batcher.pages_of(req)), migratable)
+            if act == "migrate":
                 self._migrate_or_replay(victim, req, state=req.state)
-            elif not eng.batcher.pages_of(req):
+            elif act == "reroute":
                 # admitted but no KV written yet: re-routing loses zero
                 # work — NOT a replay
                 eng.batcher.release(req)
@@ -410,8 +421,11 @@ class ServeFleet:
         one-off)."""
         for role in ("prefill", "decode"):
             if not self._alive(role):
-                survivor = min(self._alive(),
-                               key=lambda r: (r.load(), r.idx))
+                cands = self._alive()
+                pos = _RULES.route_least_loaded([(r.load(), r.idx)
+                                                 for r in cands])
+                assert pos is not None, "no survivor to promote"
+                survivor = cands[pos]
                 survivor.engine.role = "both"
                 self.profiler.events.instant(
                     "fleet.promote", replica=survivor.idx,
@@ -475,10 +489,12 @@ class ServeFleet:
         live = sum(len(r.engine.batcher.live) for r in alive)
         pure_prefill = [r for r in alive if r.role == "prefill"]
         pure_decode = [r for r in alive if r.role == "decode"]
-        rebalance = min(pure_prefill, key=lambda r: (r.load(), r.idx),
-                        default=None)
-        scale_in = min(pure_decode, key=lambda r: (r.load(), r.idx),
-                       default=None)
+        rb = _RULES.route_least_loaded([(r.load(), r.idx)
+                                        for r in pure_prefill])
+        si = _RULES.route_least_loaded([(r.load(), r.idx)
+                                        for r in pure_decode])
+        rebalance = None if rb is None else pure_prefill[rb]
+        scale_in = None if si is None else pure_decode[si]
         return {
             "queue_depth": float(queue_depth),
             "live": float(live),

@@ -38,6 +38,11 @@ import numpy as np
 
 from ..runtime.requests import (DECODE, FINISHED, PREFILL, WAITING,
                                 Request)
+# ONE definition of every discrete scheduling decision — the graftsched
+# model (verify.sched) explores these exact rules exhaustively;
+# tests/test_sched.py asserts the delegation by identity (the PR-14
+# emitter discipline: no hand transcription may survive here)
+from ..verify.opstream import SCHED_RULES as _RULES
 from .paged import NULL_PAGE, PageAllocator, ServeConfig
 
 __all__ = ["ContinuousBatcher"]
@@ -90,7 +95,7 @@ class ContinuousBatcher:
         # replay target: every position the cache must hold before decode
         # can resume (prompt + all generated but the newest, whose K/V
         # the resuming decode step writes itself)
-        req.replay_len = req.n_tokens
+        req.replay_len = _RULES.replay_target(req.n_tokens)
         if front:
             self.waiting.insert(0, req)
         else:
@@ -104,15 +109,12 @@ class ContinuousBatcher:
         replay_len + 1 positions' worth, a decoding one its next
         position.  The admission watermark subtracts this so a newly
         admitted request cannot immediately force an eviction storm."""
-        out = 0
-        for r in self.slots:
-            if r is None:
-                continue
-            target = (r.replay_len + 1 if r.state == PREFILL
-                      else r.n_tokens + 1)
-            out += max(0, self.scfg.pages_for(target)
-                       - len(self._pages[r.slot]))
-        return out
+        return _RULES.committed_outstanding(
+            [(self.scfg.pages_for(
+                _RULES.committed_target(r.state, r.replay_len,
+                                        r.n_tokens)),
+              len(self._pages[r.slot]))
+             for r in self.slots if r is not None])
 
     def admit(self) -> List[Request]:
         """Admit waiting requests into free slots while the free-page
@@ -124,8 +126,10 @@ class ContinuousBatcher:
             if slot is None:
                 break
             req = self.waiting[0]
-            uncommitted = self.alloc.free - self._committed_outstanding()
-            if uncommitted < self.scfg.pages_for(req.replay_len + 1):
+            need = self.scfg.pages_for(
+                _RULES.admission_need(req.replay_len))
+            if not _RULES.admit_ok(self.alloc.free,
+                                   self._committed_outstanding(), need):
                 break                     # watermark: avoid admit-thrash
             self.waiting.pop(0)
             req.slot = slot
@@ -166,9 +170,8 @@ class ContinuousBatcher:
         live = [r for r in self.slots
                 if r is not None and r is not protect
                 and self._pages[r.slot]]
-        if not live:
-            return None
-        return max(live, key=lambda r: r.admit_seq)
+        pos = _RULES.pick_victim([r.admit_seq for r in live])
+        return None if pos is None else live[pos]
 
     def evict(self, req: Request) -> None:
         """Free the request's pages and requeue it (front — evicted work
@@ -250,11 +253,13 @@ class ContinuousBatcher:
         pool is starved for it this tick."""
         cands = [r for r in self.slots
                  if r is not None and r.state == PREFILL]
-        if not cands:
+        pos = _RULES.pick_oldest([r.admit_seq for r in cands])
+        if pos is None:
             return None
-        req = min(cands, key=lambda r: r.admit_seq)
+        req = cands[pos]
         start = req.prefill_done
-        n_true = min(self.scfg.prefill_chunk, req.replay_len - start)
+        n_true = _RULES.prefill_chunk_len(self.scfg.prefill_chunk,
+                                          req.replay_len, start)
         if not self.ensure_pages(req, start + n_true):
             return None
         return req, start, n_true
@@ -263,12 +268,14 @@ class ContinuousBatcher:
         """DECODE requests that can take a step this tick (oldest first;
         each needs one more position's page — may evict newer ones)."""
         out: List[Request] = []
-        for req in sorted((r for r in self.slots
-                           if r is not None and r.state == DECODE),
-                          key=lambda r: r.admit_seq):
+        cands = [r for r in self.slots
+                 if r is not None and r.state == DECODE]
+        for pos in _RULES.decode_order([r.admit_seq for r in cands]):
+            req = cands[pos]
             if req.state != DECODE:
                 continue              # evicted by an older sibling above
-            if self.ensure_pages(req, req.n_tokens + 1):
+            if self.ensure_pages(req, _RULES.committed_target(
+                    req.state, req.replay_len, req.n_tokens)):
                 out.append(req)
         return [r for r in out if r.state == DECODE]
 

@@ -68,6 +68,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from .autotune import TunedPlan, needs_autotune, score_candidate, tune_topk
 from .calibration import Calibration, CodecRates, apply_live, \
     load_calibration
+# the CUSUM step function itself lives with the other control-plane
+# rules (verify.opstream.SchedEmitter) so graftsched explores the exact
+# arithmetic the detector runs; delegation pinned in tests/test_sched.py
+from ..verify.opstream import SCHED_RULES as _SCHED_RULES
 
 __all__ = [
     "live_calibrate", "measure_ring_gbps", "Attribution", "DriftDetector",
@@ -298,23 +302,13 @@ class DriftDetector:
     def update(self, resid_rel: float) -> Optional[Tuple[str, float]]:
         """One residual observation -> None, or ("slow"|"fast", stat) on
         a sustained-shift trip."""
-        if self.cooldown > 0:
-            self.cooldown -= 1
-            return None
-        r = float(resid_rel)
-        self.pos = max(0.0, self.pos + r - self.drift_rel)
-        self.neg = max(0.0, self.neg + (-r) - self.drift_rel)
-        if self.pos >= self.threshold:
-            stat = self.pos
-            direction = "slow"
-        elif self.neg >= self.threshold:
-            stat = self.neg
-            direction = "fast"
-        else:
-            return None
-        self.trips += 1
-        self.reset(cooldown=True)
-        return direction, stat
+        self.pos, self.neg, self.cooldown, trip = \
+            _SCHED_RULES.cusum_step(
+                self.pos, self.neg, self.cooldown, float(resid_rel),
+                self.drift_rel, self.threshold, self.cooldown_steps)
+        if trip is not None:
+            self.trips += 1
+        return trip
 
 
 @dataclasses.dataclass(frozen=True)

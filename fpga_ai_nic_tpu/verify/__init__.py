@@ -53,8 +53,9 @@ from .opstream import (
     rs_stream_op_stream, ag_schedule, ag_op_stream, hier_program,
     hier_op_stream, reshard_op_stream, reshard_segments,
     handoff_program, handoff_op_stream, check_dma_discipline,
-    check_weight_conservation,
+    check_weight_conservation, SchedEmitter, SCHED_RULES,
 )
+from .sched import SchedModel, build_sched, sched_cells
 from .mc import Violation, CheckResult, check, run_random, run_corpus
 
 __all__ = [
@@ -62,6 +63,7 @@ __all__ = [
     "rs_stream_op_stream", "ag_schedule", "ag_op_stream", "hier_program",
     "hier_op_stream", "reshard_op_stream", "reshard_segments",
     "handoff_program", "handoff_op_stream", "check_dma_discipline",
-    "check_weight_conservation",
+    "check_weight_conservation", "SchedEmitter", "SCHED_RULES",
+    "SchedModel", "build_sched", "sched_cells",
     "Violation", "CheckResult", "check", "run_random", "run_corpus",
 ]

@@ -49,6 +49,7 @@ from typing import (Any, Callable, Dict, Iterable, List, Optional,
 from . import opstream
 from .opstream import (GatherModel, PairModel, ProtocolError, RingModel,
                        reshard_owners)
+from .sched import SchedModel, build_sched, sched_cells
 
 # The exhaustive envelope (per route; ROADMAP acceptance): every cell
 # with n <= N_MAX, S <= S_MAX, depth <= D_MAX is explored EXHAUSTIVELY
@@ -417,6 +418,14 @@ def _static_violations(model: Any) -> List[Tuple[str, str]]:
         if dma or cov:
             out.append(("dma", "; ".join(dma + cov)))
         m2 = opstream.check_weight_conservation(model.ops)
+    elif isinstance(model, SchedModel):
+        # the control-plane family carries no op streams — its static
+        # pre-pass is the validate_shape analogue (a lone request must
+        # fit one pool, or the liveness claim is forfeit unexplored)
+        shape = model.shape_violations()
+        if shape:
+            out.append(("shape", "; ".join(shape)))
+        m2 = []
     else:
         m2 = opstream.check_weight_conservation(model.streams)
     if m2:
@@ -434,7 +443,8 @@ def run_cell(route: str, cell: Tuple[Any, ...],
     builder: Dict[str, Callable[..., Any]] = {
         "flat": build_flat, "streaming": build_streaming,
         "ag": build_ag, "hier": build_hier, "reshard": build_reshard,
-        "handoff": build_handoff, "gather": build_gather}
+        "handoff": build_handoff, "gather": build_gather,
+        "sched": build_sched}
     model = builder[route](*cell)
     static = _static_violations(model)
     if static:
@@ -505,6 +515,7 @@ def run_corpus(emit: Optional[Callable[[str], None]] = None,
                       for integ in (False, True)])
     sweep("handoff", handoff_cells())
     sweep("gather", gather_cells())
+    sweep("sched", sched_cells())
 
     # POR-vs-naive comparison on the reported cells (flat route; the
     # naive full DFS is only tractable on small cells)

@@ -1967,3 +1967,225 @@ class GatherModel:
         # EVERY interleaving (the predicate is interleaving-invariant),
         # so no schedule freedom is needed to witness it.
         return acts[0] if acts else None
+
+
+# ---------------------------------------------------------------------------
+# the serving control-plane emitter (graftsched, verify.sched)
+# ---------------------------------------------------------------------------
+
+# request lifecycle vocabulary — the exact strings
+# `runtime.requests.{WAITING,PREFILL,DECODE,FINISHED}` carry, redeclared
+# here so the emitter (and the sched model built on it) never imports
+# the numpy-bearing runtime package.  tests/test_sched.py pins the
+# equality, the same discipline as OPT_N_STATE above.
+SCHED_WAITING = "waiting"
+SCHED_PREFILL = "prefill"
+SCHED_DECODE = "decode"
+SCHED_FINISHED = "finished"
+
+
+class SchedEmitter:
+    """ONE definition of every discrete policy decision the serving
+    control plane makes — the PR-14 emitter discipline applied to the
+    scheduler/fleet/autoscaler instead of a wire protocol.
+
+    The wire emitters above produce op *streams*; the control plane's
+    analogue is its transition *rules*: watermark admission, LIFO
+    eviction, least-loaded routing, kill-victim choice, the
+    migrate/reroute/replay trichotomy, the CUSUM detector step and the
+    scale/shed gates.  Each rule is a pure function of plain ints and
+    strings, emitted once here and consumed twice —
+
+      - by the real hot paths (`serve.scheduler.ContinuousBatcher`,
+        `serve.fleet.ServeFleet`, `serve.autoscale.Autoscaler`,
+        `tune.adapt.DriftDetector`) as thin delegates, and
+      - by the exhaustive control-plane model (`verify.sched.SchedModel`)
+        the graftmc corpus explores,
+
+    so the checker's verdicts are about the SHIPPED policies, not a
+    transcription of them (tests pin the delegation by identity and by
+    source inspection — there is no second definition to drift).
+
+    Selection rules take parallel value sequences and return an INDEX
+    into the caller's candidate list (or None when empty): the caller
+    keeps its own object types (Request/Replica vs the model's plain
+    lists) while the comparison logic stays single-sourced.
+    """
+
+    # -- batcher: commitment-aware watermark admission ----------------------
+
+    @staticmethod
+    def replay_target(n_tokens: int) -> int:
+        """Positions a (re)admission must prefill before decode resumes:
+        every position the cache must already hold — prompt + generated
+        minus the newest token, whose K/V the resuming decode step
+        writes itself (== ``Request.n_tokens``)."""
+        return n_tokens
+
+    @staticmethod
+    def admission_need(replay_len: int) -> int:
+        """Positions the free-page watermark must cover to admit: the
+        replay plus ONE decode step, so admission can never immediately
+        thrash (the PR-10 admit-thrash bug class)."""
+        return replay_len + 1
+
+    @staticmethod
+    def committed_target(state: str, replay_len: int,
+                         n_tokens: int) -> int:
+        """Positions a LIVE request will claim without a new admission
+        decision: its full replay + first decode while prefilling, its
+        next position while decoding."""
+        return (replay_len + 1 if state == SCHED_PREFILL
+                else n_tokens + 1)
+
+    @staticmethod
+    def committed_outstanding(entries: Sequence[Tuple[int, int]]) -> int:
+        """Pages promised but not yet allocated (allocation is lazy),
+        over (target_pages, held_pages) pairs for every live request."""
+        return sum(max(0, target - held) for target, held in entries)
+
+    @staticmethod
+    def admit_ok(free: int, committed: int, need: int) -> bool:
+        """The watermark: admit only while the UNCOMMITTED free pages
+        cover the candidate's own need."""
+        return free - committed >= need
+
+    @staticmethod
+    def pick_victim(admit_seqs: Sequence[int]) -> Optional[int]:
+        """LIFO eviction: the NEWEST-admitted candidate (index into the
+        caller's page-holding, non-protected live list).  Newest-first
+        is the termination argument: the oldest request monotonically
+        progresses, so any workload whose single worst request fits the
+        pool terminates."""
+        if not admit_seqs:
+            return None
+        return max(range(len(admit_seqs)),
+                   key=lambda i: admit_seqs[i])
+
+    @staticmethod
+    def pick_oldest(admit_seqs: Sequence[int]) -> Optional[int]:
+        """Oldest-admitted candidate — the prefill-chunk scheduling
+        order (a long prompt never starves an older one)."""
+        if not admit_seqs:
+            return None
+        return min(range(len(admit_seqs)),
+                   key=lambda i: admit_seqs[i])
+
+    @staticmethod
+    def decode_order(admit_seqs: Sequence[int]) -> List[int]:
+        """Decode-batch service order: oldest first (eviction cascades
+        triggered by page claims then only ever hit newer requests)."""
+        return sorted(range(len(admit_seqs)),
+                      key=lambda i: admit_seqs[i])
+
+    @staticmethod
+    def prefill_chunk_len(chunk: int, replay_len: int,
+                          start: int) -> int:
+        """True (unpadded) token count of this tick's prefill chunk."""
+        return min(chunk, replay_len - start)
+
+    # -- fleet: routing + membership ----------------------------------------
+
+    @staticmethod
+    def route_least_loaded(loads: Sequence[Tuple[int, int]]
+                           ) -> Optional[int]:
+        """Deterministic least-loaded routing with stable ties: index of
+        the minimum (load, replica_idx) pair — what makes a seeded
+        fleet run replay exactly."""
+        if not loads:
+            return None
+        return min(range(len(loads)), key=lambda i: loads[i])
+
+    @staticmethod
+    def pick_kill_victim(loads: Sequence[Tuple[int, int]]
+                         ) -> Optional[int]:
+        """Chaos kill target: the loaded-MOST candidate (maximum blast
+        radius), stable ties by lowest replica idx."""
+        if not loads:
+            return None
+        return max(range(len(loads)),
+                   key=lambda i: (loads[i][0], -loads[i][1]))
+
+    @staticmethod
+    def migration_action(state: str, has_pages: bool,
+                         migratable: bool) -> str:
+        """The kill path's per-request trichotomy: 'migrate' live KV to
+        a survivor when the pool buffers are still addressable,
+        'reroute' a pageless request (zero work lost — NOT a replay),
+        'replay' otherwise (KV lost, generated tokens kept)."""
+        if (migratable and state in (SCHED_DECODE, SCHED_PREFILL)
+                and has_pages):
+            return "migrate"
+        if not has_pages:
+            return "reroute"
+        return "replay"
+
+    # -- autoscaler: CUSUM detection + action gates -------------------------
+
+    @staticmethod
+    def load_residual(queue_depth: float, target_per_decode: float,
+                      n_decode: int) -> float:
+        """The controller's detector input: relative queue-depth excess
+        over what the decode pool should absorb."""
+        return queue_depth / (target_per_decode * n_decode) - 1.0
+
+    @staticmethod
+    def cusum_step(pos: float, neg: float, cooldown: int, resid: float,
+                   drift: float, threshold: float, cooldown_steps: int
+                   ) -> Tuple[float, float, int,
+                              Optional[Tuple[str, float]]]:
+        """One two-sided CUSUM update with hysteresis — the
+        `tune.adapt.DriftDetector` step as a pure function of
+        (pos, neg, cooldown).  Returns the new statistics plus None or
+        the ("slow"|"fast", stat) trip; a trip resets both sides and
+        arms the cooldown (no opposite-direction trip can land inside
+        the window — the no-flap invariant the sched model checks)."""
+        if cooldown > 0:
+            return pos, neg, cooldown - 1, None
+        r = float(resid)
+        pos = max(0.0, pos + r - drift)
+        neg = max(0.0, neg + (-r) - drift)
+        if pos >= threshold:
+            trip = ("slow", pos)
+        elif neg >= threshold:
+            trip = ("fast", neg)
+        else:
+            return pos, neg, 0, None
+        return 0.0, 0.0, cooldown_steps, trip
+
+    @staticmethod
+    def scale_up_fallback(n_prefill_pure: int,
+                          rebalance_idx: int) -> str:
+        """With no spare device left, a 'slow' trip rebalances a SURPLUS
+        pure-prefill replica to role='both' — never the last one — else
+        the trip is suppressed (counted, actionless)."""
+        return ("rebalance"
+                if n_prefill_pure >= 2 and rebalance_idx >= 0
+                else "suppress")
+
+    @staticmethod
+    def scale_down_ok(n_decode_pure: int, min_decode: int,
+                      queue_depth: float, scale_in_idx: int) -> bool:
+        """A 'fast' trip drains a pure decode replica only above the
+        floor, with an empty queue, and with a valid target."""
+        return (n_decode_pure > min_decode and queue_depth == 0
+                and scale_in_idx >= 0)
+
+    @staticmethod
+    def shed_action(hold: bool, free_frac: float, lo: float,
+                    hi: float) -> Optional[str]:
+        """The admission shed valve's hysteresis band on the free-page
+        fraction: 'shed_on' below lo, 'shed_off' above hi, None inside
+        the band (the lo < hi gap is what keeps the valve from
+        chattering at the boundary)."""
+        if not hold and free_frac < lo:
+            return "shed_on"
+        if hold and free_frac > hi:
+            return "shed_off"
+        return None
+
+
+# the singleton every consumer binds — tests assert delegation by
+# IDENTITY against this exact object (`serve.scheduler._RULES is
+# SCHED_RULES`), the PR-14 TestDelegationIdentity discipline
+SCHED_RULES = SchedEmitter()

@@ -1,0 +1,19 @@
+"""graftmc bad fixture: the serving control-plane model with a LEAKY
+eviction — every evicted request returns one page short of what it
+held, so the per-replica ledger (free + promised + resident == pool)
+breaks the first time the pool runs dry and the LIFO eviction fires.
+`make modelcheck` with GRAFTMC_FIXTURE pointing here MUST fail with a
+page-conservation counterexample (tests/test_verify.py rides the
+subprocess exit-code pattern).  The cell (R=2, P=4, K=1) is the
+smallest whose clean run provably reaches an eviction (max_new=3:
+two admitted requests outgrow the 4-page pool mid-decode)."""
+
+from fpga_ai_nic_tpu.verify import sched
+
+
+def build():
+    model = sched.build_sched(2, 4, 1, "none", mutate="leak_evict")
+    # the fixture route prefix is what the exit-code battery's
+    # counterexample cleanup keys on
+    model.meta["route"] = "fixture"
+    return model

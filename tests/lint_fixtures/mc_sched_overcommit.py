@@ -1,0 +1,20 @@
+"""graftmc bad fixture: the serving control-plane model with the
+commitment-aware admission watermark DROPPED — the batcher admits on
+free slots alone, promising more pages than the pool holds.  The
+model's independent admission-event invariant (sum of committed
+targets <= pool) trips immediately: the PR-10 admit-thrash class.
+`make modelcheck` with GRAFTMC_FIXTURE pointing here MUST fail with an
+over-commit counterexample (tests/test_verify.py rides the subprocess
+exit-code pattern).  Cell (R=2, P=2, K=1): two one-token requests
+whose admission targets (2 pages each) cannot both fit the 2-page
+pool."""
+
+from fpga_ai_nic_tpu.verify import sched
+
+
+def build():
+    model = sched.build_sched(2, 2, 1, "none", mutate="drop_watermark")
+    # the fixture route prefix is what the exit-code battery's
+    # counterexample cleanup keys on
+    model.meta["route"] = "fixture"
+    return model

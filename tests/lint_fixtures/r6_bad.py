@@ -1,0 +1,17 @@
+"""BAD fixture — R6 site-tuple derivation.
+
+A chaos module exporting hand-written ``*_SITES`` tuples: the exact
+transcription class PR 12 caught by review ("serve.handoff" added as a
+fire point but missing from WIRE_SITES, so no sweep ever exercised it).
+Both public literal tuples below must fire R6.
+"""
+
+# a fire point added to the code but not to this literal silently
+# drops out of every chaos sweep — that is the bug class
+SERVE_SITES = ("serve.step", "serve.handoff", "fleet.membership")
+
+CKPT_SITES = ("ckpt.save", "ckpt.restore")
+
+
+def plan_sites():
+    return SERVE_SITES + CKPT_SITES

@@ -1,0 +1,27 @@
+"""GOOD fixture — R6 site-tuple derivation.
+
+The committed convention: the fire-point maps are the single source of
+truth (private plumbing, legal as literals) and every exported
+``*_SITES`` tuple is DERIVED from them, so a new fire point can never
+silently drop out of the chaos sweep.  Computed composition (tuple
+concatenation) is equally legal — it cannot drift on its own.
+"""
+
+# chaos FIRE point (the code boundary calling FaultPlan.fire) -> SITE
+_SERVE_POINT_SITES = {
+    "serve.engine.ServeEngine.tick": "serve.step",
+    "serve.fleet.ServeFleet._handoff": "serve.handoff",
+    "serve.fleet.ServeFleet.tick": "fleet.membership",
+}
+_CKPT_POINT_SITES = {
+    "utils.checkpoint.Checkpointer.save": "ckpt.save",
+    "utils.checkpoint.Checkpointer.restore": "ckpt.restore",
+}
+
+SERVE_SITES = tuple(dict.fromkeys(_SERVE_POINT_SITES.values()))
+CKPT_SITES = tuple(dict.fromkeys(_CKPT_POINT_SITES.values()))
+SITES = SERVE_SITES + CKPT_SITES
+
+
+def plan_sites():
+    return SITES

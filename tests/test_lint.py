@@ -35,9 +35,10 @@ DEST = {
     "r3": "fpga_ai_nic_tpu/ops",
     "r4": "fpga_ai_nic_tpu/parallel",
     "r5": "tools",
+    "r6": "fpga_ai_nic_tpu/runtime",
 }
 EXPECT_CODE = {"r0": "R0", "r1": "R1", "r2": "R2", "r3": "R3",
-               "r4": "R4", "r5": "R5"}
+               "r4": "R4", "r5": "R5", "r6": "R6"}
 
 
 def _fixture(rule, kind):

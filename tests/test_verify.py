@@ -614,7 +614,7 @@ class TestExhaustive:
         assert stats.cells >= 900
         assert {r.route for r in stats.routes} == {
             "flat", "streaming", "ag", "hier", "reshard", "handoff",
-            "gather"}
+            "gather", "sched"}
         for cmp in stats.compare:
             assert cmp["agree"] and cmp["reduction"] >= 5.0
         rec = mc.envelope_record(stats)
@@ -848,7 +848,7 @@ class TestMakeModelcheckExitCodes:
         assert "cells exhaustive" in proc.stdout
         assert "POR reduction" in proc.stdout
         for route in ("flat", "streaming", "ag", "hier", "reshard",
-                      "handoff", "gather"):
+                      "handoff", "gather", "sched"):
             assert f"route {route}:" in proc.stdout
 
     def _fixture_fails(self, name, needle, env_extra=None):
@@ -896,6 +896,16 @@ class TestMakeModelcheckExitCodes:
                                    "weight collision")
         assert "M2:" in proc.stdout
 
+    def test_sched_leaked_eviction_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_sched_leak.py",
+                                   "page ledger broken")
+        assert "M1:" in proc.stdout
+
+    def test_sched_overcommit_fixture_fails_loudly(self):
+        proc = self._fixture_fails("mc_sched_overcommit.py",
+                                   "over-commit")
+        assert "M1:" in proc.stdout
+
     def test_envelope_artifact_schema(self):
         """The committed envelope record (MC_ENVELOPE_r*.json) carries
         the per-route rows obs-gate's mc.* keys extract."""
@@ -907,7 +917,7 @@ class TestMakeModelcheckExitCodes:
             d = json.load(fh)
         routes = {r["route"] for r in d["routes"]}
         assert routes == {"flat", "streaming", "ag", "hier", "reshard",
-                          "handoff", "gather"}
+                          "handoff", "gather", "sched"}
         for r in d["routes"]:
             assert r["cells"] > 0 and r["states"] > 0
         assert d["failures"] == 0 and d["ok"]
